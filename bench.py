@@ -1,0 +1,105 @@
+"""Benchmark entry point (driver-run on real TPU hardware).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Workload: GPT-2 350M causal-LM training step, bf16 compute + fp32 master, on the
+available chip(s).  Reports model FLOPs utilisation (MFU) against the chip's
+bf16 peak; ``vs_baseline`` is MFU relative to the BASELINE.md acceptance target
+of 35% MFU.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_model
+
+MODEL_SIZE = os.environ.get("BENCH_MODEL", "350m")
+SEQ = int(os.environ.get("BENCH_SEQ", 1024))
+MICRO = int(os.environ.get("BENCH_MICRO", 4))
+STEPS = int(os.environ.get("BENCH_STEPS", 10))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+ZERO_STAGE = int(os.environ.get("BENCH_ZERO", 0))
+
+# bf16 peak TFLOPS per chip by TPU generation (public specs)
+PEAK_TFLOPS = {
+    "v4": 275.0, "v5e": 197.0, "v5 lite": 197.0, "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def chip_peak_tflops() -> float:
+    name = str(jax.devices()[0]).lower()
+    for key, peak in PEAK_TFLOPS.items():
+        if key in name:
+            return peak
+    return 197.0
+
+
+def main():
+    n_chips = jax.device_count()
+    model = gpt2_model(MODEL_SIZE, max_seq_len=SEQ, dtype="bfloat16",
+                       remat=bool(int(os.environ.get("BENCH_REMAT", "1"))))
+    n_params = model.meta["n_params"]
+    cfg = model.config
+    # MFU accounting: 6N matmul flops/token + causal attention
+    # (12*L*S*D fwd+bwd, halved for causal masking)
+    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_layers * SEQ * cfg.d_model
+
+    config = {
+        "train_micro_batch_size_per_gpu": MICRO,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": ZERO_STAGE},
+        "steps_per_print": 0,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    global_batch = MICRO * engine.topology.dp_world_size
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, size=(1, global_batch, SEQ), dtype=np.int32)}
+
+    for _ in range(WARMUP):
+        loss = engine.train_batch(batch=batch())
+    float(loss)   # true device sync (block_until_ready is not enough on the
+                  # axon remote-TPU platform; a host transfer is)
+
+    t0 = time.time()
+    for _ in range(STEPS):
+        loss = engine.train_batch(batch=batch())
+    float(loss)   # chained data dependence -> all steps complete
+    dt = (time.time() - t0) / STEPS
+
+    tokens_per_sec = global_batch * SEQ / dt
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+    mfu = tokens_per_sec_chip * flops_per_token / (chip_peak_tflops() * 1e12)
+
+    print(json.dumps({
+        "metric": f"gpt2_{MODEL_SIZE}_bf16_zero{ZERO_STAGE}_mfu",
+        "value": round(mfu, 4),
+        "unit": "MFU_fraction",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 1),
+            "step_time_s": round(dt, 4),
+            "seq_len": SEQ,
+            "micro_batch": MICRO,
+            "n_chips": n_chips,
+            "n_params": n_params,
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Named device-mesh topology — the TPU-native equivalent of DeepSpeed's process
+groups (reference: deepspeed/utils/groups.py and deepspeed/runtime/pipe/topology.py:12
+``ProcessTopology``).
+
+Where the reference builds NCCL process groups by slicing rank lists, here a single
+``jax.sharding.Mesh`` carries every parallel dimension as a named axis, and a
+"process group" is a tuple of axis names.  Collectives ride ICI when the axes are
+innermost (model/seq) and DCN when outermost (pipe).
+
+Axis layout (outermost → innermost):
+
+    ("pipe", "expert", "data", "seq", "model")
+
+- ``model``  — tensor parallelism, innermost → fastest ICI all-reduce.
+- ``seq``    — Ulysses/ring sequence parallelism (all-to-all heavy).
+- ``data``   — expert-data-parallel axis; together with ``expert`` it forms the full
+  data-parallel dimension.  Expert parallelism is carved out of data parallelism,
+  matching the reference group algebra (groups.py:161
+  ``_get_expert_parallel_ranks``).
+- ``expert`` — expert parallelism for MoE layers.
+- ``pipe``   — pipeline stages, outermost → p2p over DCN/outer-ICI.
+
+ZeRO shards dense parameters over ``("expert", "data", "seq")`` — the sequence×data
+combined group the reference uses when Ulysses is active (engine.py:1460,
+groups.py:459 ``_get_sequence_data_parallel_group``) — and expert parameters over
+``("data", "seq")`` (the expert-data-parallel group).
+"""
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+MESH_AXIS_ORDER = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclass
+class MeshTopology:
+    """Factory + registry for the framework's device mesh.
+
+    The full data-parallel world (what the reference calls the DP group) has size
+    ``expert_parallel_size * (data axis size)``; ZeRO additionally folds in the
+    ``seq`` axis.
+    """
+    data_parallel_size: Optional[int] = None      # TOTAL dp (including expert axis)
+    model_parallel_size: int = 1
+    pipe_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    devices: Optional[Sequence] = None
+    mesh: Mesh = field(init=False, default=None)
+
+    def __post_init__(self):
+        devices = list(self.devices) if self.devices is not None else jax.devices()
+        n = len(devices)
+        tp, pp, sp, ep = (self.model_parallel_size, self.pipe_parallel_size,
+                          self.sequence_parallel_size, self.expert_parallel_size)
+        if self.data_parallel_size is None:
+            denom = tp * pp * sp
+            if n % denom != 0:
+                raise ValueError(
+                    f"device count {n} not divisible by model×pipe×seq = {denom}")
+            self.data_parallel_size = n // denom
+        dp = self.data_parallel_size
+        if dp % ep != 0:
+            raise ValueError(
+                f"expert_parallel_size {ep} must divide data_parallel_size {dp}")
+        if pp * ep * (dp // ep) * sp * tp != n:
+            raise ValueError(
+                f"mesh {pp}×{ep}×{dp // ep}×{sp}×{tp} != {n} devices")
+        shape = (pp, ep, dp // ep, sp, tp)
+        device_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(device_array, MESH_AXIS_ORDER)
+
+    # ------------------------------------------------------------------ groups
+    # Each returns a tuple of mesh axis names — the "process group" handle used
+    # throughout the framework (PartitionSpec entries, lax collective axis_name).
+    @property
+    def data_parallel_axes(self) -> Tuple[str, ...]:
+        """Full DP group (reference groups._get_data_parallel_group)."""
+        return (EXPERT_AXIS, DATA_AXIS)
+
+    @property
+    def zero_shard_axes(self) -> Tuple[str, ...]:
+        """Axes ZeRO shards dense state over (seq-data combined group,
+        reference groups.py:459)."""
+        return (EXPERT_AXIS, DATA_AXIS, SEQ_AXIS)
+
+    @property
+    def expert_parallel_axes(self) -> Tuple[str, ...]:
+        return (EXPERT_AXIS,)
+
+    @property
+    def expert_data_parallel_axes(self) -> Tuple[str, ...]:
+        """DP group for one expert's replicas (reference
+        groups._get_expert_data_parallel_group)."""
+        return (DATA_AXIS,)
+
+    @property
+    def model_parallel_axes(self) -> Tuple[str, ...]:
+        return (MODEL_AXIS,)
+
+    @property
+    def sequence_parallel_axes(self) -> Tuple[str, ...]:
+        return (SEQ_AXIS,)
+
+    @property
+    def pipe_parallel_axes(self) -> Tuple[str, ...]:
+        return (PIPE_AXIS,)
+
+    # ------------------------------------------------------------------ sizes
+    def axis_size(self, axes) -> int:
+        if isinstance(axes, str):
+            axes = (axes,)
+        size = 1
+        for a in axes:
+            size *= self.mesh.shape[a]
+        return size
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    @property
+    def dp_world_size(self) -> int:
+        return self.axis_size(self.data_parallel_axes)
+
+    @property
+    def zero_world_size(self) -> int:
+        return self.axis_size(self.zero_shard_axes)
+
+    # ------------------------------------------------------------------ helpers
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, extra_batch_axes: Tuple[str, ...] = ()) -> NamedSharding:
+        """Sharding for a [batch, seq, ...] input batch: batch over the DP group,
+        sequence over the seq axis."""
+        batch_axes = tuple(self.data_parallel_axes) + tuple(extra_batch_axes)
+        return NamedSharding(self.mesh, P(batch_axes, SEQ_AXIS))
+
+
+_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def set_topology(topo: MeshTopology):
+    global _TOPOLOGY
+    _TOPOLOGY = topo
+
+
+def get_topology() -> MeshTopology:
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = MeshTopology()
+    return _TOPOLOGY
+
+
+def reset_topology():
+    global _TOPOLOGY
+    _TOPOLOGY = None

@@ -1,0 +1,2 @@
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.gpt2 import gpt2_model, GPT2Config

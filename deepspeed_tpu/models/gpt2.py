@@ -1,0 +1,188 @@
+"""GPT-2 family, TPU-native: pure-functional params pytree, ``lax.scan`` over a
+stacked layer dimension (one compiled layer body, MXU-friendly static shapes),
+bf16-ready, with tensor-parallel logical specs on the Megatron pattern
+(column-parallel QKV/MLP-in, row-parallel proj/MLP-out).
+
+This is the framework's flagship dense LM for the BASELINE.md configs
+(GPT-2 125M / 1.3B).  Capability parity target: the models DeepSpeed's examples
+train via Megatron-DeepSpeed; architecture follows the public GPT-2 paper, not
+the reference's code.
+"""
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"          # compute dtype; master params are fp32
+    remat: bool = False             # activation checkpointing per layer
+    attention_impl: str = "auto"    # auto | xla | flash (pallas)
+
+    @property
+    def d_mlp(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+# presets matching the BASELINE.md configs
+GPT2_SIZES = {
+    "125m": dict(num_layers=12, num_heads=12, d_model=768),
+    "350m": dict(num_layers=24, num_heads=16, d_model=1024),
+    "760m": dict(num_layers=24, num_heads=16, d_model=1536),
+    "1.3b": dict(num_layers=24, num_heads=32, d_model=2048),
+    "2.7b": dict(num_layers=32, num_heads=32, d_model=2560),
+    "6.7b": dict(num_layers=32, num_heads=32, d_model=4096),
+    "13b": dict(num_layers=40, num_heads=40, d_model=5120),
+}
+
+
+def init_params(config: GPT2Config, rng) -> dict:
+    D, V, S, L, M = (config.d_model, config.vocab_size, config.max_seq_len,
+                     config.num_layers, config.d_mlp)
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    # residual-projection init scaled by depth (GPT-2 paper convention)
+    res_std = std / (2 * L) ** 0.5
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+
+    def stack_init(key, shape, scale):
+        return norm(key, (L,) + shape) * scale
+
+    params = {
+        "wte": norm(next(k), (V, D)) * std,
+        "wpe": norm(next(k), (S, D)) * std,
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D)),
+            "ln1_bias": jnp.zeros((L, D)),
+            "qkv_w": stack_init(next(k), (D, 3 * D), std),
+            "qkv_b": jnp.zeros((L, 3 * D)),
+            "proj_w": stack_init(next(k), (D, D), res_std),
+            "proj_b": jnp.zeros((L, D)),
+            "ln2_scale": jnp.ones((L, D)),
+            "ln2_bias": jnp.zeros((L, D)),
+            "mlp_in_w": stack_init(next(k), (D, M), std),
+            "mlp_in_b": jnp.zeros((L, M)),
+            "mlp_out_w": stack_init(next(k), (M, D), res_std),
+            "mlp_out_b": jnp.zeros((L, D)),
+        },
+        "lnf_scale": jnp.ones((D,)),
+        "lnf_bias": jnp.zeros((D,)),
+    }
+    return params
+
+
+def logical_specs(config: GPT2Config) -> dict:
+    """Tensor-parallel layout over the ``model`` mesh axis (Megatron pattern:
+    reference capability = client-mpu TP, engine.py:1095 + AutoTP
+    module_inject/auto_tp.py:165)."""
+    return {
+        "wte": P("model", None),          # vocab-parallel embedding
+        "wpe": P(),
+        "blocks": {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "qkv_w": P(None, None, "model"),   # column parallel
+            "qkv_b": P(None, "model"),
+            "proj_w": P(None, "model", None),  # row parallel
+            "proj_b": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "mlp_in_w": P(None, None, "model"),
+            "mlp_in_b": P(None, "model"),
+            "mlp_out_w": P(None, "model", None),
+            "mlp_out_b": P(),
+        },
+        "lnf_scale": P(), "lnf_bias": P(),
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, layer, config: GPT2Config, rng=None):
+    """One transformer block; shapes [B, S, D]."""
+    B, S, D = x.shape
+    H, hd = config.num_heads, config.head_dim
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
+    qkv = h @ layer["qkv_w"].astype(h.dtype) + layer["qkv_b"].astype(h.dtype)
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd)
+    kk = kk.reshape(B, S, H, hd)
+    v = v.reshape(B, S, H, hd)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = attn.reshape(B, S, D)
+    x = x + attn @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
+    h = h @ layer["mlp_in_w"].astype(h.dtype) + layer["mlp_in_b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    x = x + h @ layer["mlp_out_w"].astype(x.dtype) + layer["mlp_out_b"].astype(x.dtype)
+    return x
+
+
+def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
+    """Token ids [B, S] -> logits [B, S, V].  Layers run under ``lax.scan`` so
+    XLA compiles one block and (under ZeRO-3 shardings) gathers each layer's
+    params just-in-time, overlapping the all-gather with the previous layer's
+    compute — the reference's prefetch coordinator
+    (partitioned_param_coordinator.py:256) collapses into XLA scheduling."""
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
+
+    block_fn = partial(_block, config=config, rng=rng)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def body(carry, layer):
+        return block_fn(carry, layer), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                    config.layer_norm_eps)
+    logits = x @ params["wte"].astype(dtype).T   # tied embedding
+    return logits
+
+
+def count_params(config: GPT2Config) -> int:
+    D, V, S, L, M = (config.d_model, config.vocab_size, config.max_seq_len,
+                     config.num_layers, config.d_mlp)
+    per_layer = 4 * D + 3 * D * D + 3 * D + D * D + D + 2 * D * M + M + D
+    return V * D + S * D + L * per_layer + 2 * D
+
+
+def gpt2_model(size: str = "125m", **overrides) -> Model:
+    cfg_kwargs = dict(GPT2_SIZES[size]) if size in GPT2_SIZES else {}
+    cfg_kwargs.update(overrides)
+    config = GPT2Config(**cfg_kwargs)
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        logical_specs=logical_specs(config),
+        flops_per_token=6.0 * n_params,
+        meta={"name": f"gpt2-{size}", "n_params": n_params},
+    )

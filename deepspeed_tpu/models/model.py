@@ -1,0 +1,61 @@
+"""Model protocol for the engine.
+
+The reference wraps an ``nn.Module`` (engine.py:1058); the TPU-native engine
+instead consumes a pure (init, apply, loss) triple plus per-parameter logical
+PartitionSpecs carrying the tensor-parallel layout.  Anything — flax, haiku, or
+hand-rolled pytrees — can be adapted to this.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class Model:
+    config: Any = None
+    #: rng -> params pytree (fp32)
+    init_fn: Callable = None
+    #: (params, batch, rng) -> logits
+    apply_fn: Callable = None
+    #: (params, batch, rng) -> scalar loss; defaults to causal-LM cross-entropy
+    #: over ``apply_fn`` logits and ``batch["input_ids"]`` shifted by one.
+    loss_fn: Optional[Callable] = None
+    #: pytree of jax.sharding.PartitionSpec (or None) matching params — the
+    #: tensor-parallel ("model" axis) layout. ZeRO axes are layered on top.
+    logical_specs: Any = None
+    #: approximate FLOPs per token for MFU accounting (6*N for dense LMs)
+    flops_per_token: Optional[float] = None
+    #: extra metadata (e.g. number of params)
+    meta: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.loss_fn is None and self.apply_fn is not None:
+            self.loss_fn = _default_lm_loss(self.apply_fn)
+
+    def init(self, rng):
+        return self.init_fn(rng)
+
+    def apply(self, params, batch, rng=None):
+        return self.apply_fn(params, batch, rng)
+
+    def loss(self, params, batch, rng=None):
+        return self.loss_fn(params, batch, rng)
+
+
+def _default_lm_loss(apply_fn):
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch, rng=None):
+        tokens = batch["input_ids"]
+        logits = apply_fn(params, batch, rng)
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        mask = batch.get("attention_mask")
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets)
+        if mask is not None:
+            m = mask[:, 1:].astype(jnp.float32)
+            return (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return losses.mean()
+
+    return loss_fn

@@ -1,0 +1,107 @@
+"""Metric sinks (reference: deepspeed/monitor/monitor.py:29 ``MonitorMaster``
+dispatching to TensorBoard/WandB/CSV writers)."""
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+Event = Tuple[str, float, int]     # (name, value, step)
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    """reference: monitor/csv_monitor.py:12"""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._files = {}
+        if self.enabled:
+            self.out_dir = os.path.join(config.output_path or "csv_monitor",
+                                        config.job_name)
+            os.makedirs(self.out_dir, exist_ok=True)
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.out_dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(Monitor):
+    """reference: monitor/tensorboard.py:13 (uses tensorboardX/torch.utils if
+    available, else disables itself)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                path = os.path.join(config.output_path or "tensorboard",
+                                    config.job_name)
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception:
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled or self.writer is None:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """reference: monitor/wandb.py:12"""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.wandb = None
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group)
+                self.wandb = wandb
+            except Exception:
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled or self.wandb is None:
+            return
+        for name, value, step in events:
+            self.wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Dispatches to all enabled sinks; only process 0 writes (reference
+    monitor.py:29 checks rank 0)."""
+
+    def __init__(self, monitor_config):
+        self.config = monitor_config
+        self.sinks: List[Monitor] = []
+        if jax.process_index() == 0:
+            self.sinks = [s for s in (
+                TensorBoardMonitor(monitor_config.tensorboard),
+                WandbMonitor(monitor_config.wandb),
+                CSVMonitor(monitor_config.csv_monitor),
+            ) if s.enabled]
+        self.enabled = bool(self.sinks)
+
+    def write_events(self, events: List[Event]):
+        for s in self.sinks:
+            s.write_events(events)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.attention import causal_attention

@@ -1,0 +1,49 @@
+"""Attention dispatch: XLA einsum attention (always available) and the Pallas
+flash-attention kernel on real TPU (reference capability: the fused attention in
+csrc/transformer/*.cu and csrc/transformer/inference/csrc/softmax.cu, rebuilt as
+TPU kernels rather than translated).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _on_tpu() -> bool:
+    try:
+        d = jax.devices()[0]
+        # experimental TPU platforms (e.g. axon tunnels) report their own
+        # platform string but a TPU device kind
+        return d.platform == "tpu" or "tpu" in str(d).lower()
+    except Exception:
+        return False
+
+
+def xla_causal_attention(q, k, v):
+    """Reference einsum attention with causal mask; [B, S, H, hd] layout.
+    fp32 softmax accumulation for bf16 inputs."""
+    B, S, H, hd = q.shape
+    scale = hd ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_causal_attention(q, k, v):
+    """Pallas TPU flash attention (blockwise, never materialises the [S,S]
+    scores in HBM)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=True)
+
+
+def causal_attention(q, k, v, impl: str = "auto"):
+    """q/k/v: [B, S, H, hd] -> [B, S, H, hd]."""
+    if impl == "flash" or (impl == "auto" and _on_tpu() and q.shape[1] >= 256):
+        try:
+            return flash_causal_attention(q, k, v)
+        except Exception:
+            pass
+    return xla_causal_attention(q, k, v)

@@ -1,0 +1,20 @@
+"""Blockwise (flash) causal attention for TPU.
+
+Current implementation delegates to JAX's public Pallas TPU flash-attention op
+(``jax.experimental.pallas.ops.tpu.flash_attention``) with our [B, S, H, hd]
+layout; a from-scratch kernel specialised to this framework (segment ids, ring
+attention hooks, decode path) lives on the roadmap in ops/pallas/.
+"""
+import jax.numpy as jnp
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float = None):
+    """q/k/v: [B, S, H, hd] -> [B, S, H, hd]."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _pallas_flash)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    # pallas op expects [B, H, S, hd]
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _pallas_flash(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.checkpoint_engine.engine import save_state, load_state

@@ -1,0 +1,363 @@
+"""DeepSpeed-style JSON config system (reference: deepspeed/runtime/config.py:666
+``DeepSpeedConfig`` aggregating ~30 subsystem configs at :773-876, plus the
+batch-size triangulation at :911-933).
+
+The same JSON keys are accepted; TPU-specific additions live under the ``"mesh"``
+section (parallel dimension sizes), since the reference delegates TP/PP topology to
+the client mpu / PipelineModule rather than the JSON.
+"""
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+# --------------------------------------------------------------------------- fp16/bf16
+class FP16Config(DeepSpeedConfigModel):
+    """reference: runtime/fp16 config keys (config.py fp16 section)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0           # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    # accumulate gradients in fp32 master buffers (reference bf16_optimizer)
+    immediate_grad_update: bool = False
+
+
+# --------------------------------------------------------------------------- zero
+class OffloadParamConfig(DeepSpeedConfigModel):
+    """reference: runtime/zero/offload_config.py DeepSpeedZeroOffloadParamConfig."""
+    device: str = "none"              # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: str = "none"              # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """reference: runtime/zero/config.py:81 DeepSpeedZeroConfig."""
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    cpu_offload: Optional[bool] = None   # deprecated bool; migrated below
+    prefetch_bucket_size: int = 50_000_000
+    param_persistence_threshold: int = 100_000
+    model_persistence_threshold: int = 2 ** 62
+    max_live_parameters: int = 1_000_000_000
+    max_reuse_distance: int = 1_000_000_000
+    gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    # ZeRO++ (reference engine.py:825-834)
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+
+    def __init__(self, **data):
+        # reference deprecation: cpu_offload=True ≙ offload_optimizer.device=cpu
+        if data.get("cpu_offload") and "offload_optimizer" not in data:
+            logger.warning("zero_optimization.cpu_offload is deprecated; use "
+                           "offload_optimizer: {device: cpu}")
+            data["offload_optimizer"] = {"device": "cpu"}
+        super().__init__(**data)
+
+
+# --------------------------------------------------------------------------- mesh (TPU)
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-native addition: named-axis parallel dims for the device mesh."""
+    model_parallel_size: int = 1
+    pipe_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    data_parallel_size: Optional[int] = None   # inferred from device count
+
+
+# --------------------------------------------------------------------------- aux
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """reference: runtime/activation_checkpointing/checkpointing.py:789 configure."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native knob: jax.checkpoint policy name
+    policy: str = "nothing_saveable"
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = Field(default_factory=list)
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+
+class AioConfig(DeepSpeedConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class CurriculumParams(DeepSpeedConfigModel):
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CurriculumLearningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+class PLDConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    theta: float = 1.0
+    gamma: float = 0.001
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    num_gpus_per_node: int = 1
+    model_parallel_size: int = 1
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    # TPU-native: async orbax-style checkpointing
+    async_save: bool = False
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+# --------------------------------------------------------------------------- root
+class DeepSpeedConfig:
+    """Parses the JSON dict / file and exposes typed sub-configs + batch math."""
+
+    def __init__(self, config: Union[str, Dict], mesh_topology=None, mpu=None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise FileNotFoundError(f"DeepSpeed config path not found: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ValueError(
+                f"config must be a dict or a path to a JSON file, got {type(config)}")
+
+        d = self._param_dict
+        self.train_batch_size = d.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+
+        self.optimizer_name = None
+        self.optimizer_params = None
+        opt = d.get(C.OPTIMIZER)
+        if opt:
+            self.optimizer_name = opt.get("type", "").lower()
+            self.optimizer_params = opt.get("params", {})
+        self.optimizer_legacy_fusion = bool(opt.get("legacy_fusion", False)) if opt else False
+
+        sched = d.get(C.SCHEDULER)
+        self.scheduler_name = sched.get("type") if sched else None
+        self.scheduler_params = sched.get("params", {}) if sched else {}
+
+        self.fp16 = FP16Config(**d.get(C.FP16, {}))
+        self.bf16 = BF16Config(**d.get(C.BF16, d.get("bfloat16", {})))
+        self.zero_config = ZeroConfig(**d.get(C.ZERO_OPTIMIZATION, {}))
+        self.mesh_config = MeshConfig(**d.get("mesh", {}))
+        self.gradient_clipping = float(d.get(C.GRADIENT_CLIPPING, 0.0))
+        self.prescale_gradients = bool(d.get(C.PRESCALE_GRADIENTS, False))
+        self.gradient_predivide_factor = float(d.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0))
+        self.steps_per_print = int(d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
+        self.wall_clock_breakdown = bool(d.get(C.WALL_CLOCK_BREAKDOWN, False))
+        self.dump_state = bool(d.get(C.DUMP_STATE, False))
+        self.disable_allgather = bool(d.get("disable_allgather", False))
+        self.seed = int(d.get("seed", 42))
+
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **d.get("activation_checkpointing", {}))
+        self.flops_profiler_config = FlopsProfilerConfig(**d.get("flops_profiler", {}))
+        self.comms_config = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=TensorBoardConfig(**d.get("tensorboard", {})),
+            wandb=WandbConfig(**d.get("wandb", {})),
+            csv_monitor=CSVConfig(**d.get("csv_monitor", {})))
+        self.aio_config = AioConfig(**d.get("aio", {}))
+        self.curriculum_learning = CurriculumLearningConfig(
+            **d.get("curriculum_learning", {}))
+        self.curriculum_enabled_legacy = self.curriculum_learning.enabled
+        self.curriculum_params_legacy = d.get("curriculum_learning", {})
+        self.data_efficiency_config = d.get("data_efficiency", {})
+        self.eigenvalue_config = EigenvalueConfig(**d.get("eigenvalue", {}))
+        self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
+        self.elasticity_config = ElasticityConfig(**d.get("elasticity", {}))
+        self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
+        self.data_types_config = DataTypesConfig(**d.get("data_types", {}))
+        self.compression_config = d.get("compression_training", {})
+        self.autotuning_config = d.get("autotuning", {})
+        self.sparse_gradients_enabled = bool(d.get("sparse_gradients", False))
+        self.communication_data_type = d.get("communication_data_type", None)
+        self.memory_breakdown = bool(d.get("memory_breakdown", False))
+
+        self.zero_enabled = self.zero_config.stage > 0
+        self.zero_optimization_stage = self.zero_config.stage
+
+        dp_world = mesh_topology.dp_world_size if mesh_topology is not None else None
+        self._resolve_batch_sizes(dp_world)
+        self._sanity_check()
+
+    # ------------------------------------------------------------------ batch math
+    def _resolve_batch_sizes(self, dp_world: Optional[int]):
+        """Batch-size triangulation: train = micro × gas × dp
+        (reference config.py:911-933)."""
+        dp = dp_world or 1
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            micro = train // dp
+        elif micro is not None:
+            gas = 1
+            train = micro * dp
+        else:
+            raise ValueError(
+                "One of train_batch_size or train_micro_batch_size_per_gpu "
+                "must be set in the DeepSpeed config")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+        self._dp_world_for_check = dp
+
+    def _sanity_check(self):
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        dp = self._dp_world_for_check
+        if micro is None or micro <= 0 or gas is None or gas <= 0:
+            raise ValueError(
+                f"Invalid batch config: micro={micro} gas={gas} "
+                f"(train={train}, dp={dp})")
+        if train != micro * gas * dp:
+            raise ValueError(
+                f"Check batch-size settings: train_batch_size {train} != "
+                f"micro_batch {micro} × gradient_accumulation_steps {gas} × "
+                f"data-parallel world {dp}")
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_config.stage > 3:
+            raise ValueError(f"ZeRO stage {self.zero_config.stage} > 3 is invalid")
+
+    def print_config(self):
+        logger.info(f"DeepSpeedConfig: {json.dumps(self._param_dict, indent=2, default=str)}")

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    LossScaleState, create_loss_scaler, has_overflow, update_scale)

@@ -1,0 +1,89 @@
+"""Static/dynamic loss scaling (reference: deepspeed/runtime/fp16/loss_scaler.py).
+
+Pure-functional: the mutable scaler state (:class:`LossScaleState`) is an
+arrays-only pytree threaded through the jitted train step; the static knobs live
+in :class:`LossScalerConfig` and are closed over at trace time.  Overflow skip is
+a select on the update, matching the reference's skip-step-and-shrink-scale
+semantics.
+"""
+from dataclasses import dataclass
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray           # f32 scalar
+    cur_iter: jnp.ndarray            # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    cur_hysteresis: jnp.ndarray      # i32 scalar
+
+
+@dataclass(frozen=True)
+class LossScalerConfig:
+    dynamic: bool = True
+    scale_window: int = 1000
+    scale_factor: float = 2.0
+    min_scale: float = 1.0
+    delayed_shift: int = 2           # hysteresis
+
+
+def create_loss_scaler(enabled: bool,
+                       loss_scale: float = 0.0,
+                       initial_scale_power: int = 16,
+                       loss_scale_window: int = 1000,
+                       hysteresis: int = 2,
+                       min_loss_scale: float = 1.0
+                       ) -> Tuple[LossScaleState, LossScalerConfig]:
+    if not enabled:
+        state = LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(-1),
+                               jnp.int32(1))
+        return state, LossScalerConfig(dynamic=False)
+    dynamic = loss_scale == 0.0
+    init = float(2.0 ** initial_scale_power) if dynamic else float(loss_scale)
+    state = LossScaleState(jnp.float32(init), jnp.int32(0), jnp.int32(-1),
+                           jnp.int32(hysteresis))
+    cfg = LossScalerConfig(dynamic=dynamic, scale_window=int(loss_scale_window),
+                           min_scale=float(min_loss_scale),
+                           delayed_shift=int(hysteresis))
+    return state, cfg
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """Global NaN/Inf scan over a gradient pytree (reference
+    ``has_overflow_serial`` / ``_has_inf_or_nan``, stage3.py:2039)."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l.astype(jnp.float32))))
+             for l in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray,
+                 cfg: LossScalerConfig) -> LossScaleState:
+    """Dynamic loss-scale update (reference LossScaler.update_scale)."""
+    if not cfg.dynamic:
+        return state._replace(cur_iter=state.cur_iter + 1)
+    hysteresis_exhausted = state.cur_hysteresis <= 1
+    shrink = jnp.logical_and(overflow, hysteresis_exhausted)
+    new_hysteresis = jnp.where(
+        overflow, jnp.maximum(state.cur_hysteresis - 1, 0), state.cur_hysteresis)
+    shrunk = jnp.maximum(state.cur_scale / cfg.scale_factor, cfg.min_scale)
+    # growth fires on the scale_window-th consecutive good step:
+    # (cur_iter - last_overflow_iter) reaches a multiple of scale_window
+    # (last_overflow_iter starts at -1, updates are evaluated pre-increment)
+    stable = (state.cur_iter - state.last_overflow_iter) % cfg.scale_window == 0
+    grow = jnp.logical_and(jnp.logical_not(overflow), stable)
+    new_scale = jnp.where(shrink, shrunk,
+                          jnp.where(grow, state.cur_scale * cfg.scale_factor,
+                                    state.cur_scale))
+    new_last = jnp.where(overflow, state.cur_iter, state.last_overflow_iter)
+    # hysteresis refills on growth, not on shrink (reference: once exhausted,
+    # every further overflow shrinks immediately until a stable window passes)
+    new_hysteresis = jnp.where(grow, jnp.int32(cfg.delayed_shift),
+                               new_hysteresis)
+    return LossScaleState(new_scale, state.cur_iter + 1, new_last,
+                          new_hysteresis)

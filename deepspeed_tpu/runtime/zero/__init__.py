@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy, add_zero_axes_to_spec

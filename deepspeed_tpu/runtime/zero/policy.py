@@ -1,0 +1,170 @@
+"""ZeRO stages as sharding policies over parameter/gradient/optimizer pytrees.
+
+Reference semantics being reproduced (SURVEY.md §2.3):
+
+- stage 0 — plain data parallelism: replicated params/opt state, all-reduced grads
+  (reference: engine.py:2266 bucketed allreduce).
+- stage 1 — optimizer state partitioned over the DP group (reference:
+  stage_1_and_2.py:95 with partition_grads=False): grads all-reduced, each rank
+  updates its shard, updated params all-gathered (stage_1_and_2.py:1700).
+- stage 2 — gradients partitioned too (stage_1_and_2.py:1271 reduce_ipg_grads →
+  reduce_scatter).
+- stage 3 — parameters partitioned as well; gathered on use (stage3.py:72,
+  partition_parameters.py:707).
+
+On TPU there are no hooks or buckets: each stage is a triple of shardings
+(param storage, gradient, optimizer state).  The train step is jitted with those
+in/out shardings plus ``with_sharding_constraint`` on the grads; XLA's SPMD
+partitioner then inserts exactly the collectives the reference issues by hand —
+psum for replicated grads, reduce-scatter for sharded grads, all-gather for
+sharded params at use sites — and overlaps them with compute (the reference's
+``overlap_comm`` side-stream, stage_1_and_2.py:963, is automatic).
+
+Sharding rule per array: add the ZeRO mesh axes to the first dimension that is
+divisible by the ZeRO world size and not already sharded by the logical (TP) spec.
+Small params below ``param_persistence_threshold`` stay replicated, matching the
+reference's persistence heuristic (parameter_offload.py:360).
+"""
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import MeshTopology
+
+
+def _spec_tuple(spec: Optional[P], ndim: int) -> Tuple:
+    entries = tuple(spec) if spec is not None else ()
+    return entries + (None,) * (ndim - len(entries))
+
+
+def _canon(entries) -> P:
+    """PartitionSpec with trailing Nones stripped (P('x') != P('x', None))."""
+    entries = list(entries)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def _used_axes(entries) -> set:
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        if isinstance(e, (tuple, list)):
+            used.update(e)
+        else:
+            used.add(e)
+    return used
+
+
+def add_zero_axes_to_spec(shape: Tuple[int, ...],
+                          logical_spec: Optional[P],
+                          zero_axes: Tuple[str, ...],
+                          mesh: jax.sharding.Mesh,
+                          min_size: int = 0) -> P:
+    """Extend ``logical_spec`` (TP sharding) with the ZeRO axes on a free dim.
+
+    Falls back to the unmodified logical spec (replication over the DP group)
+    when no dimension is cleanly divisible — the reference keeps such params
+    unpartitioned too (persistence threshold / padding-free policy; we prefer
+    replication over padding for correctness at small scale).
+    """
+    entries = list(_spec_tuple(logical_spec, len(shape)))
+    used = _used_axes(entries)
+    free_zero = tuple(a for a in zero_axes if a not in used)
+    if not free_zero:
+        return _canon(entries)
+    zero_world = 1
+    for a in free_zero:
+        zero_world *= mesh.shape[a]
+    total = 1
+    for s in shape:
+        total *= s
+    if zero_world <= 1 or total < max(min_size, 1):
+        return _canon(entries)
+    for i, dim in enumerate(shape):
+        if entries[i] is None and dim % zero_world == 0 and dim >= zero_world:
+            entries[i] = free_zero if len(free_zero) > 1 else free_zero[0]
+            return _canon(entries)
+    # second pass: compose with existing sharding on a dim (e.g. TP-sharded dim
+    # also divisible by zero world on the per-shard size)
+    for i, dim in enumerate(shape):
+        if entries[i] is not None:
+            cur = entries[i] if isinstance(entries[i], tuple) else (entries[i],)
+            cur_world = 1
+            for a in cur:
+                cur_world *= mesh.shape[a]
+            if dim % (cur_world * zero_world) == 0:
+                entries[i] = tuple(cur) + free_zero
+                return _canon(entries)
+    return _canon(_spec_tuple(logical_spec, len(shape)))
+
+
+@dataclass
+class ZeroShardingPolicy:
+    """Computes the (param, grad, optimizer-state) shardings for a ZeRO stage."""
+    stage: int
+    topology: MeshTopology
+    param_persistence_threshold: int = 0
+
+    def __post_init__(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"invalid ZeRO stage {self.stage}")
+        self.zero_axes = self.topology.zero_shard_axes
+        self.mesh = self.topology.mesh
+
+    # -- per-leaf specs -------------------------------------------------------
+    def _sharded_spec(self, shape, logical_spec) -> P:
+        return add_zero_axes_to_spec(shape, logical_spec, self.zero_axes,
+                                     self.mesh, self.param_persistence_threshold)
+
+    def param_spec(self, shape, logical_spec=None) -> P:
+        """Storage sharding of master params between steps."""
+        if self.stage >= 3:
+            return self._sharded_spec(shape, logical_spec)
+        return logical_spec if logical_spec is not None else P()
+
+    def grad_spec(self, shape, logical_spec=None) -> P:
+        if self.stage >= 2:
+            return self._sharded_spec(shape, logical_spec)
+        return logical_spec if logical_spec is not None else P()
+
+    def optimizer_spec(self, shape, logical_spec=None) -> P:
+        if self.stage >= 1:
+            return self._sharded_spec(shape, logical_spec)
+        return logical_spec if logical_spec is not None else P()
+
+    # -- pytree-level ---------------------------------------------------------
+    def _tree_specs(self, params, logical_specs, fn):
+        if logical_specs is None:
+            return jax.tree.map(
+                lambda p: fn(p.shape if hasattr(p, "shape") else (), None),
+                params)
+        # logical_specs must be a pytree matching params with PartitionSpec
+        # leaves (use P() for replicated, not None — None is an empty pytree).
+        return jax.tree.map(
+            lambda p, s: fn(p.shape if hasattr(p, "shape") else (), s),
+            params, logical_specs)
+
+    def param_specs(self, params, logical_specs=None):
+        return self._tree_specs(params, logical_specs, self.param_spec)
+
+    def grad_specs(self, params, logical_specs=None):
+        return self._tree_specs(params, logical_specs, self.grad_spec)
+
+    def optimizer_specs_for_params(self, params, logical_specs=None):
+        return self._tree_specs(params, logical_specs, self.optimizer_spec)
+
+    def shardings(self, specs):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs, is_leaf=lambda x: isinstance(x, P))
+
+    def constrain_grads(self, grads, grad_specs):
+        """Apply the stage-2 reduce-scatter constraint inside the train step."""
+        return jax.tree.map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, s)),
+            grads, grad_specs, is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,2 @@
+from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer

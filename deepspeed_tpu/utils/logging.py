@@ -1,0 +1,70 @@
+"""Rank-filtered logging (reference capability: deepspeed/utils/logging.py).
+
+On TPU/JAX, "rank" is ``jax.process_index()`` — one process per host — so
+``log_dist`` filters on process index rather than torch.distributed rank.
+"""
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        lg.addHandler(handler)
+    env_level = os.environ.get("DEEPSPEED_TPU_LOG_LEVEL")
+    if env_level:
+        lg.setLevel(LOG_LEVELS.get(env_level.lower(), logging.INFO))
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO):
+    """Log ``message`` only on the given process indices (None or [-1] = all)."""
+    ranks = list(ranks) if ranks is not None else []
+    my_rank = _process_index()
+    if not ranks or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str):
+    if _process_index() == 0:
+        logger.info(message)
+
+
+def warning_once_factory():
+    seen = set()
+
+    def warning_once(message: str):
+        if message not in seen:
+            seen.add(message)
+            logger.warning(message)
+
+    return warning_once
+
+
+warning_once = warning_once_factory()

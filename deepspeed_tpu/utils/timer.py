@@ -1,0 +1,151 @@
+"""Wall-clock and throughput timers (reference capability: deepspeed/utils/timer.py:43
+``SynchronizedWallClockTimer`` and :198 ``ThroughputTimer``).
+
+On TPU, synchronisation is ``jax.block_until_ready`` on the step outputs rather than
+CUDA events; the engine passes its step outputs to :meth:`SynchronizedWallClockTimer.
+Timer.stop` via the optional ``sync_obj``.
+"""
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync(obj=None):
+    if obj is not None:
+        import jax
+        jax.block_until_ready(obj)
+        # experimental remote-TPU platforms (axon tunnel) only truly fence on a
+        # device->host transfer; fetch one scalar off the object to be sure
+        leaves = jax.tree.leaves(obj)
+        if leaves:
+            first = leaves[0]
+            if hasattr(first, "ravel") and getattr(first, "size", 0) > 0:
+                jax.device_get(first.ravel()[0])
+
+
+class SynchronizedWallClockTimer:
+    """Named timers with optional device synchronisation."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = 0.0
+            self.elapsed_ = 0.0
+            self.count = 0
+
+        def start(self):
+            if self.started_:
+                return
+            self.started_ = True
+            self.start_time = time.time()
+
+        def stop(self, reset: bool = False, sync_obj=None):
+            if not self.started_:
+                return
+            _sync(sync_obj)
+            elapsed = time.time() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            self.count += 1
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.count = 0
+            self.started_ = False
+
+        def elapsed(self, reset: bool = True) -> float:
+            started = self.started_
+            if started:
+                self.stop()
+            out = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return out
+
+        def mean(self) -> float:
+            return self.elapsed_ / max(self.count, 1)
+
+    def __init__(self):
+        self.timers = OrderedDict()
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names, normalizer: float = 1.0, reset: bool = True, ranks=None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """samples/sec + tokens/sec aggregation across steps."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: Optional[int] = None, monitor_memory: bool = False):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+
+    def start(self):
+        self.started = True
+        self.start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync_obj=None):
+        if not self.started:
+            return
+        self.started = False
+        _sync(sync_obj)
+        duration = time.time() - self.start_time
+        if global_step:
+            self.global_step_count += 1
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if (report_speed and self.steps_per_output
+                    and self.global_step_count % self.steps_per_output == 0):
+                log_dist(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}", ranks=[0])
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        counted = self.global_step_count - self.start_step
+        if counted > 0 and self.total_elapsed_time > 0:
+            return self.batch_size / (self.total_elapsed_time / counted)
+        return -1.0

@@ -1,0 +1,35 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU (the reference's
+DistributedTest multi-process harness, tests/unit/common.py:102, becomes a
+virtual multi-device single process under XLA's host-platform device count)."""
+import os
+
+# must run before jax initialises its backends (the outer environment pins
+# JAX_PLATFORMS to the real TPU platform; tests always run on the virtual
+# CPU mesh)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# jax may already be imported by a sitecustomize with the platform config frozen
+# from the outer env; override it before any backend initialises.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    from deepspeed_tpu.comm import reset_topology
+    reset_topology()
+    yield
+    reset_topology()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs

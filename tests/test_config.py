@@ -1,0 +1,117 @@
+"""Config-system tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+class FakeTopo:
+    def __init__(self, dp):
+        self.dp_world_size = dp
+
+
+def test_batch_triangulation_all_given():
+    c = DeepSpeedConfig({"train_batch_size": 16,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2},
+                        mesh_topology=FakeTopo(4))
+    assert (c.train_batch_size, c.train_micro_batch_size_per_gpu,
+            c.gradient_accumulation_steps) == (16, 2, 2)
+
+
+def test_batch_triangulation_infer_gas():
+    c = DeepSpeedConfig({"train_batch_size": 16,
+                         "train_micro_batch_size_per_gpu": 2},
+                        mesh_topology=FakeTopo(4))
+    assert c.gradient_accumulation_steps == 2
+
+
+def test_batch_triangulation_infer_train():
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 3},
+                        mesh_topology=FakeTopo(4))
+    assert c.train_batch_size == 24
+
+
+def test_batch_triangulation_only_train():
+    c = DeepSpeedConfig({"train_batch_size": 8}, mesh_topology=FakeTopo(4))
+    assert c.train_micro_batch_size_per_gpu == 2
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(ValueError, match="batch-size"):
+        DeepSpeedConfig({"train_batch_size": 10,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2},
+                        mesh_topology=FakeTopo(4))
+
+
+def test_no_batch_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({}, mesh_topology=FakeTopo(1))
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(ValueError, match="fp16 and bf16"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}},
+                        mesh_topology=FakeTopo(1))
+
+
+def test_zero_config_keys():
+    c = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "offload_param": {"device": "cpu", "pin_memory": True},
+            "reduce_bucket_size": 1000,
+        }}, mesh_topology=FakeTopo(4))
+    assert c.zero_config.stage == 3
+    assert c.zero_config.offload_optimizer.device == "cpu"
+    assert c.zero_config.offload_param.pin_memory is True
+    assert c.zero_enabled
+
+
+def test_deprecated_cpu_offload_migrates():
+    c = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+    }, mesh_topology=FakeTopo(4))
+    assert c.zero_config.offload_optimizer is not None
+    assert c.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_optimizer_scheduler_sections():
+    c = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-4,
+                                                  "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+        "gradient_clipping": 1.0,
+    }, mesh_topology=FakeTopo(4))
+    assert c.optimizer_name == "adamw"
+    assert c.optimizer_params["lr"] == 2e-4
+    assert c.scheduler_name == "WarmupLR"
+    assert c.gradient_clipping == 1.0
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8,
+                             "fp16": {"enabled": True}}))
+    c = DeepSpeedConfig(str(p), mesh_topology=FakeTopo(8))
+    assert c.fp16.enabled
+    assert c.train_micro_batch_size_per_gpu == 1
+
+
+def test_fp16_defaults():
+    c = DeepSpeedConfig({"train_batch_size": 1, "fp16": {"enabled": True}},
+                        mesh_topology=FakeTopo(1))
+    assert c.fp16.initial_scale_power == 16
+    assert c.fp16.loss_scale == 0.0
+    assert c.fp16.hysteresis == 2

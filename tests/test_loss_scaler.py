@@ -1,0 +1,56 @@
+"""Dynamic loss-scale tests (reference:
+tests/unit/runtime/half_precision/test_dynamic_loss_scale.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    create_loss_scaler, has_overflow, update_scale)
+
+
+def test_initial_scale():
+    state, cfg = create_loss_scaler(True, initial_scale_power=8)
+    assert float(state.cur_scale) == 256.0
+    assert cfg.dynamic
+
+
+def test_static_scale():
+    state, cfg = create_loss_scaler(True, loss_scale=128.0)
+    assert not cfg.dynamic
+    s = update_scale(state, jnp.bool_(True), cfg)
+    assert float(s.cur_scale) == 128.0
+
+
+def test_overflow_shrinks_after_hysteresis():
+    state, cfg = create_loss_scaler(True, initial_scale_power=8, hysteresis=2)
+    s = update_scale(state, jnp.bool_(True), cfg)    # hysteresis 2 -> 1
+    assert float(s.cur_scale) == 256.0
+    s = update_scale(s, jnp.bool_(True), cfg)        # now shrink
+    assert float(s.cur_scale) == 128.0
+
+
+def test_growth_after_window():
+    state, cfg = create_loss_scaler(True, initial_scale_power=8,
+                                    loss_scale_window=4)
+    s = state
+    for _ in range(4):
+        s = update_scale(s, jnp.bool_(False), cfg)
+    assert float(s.cur_scale) == 512.0
+
+
+def test_min_scale_floor():
+    state, cfg = create_loss_scaler(True, loss_scale=0.0,
+                                    initial_scale_power=1, hysteresis=1,
+                                    min_loss_scale=1.0)
+    s = state
+    for _ in range(10):
+        s = update_scale(s, jnp.bool_(True), cfg)
+    assert float(s.cur_scale) == 1.0
+
+
+def test_has_overflow_detects_nan_inf():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    bad_nan = {"a": jnp.array([1.0, np.nan]), "b": jnp.zeros((2,))}
+    assert bool(has_overflow(bad_nan))
+    bad_inf = {"a": jnp.array([1.0, np.inf]), "b": jnp.zeros((2,))}
+    assert bool(has_overflow(bad_inf))

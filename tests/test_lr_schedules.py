@@ -1,0 +1,60 @@
+"""LR schedule unit tests (reference: tests/unit/runtime/test_lr_schedulers.py)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    get_lr_schedule, VALID_LR_SCHEDULES, WARMUP_LR, WARMUP_DECAY_LR,
+    WARMUP_COSINE_LR, ONE_CYCLE, LR_RANGE_TEST)
+
+
+def test_warmup_ramps_then_flat():
+    s = get_lr_schedule(WARMUP_LR, {"warmup_min_lr": 0.0,
+                                    "warmup_max_lr": 0.01,
+                                    "warmup_num_steps": 10})
+    assert float(s(0)) < float(s(5)) < float(s(10))
+    assert float(s(10)) == pytest.approx(0.01)
+    assert float(s(100)) == pytest.approx(0.01)
+
+
+def test_warmup_decay_hits_zero():
+    s = get_lr_schedule(WARMUP_DECAY_LR, {"total_num_steps": 100,
+                                          "warmup_max_lr": 0.01,
+                                          "warmup_num_steps": 10})
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-8)
+    assert float(s(55)) == pytest.approx(0.005, rel=0.01)
+
+
+def test_warmup_cosine():
+    s = get_lr_schedule(WARMUP_COSINE_LR, {"total_num_steps": 100,
+                                           "warmup_num_steps": 10,
+                                           "warmup_max_lr": 0.01})
+    mid = float(s(55))
+    assert 0 < float(s(99)) < mid < float(s(10))
+
+
+def test_one_cycle_shape():
+    s = get_lr_schedule(ONE_CYCLE, {"cycle_min_lr": 0.001,
+                                    "cycle_max_lr": 0.01,
+                                    "cycle_first_step_size": 10})
+    assert float(s(0)) == pytest.approx(0.001)
+    assert float(s(10)) == pytest.approx(0.01)
+    assert float(s(20)) == pytest.approx(0.001)
+
+
+def test_lr_range_test_monotone():
+    s = get_lr_schedule(LR_RANGE_TEST, {"lr_range_test_min_lr": 1e-4,
+                                        "lr_range_test_step_size": 10,
+                                        "lr_range_test_step_rate": 1.0})
+    vals = [float(s(i)) for i in range(0, 100, 10)]
+    assert all(b > a for a, b in zip(vals, vals[1:]))
+
+
+def test_unknown_raises():
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})
+
+
+def test_all_valid_instantiable():
+    for name in VALID_LR_SCHEDULES:
+        s = get_lr_schedule(name, {"total_num_steps": 10})
+        assert np.isfinite(float(s(1)))

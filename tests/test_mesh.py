@@ -1,0 +1,50 @@
+"""Mesh topology tests (reference: tests/unit/runtime/pipe/test_topology.py +
+groups algebra)."""
+import pytest
+
+from deepspeed_tpu.comm.mesh import MeshTopology
+
+
+def test_default_topology_all_data(devices8):
+    t = MeshTopology()
+    assert t.world_size == 8
+    assert t.dp_world_size == 8
+    assert t.zero_world_size == 8
+    assert dict(t.mesh.shape) == {"pipe": 1, "expert": 1, "data": 8,
+                                  "seq": 1, "model": 1}
+
+
+def test_tp_dp_split(devices8):
+    t = MeshTopology(model_parallel_size=2)
+    assert t.dp_world_size == 4
+    assert t.axis_size("model") == 2
+
+
+def test_full_5d(devices8):
+    t = MeshTopology(model_parallel_size=2, pipe_parallel_size=2,
+                     sequence_parallel_size=2)
+    assert t.dp_world_size == 1
+    assert dict(t.mesh.shape) == {"pipe": 2, "expert": 1, "data": 1,
+                                  "seq": 2, "model": 2}
+
+
+def test_expert_carved_from_data(devices8):
+    t = MeshTopology(expert_parallel_size=4)
+    assert t.dp_world_size == 8          # ep x data = 4 x 2
+    assert t.axis_size(t.expert_parallel_axes) == 4
+    assert t.axis_size(t.expert_data_parallel_axes) == 2
+
+
+def test_zero_includes_seq(devices8):
+    t = MeshTopology(sequence_parallel_size=2)
+    assert t.dp_world_size == 4
+    assert t.zero_world_size == 8        # seq x data combined group
+
+
+def test_invalid_sizes(devices8):
+    with pytest.raises(ValueError):
+        MeshTopology(model_parallel_size=3)
+    with pytest.raises(ValueError):
+        MeshTopology(expert_parallel_size=3)
+    with pytest.raises(ValueError):
+        MeshTopology(data_parallel_size=4, model_parallel_size=1)

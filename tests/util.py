@@ -1,0 +1,34 @@
+"""Shared test fixtures (reference: tests/unit/simple_model.py — SimpleModel
+and random_dataloader equivalents)."""
+import numpy as np
+
+from deepspeed_tpu.models.gpt2 import gpt2_model
+
+
+def tiny_gpt2(**overrides):
+    kwargs = dict(vocab_size=128, max_seq_len=64, num_layers=2, num_heads=4,
+                  d_model=32, dtype="float32", attention_impl="xla")
+    kwargs.update(overrides)
+    return gpt2_model(size="custom", **kwargs)
+
+
+def random_batch(batch_size=8, seq_len=16, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(batch_size, seq_len),
+                                      dtype=np.int32)}
+
+
+def random_batches(n, batch_size=8, seq_len=16, vocab=128, seed=0):
+    return [random_batch(batch_size, seq_len, vocab, seed + i)
+            for i in range(n)]
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+    cfg.update(overrides)
+    return cfg
