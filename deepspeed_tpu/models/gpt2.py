@@ -173,6 +173,20 @@ def count_params(config: GPT2Config) -> int:
     return V * D + S * D + L * per_layer + 2 * D
 
 
+def embed(params, batch, config: GPT2Config):
+    tokens = batch["input_ids"]
+    dtype = jnp.dtype(config.dtype)
+    S = tokens.shape[1]
+    return params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
+
+
+def head(params, x, config: GPT2Config):
+    dtype = jnp.dtype(config.dtype)
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                    config.layer_norm_eps)
+    return x @ params["wte"].astype(dtype).T
+
+
 def gpt2_model(size: str = "125m", **overrides) -> Model:
     cfg_kwargs = dict(GPT2_SIZES[size]) if size in GPT2_SIZES else {}
     cfg_kwargs.update(overrides)
@@ -185,4 +199,7 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,
         meta={"name": f"gpt2-{size}", "n_params": n_params},
+        embed_fn=lambda p, b: embed(p, b, config),
+        block_fn=lambda lp, x: _block(x, lp, config),
+        head_fn=lambda p, x: head(p, x, config),
     )

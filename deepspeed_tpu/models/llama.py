@@ -1,0 +1,190 @@
+"""Llama-2 / Llama-3-style decoder family, TPU-native: RMSNorm, rotary position
+embeddings, grouped-query attention, SwiGLU MLP; scan-over-layers with stacked
+params, Megatron-pattern TP specs.
+
+Covers the BASELINE.md configs "Llama-2 13B ZeRO-3 + offload" and "Llama-2 7B
+PP×ZeRO-1".  Architecture follows the public Llama papers; capability parity
+target is the reference's HF-Llama support (module_inject/containers/llama.py).
+"""
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32          # < num_heads → grouped-query attention
+    d_model: int = 4096
+    d_mlp: int = 11008
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+LLAMA_SIZES = {
+    "tiny": dict(vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
+                 num_kv_heads=2, d_model=32, d_mlp=64),
+    "7b": dict(num_layers=32, num_heads=32, num_kv_heads=32, d_model=4096,
+               d_mlp=11008),
+    "13b": dict(num_layers=40, num_heads=40, num_kv_heads=40, d_model=5120,
+                d_mlp=13824),
+    "70b": dict(num_layers=80, num_heads=64, num_kv_heads=8, d_model=8192,
+                d_mlp=28672),
+}
+
+
+def init_params(config: LlamaConfig, rng) -> dict:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    k = iter(jax.random.split(rng, 12))
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "wte": norm(next(k), (V, D)) * std,
+        "blocks": {
+            "attn_norm": jnp.ones((L, D)),
+            "wq": norm(next(k), (L, D, H * hd)) * std,
+            "wk": norm(next(k), (L, D, KV * hd)) * std,
+            "wv": norm(next(k), (L, D, KV * hd)) * std,
+            "wo": norm(next(k), (L, H * hd, D)) * res_std,
+            "mlp_norm": jnp.ones((L, D)),
+            "w_gate": norm(next(k), (L, D, M)) * std,
+            "w_up": norm(next(k), (L, D, M)) * std,
+            "w_down": norm(next(k), (L, M, D)) * res_std,
+        },
+        "final_norm": jnp.ones((D,)),
+        "lm_head": norm(next(k), (D, V)) * std,
+    }
+
+
+def logical_specs(config: LlamaConfig) -> dict:
+    return {
+        "wte": P("model", None),
+        "blocks": {
+            "attn_norm": P(),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm": P(),
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            "w_down": P(None, "model", None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, "model"),
+    }
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def rope(x, theta: float, positions=None):
+    """Rotary embeddings on [B, S, H, hd] (split-half convention)."""
+    B, S, H, hd = x.shape
+    if positions is None:
+        positions = jnp.arange(S)
+    freqs = theta ** (-jnp.arange(0, hd // 2) / (hd // 2))
+    angles = positions[:, None] * freqs[None, :]         # [S, hd/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _block(x, layer, config: LlamaConfig, rng=None):
+    B, S, D = x.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    dt = h.dtype
+    q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, hd)
+    kk = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
+    q = rope(q, config.rope_theta)
+    kk = rope(kk, config.rope_theta)
+    if KV != H:   # grouped-query: repeat kv heads
+        rep = H // KV
+        kk = jnp.repeat(kk, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
+    x = x + gated @ layer["w_down"].astype(dt)
+    return x
+
+
+def forward(params, batch, config: LlamaConfig, rng=None):
+    tokens = batch["input_ids"]
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens]
+    block_fn = partial(_block, config=config, rng=rng)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def body(carry, layer):
+        return block_fn(carry, layer), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    return x @ params["lm_head"].astype(dtype)
+
+
+def count_params(config: LlamaConfig) -> int:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    per_layer = 2 * D + D * H * hd + 2 * D * KV * hd + H * hd * D + 3 * D * M
+    return V * D + L * per_layer + D + D * V
+
+
+def embed(params, batch, config: LlamaConfig):
+    dtype = jnp.dtype(config.dtype)
+    return params["wte"].astype(dtype)[batch["input_ids"]]
+
+
+def head(params, x, config: LlamaConfig):
+    dtype = jnp.dtype(config.dtype)
+    x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    return x @ params["lm_head"].astype(dtype)
+
+
+def llama_model(size: str = "7b", **overrides) -> Model:
+    cfg_kwargs = dict(LLAMA_SIZES[size]) if size in LLAMA_SIZES else {}
+    cfg_kwargs.update(overrides)
+    config = LlamaConfig(**cfg_kwargs)
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        logical_specs=logical_specs(config),
+        flops_per_token=6.0 * n_params,
+        meta={"name": f"llama-{size}", "n_params": n_params},
+        embed_fn=lambda p, b: embed(p, b, config),
+        block_fn=lambda lp, x: _block(x, lp, config),
+        head_fn=lambda p, x: head(p, x, config),
+    )

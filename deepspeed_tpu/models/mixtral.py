@@ -1,0 +1,176 @@
+"""Mixtral-style MoE decoder: Llama blocks with top-k-routed expert SwiGLU
+FFNs, expert-parallel over the ``expert`` mesh axis (BASELINE.md config 5:
+Mixtral-8x7B EP + Ulysses SP).
+"""
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.llama import _rms_norm, rope
+from deepspeed_tpu.moe.layer import MoEConfig, moe_layer
+from deepspeed_tpu.moe.sharded_moe import topkgating
+from deepspeed_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    d_model: int = 4096
+    d_ff: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def moe(self) -> MoEConfig:
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         num_experts=self.num_experts, top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         aux_loss_coef=self.aux_loss_coef,
+                         activation="silu_glu")
+
+
+MIXTRAL_SIZES = {
+    "tiny": dict(vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
+                 num_kv_heads=2, d_model=32, d_ff=64, num_experts=4, top_k=2),
+    "8x7b": dict(),
+}
+
+
+def init_params(config: MixtralConfig, rng) -> dict:
+    D, V, L = config.d_model, config.vocab_size, config.num_layers
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    E, F = config.num_experts, config.d_ff
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "wte": norm(next(k), (V, D)) * std,
+        "blocks": {
+            "attn_norm": jnp.ones((L, D)),
+            "wq": norm(next(k), (L, D, H * hd)) * std,
+            "wk": norm(next(k), (L, D, KV * hd)) * std,
+            "wv": norm(next(k), (L, D, KV * hd)) * std,
+            "wo": norm(next(k), (L, H * hd, D)) * res_std,
+            "mlp_norm": jnp.ones((L, D)),
+            "moe": {
+                "router": norm(next(k), (L, D, E)) * std,
+                "w_gate": norm(next(k), (L, E, D, F)) * std,
+                "w_in": norm(next(k), (L, E, D, F)) * std,
+                "w_out": norm(next(k), (L, E, F, D)) * res_std,
+            },
+        },
+        "final_norm": jnp.ones((D,)),
+        "lm_head": norm(next(k), (D, V)) * std,
+    }
+
+
+def logical_specs(config: MixtralConfig) -> dict:
+    return {
+        "wte": P("model", None),
+        "blocks": {
+            "attn_norm": P(),
+            "wq": P(None, None, "model"),
+            "wk": P(None, None, "model"),
+            "wv": P(None, None, "model"),
+            "wo": P(None, "model", None),
+            "mlp_norm": P(),
+            "moe": {
+                "router": P(),
+                "w_gate": P(None, "expert", None, "model"),
+                "w_in": P(None, "expert", None, "model"),
+                "w_out": P(None, "expert", "model", None),
+            },
+        },
+        "final_norm": P(),
+        "lm_head": P(None, "model"),
+    }
+
+
+def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
+    x = carry
+    B, S, D = x.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    dt = h.dtype
+    q = rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, hd), config.rope_theta)
+    kk = rope((h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd), config.rope_theta)
+    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
+    if KV != H:
+        rep = H // KV
+        kk = jnp.repeat(kk, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    moe_out, aux = moe_layer(layer["moe"], h, config.moe, train=train, rng=rng)
+    return x + moe_out, aux
+
+
+def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
+                     rng=None):
+    tokens = batch["input_ids"]
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens]
+    block_fn = partial(_block, config=config, train=train, rng=rng)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn)
+    x, aux = lax.scan(block_fn, x, params["blocks"])
+    x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    return x @ params["lm_head"].astype(dtype), jnp.sum(aux)
+
+
+def count_params(config: MixtralConfig) -> int:
+    import numpy as np
+    shapes = jax.eval_shape(partial(init_params, config), jax.random.PRNGKey(0))
+    return int(sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes)))
+
+
+def mixtral_model(size: str = "8x7b", **overrides) -> Model:
+    import optax
+    cfg_kwargs = dict(MIXTRAL_SIZES[size]) if size in MIXTRAL_SIZES else {}
+    cfg_kwargs.update(overrides)
+    config = MixtralConfig(**cfg_kwargs)
+    n_params = count_params(config)
+    # active params per token ≈ dense part + top_k/num_experts of experts
+    active = n_params - (1 - config.top_k / config.num_experts) * (
+        3 * config.num_layers * config.num_experts * config.d_model * config.d_ff)
+
+    def loss_fn(params, batch, rng=None):
+        tokens = batch["input_ids"]
+        logits, aux = forward_with_aux(params, batch, config, train=True, rng=rng)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), tokens[:, 1:]).mean()
+        return ce + aux
+
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward_with_aux(
+            p, b, config, train=False, rng=rng)[0],
+        loss_fn=loss_fn,
+        logical_specs=logical_specs(config),
+        flops_per_token=6.0 * active,
+        meta={"name": f"mixtral-{size}", "n_params": n_params,
+              "active_params": active},
+    )

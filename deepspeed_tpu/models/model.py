@@ -26,6 +26,13 @@ class Model:
     flops_per_token: Optional[float] = None
     #: extra metadata (e.g. number of params)
     meta: dict = field(default_factory=dict)
+    #: optional pipeline decomposition (see runtime/pipe/pipeline.py):
+    #: embed_fn(params, batch) -> x; block_fn(layer_params, x) -> x;
+    #: head_fn(params, x) -> logits; blocks_key names the stacked subtree.
+    embed_fn: Optional[Callable] = None
+    block_fn: Optional[Callable] = None
+    head_fn: Optional[Callable] = None
+    blocks_key: str = "blocks"
 
     def __post_init__(self):
         if self.loss_fn is None and self.apply_fn is not None:

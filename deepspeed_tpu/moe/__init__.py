@@ -1,0 +1,4 @@
+from deepspeed_tpu.moe.layer import (MoE, MoEConfig, moe_layer,
+                                     init_moe_params, moe_logical_specs)
+from deepspeed_tpu.moe.sharded_moe import (top1gating, top2gating, topkgating,
+                                           GateOutput)

@@ -1,0 +1,117 @@
+"""Top-k gating with capacity — functional (reference: deepspeed/moe/
+sharded_moe.py:184 ``top1gating``, :282 ``top2gating``, :348 ``TopKGate``).
+
+Produces dense dispatch/combine tensors (GShard formulation) so the expert
+dispatch is two einsums whose resharding XLA lowers to the all-to-alls the
+reference issues explicitly (sharded_moe.py:425 ``MOELayer`` a2a).  Capacity is
+enforced by position-in-expert cumsum (deterministic, compile-friendly) — the
+reference's random-token-priority option trades determinism for load spread and
+is exposed via gumbel jitter on the logits instead.
+"""
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class GateOutput(NamedTuple):
+    l_aux: jnp.ndarray            # load-balancing loss (scalar)
+    combine_weights: jnp.ndarray  # [T, E, C] float
+    dispatch_mask: jnp.ndarray    # [T, E, C] bool
+    router_z_loss: jnp.ndarray    # scalar (0 when disabled)
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int, top_k: int = 1) -> int:
+    cap = int(num_tokens * top_k / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def _one_hot_dispatch(indices, gates_for_choice, num_experts, capacity):
+    """indices: [T] chosen expert per token; gates_for_choice: [T] weight.
+    Returns ([T,E,C] combine, [T,E,C] mask, per-expert counts [E])."""
+    T = indices.shape[0]
+    mask = jax.nn.one_hot(indices, num_experts, dtype=jnp.int32)     # [T, E]
+    pos_in_expert = jnp.cumsum(mask, axis=0) * mask - mask           # [T, E]
+    within = pos_in_expert < capacity
+    mask = mask * within.astype(jnp.int32)
+    pos = jnp.sum(pos_in_expert * mask, axis=1)                      # [T]
+    kept = jnp.sum(mask, axis=1) > 0                                 # [T]
+    loc = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)           # [T, C]
+    combine = (gates_for_choice * kept)[:, None, None] * \
+        mask.astype(jnp.float32)[:, :, None] * loc[:, None, :]
+    return combine, combine > 0, jnp.sum(mask, axis=0)
+
+
+def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float = 1.0,
+               min_capacity: int = 4, noise_rng: Optional[jax.Array] = None,
+               z_loss_coef: float = 0.0) -> GateOutput:
+    """logits: [T, E].  Generalises top1/top2 (reference keeps them separate).
+
+    Load-balancing aux loss follows the reference: E * Σ_e mean_tokens(me) ·
+    fraction_dispatched(ce), computed on the top-1 assignment.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = _capacity(T, E, capacity_factor, min_capacity, top_k=k)
+
+    select_logits = logits.astype(jnp.float32)
+    if noise_rng is not None:
+        # gumbel jitter — the reference's noisy_gate_policy='Jitter'/'RSample'
+        select_logits = select_logits + jax.random.gumbel(
+            noise_rng, select_logits.shape)
+
+    # aux loss on the top-1 assignment (reference top1gating l_aux)
+    top1 = jnp.argmax(select_logits, axis=-1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    z_loss = jnp.float32(0.0)
+    if z_loss_coef > 0:
+        z = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+        z_loss = z_loss_coef * jnp.mean(z ** 2)
+
+    combine_total = jnp.zeros((T, E, capacity), jnp.float32)
+    remaining = select_logits
+    chosen_gates = []
+    chosen_idx = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        chosen_idx.append(idx)
+        chosen_gates.append(jnp.take_along_axis(
+            gates, idx[:, None], axis=1)[:, 0])
+        remaining = remaining - jax.nn.one_hot(idx, E) * 1e9
+
+    # normalise the k gate values per token (reference top2gating denominator)
+    denom = sum(chosen_gates)
+    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    for idx, g in zip(chosen_idx, chosen_gates):
+        combine, _, _ = _one_hot_dispatch(idx, g / denom, E, capacity)
+        combine_total = combine_total + combine
+
+    return GateOutput(l_aux, combine_total, combine_total > 0, z_loss)
+
+
+def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               noise_rng=None) -> GateOutput:
+    """reference sharded_moe.py:184 (gate value not normalised for k=1)."""
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = _capacity(T, E, capacity_factor, min_capacity, 1)
+    select = logits.astype(jnp.float32)
+    if noise_rng is not None:
+        select = select + jax.random.gumbel(noise_rng, select.shape)
+    idx = jnp.argmax(select, axis=-1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(idx, E, dtype=jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+    gate_val = jnp.take_along_axis(gates, idx[:, None], axis=1)[:, 0]
+    combine, mask, _ = _one_hot_dispatch(idx, gate_val, E, capacity)
+    return GateOutput(l_aux, combine, mask, jnp.float32(0.0))
+
+
+def top2gating(logits, capacity_factor: float = 1.0,
+               min_capacity: int = 4, noise_rng=None) -> GateOutput:
+    """reference sharded_moe.py:282."""
+    return topkgating(logits, 2, capacity_factor, min_capacity, noise_rng)

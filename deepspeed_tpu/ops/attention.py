@@ -39,11 +39,29 @@ def flash_causal_attention(q, k, v):
     return flash_attention(q, k, v, causal=True)
 
 
-def causal_attention(q, k, v, impl: str = "auto"):
-    """q/k/v: [B, S, H, hd] -> [B, S, H, hd]."""
+def _local_causal_attention(q, k, v, impl: str = "auto"):
     if impl == "flash" or (impl == "auto" and _on_tpu() and q.shape[1] >= 256):
         try:
             return flash_causal_attention(q, k, v)
         except Exception:
             pass
     return xla_causal_attention(q, k, v)
+
+
+def causal_attention(q, k, v, impl: str = "auto"):
+    """q/k/v: [B, S, H, hd] -> [B, S, H, hd].
+
+    When the mesh has an active ``seq`` axis, attention runs under Ulysses
+    sequence parallelism (head-scatter all-to-all; see sequence/layer.py) —
+    models get SP transparently.
+    """
+    from deepspeed_tpu.comm.mesh import get_topology, SEQ_AXIS
+    try:
+        sp = get_topology().mesh.shape[SEQ_AXIS]
+    except Exception:
+        sp = 1
+    if sp > 1:
+        from deepspeed_tpu.sequence.layer import distributed_attention
+        return distributed_attention(
+            q, k, v, lambda a, b, c: _local_causal_attention(a, b, c, impl))
+    return _local_causal_attention(q, k, v, impl)

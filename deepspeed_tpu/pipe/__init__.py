@@ -1,0 +1,9 @@
+"""Re-export (reference: deepspeed/pipe/__init__.py)."""
+from deepspeed_tpu.runtime.pipe import (pipeline_model, pipeline_blocks,
+                                        ProcessTopology,
+                                        PipeDataParallelTopology,
+                                        PipeModelDataParallelTopology,
+                                        PipelineParallelGrid,
+                                        TrainSchedule, InferenceSchedule)
+
+PipelineModule = pipeline_model

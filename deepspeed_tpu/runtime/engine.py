@@ -287,6 +287,8 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ train step
     def _build_train_step(self):
+        if self.model.meta.get("pipeline"):
+            return self._build_pipeline_train_step()
         gas = self.gradient_accumulation_steps()
         fp16 = self._config.fp16.enabled
         grad_specs = self.grad_specs
@@ -316,6 +318,31 @@ class DeepSpeedEngine:
             new_state, metrics = self._apply_grads(state, grads)
             # undo loss scaling for the reported loss; mean over micro steps
             metrics["loss"] = loss_sum / scale
+            return new_state, metrics
+
+        return train_step
+
+    def _build_pipeline_train_step(self):
+        """Pipelined models consume the whole [gas, micro, ...] stack in one
+        compiled schedule (gas ≙ the pipeline's microbatch count; reference
+        PipelineEngine.train_batch, runtime/pipe/engine.py:297) — no
+        sequential accumulation scan."""
+        fp16 = self._config.fp16.enabled
+
+        def train_step(state, stacked_batch, rng):
+            params = state["params"]
+            scale = state["scaler"].cur_scale if fp16 else jnp.float32(1.0)
+
+            def loss_fn(p):
+                cparams = _tree_cast(p, self.compute_dtype)
+                loss = self.model.loss(cparams, stacked_batch, rng)
+                return loss.astype(jnp.float32) * scale
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = _tree_cast(grads, jnp.float32)
+            grads = self.zero_policy.constrain_grads(grads, self.grad_specs)
+            new_state, metrics = self._apply_grads(state, grads)
+            metrics["loss"] = loss / scale
             return new_state, metrics
 
         return train_step

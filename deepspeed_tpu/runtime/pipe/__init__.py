@@ -1,0 +1,7 @@
+from deepspeed_tpu.runtime.pipe.pipeline import (pipeline_blocks,
+                                                 pipeline_model)
+from deepspeed_tpu.runtime.pipe.topology import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    PipelineParallelGrid)
+from deepspeed_tpu.runtime.pipe.schedule import (
+    TrainSchedule, InferenceSchedule, bubble_fraction)

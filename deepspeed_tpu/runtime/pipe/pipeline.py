@@ -1,0 +1,178 @@
+"""Compiled pipeline parallelism (reference: deepspeed/runtime/pipe/engine.py:54
+``PipelineEngine`` executing a 1F1B instruction stream with p2p send/recv,
+p2p.py:50).
+
+TPU-native formulation — the whole schedule is ONE XLA program:
+
+- layer params stay stacked ``[L, ...]`` and are viewed as
+  ``[n_stages, L/n_stages, ...]`` with the stage dim sharded over the ``pipe``
+  mesh axis;
+- a ``vmap`` over the stage dim applies every stage to its activation slot in
+  parallel (each device computes only its stage — the weights are local);
+- shifting the activation buffer one slot along the stage dim lowers to an XLA
+  ``CollectivePermute`` over ICI — the reference's send/recv pairs;
+- a ``lax.scan`` over M + S - 1 ticks runs the GPipe fill/steady/drain; the
+  backward pass through the scan is the reversed pipeline (XLA schedules it —
+  no hand-written 1F1B instruction interleave needed).
+
+Bubble fraction is (S-1)/(M+S-1), identical to the reference's schedule.
+Everything stays inside the automatic SPMD partitioner, so ZeRO/TP/SP compose
+with pipelining without manual collectives.
+"""
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import get_topology, PIPE_AXIS
+
+
+def stage_params_view(blocks_params, n_stages: int):
+    """[L, ...] stacked layer params -> [n_stages, L/S, ...], stage dim
+    constrained to the pipe axis."""
+    mesh = get_topology().mesh
+
+    def reshape(p):
+        L = p.shape[0]
+        assert L % n_stages == 0, (
+            f"num_layers {L} must divide evenly into {n_stages} stages")
+        v = p.reshape(n_stages, L // n_stages, *p.shape[1:])
+        return lax.with_sharding_constraint(
+            v, NamedSharding(mesh, P(PIPE_AXIS)))
+
+    return jax.tree.map(reshape, blocks_params)
+
+
+def pipeline_blocks(block_fn: Callable, blocks_params, x_micro, n_stages: int):
+    """Run stacked transformer blocks as an n_stages pipeline.
+
+    Args:
+        block_fn: (x, layer_params) -> x, one layer.
+        blocks_params: stacked [L, ...] pytree.
+        x_micro: [n_micro, B_micro, S, D] microbatched activations.
+    Returns:
+        [n_micro, B_micro, S, D] outputs after all L layers.
+    """
+    if n_stages == 1:
+        def body(c, lp):
+            return block_fn(c, lp), None
+
+        def run_one(x):
+            return lax.scan(body, x, blocks_params)[0]
+        return jax.vmap(run_one)(x_micro) if x_micro.ndim > 3 else run_one(x_micro)
+
+    n_micro = x_micro.shape[0]
+    assert n_micro >= n_stages, (
+        f"need >= {n_stages} microbatches to fill the pipeline, got {n_micro} "
+        f"(set gradient_accumulation_steps >= pipe_parallel_size)")
+    staged = stage_params_view(blocks_params, n_stages)
+    mesh = get_topology().mesh
+    state_spec = NamedSharding(mesh, P(PIPE_AXIS))
+
+    def stage_apply(stage_params, x):
+        def body(c, lp):
+            return block_fn(c, lp), None
+        return lax.scan(body, x, stage_params)[0]
+
+    vstages = jax.vmap(stage_apply)
+
+    state = jnp.zeros((n_stages,) + x_micro.shape[1:], x_micro.dtype)
+    state = lax.with_sharding_constraint(state, state_spec)
+    outputs = jnp.zeros_like(x_micro)
+    n_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # ingest microbatch t at stage 0 (clamped after the last microbatch —
+        # those ticks only drain the tail stages)
+        inp = lax.dynamic_index_in_dim(
+            x_micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False)
+        state = lax.dynamic_update_index_in_dim(state, inp, 0, axis=0)
+        state = lax.with_sharding_constraint(state, state_spec)
+        state = vstages(staged, state)
+        state = lax.with_sharding_constraint(state, state_spec)
+        # microbatch t-(S-1) finishes at the last stage this tick
+        out_t = t - (n_stages - 1)
+        finished = lax.dynamic_index_in_dim(
+            state, n_stages - 1, axis=0, keepdims=False)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, finished, jnp.maximum(out_t, 0), axis=0)
+        outputs = jnp.where(out_t >= 0, updated, outputs)
+        # shift: stage i's output becomes stage i+1's input (CollectivePermute)
+        state = jnp.roll(state, shift=1, axis=0)
+        state = lax.with_sharding_constraint(state, state_spec)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(n_ticks))
+    return outputs
+
+
+def pipeline_model(model, num_stages: int):
+    """Wrap a Model exposing (embed_fn, block_fn, head_fn) into a pipelined
+    Model (reference: PipelineModule, runtime/pipe/module.py:86; tied
+    embeddings live outside the pipelined region — the reference's
+    TiedLayerSpec replication, module.py:421 — so no tied-grad all-reduce is
+    needed: the embedding computes on every stage and XLA keeps one copy per
+    non-pipe mesh position)."""
+    from deepspeed_tpu.models.model import Model
+    import optax
+
+    assert model.embed_fn is not None and model.block_fn is not None \
+        and model.head_fn is not None, \
+        "model must expose embed_fn/block_fn/head_fn for pipelining"
+
+    def pipelined_apply_micro(params, stacked_batch, rng=None):
+        """stacked_batch leaves: [n_micro, B_micro, ...] -> logits
+        [n_micro, B_micro, S, V]."""
+        x = jax.vmap(lambda b: model.embed_fn(params, b))(stacked_batch)
+        x = pipeline_blocks(
+            lambda h, lp: model.block_fn(lp, h),
+            params[model.blocks_key], x, num_stages)
+        return jax.vmap(lambda h: model.head_fn(params, h))(x)
+
+    def loss_fn(params, stacked_batch, rng=None):
+        logits = pipelined_apply_micro(params, stacked_batch, rng)
+        tokens = stacked_batch["input_ids"]
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :, :-1].astype(jnp.float32), tokens[:, :, 1:])
+        return ce.mean()
+
+    def apply_fn(params, batch, rng=None):
+        # single (non-micro) batch: run as one microbatch group of size S
+        return model.apply_fn(params, batch, rng)
+
+    # storage layout: the stacked layer dim of every blocks leaf is sharded
+    # over the pipe axis (stage-major), so the [n_stages, L/S, ...] view in
+    # pipeline_blocks is a local reshape
+    specs = model.logical_specs
+    if specs is not None:
+        def add_pipe(spec):
+            entries = list(tuple(spec)) or [None]
+            assert entries[0] is None, \
+                f"blocks leaf dim0 (layers) already sharded: {spec}"
+            entries[0] = PIPE_AXIS
+            return P(*entries)
+
+        specs = dict(specs)
+        specs[model.blocks_key] = jax.tree.map(
+            add_pipe, specs[model.blocks_key],
+            is_leaf=lambda x: isinstance(x, P))
+
+    m = Model(
+        config=model.config,
+        init_fn=model.init_fn,
+        apply_fn=apply_fn,
+        loss_fn=loss_fn,
+        logical_specs=specs,
+        flops_per_token=model.flops_per_token,
+        meta={**model.meta, "pipeline": True, "num_stages": num_stages},
+    )
+    m.embed_fn = model.embed_fn
+    m.block_fn = model.block_fn
+    m.head_fn = model.head_fn
+    m.blocks_key = model.blocks_key
+    return m

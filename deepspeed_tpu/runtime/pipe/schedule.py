@@ -1,0 +1,186 @@
+"""Pipeline instruction schedules (reference: deepspeed/runtime/pipe/
+schedule.py:189 ``TrainSchedule`` + instruction classes :327-475).
+
+Pure logic, kept for capability parity and analysis: on TPU the schedule is
+*compiled* (the vmap+shift loop in pipe/pipeline.py executes a GPipe-equivalent
+schedule inside one XLA program), but the instruction-stream generators remain
+useful for bubble accounting, tests, and any host-driven executor.
+"""
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (self.__class__ == other.__class__
+                and self.kwargs == other.kwargs)
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Iterable of per-step instruction lists for one stage (reference
+    schedule.py:8)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    def steps(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference schedule.py:117)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        out = []
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds = []
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % 2))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % 2))
+            out.append(cmds)
+        return out
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference capability: schedule.py:189): per stage, warmup
+    forwards fill the pipeline, steady state alternates one-forward-one-
+    backward, drain flushes remaining backwards, then grads reduce + step.
+
+    Generated from first principles (warmup/steady/drain phases) rather than
+    the reference's parity-based clock arithmetic; the observable contract —
+    M forwards and M backwards per stage, backward b only after forward b,
+    peak of ``num_pipe_buffers`` in-flight activations — is identical and
+    pinned by tests.
+    """
+
+    def steps(self):
+        M, s, S = self.micro_batches, self.stage_id, self.stages
+        num_warmup = min(S - s - 1, M)
+        out = []
+
+        def fwd_cmds(mb):
+            cmds = []
+            if self._valid_stage(self.prev_stage):
+                cmds.append(RecvActivation(buffer_id=self._buffer_idx(mb)))
+            else:
+                cmds.append(LoadMicroBatch(buffer_id=self._buffer_idx(mb)))
+            cmds.append(ForwardPass(buffer_id=self._buffer_idx(mb)))
+            if self._valid_stage(self.next_stage):
+                cmds.append(SendActivation(buffer_id=self._buffer_idx(mb)))
+            return cmds
+
+        def bwd_cmds(mb):
+            cmds = []
+            if self._valid_stage(self.next_stage):
+                cmds.append(RecvGrad(buffer_id=self._buffer_idx(mb)))
+            cmds.append(BackwardPass(buffer_id=self._buffer_idx(mb)))
+            if self._valid_stage(self.prev_stage):
+                cmds.append(SendGrad(buffer_id=self._buffer_idx(mb)))
+            return cmds
+
+        fwd_mb, bwd_mb = 0, 0
+        for _ in range(num_warmup):
+            out.append(fwd_cmds(fwd_mb))
+            fwd_mb += 1
+        while fwd_mb < M:                       # steady state: 1F1B
+            out.append(fwd_cmds(fwd_mb))
+            fwd_mb += 1
+            out.append(bwd_cmds(bwd_mb))
+            bwd_mb += 1
+        while bwd_mb < M:                       # drain
+            out.append(bwd_cmds(bwd_mb))
+            bwd_mb += 1
+        out.append([ReduceTiedGrads(), ReduceGrads(), OptimizerStep()])
+        return out
+
+    def num_pipe_buffers(self):
+        """Peak in-flight activations for this stage (1F1B memory bound)."""
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _buffer_idx(self, micro_batch_id):
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """GPipe bubble: (S-1) / (M + S - 1)."""
+    return (stages - 1) / (micro_batches + stages - 1)

@@ -1,0 +1,141 @@
+"""Cartesian process topology (reference: deepspeed/runtime/pipe/topology.py:12
+``ProcessTopology``, :232 ``PipeDataParallelTopology``, :244
+``PipeModelDataParallelTopology``, :251 ``PipelineParallelGrid``).
+
+Pure logic — on TPU the *execution* topology is the named mesh
+(comm/mesh.py), but rank↔coordinate algebra is still needed by the launcher,
+checkpoint naming, and grid-style user code, and is directly unit-testable.
+"""
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List
+
+
+class ProcessTopology:
+    """Maps ranks <-> cartesian coordinates over named axes (row-major, first
+    axis outermost)."""
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping: Dict = {}
+        for coord in product(*[range(d) for d in dims]):
+            key = dict(zip(axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = len(self.mapping)
+
+    def get_rank(self, **coord_kwargs) -> int:
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"invalid coord {coord_kwargs}"
+        return self.mapping[key]
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes=("data",),
+                      inner_sep: str = "_", outer_sep: str = "-") -> str:
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        coord = self.get_coord(rank)
+        for ax in axes:
+            names.append(f"{ax}{inner_sep}{getattr(coord, ax):02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Rank lists that vary only along ``axis`` (the reference's process
+        groups for that axis)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other in product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other))
+            ranks = [self.get_rank(**{axis: i, **fixed})
+                     for i in range(self.get_dim(axis))]
+            if len(ranks) > 1:
+                lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return [self.get_rank(**coord._asdict())
+                for coord in self.mapping if matches(coord)]
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """reference topology.py:232 — pipe × data."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """reference topology.py:244 — pipe × data × model (3D)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """reference topology.py:251 — axis sizes/ids for a given rank over a
+    topology."""
+
+    def __init__(self, topology: ProcessTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+        self.pipe_parallel_size = topology.get_dim("pipe") or 1
+        self.data_parallel_size = topology.get_dim("data") or 1
+        self.model_parallel_size = topology.get_dim("model") or 1
+        coord = topology.get_coord(global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0)
+
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id: int) -> int:
+        coord = self._topo.get_coord(self.global_rank)
+        kwargs = coord._asdict()
+        kwargs["pipe"] = stage_id
+        return self._topo.get_rank(**kwargs)

@@ -1,0 +1,68 @@
+"""Ulysses sequence parallelism (reference: deepspeed/sequence/layer.py:37
+``DistributedAttention`` with ``_SeqAllToAll`` at :15).
+
+The algorithm is identical to the reference: q/k/v arrive sequence-sharded
+[B, S/sp, H, hd]; an all-to-all over the ``seq`` mesh axis scatters heads and
+gathers sequence → [B, S, H/sp, hd]; local attention runs over the full
+sequence on a subset of heads; a reverse all-to-all restores sequence sharding.
+On TPU the all-to-alls are ``lax.all_to_all`` over the ``seq`` axis inside a
+``shard_map`` — they ride ICI and XLA overlaps them with the attention matmuls.
+"""
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from deepspeed_tpu.comm.mesh import get_topology, SEQ_AXIS, MODEL_AXIS
+
+
+def seq_all_to_all(x, scatter_axis: int, gather_axis: int):
+    """The reference's _SeqAllToAll: inside shard_map/jit collective."""
+    return lax.all_to_all(x, SEQ_AXIS, split_axis=scatter_axis,
+                          concat_axis=gather_axis, tiled=True)
+
+
+def distributed_attention(q, k, v, local_attn):
+    """q/k/v: [B, S, H, hd] (globally); runs ``local_attn`` over full sequence
+    with heads scattered across the ``seq`` axis.
+
+    ``local_attn(q, k, v) -> out`` must be shape-preserving.
+    """
+    topo = get_topology()
+    mesh = topo.mesh
+    sp = mesh.shape[SEQ_AXIS]
+    if sp == 1:
+        return local_attn(q, k, v)
+    # fully-manual specs: batch over the dp axes, sequence over seq, heads over
+    # model (partial-manual `axis_names` mode currently trips an XLA abort when
+    # nested under grad+scan on the CPU backend)
+    dp = tuple(topo.data_parallel_axes)
+    spec = P(dp, SEQ_AXIS, MODEL_AXIS, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def inner(ql, kl, vl):
+        # [b, S/sp, h, hd] -> scatter heads(2), gather seq(1) -> [b, S, h/sp, hd]
+        qg = seq_all_to_all(ql, 2, 1)
+        kg = seq_all_to_all(kl, 2, 1)
+        vg = seq_all_to_all(vl, 2, 1)
+        out = local_attn(qg, kg, vg)
+        # reverse: scatter seq(1), gather heads(2)
+        return seq_all_to_all(out, 1, 2)
+
+    return inner(q, k, v)
+
+
+class DistributedAttention:
+    """API-parity shim for the reference's module interface."""
+
+    def __init__(self, local_attention, sequence_process_group=None,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        return distributed_attention(
+            query, key, value,
+            lambda q, k, v: self.local_attn(q, k, v, *args, **kwargs))
