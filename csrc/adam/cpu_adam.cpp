@@ -1,0 +1,123 @@
+// Host-side Adam/AdamW for ZeRO-Offload (reference capability:
+// csrc/adam/cpu_adam_impl.cpp — AVX-vectorised Adam against host DRAM).
+// Fresh implementation: OpenMP-parallel, auto-vectorised by -O3 -march=native
+// (the compiler emits AVX512 for these simple fused loops), with an optional
+// fused bf16 emit of the updated parameters so the device working copy can be
+// uploaded without a second pass.
+#include <cstddef>
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+
+extern "C" {
+
+// one flat-tensor Adam step on fp32 master params.
+// step is 1-based. adamw != 0 -> decoupled weight decay (AdamW); otherwise
+// classic L2 (added to the gradient).
+void ds_adam_step(float* params, const float* grads, float* exp_avg,
+                  float* exp_avg_sq, size_t n, float lr, float beta1,
+                  float beta2, float eps, float weight_decay, int step,
+                  int adamw) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+#pragma omp parallel for schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (!adamw && weight_decay > 0.0f) g += weight_decay * params[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    // decoupled decay is NOT bias-corrected: p -= lr*wd*p, separate from the
+    // step_size (= lr/bc1) applied to the Adam update
+    float p = params[i];
+    if (adamw && weight_decay > 0.0f) p -= lr * weight_decay * p;
+    params[i] = p - step_size * (m / denom);
+  }
+}
+
+// round-to-nearest-even fp32 -> bf16
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint32_t lsb = (x >> 16) & 1;
+  x += 0x7fff + lsb;
+  return (uint16_t)(x >> 16);
+}
+
+void ds_adam_step_bf16_out(float* params, const float* grads, float* exp_avg,
+                           float* exp_avg_sq, uint16_t* out_bf16, size_t n,
+                           float lr, float beta1, float beta2, float eps,
+                           float weight_decay, int step, int adamw) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+#pragma omp parallel for schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (!adamw && weight_decay > 0.0f) g += weight_decay * params[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    float p = params[i];
+    if (adamw && weight_decay > 0.0f) p -= lr * weight_decay * p;
+    p -= step_size * (m / denom);
+    params[i] = p;
+    out_bf16[i] = f32_to_bf16(p);
+  }
+}
+
+// Adagrad (reference csrc/adagrad/cpu_adagrad.cpp capability)
+void ds_adagrad_step(float* params, const float* grads, float* exp_avg_sq,
+                     size_t n, float lr, float eps, float weight_decay) {
+#pragma omp parallel for schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (weight_decay > 0.0f) g += weight_decay * params[i];
+    float v = exp_avg_sq[i] + g * g;
+    exp_avg_sq[i] = v;
+    params[i] -= lr * g / (std::sqrt(v) + eps);
+  }
+}
+
+// LAMB trust-ratio step on one flat tensor (reference csrc/lamb capability):
+// caller computes per-tensor norms is unnecessary — we do both passes here.
+void ds_lamb_step(float* params, const float* grads, float* exp_avg,
+                  float* exp_avg_sq, size_t n, float lr, float beta1,
+                  float beta2, float eps, float weight_decay, int step) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  double p_norm_sq = 0.0, u_norm_sq = 0.0;
+#pragma omp parallel for schedule(static) reduction(+:p_norm_sq, u_norm_sq)
+  for (size_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float update = (m / bc1) / (std::sqrt(v / bc2) + eps)
+                   + weight_decay * params[i];
+    // stash update in-place trick is unsafe with two passes; recompute below
+    p_norm_sq += (double)params[i] * params[i];
+    u_norm_sq += (double)update * update;
+  }
+  float trust = 1.0f;
+  if (p_norm_sq > 0 && u_norm_sq > 0)
+    trust = (float)(std::sqrt(p_norm_sq) / std::sqrt(u_norm_sq));
+#pragma omp parallel for schedule(static)
+  for (size_t i = 0; i < n; ++i) {
+    float m = exp_avg[i];
+    float v = exp_avg_sq[i];
+    float update = (m / bc1) / (std::sqrt(v / bc2) + eps)
+                   + weight_decay * params[i];
+    params[i] -= lr * trust * update;
+  }
+}
+
+}  // extern "C"

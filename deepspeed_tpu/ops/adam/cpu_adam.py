@@ -1,0 +1,115 @@
+"""DeepSpeedCPUAdam — host-side SIMD Adam on numpy buffers (reference:
+deepspeed/ops/adam/cpu_adam.py over csrc/adam/cpu_adam_impl.cpp).
+
+Operates on flat fp32 master buffers in host DRAM; the fused bf16-emit variant
+produces the device working copy in the same pass.  Backed by the C++ op
+(csrc/adam/cpu_adam.cpp) built through op_builder.
+"""
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from op_builder import CPUAdamBuilder, load_op
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True, amsgrad: bool = False,
+                 fp32_optimizer_states: bool = True):
+        assert not amsgrad, "amsgrad not supported"
+        self.lr = float(lr)
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self._lib = load_op(CPUAdamBuilder())
+        self._lib.ds_adam_step.restype = None
+        self._lib.ds_adam_step_bf16_out.restype = None
+
+    @staticmethod
+    def _ptr(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             exp_avg_sq: np.ndarray, lr: Optional[float] = None,
+             out_bf16: Optional[np.ndarray] = None,
+             step: Optional[int] = None):
+        """One in-place Adam step on flat fp32 arrays; optionally emits the
+        updated params as bf16 (uint16 view) into ``out_bf16``.
+
+        ``step`` (1-based) sets the bias-correction step explicitly; when the
+        caller updates many tensors belonging to one optimizer step it MUST
+        pass it, otherwise the internal counter advances per tensor."""
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        n = params.size
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        else:
+            self.step_count = int(step)
+        lr = self.lr if lr is None else float(lr)
+        args = (self._ptr(params), self._ptr(grads), self._ptr(exp_avg),
+                self._ptr(exp_avg_sq))
+        if out_bf16 is not None:
+            assert out_bf16.dtype == np.uint16 and out_bf16.size == n
+            self._lib.ds_adam_step_bf16_out(
+                *args, out_bf16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                ctypes.c_size_t(n), ctypes.c_float(lr),
+                ctypes.c_float(self.beta1), ctypes.c_float(self.beta2),
+                ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+                ctypes.c_int(int(step)), ctypes.c_int(int(self.adamw_mode)))
+        else:
+            self._lib.ds_adam_step(
+                *args, ctypes.c_size_t(n), ctypes.c_float(lr),
+                ctypes.c_float(self.beta1), ctypes.c_float(self.beta2),
+                ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+                ctypes.c_int(int(step)), ctypes.c_int(int(self.adamw_mode)))
+
+
+class DeepSpeedCPUAdagrad:
+    """reference: deepspeed/ops/adagrad/cpu_adagrad.py"""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr, self.eps, self.weight_decay = float(lr), float(eps), float(weight_decay)
+        self._lib = load_op(CPUAdamBuilder())
+        self._lib.ds_adagrad_step.restype = None
+
+    def step(self, params, grads, exp_avg_sq, lr=None):
+        n = params.size
+        self._lib.ds_adagrad_step(
+            DeepSpeedCPUAdam._ptr(params), DeepSpeedCPUAdam._ptr(grads),
+            DeepSpeedCPUAdam._ptr(exp_avg_sq), ctypes.c_size_t(n),
+            ctypes.c_float(self.lr if lr is None else lr),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay))
+
+
+class DeepSpeedCPULamb:
+    """Host LAMB with per-tensor trust ratio (reference: csrc/lamb capability)."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.0):
+        self.lr = float(lr)
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+        self.eps, self.weight_decay = float(eps), float(weight_decay)
+        self.step_count = 0
+        self._lib = load_op(CPUAdamBuilder())
+        self._lib.ds_lamb_step.restype = None
+
+    def step(self, params, grads, exp_avg, exp_avg_sq, lr=None, step=None):
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        else:
+            self.step_count = int(step)
+        self._lib.ds_lamb_step(
+            DeepSpeedCPUAdam._ptr(params), DeepSpeedCPUAdam._ptr(grads),
+            DeepSpeedCPUAdam._ptr(exp_avg), DeepSpeedCPUAdam._ptr(exp_avg_sq),
+            ctypes.c_size_t(params.size),
+            ctypes.c_float(self.lr if lr is None else lr),
+            ctypes.c_float(self.beta1), ctypes.c_float(self.beta2),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+            ctypes.c_int(int(step)))

@@ -1,0 +1,83 @@
+"""Async I/O handle (reference: deepspeed/ops/aio over csrc/aio — the
+``aio_handle`` pybind object with async pread/pwrite + wait)."""
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from op_builder import AsyncIOBuilder, load_op
+
+
+class AsyncIOHandle:
+    """Thread-pool async file reader/writer for numpy buffers.
+
+    Mirrors the reference handle API: ``async_pread``/``async_pwrite`` submit
+    and return immediately; ``wait()`` blocks until all in-flight requests
+    complete and returns the number of failures.
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 thread_count: int = 4):
+        self._lib = load_op(AsyncIOBuilder())
+        self._lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        self._lib.ds_aio_wait.restype = ctypes.c_long
+        self._lib.ds_aio_inflight.restype = ctypes.c_long
+        self._lib.ds_aio_pread.restype = ctypes.c_int
+        self._lib.ds_aio_pwrite.restype = ctypes.c_int
+        self._h = ctypes.c_void_p(
+            self._lib.ds_aio_handle_new(ctypes.c_int(thread_count)))
+        self.block_size = block_size
+        self.thread_count = thread_count
+        # keep submitted buffers alive until wait()
+        self._pinned = []
+
+    def _buf_ptr(self, arr: np.ndarray):
+        assert arr.flags.c_contiguous
+        return arr.ctypes.data_as(ctypes.c_char_p)
+
+    def async_pread(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        rc = self._lib.ds_aio_pread(
+            self._h, filename.encode(), self._buf_ptr(buffer),
+            ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
+        if rc == 0:
+            self._pinned.append(buffer)
+        return rc
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        rc = self._lib.ds_aio_pwrite(
+            self._h, filename.encode(), self._buf_ptr(buffer),
+            ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
+        if rc == 0:
+            self._pinned.append(buffer)
+        return rc
+
+    def sync_pread(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        rc = self.async_pread(buffer, filename, offset)
+        if rc == 0:
+            rc = -self.wait()
+        return rc
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
+        rc = self.async_pwrite(buffer, filename, offset)
+        if rc == 0:
+            rc = -self.wait()
+        return rc
+
+    def wait(self) -> int:
+        errors = self._lib.ds_aio_wait(self._h)
+        self._pinned.clear()
+        return int(errors)
+
+    def inflight(self) -> int:
+        return int(self._lib.ds_aio_inflight(self._h))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._lib.ds_aio_handle_free(h)
+            except Exception:
+                pass
+            self._h = None

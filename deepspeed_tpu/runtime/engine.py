@@ -111,6 +111,9 @@ class DeepSpeedEngine:
             stage=zc.stage, topology=self.topology,
             param_persistence_threshold=(zc.param_persistence_threshold
                                          if zc.stage >= 3 else 0))
+        off = zc.offload_optimizer
+        self._offload_device = off.device if off is not None else "none"
+        self._offload = self._offload_device in ("cpu", "nvme")
 
         # ---- parameters ------------------------------------------------------
         # Parameters are *born sharded*: shapes come from eval_shape, the ZeRO
@@ -124,17 +127,20 @@ class DeepSpeedEngine:
             shapes = jax.eval_shape(model.init, init_rng)
         else:
             shapes = jax.eval_shape(lambda: model_parameters)
+        # with host offload, the device keeps only a compute-dtype working
+        # copy; fp32 masters live in host DRAM (reference ZeRO-Offload shape)
+        storage_dtype = self.compute_dtype if self._offload else jnp.float32
         shapes = jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, jnp.float32)
+            lambda s: jax.ShapeDtypeStruct(s.shape, storage_dtype)
             if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
         self.param_specs = self.zero_policy.param_specs(shapes, logical)
         self.param_shardings = self.zero_policy.shardings(self.param_specs)
         if model_parameters is None:
             params = jax.jit(
-                lambda r: _tree_cast(model.init(r), jnp.float32),
+                lambda r: _tree_cast(model.init(r), storage_dtype),
                 out_shardings=self.param_shardings)(init_rng)
         else:
-            params = jax.device_put(_tree_cast(model_parameters, jnp.float32),
+            params = jax.device_put(_tree_cast(model_parameters, storage_dtype),
                                     self.param_shardings)
         self.grad_specs = self.zero_policy.grad_specs(params, logical)
         self.grad_shardings = self.zero_policy.shardings(self.grad_specs)
@@ -151,30 +157,55 @@ class DeepSpeedEngine:
             self.lr_schedule = lr_scheduler
         self.base_lr = base_lr
 
-        if optimizer is not None and isinstance(optimizer, optax.GradientTransformation):
-            inner = optimizer
+        self.host_optimizer = None
+        if self._offload:
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            nvme_swapper = None
+            if self._offload_device == "nvme":
+                import tempfile
+                from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+                swap_dir = (self._config.zero_config.offload_optimizer.nvme_path
+                            or tempfile.mkdtemp(prefix="ds_nvme_"))
+                nvme_swapper = AsyncTensorSwapper(
+                    os.path.join(str(swap_dir), "zero_stage_offload"),
+                    aio_config=self._config.aio_config)
+            self.host_optimizer = HostOffloadOptimizer(
+                params, self._config.optimizer_name,
+                self._config.optimizer_params,
+                gradient_clipping=self._config.gradient_clipping,
+                lr_schedule=self.lr_schedule,
+                nvme_swapper=nvme_swapper)
+            self.optimizer = self.host_optimizer
+            opt_state = ()
+            self.opt_specs = ()
+            self.opt_shardings = ()
         else:
-            inner = build_optimizer(self._config.optimizer_name,
-                                    self._config.optimizer_params,
-                                    lr_schedule=self.lr_schedule)
-        chain = []
-        if self._config.gradient_clipping > 0:
-            chain.append(optax.clip_by_global_norm(self._config.gradient_clipping))
-        chain.append(inner)
-        self.optimizer = optax.chain(*chain) if len(chain) > 1 else inner
+            if optimizer is not None and isinstance(
+                    optimizer, optax.GradientTransformation):
+                inner = optimizer
+            else:
+                inner = build_optimizer(self._config.optimizer_name,
+                                        self._config.optimizer_params,
+                                        lr_schedule=self.lr_schedule)
+            chain = []
+            if self._config.gradient_clipping > 0:
+                chain.append(
+                    optax.clip_by_global_norm(self._config.gradient_clipping))
+            chain.append(inner)
+            self.optimizer = optax.chain(*chain) if len(chain) > 1 else inner
 
-        opt_state = jax.eval_shape(self.optimizer.init, params)
-        self.opt_specs = optax.tree_map_params(
-            self.optimizer,
-            lambda _, spec: spec,
-            opt_state, opt_param_specs,
-            transform_non_params=lambda _: P())
-        self.opt_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), self.opt_specs,
-            is_leaf=lambda x: isinstance(x, P))
-        with self.mesh:
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=self.opt_shardings)(params)
+            opt_state = jax.eval_shape(self.optimizer.init, params)
+            self.opt_specs = optax.tree_map_params(
+                self.optimizer,
+                lambda _, spec: spec,
+                opt_state, opt_param_specs,
+                transform_non_params=lambda _: P())
+            self.opt_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self.opt_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            with self.mesh:
+                opt_state = jax.jit(self.optimizer.init,
+                                    out_shardings=self.opt_shardings)(params)
 
         # ---- loss scaling ----------------------------------------------------
         f = self._config.fp16
@@ -419,6 +450,33 @@ class DeepSpeedEngine:
                 grad_fn,
                 out_shardings=(None, self.grad_shardings),
                 donate_argnums=(3,))
+        elif name == "grad_step":
+            # offload path: scan the gas micro-batches, stop at gradients
+            gas = self.gradient_accumulation_steps()
+            policy, grad_specs = self.zero_policy, self.grad_specs
+
+            def grad_step(state, stacked_batch, rng):
+                params = state["params"]
+                scale = (state["scaler"].cur_scale
+                         if self._config.fp16.enabled else jnp.float32(1.0))
+
+                def micro(carry, mb):
+                    grads_acc, loss_acc = carry
+                    loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
+                        params, mb, rng, scale / gas)
+                    grads = _tree_cast(grads, jnp.float32)
+                    grads = policy.constrain_grads(grads, grad_specs)
+                    return (jax.tree.map(jnp.add, grads_acc, grads),
+                            loss_acc + loss), None
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zeros = policy.constrain_grads(zeros, grad_specs)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zeros, jnp.float32(0.0)), stacked_batch)
+                return loss_sum / scale, grads
+
+            fn = jax.jit(grad_step, out_shardings=(None, self.grad_shardings))
         elif name == "apply":
             fn = jax.jit(
                 self._apply_grads,
@@ -489,8 +547,13 @@ class DeepSpeedEngine:
                     f"train_batch(batch=...) leaves must lead with gas={gas}, "
                     f"got {lead}")
         batch = self._shard_batch(batch, stacked=True)
-        fn = self._get_compiled("train_step")
-        self.state, metrics = fn(self.state, batch, self._next_rng())
+        if self._offload:
+            loss, grads = self._get_compiled("grad_step")(
+                self.state, batch, self._next_rng())
+            metrics = self._host_apply(grads, loss)
+        else:
+            fn = self._get_compiled("train_step")
+            self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
         self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics["loss"])
         return metrics["loss"]
@@ -530,12 +593,42 @@ class DeepSpeedEngine:
             return
         if self._micro_grads is None:
             raise RuntimeError("step() called without accumulated gradients")
-        self.state, metrics = self._get_compiled("apply")(
-            self.state, self._micro_grads)
+        if self._offload:
+            metrics = self._host_apply(self._micro_grads, self._last_loss)
+        else:
+            self.state, metrics = self._get_compiled("apply")(
+                self.state, self._micro_grads)
+            if self._last_loss is not None:
+                metrics["loss"] = self._last_loss
         self._micro_grads = None
-        if self._last_loss is not None:
-            metrics["loss"] = self._last_loss
         self._finish_step(metrics)
+
+    def _host_apply(self, grads, loss):
+        """Offload epilogue: unscale on host, C++ optimizer step in host DRAM
+        (or NVMe-streamed moments), upload compute-dtype working params."""
+        import numpy as np_
+        from deepspeed_tpu.runtime.fp16.loss_scaler import update_scale
+        fp16 = self._config.fp16.enabled
+        scaler = self.state["scaler"]
+        scale = float(scaler.cur_scale) if fp16 else 1.0
+        if scale != 1.0:
+            grads = jax.tree.map(lambda g: g / scale, grads)
+        step_index = int(self.state["step"])
+        new_params, grad_norm, overflow = self.host_optimizer.step(
+            grads, step_index, self.compute_dtype)
+        if not overflow:
+            self.state["params"] = jax.device_put(new_params,
+                                                  self.param_shardings)
+            self.state["step"] = self.state["step"] + 1
+        if fp16:
+            self.state["scaler"] = update_scale(
+                scaler, jnp.bool_(overflow), self.scaler_config)
+        return {
+            "loss": loss if loss is not None else jnp.float32(0.0),
+            "grad_norm": jnp.float32(grad_norm),
+            "overflow": jnp.bool_(overflow),
+            "loss_scale": self.state["scaler"].cur_scale,
+        }
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch, stacked=False)
@@ -586,6 +679,16 @@ class DeepSpeedEngine:
             "config": self._config._param_dict,
         }
         save_state(ckpt_dir, self.state, extra)
+        if self.host_optimizer is not None:
+            import numpy as np_
+            sd = self.host_optimizer.state_dict()
+            flat = {"step_count": np_.int64(sd["step_count"])}
+            for p, arr in sd["master"].items():
+                flat[f"master::{p}"] = arr
+            for p, moments in sd["moments"].items():
+                for j, mbuf in enumerate(moments):
+                    flat[f"moment{j}::{p}"] = mbuf
+            np_.savez(os.path.join(ckpt_dir, "host_optimizer.npz"), **flat)
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
@@ -609,6 +712,23 @@ class DeepSpeedEngine:
             ckpt_dir, self.state, self.state_shardings,
             load_optimizer_states=load_optimizer_states and not load_module_only)
         self.state = state
+        host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
+        if self.host_optimizer is not None and os.path.exists(host_path) \
+                and load_optimizer_states and not load_module_only:
+            import numpy as np_
+            flat = np_.load(host_path)
+            sd = {"master": {}, "moments": {},
+                  "step_count": int(flat["step_count"])}
+            for key in flat.files:
+                if key.startswith("master::"):
+                    sd["master"][key[len("master::"):]] = flat[key]
+                elif key.startswith("moment"):
+                    j, p = key.split("::", 1)
+                    sd["moments"].setdefault(p, {})[int(j[len("moment"):])] = \
+                        flat[key]
+            sd["moments"] = {p: [d[j] for j in sorted(d)]
+                             for p, d in sd["moments"].items()}
+            self.host_optimizer.load_state_dict(sd)
         self.global_steps = extra.get("global_steps", 0)
         self.global_samples = extra.get("global_samples", 0)
         self.skipped_steps = extra.get("skipped_steps", 0)

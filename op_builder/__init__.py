@@ -1,0 +1,4 @@
+from op_builder.builder import (OpBuilder, CPUAdamBuilder, AsyncIOBuilder,
+                                load_op)
+
+ALL_OPS = {b.NAME: b for b in (CPUAdamBuilder, AsyncIOBuilder)}

@@ -1,0 +1,117 @@
+"""Native-op tests vs Python references (reference pattern:
+tests/unit/ops/adam/test_cpu_adam.py compares the C++ op against torch)."""
+import os
+import numpy as np
+import pytest
+
+
+def _ref_adamw(p, g, m, v, lr, b1, b2, eps, wd, step):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    mhat = m2 / (1 - b1 ** step)
+    vhat = v2 / (1 - b2 ** step)
+    p2 = p * (1 - lr * wd) - lr * mhat / (np.sqrt(vhat) + eps)
+    return p2, m2, v2
+
+
+def test_cpu_adam_matches_reference():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(0)
+    n = 4097
+    p = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    pr, mr, vr = p.copy(), m.copy(), v.copy()
+    opt = DeepSpeedCPUAdam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                           weight_decay=0.01, adamw_mode=True)
+    for step in range(1, 4):
+        opt.step(p, g, m, v)
+        pr, mr, vr = _ref_adamw(pr, g, mr, vr, 1e-3, 0.9, 0.999, 1e-8, 0.01,
+                                step)
+    np.testing.assert_allclose(p, pr, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m, mr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(v, vr, rtol=1e-5, atol=1e-7)
+
+
+def test_cpu_adam_bf16_out():
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(1)
+    n = 1024
+    p = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    out = np.zeros(n, np.uint16)
+    DeepSpeedCPUAdam(lr=1e-2).step(p, g, m, v, out_bf16=out)
+    back = np.asarray(out.view(jnp.bfloat16).astype(np.float32))
+    np.testing.assert_allclose(back, p, rtol=0.01, atol=1e-3)
+
+
+def test_cpu_adagrad():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdagrad
+    n = 256
+    p = np.ones(n, np.float32)
+    g = np.full(n, 0.5, np.float32)
+    v = np.zeros(n, np.float32)
+    DeepSpeedCPUAdagrad(lr=0.1).step(p, g, v)
+    np.testing.assert_allclose(v, 0.25, rtol=1e-6)
+    np.testing.assert_allclose(p, 1.0 - 0.1 * 0.5 / (0.5 + 1e-10), rtol=1e-5)
+
+
+def test_cpu_lamb_trust_ratio():
+    from deepspeed_tpu.ops.adam import DeepSpeedCPULamb
+    rng = np.random.default_rng(2)
+    n = 512
+    p = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    p0 = p.copy()
+    DeepSpeedCPULamb(lr=1e-2).step(p, g, m, v)
+    assert not np.allclose(p, p0)
+    assert np.isfinite(p).all()
+
+
+def test_aio_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(thread_count=2)
+    data = np.arange(100_000, dtype=np.float32)
+    path = str(tmp_path / "swap.bin")
+    assert h.async_pwrite(data, path) == 0
+    assert h.wait() == 0
+    out = np.zeros_like(data)
+    assert h.async_pread(out, path) == 0
+    assert h.wait() == 0
+    np.testing.assert_array_equal(out, data)
+
+
+def test_aio_offset_and_parallel(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(thread_count=4)
+    path = str(tmp_path / "multi.bin")
+    chunks = [np.full(1000, i, dtype=np.float32) for i in range(8)]
+    for i, c in enumerate(chunks):
+        assert h.async_pwrite(c, path, offset=i * c.nbytes) == 0
+    assert h.wait() == 0
+    for i in range(8):
+        out = np.zeros(1000, np.float32)
+        assert h.sync_pread(out, path, offset=i * 4000) == 0
+        np.testing.assert_array_equal(out, chunks[i])
+
+
+def test_aio_missing_file_errors():
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(thread_count=1)
+    buf = np.zeros(10, np.float32)
+    assert h.async_pread(buf, "/nonexistent/path/file.bin") == -1
+
+
+def test_op_builder_cache():
+    from op_builder import CPUAdamBuilder
+    b = CPUAdamBuilder()
+    assert b.is_compatible()
+    so1 = b.so_path()
+    b.jit_load()
+    assert os.path.exists(so1)
