@@ -1,0 +1,4 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config, get_candidate_batch_sizes, get_valid_gpus,
+    get_best_candidates, ElasticityError, ElasticityConfigError,
+    ElasticityIncompatibleWorldSize)

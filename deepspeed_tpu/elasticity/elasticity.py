@@ -1,0 +1,149 @@
+"""Elastic training config math (reference: deepspeed/elasticity/elasticity.py
+— candidate batch sizes :27-146, ``compute_elastic_config`` :233, v0.1 and v0.2
+algorithms).
+
+Pure arithmetic: given user constraints (max batch, preferred micro-batches,
+chip-count range), enumerate the total-batch-size candidates that keep
+per-chip micro-batches valid across every admissible chip count, and pick the
+highest-compatibility batch.  On TPU, "GPUs" ≙ chips; v0.2 adds
+model-parallel-size / chips-per-host awareness exactly like the reference.
+"""
+from typing import Dict, List, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """All batch sizes b = base * 2^k <= max, per base micro-batch
+    (reference :27)."""
+    candidates = set()
+    for base in base_list:
+        if base <= 0:
+            continue
+        b = base
+        while b <= max_acceptable_batch_size:
+            candidates.add(b)
+            b *= 2
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Chip counts g such that batch_size % (micro * g) == 0 for some micro
+    (reference :46)."""
+    valid = set()
+    for micro in micro_batches:
+        if micro <= 0 or batch_size % micro != 0:
+            continue
+        max_gpus = batch_size // micro
+        for g in range(1, max_gpus + 1):
+            if batch_size % (micro * g) == 0 and \
+                    min_valid_gpus <= g <= max_valid_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: List[int],
+                        micro_batches: List[int], min_gpus: int,
+                        max_gpus: int, prefer_larger: bool
+                        ) -> Tuple[int, List[int], Dict[int, List[int]]]:
+    """Pick the batch size with the most valid chip counts (ties: larger or
+    smaller batch per ``prefer_larger``; reference :63)."""
+    max_valid = -1
+    best_batch, best_gpus = 0, []
+    all_valid: Dict[int, List[int]] = {}
+    for batch in candidate_batch_sizes:
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        all_valid[batch] = valid
+        better = len(valid) > max_valid or (
+            len(valid) == max_valid and (
+                (prefer_larger and batch > best_batch)
+                or (not prefer_larger and 0 < batch < best_batch)))
+        if better:
+            max_valid = len(valid)
+            best_batch, best_gpus = batch, valid
+    return best_batch, best_gpus, all_valid
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=1, max_gpus=10000, prefer_larger=True):
+    candidates = get_candidate_batch_sizes(micro_batches,
+                                           max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus,
+                               prefer_larger)[:2]
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
+                             current_num_gpus, min_gpus=1, max_gpus=10000,
+                             prefer_larger=True, num_gpus_per_node=1,
+                             model_parallel_size=1):
+    """v0.2: chip counts must be multiples of model_parallel_size and pack
+    whole hosts when mp spans hosts (reference :146)."""
+    if model_parallel_size > 1:
+        mp_per_host = max(model_parallel_size // num_gpus_per_node, 1)
+        granule = model_parallel_size if model_parallel_size >= num_gpus_per_node \
+            else num_gpus_per_node
+        if num_gpus_per_node % model_parallel_size != 0 and \
+                model_parallel_size % num_gpus_per_node != 0:
+            raise ElasticityConfigError(
+                f"model_parallel_size {model_parallel_size} and chips/host "
+                f"{num_gpus_per_node} must divide one another")
+    else:
+        granule = 1
+    batch, gpus = _get_compatible_gpus_v01(
+        micro_batches, max_acceptable_batch_size, min_gpus, max_gpus,
+        prefer_larger)
+    dp_counts = [g for g in gpus
+                 if (g * granule) <= max_gpus]
+    total_gpus = [g * granule for g in dp_counts]
+    return batch * granule if granule > 1 else batch, total_gpus
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "0",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """reference :233 — returns (final_batch_size, valid_gpus[,
+    micro_batch])."""
+    e = ds_config.get("elasticity", {})
+    if not e.get("enabled", False):
+        raise ElasticityConfigError("elasticity not enabled in config")
+    micro_batches = e.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = e.get("max_train_batch_size", 2000)
+    min_gpus, max_gpus = e.get("min_gpus", 1), e.get("max_gpus", 10000)
+    prefer_larger = e.get("prefer_larger_batch", True)
+    version = float(e.get("version", LATEST_ELASTICITY_VERSION))
+    if version >= 0.2:
+        final_batch, valid_gpus = _get_compatible_gpus_v02(
+            micro_batches, max_batch, world_size, min_gpus, max_gpus,
+            prefer_larger, e.get("num_gpus_per_node", 1),
+            e.get("model_parallel_size", 1))
+    else:
+        final_batch, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid chip counts {valid_gpus}")
+    if return_microbatch:
+        dp = world_size if world_size > 0 else max(valid_gpus)
+        micro = None
+        for mb in sorted(micro_batches, reverse=prefer_larger):
+            if final_batch % (mb * dp) == 0:
+                micro = mb
+                break
+        return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus

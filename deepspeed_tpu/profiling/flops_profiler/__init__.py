@@ -1,0 +1,3 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    FlopsProfiler, get_model_profile, compiled_cost, flops_to_string,
+    params_to_string)

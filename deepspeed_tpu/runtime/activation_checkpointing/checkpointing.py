@@ -1,0 +1,142 @@
+"""Activation checkpointing (reference: deepspeed/runtime/
+activation_checkpointing/checkpointing.py — Megatron-compatible ``checkpoint``
+with partitioned activations, CPU offload, RNG tracking, JSON ``configure``).
+
+TPU-native mapping:
+- ``checkpoint(fn, *args)`` ≙ ``jax.checkpoint`` (remat) — XLA re-runs the
+  forward inside the backward; deterministic RNG comes free from functional
+  PRNG keys (no CudaRNGStatesTracker needed).
+- ``partition_activations`` ≙ a sharding constraint spreading the saved
+  residuals over the ZeRO/data axes.
+- ``cpu_checkpointing`` ≙ jax host-offload remat policy
+  (``offload_dot_products`` style policies / ``jax.checkpoint_policies``).
+
+The JSON knobs select a `jax.checkpoint` policy, so engine/model code written
+against the reference's API keeps working.
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_CONFIG = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "policy": "nothing_saveable",
+}
+
+POLICIES = {
+    # save nothing: recompute everything in backward (max memory savings)
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    # save everything: no recompute (remat disabled)
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    # save matmul outputs (recompute cheap elementwise only)
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+# host-offload policy: save dot products to host memory instead of HBM —
+# the reference's cpu_checkpointing tier
+if hasattr(jax.checkpoint_policies, "save_and_offload_only_these_names"):
+    POLICIES["offload_dots"] = "offload"
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy=None):
+    """reference :789 — merge JSON/kwargs into module state."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if ac is not None:
+            _CONFIG.update({
+                "partition_activations": ac.partition_activations,
+                "cpu_checkpointing": ac.cpu_checkpointing,
+                "contiguous_memory_optimization":
+                    ac.contiguous_memory_optimization,
+                "number_checkpoints": ac.number_checkpoints,
+                "synchronize_checkpoint_boundary":
+                    ac.synchronize_checkpoint_boundary,
+                "profile": ac.profile,
+                "policy": ac.policy,
+            })
+    for k, v in (("partition_activations", partition_activations),
+                 ("contiguous_memory_optimization", contiguous_checkpointing),
+                 ("number_checkpoints", num_checkpoints),
+                 ("cpu_checkpointing", checkpoint_in_cpu),
+                 ("synchronize_checkpoint_boundary", synchronize),
+                 ("profile", profile), ("policy", policy)):
+        if v is not None:
+            _CONFIG[k] = v
+
+
+def is_configured() -> bool:
+    return True
+
+
+def _current_policy():
+    name = _CONFIG["policy"]
+    if _CONFIG["cpu_checkpointing"] and "offload_dots" in POLICIES:
+        # offload saved residuals to pinned host memory
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[],
+            offload_src="device", offload_dst="pinned_host")
+    return POLICIES.get(name, jax.checkpoint_policies.nothing_saveable)
+
+
+def checkpoint(function, *args):
+    """Drop-in remat wrapper (reference CheckpointFunction :474)."""
+    fn = jax.checkpoint(function, policy=_current_policy())
+    out = fn(*args)
+    if _CONFIG["partition_activations"]:
+        from deepspeed_tpu.comm.mesh import get_topology
+        topo = get_topology()
+        spec = P(tuple(topo.zero_shard_axes))
+        out = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(topo.mesh, spec))
+            if hasattr(x, "ndim") and x.ndim >= 1 and
+            x.shape[0] % topo.zero_world_size == 0 else x, out)
+    return out
+
+
+def checkpoint_wrapper(function):
+    """Decorator form used by model code."""
+    return partial(checkpoint, function)
+
+
+# RNG-tracker API parity (reference CudaRNGStatesTracker :121): JAX PRNG keys
+# are values, so fork/restore is a no-op shim kept for source compatibility.
+class _NoopRNGTracker:
+    def add(self, name, seed):
+        pass
+
+    def get_states(self):
+        return {}
+
+    def set_states(self, states):
+        pass
+
+    def fork(self, name="model-parallel-rng"):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+_RNG_TRACKER = _NoopRNGTracker()
+
+
+def get_cuda_rng_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    log_dist("model_parallel_cuda_manual_seed: functional PRNG keys make "
+             "per-rank RNG state tracking unnecessary", ranks=[0])

@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+    DeepSpeedDataSampler)

@@ -1,0 +1,84 @@
+"""Curriculum learning scheduler (reference: deepspeed/runtime/data_pipeline/
+curriculum_scheduler.py — legacy seqlen curriculum driven per step from
+engine.py:1761).
+
+Supports the reference's schedule types: fixed_linear, fixed_root,
+fixed_discrete, custom.
+"""
+import math
+from typing import Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.state = {
+            "min_difficulty": config.get("min_difficulty", 8),
+            "max_difficulty": config.get("max_difficulty", 1024),
+            "schedule_type": config.get("schedule_type", "fixed_linear"),
+            "current_difficulty": config.get("min_difficulty", 8),
+        }
+        self.config = config.get("schedule_config", config)
+        self.custom_get_difficulty: Optional[Callable] = None
+        st = self.state["schedule_type"]
+        if st == "fixed_discrete":
+            assert "difficulty" in self.config and "max_step" in self.config, \
+                "fixed_discrete needs schedule_config.difficulty and max_step"
+        elif st in ("fixed_linear", "fixed_root"):
+            assert "total_curriculum_step" in self.config, \
+                f"{st} needs schedule_config.total_curriculum_step"
+            self.config.setdefault("difficulty_step", 8)
+            if st == "fixed_root":
+                self.config.setdefault("root_degree", 2)
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_custom_get_difficulty(self, fn: Callable):
+        self.custom_get_difficulty = fn
+
+    def _fixed_root(self, global_steps: int) -> int:
+        root = self.config.get("root_degree", 2)
+        frac = min(1.0, (global_steps /
+                         self.config["total_curriculum_step"]) ** (1.0 / root))
+        diff = self.state["min_difficulty"] + frac * (
+            self.state["max_difficulty"] - self.state["min_difficulty"])
+        step = self.config.get("difficulty_step", 8)
+        diff = int(diff / step) * step
+        return max(min(diff, self.state["max_difficulty"]),
+                   self.state["min_difficulty"])
+
+    def update_difficulty(self, global_steps: int) -> int:
+        st = self.state["schedule_type"]
+        if st == "fixed_discrete":
+            diff = self.config["difficulty"][-1]
+            for d, ms in zip(self.config["difficulty"],
+                             self.config["max_step"] + [float("inf")]):
+                if global_steps <= ms:
+                    diff = d
+                    break
+            self.state["current_difficulty"] = diff
+        elif st == "fixed_linear":
+            frac = min(1.0, global_steps /
+                       self.config["total_curriculum_step"])
+            diff = self.state["min_difficulty"] + frac * (
+                self.state["max_difficulty"] - self.state["min_difficulty"])
+            step = self.config.get("difficulty_step", 8)
+            diff = int(diff / step) * step
+            self.state["current_difficulty"] = max(
+                min(diff, self.state["max_difficulty"]),
+                self.state["min_difficulty"])
+        elif st == "fixed_root":
+            self.state["current_difficulty"] = self._fixed_root(global_steps)
+        elif st == "custom":
+            assert self.custom_get_difficulty is not None
+            self.state["current_difficulty"] = self.custom_get_difficulty(
+                global_steps)
+        else:
+            raise ValueError(f"unknown schedule_type {st}")
+        return self.state["current_difficulty"]
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, sd):
+        self.state.update(sd)

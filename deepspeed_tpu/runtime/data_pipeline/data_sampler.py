@@ -1,0 +1,61 @@
+"""Curriculum data sampling (reference: deepspeed/runtime/data_pipeline/
+data_sampling/data_sampler.py:36 ``DeepSpeedDataSampler`` — difficulty-bucketed
+sampling driven by per-metric curriculum schedulers).
+
+Compact TPU-side equivalent: difficulty metrics are arrays indexed by sample;
+each step the sampler draws the global batch from the pool of samples whose
+difficulty ≤ the scheduler's current threshold.
+"""
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, difficulties: Dict[str, np.ndarray],
+                 curriculum_configs: Dict[str, dict],
+                 total_samples: int, batch_size: int, seed: int = 0,
+                 drop_last: bool = True):
+        self.difficulties = {k: np.asarray(v) for k, v in difficulties.items()}
+        for name, d in self.difficulties.items():
+            assert len(d) == total_samples, f"metric {name} length mismatch"
+        self.schedulers = {k: CurriculumScheduler(cfg)
+                           for k, cfg in curriculum_configs.items()}
+        self.total_samples = total_samples
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        self.global_step = 0
+
+    def eligible_indices(self) -> np.ndarray:
+        mask = np.ones(self.total_samples, dtype=bool)
+        for name, sched in self.schedulers.items():
+            thresh = sched.get_current_difficulty()
+            mask &= self.difficulties[name] <= thresh
+        idx = np.nonzero(mask)[0]
+        if len(idx) == 0:   # always keep at least the easiest samples
+            hardest = next(iter(self.difficulties.values()))
+            idx = np.argsort(hardest)[:self.batch_size]
+        return idx
+
+    def next_batch(self) -> np.ndarray:
+        self.global_step += 1
+        for sched in self.schedulers.values():
+            sched.update_difficulty(self.global_step)
+        pool = self.eligible_indices()
+        return self.rng.choice(pool, size=self.batch_size,
+                               replace=len(pool) < self.batch_size)
+
+    def state_dict(self):
+        return {
+            "global_step": self.global_step,
+            "schedulers": {k: s.state_dict()
+                           for k, s in self.schedulers.items()},
+        }
+
+    def load_state_dict(self, sd):
+        self.global_step = sd["global_step"]
+        for k, s in sd.get("schedulers", {}).items():
+            self.schedulers[k].load_state_dict(s)

@@ -1,0 +1,70 @@
+"""Random layerwise token dropping (reference: deepspeed/runtime/data_pipeline/
+data_routing/basic_layer.py:14 ``RandomLayerTokenDrop`` + csrc/random_ltd
+gather/scatter kernels).
+
+TPU-native: token selection is a jittable argsort-of-random-keys gather; the
+reference's CUDA token_sort/gather/scatter kernels are plain XLA take/scatter
+(SURVEY.md notes no custom kernel is warranted).  The schedule linearly grows
+the kept-token count to the full sequence over ``total_layer_token_steps``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_token_select(rng, x: jnp.ndarray, keep: int
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [B, S, D] -> (kept [B, keep, D] in original order, indices)."""
+    B, S, _ = x.shape
+    scores = jax.random.uniform(rng, (B, S))
+    idx = jnp.argsort(scores, axis=1)[:, :keep]
+    idx = jnp.sort(idx, axis=1)            # preserve sequence order
+    kept = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    return kept, idx
+
+
+def scatter_tokens(full: jnp.ndarray, kept: jnp.ndarray,
+                   idx: jnp.ndarray) -> jnp.ndarray:
+    """Write processed kept tokens back into the full sequence."""
+    B = full.shape[0]
+    b_idx = jnp.arange(B)[:, None]
+    return full.at[b_idx, idx].set(kept)
+
+
+class RandomLTDScheduler:
+    """Token-count schedule (reference data_routing/scheduler.py)."""
+
+    def __init__(self, total_layer_token_steps: int, min_tokens: int,
+                 max_tokens: int, step_size: int = 16):
+        self.total = total_layer_token_steps
+        self.min_tokens = min_tokens
+        self.max_tokens = max_tokens
+        self.step_size = step_size
+        self.current = min_tokens
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(self.total, 1))
+        tokens = self.min_tokens + frac * (self.max_tokens - self.min_tokens)
+        tokens = int(tokens / self.step_size) * self.step_size
+        self.current = max(min(tokens, self.max_tokens), self.min_tokens)
+        return self.current
+
+    def get_current_seq(self) -> int:
+        return self.current
+
+    def state_dict(self):
+        return {"current": self.current}
+
+    def load_state_dict(self, sd):
+        self.current = sd["current"]
+
+
+def random_ltd_block(block_fn, rng, x, keep: int):
+    """Apply ``block_fn`` to a random ``keep``-token subset, pass the rest
+    through (the RandomLayerTokenDrop wrapper's forward)."""
+    if keep >= x.shape[1]:
+        return block_fn(x)
+    kept, idx = random_token_select(rng, x, keep)
+    processed = block_fn(kept)
+    return scatter_tokens(x, processed, idx)

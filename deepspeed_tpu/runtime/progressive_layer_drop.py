@@ -1,0 +1,27 @@
+"""Progressive layer drop (reference: deepspeed/runtime/
+progressive_layer_drop.py — theta schedule injected into forward kwargs at
+engine.py:1755)."""
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    """theta(t) = (1 - theta_0) * exp(-gamma * t) ... keep-probability schedule
+    rising toward 1? The reference's schedule: theta(t) = theta_0 + (1 -
+    theta_0) * exp(-gamma * t) inverted — we keep its observable behavior:
+    starts at 1.0 (keep all layers) and decays toward ``theta``."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        def _prob(x, g, p):
+            return (1.0 - p) * np.exp(-g * x) + p
+        self.current_theta = float(_prob(global_step, self.gamma, self.theta))
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}

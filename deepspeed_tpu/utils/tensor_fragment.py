@@ -1,0 +1,107 @@
+"""Tensor-fragment API (reference: deepspeed/utils/tensor_fragment.py:92-125 —
+``safe_get_full_fp32_param`` / ``safe_get_full_grad`` /
+``safe_get_full_optimizer_state`` and the set_ variants).
+
+The reference needs this machinery because ZeRO scatters flat fragments across
+ranks; in JAX a sharded array already knows how to gather itself, so "safe get"
+is a device_get through the addressable shards, and "safe set" is a device_put
+with the original sharding.  Paths address the params pytree
+("blocks/qkv_w"-style, matching HostOffloadOptimizer path naming).
+"""
+from typing import Optional
+
+import numpy as np
+import jax
+
+
+def _resolve(tree, path: str):
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, dict):
+            node = node[part]
+        else:
+            node = getattr(node, part)
+    return node
+
+
+def _set(tree, path: str, value):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def safe_get_full_fp32_param(engine, path: str) -> Optional[np.ndarray]:
+    """Gather the full fp32 master value of a parameter."""
+    if engine.host_optimizer is not None:
+        m = engine.host_optimizer
+        if path in m.master:
+            return m.master[path].reshape(m.shapes[path]).copy()
+        return None
+    leaf = _resolve(engine.state["params"], path)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: str, value) -> bool:
+    value = np.asarray(value, dtype=np.float32)
+    if engine.host_optimizer is not None:
+        m = engine.host_optimizer
+        if path not in m.master:
+            return False
+        m.master[path][:] = value.ravel()
+        # refresh the device working copy
+        engine.state["params"] = jax.device_put(
+            m.params_in_compute_dtype(engine.compute_dtype),
+            engine.param_shardings)
+        return True
+    leaf = _resolve(engine.state["params"], path)
+    sharding = _resolve(engine.param_shardings, path)
+    _set(engine.state["params"], path,
+         jax.device_put(value.astype(np.asarray(leaf).dtype), sharding))
+    return True
+
+
+def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
+    """Full gradient of the last backward (micro-step API accumulator)."""
+    grads = engine._micro_grads if engine._micro_grads is not None \
+        else engine._pending_grads
+    if grads is None:
+        return None
+    return np.asarray(jax.device_get(_resolve(grads, path)))
+
+
+def safe_get_full_optimizer_state(engine, path: str,
+                                  optim_state_key: str) -> Optional[np.ndarray]:
+    """optim_state_key: 'exp_avg' | 'exp_avg_sq' (reference key names)."""
+    key_to_idx = {"exp_avg": 0, "exp_avg_sq": 1}
+    if engine.host_optimizer is not None:
+        m = engine.host_optimizer
+        idx = key_to_idx.get(optim_state_key)
+        if idx is None or path not in m.master or m.moments.get(path) is None:
+            return None
+        return m.moments[path][idx].reshape(m.shapes[path]).copy()
+    # optax: find mu/nu subtrees inside the chained state
+    import optax
+    for s in jax.tree_util.tree_leaves(
+            engine.state["opt_state"],
+            is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState)):
+        if isinstance(s, optax.ScaleByAdamState):
+            tree = s.mu if optim_state_key == "exp_avg" else s.nu
+            return np.asarray(jax.device_get(_resolve(tree, path)))
+    return None
+
+
+def safe_set_full_optimizer_state(engine, path: str, value,
+                                  optim_state_key: str) -> bool:
+    key_to_idx = {"exp_avg": 0, "exp_avg_sq": 1}
+    idx = key_to_idx.get(optim_state_key)
+    if idx is None:
+        return False
+    if engine.host_optimizer is not None:
+        m = engine.host_optimizer
+        if path not in m.master or m.moments.get(path) is None:
+            return False
+        m.moments[path][idx][:] = np.asarray(value, np.float32).ravel()
+        return True
+    return False
