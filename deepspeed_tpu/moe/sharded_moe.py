@@ -27,12 +27,22 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
     return max(cap, min_capacity)
 
 
-def _one_hot_dispatch(indices, gates_for_choice, num_experts, capacity):
+def _one_hot_dispatch(indices, gates_for_choice, num_experts, capacity,
+                      occupancy=None):
     """indices: [T] chosen expert per token; gates_for_choice: [T] weight.
-    Returns ([T,E,C] combine, [T,E,C] mask, per-expert counts [E])."""
+
+    ``occupancy`` [E] is the number of capacity slots already consumed by
+    earlier choice rounds; positions for this round start after it and the
+    capacity drop is applied to the offset position (reference
+    sharded_moe.py:304-318 ``locations2 += sum(mask1)``), so a token's top-1
+    and another token's top-2 for the same expert can never share a slot.
+    Returns ([T,E,C] combine, [T,E,C] mask, per-expert kept counts [E]).
+    """
     T = indices.shape[0]
     mask = jax.nn.one_hot(indices, num_experts, dtype=jnp.int32)     # [T, E]
     pos_in_expert = jnp.cumsum(mask, axis=0) * mask - mask           # [T, E]
+    if occupancy is not None:
+        pos_in_expert = pos_in_expert + occupancy[None, :] * mask
     within = pos_in_expert < capacity
     mask = mask * within.astype(jnp.int32)
     pos = jnp.sum(pos_in_expert * mask, axis=1)                      # [T]
@@ -86,9 +96,12 @@ def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float = 1.0,
     # normalise the k gate values per token (reference top2gating denominator)
     denom = sum(chosen_gates)
     denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    occupancy = jnp.zeros((E,), jnp.int32)
     for idx, g in zip(chosen_idx, chosen_gates):
-        combine, _, _ = _one_hot_dispatch(idx, g / denom, E, capacity)
+        combine, _, counts = _one_hot_dispatch(idx, g / denom, E, capacity,
+                                               occupancy=occupancy)
         combine_total = combine_total + combine
+        occupancy = occupancy + counts
 
     return GateOutput(l_aux, combine_total, combine_total > 0, z_loss)
 

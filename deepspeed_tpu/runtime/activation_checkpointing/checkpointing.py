@@ -81,13 +81,20 @@ def is_configured() -> bool:
     return True
 
 
+# residual names the in-tree models annotate via jax.ad_checkpoint.
+# checkpoint_name (models/gpt2.py "attn_out", llama/mixtral likewise) — the
+# host-offload tier saves these to pinned host DRAM instead of HBM
+OFFLOADABLE_NAMES = ["attn_out"]
+
+
 def _current_policy():
     name = _CONFIG["policy"]
     if _CONFIG["cpu_checkpointing"] and "offload_dots" in POLICIES:
-        # offload saved residuals to pinned host memory
+        # offload named residuals to pinned host memory (reference
+        # cpu_checkpointing, checkpointing.py:461)
         return jax.checkpoint_policies.save_and_offload_only_these_names(
             names_which_can_be_saved=[],
-            names_which_can_be_offloaded=[],
+            names_which_can_be_offloaded=list(OFFLOADABLE_NAMES),
             offload_src="device", offload_dst="pinned_host")
     return POLICIES.get(name, jax.checkpoint_policies.nothing_saveable)
 

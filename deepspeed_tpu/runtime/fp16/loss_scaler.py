@@ -27,6 +27,7 @@ class LossScalerConfig:
     scale_factor: float = 2.0
     min_scale: float = 1.0
     delayed_shift: int = 2           # hysteresis
+    consecutive_hysteresis: bool = False  # refill on every good step
 
 
 def create_loss_scaler(enabled: bool,
@@ -34,7 +35,8 @@ def create_loss_scaler(enabled: bool,
                        initial_scale_power: int = 16,
                        loss_scale_window: int = 1000,
                        hysteresis: int = 2,
-                       min_loss_scale: float = 1.0
+                       min_loss_scale: float = 1.0,
+                       consecutive_hysteresis: bool = False
                        ) -> Tuple[LossScaleState, LossScalerConfig]:
     if not enabled:
         state = LossScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(-1),
@@ -46,7 +48,8 @@ def create_loss_scaler(enabled: bool,
                            jnp.int32(hysteresis))
     cfg = LossScalerConfig(dynamic=dynamic, scale_window=int(loss_scale_window),
                            min_scale=float(min_loss_scale),
-                           delayed_shift=int(hysteresis))
+                           delayed_shift=int(hysteresis),
+                           consecutive_hysteresis=bool(consecutive_hysteresis))
     return state, cfg
 
 
@@ -81,9 +84,17 @@ def update_scale(state: LossScaleState, overflow: jnp.ndarray,
                           jnp.where(grow, state.cur_scale * cfg.scale_factor,
                                     state.cur_scale))
     new_last = jnp.where(overflow, state.cur_iter, state.last_overflow_iter)
-    # hysteresis refills on growth, not on shrink (reference: once exhausted,
-    # every further overflow shrinks immediately until a stable window passes)
-    new_hysteresis = jnp.where(grow, jnp.int32(cfg.delayed_shift),
-                               new_hysteresis)
+    if cfg.consecutive_hysteresis:
+        # reference fused_optimizer.py: with consecutive_hysteresis the budget
+        # refills on every non-overflow step, so only *consecutive* overflows
+        # can exhaust it and shrink the scale
+        new_hysteresis = jnp.where(jnp.logical_not(overflow),
+                                   jnp.int32(cfg.delayed_shift),
+                                   new_hysteresis)
+    else:
+        # hysteresis refills on growth, not on shrink (once exhausted, every
+        # further overflow shrinks immediately until a stable window passes)
+        new_hysteresis = jnp.where(grow, jnp.int32(cfg.delayed_shift),
+                                   new_hysteresis)
     return LossScaleState(new_scale, state.cur_iter + 1, new_last,
                           new_hysteresis)

@@ -10,6 +10,7 @@ instant and source edits rebuild automatically.
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 from typing import List, Optional
 
@@ -47,6 +48,19 @@ class OpBuilder:
             with open(src, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.cxx_args()).encode())
+        # -march=native makes the binary host-ISA-specific: key the cache on
+        # the machine identity too, so a cache dir moved across hosts rebuilds
+        # instead of dlopening a .so that may use unsupported instructions.
+        h.update(platform.machine().encode())
+        h.update(platform.processor().encode())
+        try:
+            with open("/proc/cpuinfo", "rb") as f:
+                for line in f:
+                    if line.startswith(b"flags"):
+                        h.update(line)
+                        break
+        except OSError:
+            pass
         return h.hexdigest()[:16]
 
     def so_path(self) -> str:

@@ -46,6 +46,27 @@ def test_aux_loss_uniform_vs_skewed():
     assert abs(l_uni - 1.0) < 0.3     # balanced -> E * E*(1/E^2) = 1
 
 
+def test_topk_no_slot_collisions():
+    """Round-1 advisor finding: per-choice cumsums restarting at zero let a
+    token's top-1 and another token's top-2 share an (expert, slot) pair,
+    corrupting the dispatch einsum.  Occupancy must carry across choices
+    (reference sharded_moe.py:304-318 offsets locations2 by mask1 counts)."""
+    T, E, K = 64, 4, 2
+    logits = jax.random.normal(jax.random.PRNGKey(3), (T, E))
+    out = topkgating(logits, K, capacity_factor=1.5, min_capacity=2)
+    per_slot = np.asarray(out.dispatch_mask).sum(axis=0)   # [E, C]
+    assert per_slot.max() <= 1, "an (expert, slot) pair holds >1 token"
+    cap = out.dispatch_mask.shape[-1]
+    per_expert = np.asarray(out.dispatch_mask).sum(axis=(0, 2))
+    assert per_expert.max() <= cap, "expert oversubscribed beyond capacity"
+    # with the generous capacity above, most tokens keep both choices: the
+    # combine weights for fully-kept tokens still sum to 1
+    w = np.asarray(out.combine_weights).sum(axis=(1, 2))
+    full = w[w > 0.99]
+    assert len(full) > T // 2
+    np.testing.assert_allclose(full, 1.0, atol=1e-5)
+
+
 def test_topk_matches_top2():
     logits = jax.random.normal(jax.random.PRNGKey(2), (32, 4))
     a = topkgating(logits, 2, capacity_factor=2.0)
