@@ -22,10 +22,11 @@ from deepspeed_tpu.models.gpt2 import gpt2_model
 
 MODEL_SIZE = os.environ.get("BENCH_MODEL", "350m")
 SEQ = int(os.environ.get("BENCH_SEQ", 1024))
-MICRO = int(os.environ.get("BENCH_MICRO", 4))
+MICRO = int(os.environ.get("BENCH_MICRO", 16))
 STEPS = int(os.environ.get("BENCH_STEPS", 10))
 WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
 ZERO_STAGE = int(os.environ.get("BENCH_ZERO", 0))
+REMAT_POLICY = os.environ.get("BENCH_REMAT_POLICY", "nothing")
 
 # bf16 peak TFLOPS per chip by TPU generation (public specs)
 PEAK_TFLOPS = {
@@ -45,7 +46,8 @@ def chip_peak_tflops() -> float:
 def main():
     n_chips = jax.device_count()
     model = gpt2_model(MODEL_SIZE, max_seq_len=SEQ, dtype="bfloat16",
-                       remat=bool(int(os.environ.get("BENCH_REMAT", "1"))))
+                       remat=bool(int(os.environ.get("BENCH_REMAT", "1"))),
+                       remat_policy=REMAT_POLICY)
     n_params = model.meta["n_params"]
     cfg = model.config
     # MFU accounting: 6N matmul flops/token + causal attention

@@ -13,6 +13,7 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -32,6 +33,7 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     dtype: str = "float32"          # compute dtype; master params are fp32
     remat: bool = False             # activation checkpointing per layer
+    remat_policy: str = "nothing"   # nothing | save_attn | dots | offload_attn
     attention_impl: str = "auto"    # auto | xla | flash (pallas)
 
     @property
@@ -113,6 +115,25 @@ def logical_specs(config: GPT2Config) -> dict:
     }
 
 
+def remat_policy(name: str):
+    """Remat policies for per-layer activation checkpointing (the reference's
+    activation_checkpointing tiers become jax.checkpoint policies)."""
+    if name in (None, "nothing", "nothing_saveable"):
+        return jax.checkpoint_policies.nothing_saveable
+    if name in ("save_attn",):
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name in ("dots", "dots_saveable"):
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name in ("offload_attn",):
+        # host-offload tier: attention outputs go to pinned host DRAM instead
+        # of HBM (reference cpu_checkpointing)
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_out"],
+            offload_src="device", offload_dst="pinned_host")
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
 def _layer_norm(x, scale, bias, eps):
     x32 = x.astype(jnp.float32)
     mu = x32.mean(-1, keepdims=True)
@@ -133,6 +154,10 @@ def _block(x, layer, config: GPT2Config, rng=None):
     v = v.reshape(B, S, H, hd)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
     attn = attn.reshape(B, S, D)
+    # named residual: the save_attn remat policy keeps attention outputs and
+    # recomputes the (cheap, MXU-bound) linear parts in the backward pass —
+    # re-running the flash kernel is the expensive half of full remat
+    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     x = x + attn @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
     h = h @ layer["mlp_in_w"].astype(h.dtype) + layer["mlp_in_b"].astype(h.dtype)
@@ -154,7 +179,8 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
 
     block_fn = partial(_block, config=config, rng=rng)
     if config.remat:
-        block_fn = jax.checkpoint(block_fn)
+        block_fn = jax.checkpoint(block_fn,
+                                  policy=remat_policy(config.remat_policy))
 
     def body(carry, layer):
         return block_fn(carry, layer), None

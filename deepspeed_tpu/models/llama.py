@@ -10,6 +10,7 @@ from dataclasses import dataclass
 from functools import partial
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -31,6 +32,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = False
+    remat_policy: str = "nothing"
     attention_impl: str = "auto"
 
     @property
@@ -130,6 +132,7 @@ def _block(x, layer, config: LlamaConfig, rng=None):
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
     gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
@@ -143,7 +146,9 @@ def forward(params, batch, config: LlamaConfig, rng=None):
     x = params["wte"].astype(dtype)[tokens]
     block_fn = partial(_block, config=config, rng=rng)
     if config.remat:
-        block_fn = jax.checkpoint(block_fn)
+        from deepspeed_tpu.models.gpt2 import remat_policy
+        block_fn = jax.checkpoint(
+            block_fn, policy=remat_policy(config.remat_policy))
 
     def body(carry, layer):
         return block_fn(carry, layer), None

@@ -6,6 +6,7 @@ from dataclasses import dataclass
 from functools import partial
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -34,6 +35,7 @@ class MixtralConfig:
     rms_norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = False
+    remat_policy: str = "nothing"
     attention_impl: str = "auto"
 
     @property
@@ -121,6 +123,7 @@ def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
     moe_out, aux = moe_layer(layer["moe"], h, config.moe, train=train, rng=rng)
@@ -134,7 +137,9 @@ def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
     x = params["wte"].astype(dtype)[tokens]
     block_fn = partial(_block, config=config, train=train, rng=rng)
     if config.remat:
-        block_fn = jax.checkpoint(block_fn)
+        from deepspeed_tpu.models.gpt2 import remat_policy
+        block_fn = jax.checkpoint(
+            block_fn, policy=remat_policy(config.remat_policy))
     x, aux = lax.scan(block_fn, x, params["blocks"])
     x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
     return x @ params["lm_head"].astype(dtype), jnp.sum(aux)

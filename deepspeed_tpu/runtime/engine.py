@@ -213,7 +213,8 @@ class DeepSpeedEngine:
             enabled=f.enabled, loss_scale=f.loss_scale,
             initial_scale_power=f.initial_scale_power,
             loss_scale_window=f.loss_scale_window, hysteresis=f.hysteresis,
-            min_loss_scale=f.min_loss_scale)
+            min_loss_scale=f.min_loss_scale,
+            consecutive_hysteresis=f.consecutive_hysteresis)
 
         self.state: Dict[str, Any] = {
             "params": params,
@@ -241,11 +242,14 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._pending_grads = None    # grads computed by forward(), applied by backward()
         self._data_iterator = None    # persistent iterator over training_dataloader
+        self._client_iter_src = None  # iterable passed to train_batch(data_iter=...)
+        self._client_iter = None      # its cached iterator
 
         # ---- bookkeeping -----------------------------------------------------
         self.global_steps = 0
         self.global_samples = 0
-        self.skipped_steps = 0
+        self._skipped_steps = 0
+        self._pending_overflow = []   # unresolved device-side overflow flags
         self.micro_steps = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -302,6 +306,25 @@ class DeepSpeedEngine:
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
+
+    # skipped_steps is lazily resolved: per-step overflow flags stay on device
+    # (fetching each would cost a host round trip per step) and are summed in
+    # one transfer when the counter is actually read
+    @property
+    def skipped_steps(self) -> int:
+        self._resolve_overflows()
+        return self._skipped_steps
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        self._pending_overflow = []
+        self._skipped_steps = int(value)
+
+    def _resolve_overflows(self):
+        if self._pending_overflow:
+            flags = jax.device_get(self._pending_overflow)
+            self._skipped_steps += int(np.sum(np.asarray(flags)))
+            self._pending_overflow = []
 
     def _build_monitor(self):
         try:
@@ -536,9 +559,17 @@ class DeepSpeedEngine:
                     self._data_iterator = iter(
                         RepeatingLoader(self.training_dataloader))
                 data_iter = self._data_iterator
-            batch = self._stack_micro_batches(iter(data_iter)
-                                              if not hasattr(data_iter, "__next__")
-                                              else data_iter)
+            if not hasattr(data_iter, "__next__"):
+                # non-iterator iterable (list, DataLoader): cache a repeating
+                # iterator keyed on the object so successive train_batch calls
+                # advance through it instead of replaying its head, and wrap
+                # around at the end instead of leaking StopIteration mid-step
+                if self._client_iter_src is not data_iter:
+                    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+                    self._client_iter_src = data_iter
+                    self._client_iter = iter(RepeatingLoader(data_iter))
+                data_iter = self._client_iter
+            batch = self._stack_micro_batches(data_iter)
         else:
             gas = self.gradient_accumulation_steps()
             lead = jax.tree.leaves(batch)[0].shape[0]
@@ -555,7 +586,12 @@ class DeepSpeedEngine:
             fn = self._get_compiled("train_step")
             self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
-        self.timers(TRAIN_BATCH_TIMER).stop(sync_obj=metrics["loss"])
+        # syncing on the loss every step costs a device->host round trip
+        # (~100 ms on tunneled platforms); only pay it when the user asked
+        # for wall-clock breakdowns
+        self.timers(TRAIN_BATCH_TIMER).stop(
+            sync_obj=metrics["loss"] if self._config.wall_clock_breakdown
+            else None)
         return metrics["loss"]
 
     def forward(self, batch):
@@ -637,11 +673,21 @@ class DeepSpeedEngine:
     def _finish_step(self, metrics):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        if self._config.fp16.enabled and bool(metrics.get("overflow", False)):
-            self.skipped_steps += 1
-            log_dist(
-                f"[step {self.global_steps}] overflow, skipping update; "
-                f"loss scale -> {float(metrics['loss_scale'])}", ranks=[0])
+        if self._config.fp16.enabled:
+            # don't force a device->host fetch of the overflow flag every
+            # step — bank it and resolve at report boundaries / on access
+            at_print = (self._config.steps_per_print and
+                        self.global_steps % self._config.steps_per_print == 0)
+            if at_print or self._config.wall_clock_breakdown:
+                self._resolve_overflows()
+                if bool(metrics.get("overflow", False)):
+                    self._skipped_steps += 1
+                    log_dist(
+                        f"[step {self.global_steps}] overflow, skipping "
+                        f"update; loss scale -> "
+                        f"{float(metrics['loss_scale'])}", ranks=[0])
+            else:
+                self._pending_overflow.append(metrics.get("overflow", False))
         self.last_metrics = {k: v for k, v in metrics.items()}
         # sync on the step outputs so wall-clock covers the async dispatch
         self.tput_timer.stop(sync_obj=metrics.get("loss"))
