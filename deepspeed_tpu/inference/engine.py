@@ -10,9 +10,13 @@ Capabilities mapped TPU-native:
   XLA compilation);
 - greedy and temperature sampling with right-padded static shapes.
 
-A fused KV-cache decode-attention Pallas kernel is the planned fast path; the
-current loop recomputes full attention per emitted token (correct, compiled,
-O(L²) — fine for parity testing, not yet for serving throughput).
+KV-cache fast path (default): prefill fills a static [L, B, S_max, KV, hd]
+cache and each decode step runs the from-scratch Pallas decode-attention
+kernel (ops/pallas/decode_attention.py — the ``ds_softmax_context``
+equivalent, csrc/transformer/inference/csrc/pt_binding.cpp:434), so per-token
+cost is O(S) cache streaming instead of O(S²) recompute.  Sampling: greedy /
+temperature / top-k / top-p (inference/sampling.py) with EOS early-stop.
+``use_cache=False`` keeps the O(S²) recompute loop as the numerics oracle.
 """
 from functools import partial
 from typing import Optional
@@ -118,28 +122,111 @@ class InferenceEngine:
 
         return jax.jit(gen, static_argnames=())
 
+    # ------------------------------------------------------------ cached path
+    def _build_cached_generate(self, prompt_pad: int, max_new: int,
+                               do_sample: bool, top_k: int, top_p: float,
+                               eos_id: Optional[int]):
+        """Prefill + lax.scan decode loop over the KV cache; one compiled
+        program per (prompt_pad, max_new, sampling-config) bucket."""
+        from deepspeed_tpu.inference.sampling import sample
+        model = self.model
+        dtype = self.dtype
+        total = prompt_pad + max_new
+
+        def gen(params, tokens_padded, lengths, rng, temperature):
+            B = tokens_padded.shape[0]
+            cache = model.init_cache_fn(B, total, dtype)
+            logits, cache = model.prefill_fn(
+                params, {"input_ids": tokens_padded}, cache)
+            last = logits[jnp.arange(B), lengths - 1]       # [B, V]
+            rng, sub = jax.random.split(rng)
+            nxt = sample(last, sub, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, top_p=top_p)
+            done = (jnp.full((B,), False) if eos_id is None
+                    else nxt == eos_id)
+
+            def body(carry, _):
+                cache, tok, lens, rng, done = carry
+                logits, cache = model.decode_fn(params, tok, cache, lens)
+                rng, sub = jax.random.split(rng)
+                new = sample(logits, sub, do_sample=do_sample,
+                             temperature=temperature, top_k=top_k, top_p=top_p)
+                if eos_id is not None:
+                    new = jnp.where(done, jnp.int32(eos_id), new)
+                    new_done = jnp.logical_or(done, new == eos_id)
+                else:
+                    new_done = done
+                return (cache, new, lens + 1, rng, new_done), tok
+
+            (_, last_tok, _, _, _), emitted = jax.lax.scan(
+                body, (cache, nxt, lengths, rng, done), None, length=max_new)
+            gen_tokens = emitted.T                           # [B, max_new]
+            # write generated tokens at each row's true positions
+            out = jnp.zeros((B, total), jnp.int32)
+            out = jax.lax.dynamic_update_slice(out, tokens_padded, (0, 0))
+            idx = lengths[:, None] + jnp.arange(max_new)[None, :]
+            out = out.at[jnp.arange(B)[:, None], idx].set(gen_tokens)
+            return out, gen_tokens
+
+        return jax.jit(gen)
+
+    @staticmethod
+    def _pad_bucket(n: int, quantum: int = 64) -> int:
+        return max(quantum, -(-n // quantum) * quantum)
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
-                 rng: Optional[jax.Array] = None, **kw):
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None,
+                 use_cache: bool = True, **kw):
         """Autoregressive generation (reference: InferenceEngine.generate guard,
-        inference/engine.py:576 — here it is the real decode loop)."""
+        inference/engine.py:576 — here it is the real decode loop).
+
+        With ``use_cache`` (default) the KV-cache fast path runs: prefill +
+        per-token decode against the cache (O(S) per token).  ``use_cache=
+        False`` keeps the O(S²) recompute loop (numerics oracle)."""
         input_ids = np.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         B, S = input_ids.shape
-        total = S + max_new_tokens
-        max_ctx = getattr(self.model.config, "max_seq_len", total)
-        if total > max_ctx:
+        max_ctx = getattr(self.model.config, "max_seq_len", S + max_new_tokens)
+        if S + max_new_tokens > max_ctx:
             raise ValueError(
                 f"generate: prompt {S} + max_new_tokens {max_new_tokens} "
                 f"exceeds model context {max_ctx}")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        cached_ok = (use_cache and self.model.init_cache_fn is not None
+                     and self.model.prefill_fn is not None
+                     and self.model.decode_fn is not None)
+        if cached_ok:
+            prompt_pad = min(self._pad_bucket(S), max_ctx - max_new_tokens)
+            if prompt_pad < S:
+                prompt_pad = S
+            tokens = np.zeros((B, prompt_pad), dtype=np.int32)
+            tokens[:, :S] = input_ids
+            length = np.full((B,), S, dtype=np.int32)
+            key = ("cached", prompt_pad, max_new_tokens, do_sample,
+                   int(top_k), float(top_p), eos_token_id)
+            if key not in self._generate_fns:
+                self._generate_fns[key] = self._build_cached_generate(
+                    prompt_pad, max_new_tokens, do_sample, int(top_k),
+                    float(top_p), eos_token_id)
+            out, _ = self._generate_fns[key](
+                self.params, jnp.asarray(tokens), jnp.asarray(length), rng,
+                jnp.float32(temperature))
+            out = np.asarray(out)
+            # reference-compatible shape: [B, S + max_new]
+            return out[:, :S + max_new_tokens]
+
+        total = S + max_new_tokens
         tokens = np.zeros((B, total), dtype=np.int32)
         tokens[:, :S] = input_ids
         length = np.full((B,), S, dtype=np.int32)
         key = (total, not do_sample)
         if key not in self._generate_fns:
             self._generate_fns[key] = self._build_generate(total, not do_sample)
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
         out = self._generate_fns[key](
             self.params, jnp.asarray(tokens), jnp.asarray(length), rng,
             jnp.float32(temperature))

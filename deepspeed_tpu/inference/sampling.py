@@ -1,0 +1,52 @@
+"""Token sampling for the generate loop: greedy, temperature, top-k, top-p.
+
+Reference capability: the sampling the reference delegates to HF generate()
+on top of its fused kernels; here it is part of the compiled decode loop.
+All transforms are static-shape and jit-friendly (sorting, not rejection
+sampling), so the whole generate loop stays a single compiled program.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the k highest logits per row; mask the rest. logits [B, V]."""
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][:, -1:]            # [B, 1]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus sampling mask: keep the smallest prefix of the sorted
+    distribution with cumulative probability >= p. logits [B, V]."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]   # descending
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while the cumulative mass *before* them is < p (the first
+    # token is always kept)
+    keep_sorted = (cum - probs) < p
+    # threshold logit = smallest kept logit per row
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample(logits: jnp.ndarray, rng: jax.Array, *,
+           do_sample: bool = True, temperature: float = 1.0,
+           top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    """logits [B, V] -> token ids [B] (int32)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k:
+        logits = apply_top_k(logits, top_k)
+    if top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)

@@ -142,28 +142,38 @@ def _layer_norm(x, scale, bias, eps):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block(x, layer, config: GPT2Config, rng=None):
-    """One transformer block; shapes [B, S, D]."""
+def _block_qkv(x, layer, config: GPT2Config):
+    """LN1 + QKV projection; x [B, S, D] -> q/k/v [B, S, H, hd]."""
     B, S, D = x.shape
     H, hd = config.num_heads, config.head_dim
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
     qkv = h @ layer["qkv_w"].astype(h.dtype) + layer["qkv_b"].astype(h.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd)
-    kk = kk.reshape(B, S, H, hd)
-    v = v.reshape(B, S, H, hd)
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
-    attn = attn.reshape(B, S, D)
-    # named residual: the save_attn remat policy keeps attention outputs and
-    # recomputes the (cheap, MXU-bound) linear parts in the backward pass —
-    # re-running the flash kernel is the expensive half of full remat
-    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+    return (q.reshape(B, S, H, hd), kk.reshape(B, S, H, hd),
+            v.reshape(B, S, H, hd))
+
+
+def _block_finish(x, attn, layer, config: GPT2Config):
+    """Post-attention half: proj + residual + MLP; x/attn [B, S, D]."""
     x = x + attn @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
     h = h @ layer["mlp_in_w"].astype(h.dtype) + layer["mlp_in_b"].astype(h.dtype)
     h = jax.nn.gelu(h, approximate=True)
     x = x + h @ layer["mlp_out_w"].astype(x.dtype) + layer["mlp_out_b"].astype(x.dtype)
     return x
+
+
+def _block(x, layer, config: GPT2Config, rng=None):
+    """One transformer block; shapes [B, S, D]."""
+    B, S, D = x.shape
+    q, kk, v = _block_qkv(x, layer, config)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = attn.reshape(B, S, D)
+    # named residual: the save_attn remat policy keeps attention outputs and
+    # recomputes the (cheap, MXU-bound) linear parts in the backward pass —
+    # re-running the flash kernel is the expensive half of full remat
+    attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+    return _block_finish(x, attn, layer, config)
 
 
 def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
@@ -190,6 +200,70 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
                     config.layer_norm_eps)
     logits = x @ params["wte"].astype(dtype).T   # tied embedding
     return logits
+
+
+# --------------------------------------------------------------------- decode
+# KV-cache serving path (reference capability: ds_softmax_context KV-cache
+# attention, csrc/transformer/inference/csrc/pt_binding.cpp:434, plus the
+# inference containers' cache management).  Caches are [L, B, S_max, H, hd];
+# decode is a lax.scan over layers with a single-token decode-attention kernel.
+
+def init_cache(config: GPT2Config, batch_size: int, max_len: int, dtype=None):
+    dtype = jnp.dtype(dtype or config.dtype)
+    L, H, hd = config.num_layers, config.num_heads, config.head_dim
+    shape = (L, batch_size, max_len, H, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill(params, batch, cache, config: GPT2Config):
+    """Run the causal forward over (right-padded) prompts, filling the cache.
+    Returns (logits [B, S, V], cache)."""
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
+
+    def body(carry, layer):
+        q, kk, v = _block_qkv(carry, layer, config)
+        attn = causal_attention(q, kk, v, impl=config.attention_impl)
+        out = _block_finish(carry, attn.reshape(B, S, -1), layer, config)
+        return out, (kk, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
+                                      (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype),
+                                      (0, 0, 0, 0, 0)),
+    }
+    logits = head(params, x, config)
+    return logits, cache
+
+
+def decode_step(params, tokens, cache, lengths, config: GPT2Config):
+    """One decode step.  tokens [B] int32, lengths [B] = current cache fill
+    per row (the new token's position).  Returns (logits [B, V], cache)."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    B = tokens.shape[0]
+    dtype = jnp.dtype(config.dtype)
+    D = config.d_model
+    x = (params["wte"].astype(dtype)[tokens] +
+         params["wpe"].astype(dtype)[lengths])              # [B, D]
+    rows = jnp.arange(B)
+
+    def body(carry, layer_kv):
+        layer, kc, vc = layer_kv
+        q, kk, v = _block_qkv(carry[:, None, :], layer, config)
+        kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
+        vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1)
+        out = _block_finish(carry, attn.reshape(B, D).astype(carry.dtype),
+                            layer, config)
+        return out, (kc, vc)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = head(params, x[:, None, :], config)[:, 0]
+    return logits, {"k": ks, "v": vs}
 
 
 def count_params(config: GPT2Config) -> int:
@@ -228,4 +302,7 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),
+        init_cache_fn=lambda bs, ml, dtype=None: init_cache(config, bs, ml, dtype),
+        prefill_fn=lambda p, b, c: prefill(p, b, c, config),
+        decode_fn=lambda p, t, c, l: decode_step(p, t, c, l, config),
     )

@@ -104,20 +104,28 @@ def _rms_norm(x, scale, eps):
 
 
 def rope(x, theta: float, positions=None):
-    """Rotary embeddings on [B, S, H, hd] (split-half convention)."""
+    """Rotary embeddings on [B, S, H, hd] (split-half convention).
+    ``positions``: [S] (shared across batch) or [B, S] (per-row, decode)."""
     B, S, H, hd = x.shape
     if positions is None:
         positions = jnp.arange(S)
     freqs = theta ** (-jnp.arange(0, hd // 2) / (hd // 2))
-    angles = positions[:, None] * freqs[None, :]         # [S, hd/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if positions.ndim == 1:
+        angles = positions[:, None] * freqs[None, :]     # [S, hd/2]
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:
+        angles = positions[:, :, None] * freqs[None, None, :]   # [B, S, hd/2]
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
 
 
-def _block(x, layer, config: LlamaConfig, rng=None):
+def _block_qkv(x, layer, config: LlamaConfig, positions=None):
+    """RMSNorm + QKV + rotary; x [B, S, D] -> q [B,S,H,hd], k/v [B,S,KV,hd]
+    (kv heads NOT repeated — the caller decides, so caches stay compact)."""
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
@@ -125,19 +133,31 @@ def _block(x, layer, config: LlamaConfig, rng=None):
     q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, hd)
     kk = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd)
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
-    q = rope(q, config.rope_theta)
-    kk = rope(kk, config.rope_theta)
+    q = rope(q, config.rope_theta, positions)
+    kk = rope(kk, config.rope_theta, positions)
+    return q, kk, v
+
+
+def _block_finish(x, attn, layer, config: LlamaConfig):
+    dt = x.dtype
+    x = x + attn @ layer["wo"].astype(dt)
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
+    x = x + gated @ layer["w_down"].astype(dt)
+    return x
+
+
+def _block(x, layer, config: LlamaConfig, rng=None):
+    B, S, D = x.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    q, kk, v = _block_qkv(x, layer, config)
     if KV != H:   # grouped-query: repeat kv heads
         rep = H // KV
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
-    x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
-    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
-    x = x + gated @ layer["w_down"].astype(dt)
-    return x
+    return _block_finish(x, attn.reshape(B, S, H * hd), layer, config)
 
 
 def forward(params, batch, config: LlamaConfig, rng=None):
@@ -156,6 +176,71 @@ def forward(params, batch, config: LlamaConfig, rng=None):
     x, _ = lax.scan(body, x, params["blocks"])
     x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
     return x @ params["lm_head"].astype(dtype)
+
+
+# --------------------------------------------------------------------- decode
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None):
+    dtype = jnp.dtype(dtype or config.dtype)
+    L, KV, hd = config.num_layers, config.num_kv_heads, config.head_dim
+    shape = (L, batch_size, max_len, KV, hd)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill(params, batch, cache, config: LlamaConfig):
+    """Causal forward over right-padded prompts, filling the (compact,
+    KV-head) cache.  Returns (logits [B, S, V], cache)."""
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+    dtype = jnp.dtype(config.dtype)
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    x = params["wte"].astype(dtype)[tokens]
+
+    def body(carry, layer):
+        q, kk, v = _block_qkv(carry, layer, config)
+        ka, va = kk, v
+        if KV != H:
+            rep = H // KV
+            ka = jnp.repeat(kk, rep, axis=2)
+            va = jnp.repeat(v, rep, axis=2)
+        attn = causal_attention(q, ka, va, impl=config.attention_impl)
+        out = _block_finish(carry, attn.reshape(B, S, H * hd), layer, config)
+        return out, (kk, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
+                                      (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype),
+                                      (0, 0, 0, 0, 0)),
+    }
+    return head(params, x, config), cache
+
+
+def decode_step(params, tokens, cache, lengths, config: LlamaConfig):
+    """One decode step: tokens [B], lengths [B] current fill counts.
+    Rotary uses per-row positions; the GQA cache stays compact (KV heads) —
+    the decode kernel handles the query-group mapping."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    B = tokens.shape[0]
+    dtype = jnp.dtype(config.dtype)
+    H, hd = config.num_heads, config.head_dim
+    x = params["wte"].astype(dtype)[tokens]                 # [B, D]
+    rows = jnp.arange(B)
+
+    def body(carry, layer_kv):
+        layer, kc, vc = layer_kv
+        q, kk, v = _block_qkv(carry[:, None, :], layer, config,
+                              positions=lengths[:, None])
+        kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
+        vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1)
+        out = _block_finish(carry, attn.reshape(B, H * hd).astype(carry.dtype),
+                            layer, config)
+        return out, (kc, vc)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = head(params, x[:, None, :], config)[:, 0]
+    return logits, {"k": ks, "v": vs}
 
 
 def count_params(config: LlamaConfig) -> int:
@@ -192,4 +277,7 @@ def llama_model(size: str = "7b", **overrides) -> Model:
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),
+        init_cache_fn=lambda bs, ml, dtype=None: init_cache(config, bs, ml, dtype),
+        prefill_fn=lambda p, b, c: prefill(p, b, c, config),
+        decode_fn=lambda p, t, c, l: decode_step(p, t, c, l, config),
     )

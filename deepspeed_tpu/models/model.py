@@ -33,6 +33,13 @@ class Model:
     block_fn: Optional[Callable] = None
     head_fn: Optional[Callable] = None
     blocks_key: str = "blocks"
+    #: KV-cache serving path (engines use these when present):
+    #: init_cache_fn(batch_size, max_len, dtype) -> cache pytree;
+    #: prefill_fn(params, batch, cache) -> (logits [B,S,V], cache);
+    #: decode_fn(params, tokens [B], cache, lengths [B]) -> (logits [B,V], cache)
+    init_cache_fn: Optional[Callable] = None
+    prefill_fn: Optional[Callable] = None
+    decode_fn: Optional[Callable] = None
 
     def __post_init__(self):
         if self.loss_fn is None and self.apply_fn is not None:

@@ -1,0 +1,213 @@
+"""From-scratch Pallas TPU decode-attention kernel (KV-cache attention).
+
+The serving-side equivalent of the reference's fused ``ds_softmax_context``
+(csrc/transformer/inference/csrc/pt_binding.cpp:434, softmax.cu): one query
+token per row attends to a KV cache of ``cache_len[b]`` valid positions.
+Decode attention is HBM-bandwidth-bound — the work IS streaming the cache —
+so the kernel:
+
+- keeps the cache **packed** as [S_max, KV*hd] (a [*, hd] trailing dim with
+  hd=64 would pad to 128 lanes in HBM, doubling cache bytes and bandwidth);
+- streams it through VMEM in S-blocks with an online-softmax accumulator, so
+  nothing [S, S]-shaped ever exists and arbitrarily long caches fit;
+- skips entire S-blocks past the longest row's ``cache_len`` (predicated
+  execution: the DMA for skipped blocks still lands but the FLOPs don't);
+- computes all heads' scores in ONE [bs, KV*hd] x [KV*hd, KV] matmul per
+  group by materialising the query as a block-diagonal weight (full 128-lane
+  contraction depth even though hd=64 — a per-head formulation would waste
+  half the MXU).
+
+Grouped-query attention folds in by iterating ``rep = H // KV`` query groups;
+each group maps 1:1 onto the KV heads, so the same block-diagonal trick
+applies per group.
+
+Layouts (packed, group-major):
+  q:        [B, rep, KV*hd]   (q[b, r, kvh*hd+d] = query head kvh*rep+r)
+  k/v:      [B, S_max, KV*hd]
+  cache_len:[B] int32 — number of valid cache positions per row
+  out:      [B, rep, KV*hd]
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_s, kv_heads, head_dim,
+                   rep, sm_scale, precision):
+    """Grid: (B, num_s_blocks); S is the minor (sequential) dimension so the
+    online-softmax state in scratch carries across S-blocks of one row."""
+    s_idx = pl.program_id(1)
+    n_s = pl.num_programs(1)
+    cache_len = len_ref[pl.program_id(0)]
+    Dk = kv_heads * head_dim
+
+    @pl.when(s_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s_start = s_idx * block_s
+    # entire block beyond this row's cache: skip the compute
+    @pl.when(s_start < cache_len)
+    def _compute():
+        k = k_ref[:]                               # [bs, KV*hd]
+        v = v_ref[:]
+        # validity mask for positions inside this block
+        pos = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, kv_heads), 0)     # [bs, KV]
+        valid = pos < cache_len
+
+        # block-diagonal expansion masks (built once per block; VPU-cheap)
+        row_group = jax.lax.broadcasted_iota(
+            jnp.int32, (Dk, kv_heads), 0) // head_dim       # [Dk, KV]
+        col_head = jax.lax.broadcasted_iota(
+            jnp.int32, (Dk, kv_heads), 1)                   # [Dk, KV]
+        blockdiag = (row_group == col_head)                 # [Dk, KV] bool
+
+        for r in range(rep):
+            # minor-dim insertion on bf16 vectors is unsupported by Mosaic;
+            # widen to f32 for the [Dk] -> [Dk, 1] reshape
+            q_r = q_ref[r, :].astype(jnp.float32)           # [Dk]
+            w = jnp.where(blockdiag, q_r[:, None], 0.0).astype(k.dtype)
+            scores = jax.lax.dot(
+                k, w, preferred_element_type=jnp.float32,
+                precision=precision) * sm_scale
+            scores = jnp.where(valid, scores, NEG_INF)      # [bs, KV]
+
+            m_prev = m_ref[r, :]                            # [KV]
+            l_prev = l_ref[r, :]
+            m_cur = jnp.max(scores, axis=0)                 # [KV]
+            m_new = jnp.maximum(m_prev, m_cur)
+            corr = jnp.exp(m_prev - m_new)                  # [KV]
+            p = jnp.exp(scores - m_new[None, :])            # [bs, KV]
+            p = jnp.where(valid, p, 0.0)
+            l_ref[r, :] = l_prev * corr + jnp.sum(p, axis=0)
+            m_ref[r, :] = m_new
+
+            # expand per-head probs to the packed lane layout and reduce
+            # over the block's positions:  acc[kvh*hd+d] += Σ_s p[s,kvh]·v[s,kvh*hd+d]
+            p_exp = jax.lax.dot(
+                p.astype(v.dtype), blockdiag.astype(v.dtype).T,
+                preferred_element_type=jnp.float32,
+                precision=precision)                         # [bs, Dk]
+            acc_ref[r, :] = acc_ref[r, :] * jnp.where(
+                blockdiag, corr[None, :], 0.0).sum(axis=1) + jnp.sum(
+                p_exp * v.astype(jnp.float32), axis=0)
+
+    @pl.when(s_idx == n_s - 1)
+    def _finalize():
+        # expand l (per kv head) onto the packed lanes
+        row_group = jax.lax.broadcasted_iota(
+            jnp.int32, (Dk, kv_heads), 0) // head_dim
+        col_head = jax.lax.broadcasted_iota(
+            jnp.int32, (Dk, kv_heads), 1)
+        blockdiag = (row_group == col_head)
+        for r in range(rep):
+            # VPU select-sum (a matmul here would round l through bf16)
+            l_exp = jnp.where(blockdiag, l_ref[r, :][None, :], 0.0).sum(axis=1)
+            o_ref[r, :] = (acc_ref[r, :] /
+                           jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, cache_len,
+                            sm_scale=None, block_s: int = 512):
+    """q: [B, H, hd]; k/v_cache: [B, S_max, KV, hd]; cache_len: [B] int32.
+    Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    _, S_max, KV, _ = k_cache.shape
+    rep = H // KV
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    # pick the largest tile-aligned block that divides S_max; pad the cache
+    # as a last resort (a copy — callers should size caches to a multiple of
+    # 128 to avoid it; the engine's bucketing does)
+    for cand in (block_s, 256, 128, 64, 32, 16, 8):
+        if cand <= S_max and S_max % cand == 0:
+            block_s = cand
+            break
+    else:
+        pad = -S_max % 128
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        S_max += pad
+        block_s = min(block_s, S_max)
+        while S_max % block_s:
+            block_s //= 2
+    Dk = KV * hd
+
+    # group-major packing: [B, KV, rep, hd] -> [B, rep, KV*hd]
+    qp = q.reshape(B, KV, rep, hd).transpose(0, 2, 1, 3).reshape(B, rep, Dk)
+    kp = k_cache.reshape(B, S_max, Dk)
+    vp = v_cache.reshape(B, S_max, Dk)
+
+    # fp32 inputs need full-precision MXU passes (the default lowering runs
+    # bf16-grade multiplies even for f32 operands); bf16 inputs keep the
+    # default single pass
+    precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                 else None)
+    kernel = partial(_decode_kernel, block_s=block_s, kv_heads=KV,
+                     head_dim=hd, rep=rep, sm_scale=sm_scale,
+                     precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, S_max // block_s),
+        in_specs=[
+            # whole cache_len vector in SMEM (TPU lowering rejects 1-element
+            # rank-1 blocks); the kernel indexes it by program_id
+            pl.BlockSpec((B,), lambda b, s: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, rep, Dk), lambda b, s: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((None, rep, Dk), lambda b, s: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, rep, Dk), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, KV), jnp.float32),   # m
+            pltpu.VMEM((rep, KV), jnp.float32),   # l
+            pltpu.VMEM((rep, Dk), jnp.float32),   # acc
+        ],
+    )(cache_len.astype(jnp.int32), qp, kp, vp)
+    # unpack group-major -> head-major
+    return out.reshape(B, rep, KV, hd).transpose(0, 2, 1, 3).reshape(B, H, hd)
+
+
+def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None):
+    """Reference/fallback implementation (CPU meshes, numeric tests).
+    Same signature as the Pallas kernel."""
+    B, H, hd = q.shape
+    _, S_max, KV, _ = k_cache.shape
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    if KV != H:
+        rep = H // KV
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None)
+    scores = jnp.einsum("bhd,bshd->bhs", q, k_cache,
+                        precision=prec).astype(jnp.float32)
+    scores = scores * sm_scale
+    valid = jnp.arange(S_max)[None, None, :] < cache_len[:, None, None]
+    scores = jnp.where(valid, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bshd->bhd", probs, v_cache, precision=prec)
+
+
+def decode_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
+    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere."""
+    from deepspeed_tpu.ops.attention import _on_tpu
+    if _on_tpu():
+        return decode_attention_pallas(q, k_cache, v_cache, cache_len,
+                                       sm_scale=sm_scale)
+    return decode_attention_xla(q, k_cache, v_cache, cache_len,
+                                sm_scale=sm_scale)
