@@ -83,7 +83,12 @@ class InferenceEngine:
         return self._forward(self.params, batch)
 
     # ------------------------------------------------------------------ generate
-    def _build_generate(self, total_len: int, greedy: bool):
+    def _build_generate(self, total_len: int, do_sample: bool, top_k: int,
+                        top_p: float, eos_id: Optional[int]):
+        """No-cache O(S²) recompute loop — the numerics oracle.  Supports the
+        full sampling surface (greedy/temperature/top-k/top-p/EOS) so cached
+        and uncached paths are comparable config-for-config."""
+        from deepspeed_tpu.inference.sampling import sample
         model = self.model
 
         def gen(params, tokens, length, rng, temperature):
@@ -91,33 +96,36 @@ class InferenceEngine:
             B = tokens.shape[0]
 
             def cond(state):
-                cur, *_ = state
-                return cur < total_len
+                cur, _, _, done = state
+                return jnp.logical_and(cur < total_len, ~jnp.all(done))
 
             def body(state):
-                cur, toks, rng = state
+                cur, toks, rng, done = state
                 logits = model.apply(params, {"input_ids": toks})
                 # next token for each row comes from its current last position
                 idx = jnp.minimum(jnp.maximum(length, cur) - 1, total_len - 1)
                 last = logits[jnp.arange(B), idx]          # [B, V]
-                if greedy:
-                    nxt = jnp.argmax(last, axis=-1).astype(toks.dtype)
-                else:
-                    rng, sub = jax.random.split(rng)
-                    nxt = jax.random.categorical(
-                        sub, last / jnp.maximum(temperature, 1e-6)
-                    ).astype(toks.dtype)
+                rng, sub = jax.random.split(rng)
+                nxt = sample(last, sub, do_sample=do_sample,
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p).astype(toks.dtype)
+                if eos_id is not None:
+                    nxt = jnp.where(done, jnp.asarray(eos_id, toks.dtype), nxt)
                 # only write where cur >= prompt length (else keep prompt token)
                 write = cur >= length
                 cur_col = jax.lax.dynamic_slice(toks, (0, cur), (B, 1))[:, 0]
                 new_col = jnp.where(write, nxt, cur_col)
                 toks = jax.lax.dynamic_update_slice(
                     toks, new_col[:, None], (0, cur))
-                return (cur + 1, toks, rng)
+                if eos_id is not None:
+                    done = jnp.logical_or(
+                        done, jnp.logical_and(write, new_col == eos_id))
+                return (cur + 1, toks, rng, done)
 
             start = jnp.min(length)
-            _, toks, _ = jax.lax.while_loop(
-                cond, body, (start, tokens, rng))
+            done0 = jnp.zeros((B,), bool)
+            _, toks, _, _ = jax.lax.while_loop(
+                cond, body, (start, tokens, rng, done0))
             return toks
 
         return jax.jit(gen, static_argnames=())
@@ -132,10 +140,14 @@ class InferenceEngine:
         model = self.model
         dtype = self.dtype
         total = prompt_pad + max_new
+        # the decode kernel streams the cache in S-blocks and pads unaligned
+        # caches with a full HBM copy per call — size the cache buffer itself
+        # to a 64 multiple (positions never exceed `total`; the tail is dead)
+        cache_size = -(-total // 64) * 64
 
         def gen(params, tokens_padded, lengths, rng, temperature):
             B = tokens_padded.shape[0]
-            cache = model.init_cache_fn(B, total, dtype)
+            cache = model.init_cache_fn(B, cache_size, dtype)
             logits, cache = model.prefill_fn(
                 params, {"input_ids": tokens_padded}, cache)
             last = logits[jnp.arange(B), lengths - 1]       # [B, V]
@@ -156,11 +168,17 @@ class InferenceEngine:
                     new_done = jnp.logical_or(done, new == eos_id)
                 else:
                     new_done = done
-                return (cache, new, lens + 1, rng, new_done), tok
+                return (cache, new, lens + 1, rng, new_done), new
 
-            (_, last_tok, _, _, _), emitted = jax.lax.scan(
-                body, (cache, nxt, lengths, rng, done), None, length=max_new)
-            gen_tokens = emitted.T                           # [B, max_new]
+            # max_new-1 decode steps: the prefill already sampled token 0, and
+            # emitting the scan body's *output* token means no trailing decode
+            # whose sample would be discarded
+            _, rest = jax.lax.scan(
+                body, (cache, nxt, lengths, rng, done), None,
+                length=max_new - 1)
+            gen_tokens = jnp.concatenate(
+                [nxt[:, None], rest.T.astype(nxt.dtype).reshape(B, max_new - 1)],
+                axis=1)                                      # [B, max_new]
             # write generated tokens at each row's true positions
             out = jnp.zeros((B, total), jnp.int32)
             out = jax.lax.dynamic_update_slice(out, tokens_padded, (0, 0))
@@ -224,9 +242,11 @@ class InferenceEngine:
         tokens = np.zeros((B, total), dtype=np.int32)
         tokens[:, :S] = input_ids
         length = np.full((B,), S, dtype=np.int32)
-        key = (total, not do_sample)
+        key = ("nocache", total, do_sample, int(top_k), float(top_p),
+               eos_token_id)
         if key not in self._generate_fns:
-            self._generate_fns[key] = self._build_generate(total, not do_sample)
+            self._generate_fns[key] = self._build_generate(
+                total, do_sample, int(top_k), float(top_p), eos_token_id)
         out = self._generate_fns[key](
             self.params, jnp.asarray(tokens), jnp.asarray(length), rng,
             jnp.float32(temperature))

@@ -1,0 +1,13 @@
+"""Launcher layer (reference: deepspeed/launcher/).
+
+- :mod:`deepspeed_tpu.launcher.runner` — the ``deepspeed`` CLI: hostfile +
+  include/exclude parsing, multinode backend selection.
+- :mod:`deepspeed_tpu.launcher.launch` — per-node worker launcher exporting
+  the JAX coordination env.
+- :mod:`deepspeed_tpu.launcher.multinode_runner` — pure command builders for
+  pdsh / mpi / slurm / gcloud backends.
+- :mod:`deepspeed_tpu.launcher.ds_report` — environment/ops report CLI.
+"""
+from deepspeed_tpu.launcher.multinode_runner import (  # noqa: F401
+    MultiNodeRunner, PDSHRunner, OpenMPIRunner, MPICHRunner, IMPIRunner,
+    SlurmRunner, GcloudTPURunner)

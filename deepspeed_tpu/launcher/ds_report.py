@@ -1,0 +1,87 @@
+"""Environment/ops compatibility report (reference: bin/ds_report →
+deepspeed/env_report.py).
+
+Prints the platform summary a user needs to file a bug or sanity-check an
+install: JAX/jaxlib versions, visible devices and their platform, the native
+op builders' compatibility + cache state, and the framework version.
+"""
+import os
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _op_report():
+    rows = []
+    try:
+        from op_builder.builder import CPUAdamBuilder, AsyncIOBuilder
+        builders = [CPUAdamBuilder(), AsyncIOBuilder()]
+    except Exception:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+            from op_builder.builder import CPUAdamBuilder, AsyncIOBuilder
+            builders = [CPUAdamBuilder(), AsyncIOBuilder()]
+        except Exception:
+            return rows
+    for b in builders:
+        compatible = False
+        cached = False
+        try:
+            compatible = b.is_compatible()
+            cached = os.path.exists(b.so_path())
+        except Exception:
+            pass
+        rows.append((b.__class__.__name__.replace("Builder", "").lower(),
+                     compatible, cached))
+    return rows
+
+
+def main(args=None):
+    print("-" * 70)
+    print("deepspeed_tpu environment report")
+    print("-" * 70)
+    from deepspeed_tpu.version import __version__
+    print(f"deepspeed_tpu version .... {__version__}")
+    print(f"python version ........... {sys.version.split()[0]}")
+
+    try:
+        import jax
+        import jaxlib
+        print(f"jax version .............. {jax.__version__}")
+        print(f"jaxlib version ........... {jaxlib.__version__}")
+        devices = jax.devices()
+        plat = devices[0].platform if devices else "none"
+        print(f"platform ................. {plat}")
+        print(f"device count ............. {len(devices)}")
+        for d in devices[:8]:
+            print(f"  - {d}")
+        if len(devices) > 8:
+            print(f"  ... and {len(devices) - 8} more")
+    except Exception as e:
+        print(f"jax ...................... {RED_NO} ({e})")
+
+    print("-" * 70)
+    print("native op builders (op_builder/builder.py):")
+    rows = _op_report()
+    if not rows:
+        print(f"  op_builder ............. {RED_NO} (import failed)")
+    for name, compatible, cached in rows:
+        status = GREEN_OK if compatible else RED_NO
+        cache = "cached" if cached else "not built"
+        print(f"  {name:<22} {status}  [{cache}]")
+
+    print("-" * 70)
+    relevant = {k: v for k, v in os.environ.items()
+                if k.startswith(("JAX_", "XLA_", "TPU_", "LIBTPU_"))}
+    if relevant:
+        print("environment:")
+        for k in sorted(relevant):
+            print(f"  {k}={relevant[k]}")
+    print("-" * 70)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

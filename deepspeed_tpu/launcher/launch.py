@@ -1,0 +1,142 @@
+"""Per-node launcher (reference: deepspeed/launcher/launch.py:132).
+
+The reference forks one process per local GPU, assigning
+RANK/LOCAL_RANK/MASTER_ADDR to each.  On TPU the JAX runtime owns all local
+chips from one process, so this launcher starts exactly **one** worker process
+per host and exports the JAX coordination triplet
+(COORDINATOR_ADDRESS / NPROC / PROCESS_ID) that
+``deepspeed_tpu.comm.init_distributed`` consumes for the
+``jax.distributed.initialize`` rendezvous over DCN.
+
+Signal handling and child-tree cleanup mirror the reference
+(``terminate_process_tree``, launch.py:118): SIGINT/SIGTERM forwarded to the
+worker, non-zero worker exit propagates to the launcher's exit code.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu per-node launcher")
+    parser.add_argument("--coordinator_address", type=str, required=True,
+                        help="host:port of the rank-0 JAX coordinator")
+    parser.add_argument("--nnodes", type=str, default="1",
+                        help="total number of hosts in the job, or 'auto' to "
+                             "read it from the MPI/SLURM environment")
+    parser.add_argument("--node_rank", type=str, default="0",
+                        help="this host's index in [0, nnodes), or 'auto' to "
+                             "read it from the MPI/SLURM environment")
+    parser.add_argument("--module", action="store_true",
+                        help="run the user script as a python module "
+                             "(python -m)")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec the user script directly, without the "
+                             "python interpreter")
+    parser.add_argument("--save_pid", type=str, default="",
+                        help="write the launcher pid to this file")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+_RANK_ENV = ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK", "SLURM_PROCID")
+_SIZE_ENV = ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS")
+
+
+def _resolve(value, env, candidates, what):
+    """Resolve an int or 'auto' (from the launching MPI/SLURM env)."""
+    if value != "auto":
+        return int(value)
+    for var in candidates:
+        if var in env:
+            return int(env[var])
+    raise RuntimeError(
+        f"launch: --{what}=auto but none of {candidates} is set — "
+        f"not running under mpirun/srun?")
+
+
+def build_worker_env(args, base_env=None):
+    """The env the single per-host worker runs under."""
+    env = dict(os.environ if base_env is None else base_env)
+    node_rank = _resolve(args.node_rank, env, _RANK_ENV, "node_rank")
+    nnodes = _resolve(args.nnodes, env, _SIZE_ENV, "nnodes")
+    env["COORDINATOR_ADDRESS"] = args.coordinator_address
+    env["NPROC"] = str(nnodes)
+    env["PROCESS_ID"] = str(node_rank)
+    # reference-compatible aliases (torch-style naming) so user scripts that
+    # read RANK/WORLD_SIZE keep working
+    env["RANK"] = str(node_rank)
+    env["WORLD_SIZE"] = str(nnodes)
+    addr, _, port = args.coordinator_address.partition(":")
+    env["MASTER_ADDR"] = addr
+    env["MASTER_PORT"] = port or "29500"
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    return env
+
+
+def build_worker_cmd(args):
+    if args.no_python:
+        cmd = [args.user_script]
+    elif args.module:
+        cmd = [sys.executable, "-u", "-m", args.user_script]
+    else:
+        cmd = [sys.executable, "-u", args.user_script]
+    return cmd + list(args.user_args)
+
+
+def terminate_process_tree(proc: subprocess.Popen, timeout: float = 30.0):
+    """SIGTERM then SIGKILL the worker's process group (reference
+    launch.py:118)."""
+    if proc.poll() is not None:
+        return
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.1)
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def main(args=None):
+    args = parse_args(args)
+    if args.save_pid:
+        with open(args.save_pid, "w") as f:
+            f.write(str(os.getpid()))
+
+    env = build_worker_env(args)
+    cmd = build_worker_cmd(args)
+    logger.info(f"launch: node {args.node_rank}/{args.nnodes} "
+                f"coordinator={args.coordinator_address} cmd={cmd}")
+
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    def _forward(signum, frame):
+        logger.info(f"launch: forwarding signal {signum} to worker")
+        terminate_process_tree(proc)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, _forward)
+    signal.signal(signal.SIGTERM, _forward)
+
+    rc = proc.wait()
+    if rc != 0:
+        logger.error(f"launch: worker exited with code {rc}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

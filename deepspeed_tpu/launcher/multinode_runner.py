@@ -9,6 +9,7 @@ accelerator.  Command construction is pure and unit-testable, exactly like the
 reference's tests (tests/unit/launcher/test_multinode_runner.py).
 """
 import os
+import shlex
 import shutil
 import sys
 from abc import ABC, abstractmethod
@@ -34,6 +35,28 @@ class MultiNodeRunner(ABC):
     def num_nodes(self) -> int:
         return len(self.world_info)
 
+    @property
+    def master_addr(self) -> str:
+        """User-supplied --master_addr wins; default is the first host."""
+        return getattr(self.args, "master_addr", "") or self.hosts[0]
+
+    def launch_module_args(self, node_rank: str = "auto") -> List[str]:
+        """The per-node ``launcher.launch`` invocation that exports the JAX
+        coordination env (COORDINATOR_ADDRESS/NPROC/PROCESS_ID) before the
+        user script — every backend routes through it so multi-node jobs
+        rendezvous instead of running N independent single-host jobs."""
+        cmd = [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            f"--coordinator_address={self.master_addr}:{self.args.master_port}",
+            f"--nnodes={self.num_nodes}",
+            f"--node_rank={node_rank}",
+        ]
+        if getattr(self.args, "module", False):
+            cmd.append("--module")
+        if getattr(self.args, "no_python", False):
+            cmd.append("--no_python")
+        return cmd + [self.user_script] + self.user_arguments
+
     @abstractmethod
     def get_cmd(self, environment: Dict[str, str],
                 active_resources: Dict[str, List[int]]) -> List[str]:
@@ -57,18 +80,26 @@ class PDSHRunner(MultiNodeRunner):
         environment = dict(environment)
         environment["PDSH_RCMD_TYPE"] = "ssh"
         hosts = ",".join(self.hosts)
-        exports = "".join(f"export {k}={v}; " for k, v in
-                          sorted({**self.exports}.items()))
-        master = self.hosts[0]
-        # each host runs launch.py once with its PROCESS_ID derived from %n
+        # pdsh interpolates the command through a remote shell: quote values
+        exports = "".join(f"export {k}={shlex.quote(v)}; " for k, v in
+                          sorted(self.exports.items()))
+        # each host runs launch.py once with its PROCESS_ID derived from %n;
+        # script/args pass through the remote shell, so quote each word
+        flags = ""
+        if getattr(self.args, "module", False):
+            flags += "--module "
+        if getattr(self.args, "no_python", False):
+            flags += "--no_python "
+        user = " ".join(shlex.quote(w) for w in
+                        [self.user_script] + self.user_arguments)
         cmd = [
             "pdsh", "-S", "-f", "1024", "-w", hosts,
-            exports + f"cd {os.path.abspath('.')}; "
+            exports + f"cd {shlex.quote(os.path.abspath('.'))}; "
             f"{sys.executable} -m deepspeed_tpu.launcher.launch "
-            f"--coordinator_address={master}:{self.args.master_port} "
+            f"--coordinator_address={self.master_addr}:{self.args.master_port} "
             f"--nnodes={self.num_nodes} "
             f"--node_rank=%n "
-            + self.user_script + " " + " ".join(self.user_arguments),
+            + flags + user,
         ]
         return cmd
 
@@ -81,14 +112,17 @@ class OpenMPIRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total_procs = self.num_nodes
+        # --host (not the raw hostfile) so --include/--exclude/--num_nodes
+        # filtering applied by runner.main is honoured
+        host_list = ",".join(f"{h}:1" for h in self.hosts)
         cmd = [
             "mpirun", "-n", f"{total_procs}", "--npernode", "1",
-            "--hostfile", self.args.hostfile,
+            "--host", host_list,
             "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include", "eth0",
         ]
         for k, v in sorted(self.exports.items()):
             cmd += ["-x", f"{k}={v}"]
-        cmd += [sys.executable, "-u", self.user_script] + self.user_arguments
+        cmd += self.launch_module_args(node_rank="auto")
         return cmd
 
 
@@ -99,10 +133,11 @@ class MPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self, environment, active_resources):
-        cmd = ["mpirun", "-n", f"{self.num_nodes}", "-ppn", "1"]
+        cmd = ["mpirun", "-n", f"{self.num_nodes}", "-ppn", "1",
+               "-hosts", ",".join(self.hosts)]
         for k, v in sorted(self.exports.items()):
             cmd += ["-genv", k, v]
-        cmd += [sys.executable, "-u", self.user_script] + self.user_arguments
+        cmd += self.launch_module_args(node_rank="auto")
         return cmd
 
 
@@ -116,7 +151,7 @@ class IMPIRunner(MultiNodeRunner):
         cmd = ["mpirun", "-ppn", "1", "-hosts", ",".join(self.hosts)]
         for k, v in sorted(self.exports.items()):
             cmd += ["-genv", k, v]
-        cmd += [sys.executable, "-u", self.user_script] + self.user_arguments
+        cmd += self.launch_module_args(node_rank="auto")
         return cmd
 
 
@@ -134,7 +169,7 @@ class SlurmRunner(MultiNodeRunner):
             exports = ",".join(f"{k}={v}"
                                for k, v in sorted(self.exports.items()))
             cmd += [f"--export=ALL,{exports}"]
-        cmd += [sys.executable, "-u", self.user_script] + self.user_arguments
+        cmd += self.launch_module_args(node_rank="auto")
         return cmd
 
 
@@ -148,11 +183,13 @@ class GcloudTPURunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         tpu_name = getattr(self.args, "tpu_name", "tpu")
         zone = getattr(self.args, "zone", "")
-        exports = "".join(f"export {k}={v}; " for k, v in
+        # the --command string runs through the remote shell: quote values
+        exports = "".join(f"export {k}={shlex.quote(v)}; " for k, v in
                           sorted(self.exports.items()))
-        inner = (exports + f"cd {os.path.abspath('.')}; "
-                 f"{sys.executable} -u {self.user_script} "
-                 + " ".join(self.user_arguments))
+        user = " ".join(shlex.quote(w) for w in
+                        [self.user_script] + self.user_arguments)
+        inner = (exports + f"cd {shlex.quote(os.path.abspath('.'))}; "
+                 f"{sys.executable} -u " + user)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
                "--worker=all", "--command", inner]
         if zone:

@@ -1,0 +1,259 @@
+"""``deepspeed`` CLI equivalent (reference: deepspeed/launcher/runner.py:387).
+
+Parses a hostfile + ``--include/--exclude`` filters, chooses a multinode
+runner backend (pdsh / openmpi / mpich / impi / slurm / gcloud), and launches
+the user script across hosts.  On TPU a "slot" is a host process (JAX
+single-controller SPMD owns every local chip), so slot filters select hosts,
+not accelerator indices.
+
+Single-host jobs skip the runner entirely and invoke
+:mod:`deepspeed_tpu.launcher.launch` logic in-process — the reference's
+``launch.py`` subprocess path (runner.py:514).
+"""
+import argparse
+import base64
+import collections
+import json
+import os
+import re
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.multinode_runner import (
+    PDSHRunner, OpenMPIRunner, MPICHRunner, IMPIRunner, SlurmRunner,
+    GcloudTPURunner)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "JAX_", "XLA_", "TPU_", "LIBTPU_"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+RUNNERS = {
+    "pdsh": PDSHRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "impi": IMPIRunner,
+    "slurm": SlurmRunner,
+    "gcloud": GcloudTPURunner,
+}
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="hosts to include: NODE_SPEC[@NODE_SPEC ...]")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="hosts to exclude, same syntax as --include")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="cap on the number of hosts to use")
+    parser.add_argument("--master_port", type=int, default=29500,
+                        help="JAX coordinator port on the first host")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="JAX coordinator address (default: first host)")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=sorted(RUNNERS.keys()),
+                        help="multinode launch backend")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra args for the launch backend")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="force multinode mode even for one host")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=("", "run", "tune"),
+                        help="run the autotuner instead of a training job")
+    parser.add_argument("--module", action="store_true",
+                        help="run the user script as a python module")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec the user script without the interpreter")
+    parser.add_argument("--tpu_name", type=str, default="",
+                        help="gcloud runner: TPU VM name")
+    parser.add_argument("--zone", type=str, default="",
+                        help="gcloud runner: TPU VM zone")
+    parser.add_argument("user_script", type=str,
+                        help="user training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse 'hostname slots=N' lines (reference runner.py:199)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning("Unable to find hostfile, proceeding with local "
+                       "resources only.")
+        return None
+    with open(hostfile_path) as fd:
+        return _parse_hostfile(fd.readlines())
+
+
+def _parse_hostfile(lines):
+    pattern = r"^(\S+)\s+slots=(\d+)"
+    pool = collections.OrderedDict()
+    for line in lines:
+        line = line.strip()
+        if line.startswith("#") or line == "":
+            continue
+        match = re.search(pattern, line)
+        if not match:
+            raise ValueError(f"Hostfile contains a bad entry: {line}")
+        host, slots = match.group(1), int(match.group(2))
+        if host in pool:
+            raise ValueError(f"Hostfile contains multiple entries for {host}")
+        pool[host] = slots
+    if not pool:
+        raise ValueError("Hostfile is empty or not formatted correctly")
+    return pool
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Filter hosts with NODE_SPEC[@NODE_SPEC ...] syntax, where
+    NODE_SPEC = NAME[:SLOT[,SLOT ...]] (reference runner.py:254)."""
+    NODE_SEP, SLOT_LIST_START, SLOT_SEP = "@", ":", ","
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive.")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered = collections.OrderedDict()
+    if include_str:
+        parse_str = include_str
+    else:
+        parse_str = exclude_str
+        for host, slots in host_info.items():
+            filtered[host] = list(range(slots))
+
+    for node_config in parse_str.split(NODE_SEP):
+        if SLOT_LIST_START in node_config:
+            hostname, slot_str = node_config.split(SLOT_LIST_START)
+            slots = [int(x) for x in slot_str.split(SLOT_SEP)]
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+            for s in slots:
+                if s >= host_info[hostname]:
+                    raise ValueError(f"No slot '{s}' specified on host "
+                                     f"'{hostname}'")
+            if include_str:
+                filtered.setdefault(hostname, [])
+                filtered[hostname] = sorted(set(filtered[hostname] + slots))
+            else:
+                for s in slots:
+                    if s in filtered.get(hostname, []):
+                        filtered[hostname].remove(s)
+                if not filtered.get(hostname):
+                    filtered.pop(hostname, None)
+        else:
+            hostname = node_config
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+            if include_str:
+                filtered[hostname] = list(range(host_info[hostname]))
+            else:
+                filtered.pop(hostname, None)
+    return filtered
+
+
+def encode_world_info(world_info):
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded))
+
+
+def _collect_exports(args):
+    """Env vars propagated to remote hosts (reference's EXPORT_ENVS +
+    .deepspeed_env file).  Values are raw — shell-interpolating runners
+    (pdsh/gcloud) quote them at command-build time; exec-style runners
+    (mpirun/srun) must receive them unquoted."""
+    exports = {}
+    for var, val in os.environ.items():
+        if any(var == v or (v.endswith("_") and var.startswith(v))
+               for v in EXPORT_ENVS):
+            exports[var] = val
+    env_file = os.path.join(os.path.expanduser("~"),
+                            DEEPSPEED_ENVIRONMENT_NAME)
+    for candidate in (DEEPSPEED_ENVIRONMENT_NAME, env_file):
+        if os.path.isfile(candidate):
+            with open(candidate) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and "=" in line and not line.startswith("#"):
+                        key, val = line.split("=", 1)
+                        exports[key] = val
+            break
+    return exports
+
+
+def run_single_host(args):
+    """Single-host path: run launch.py logic in a subprocess (reference
+    runner.py:514 builds the same command)."""
+    from deepspeed_tpu.launcher import launch as launch_mod
+    launch_args = [
+        f"--coordinator_address=127.0.0.1:{args.master_port}",
+        "--nnodes=1", "--node_rank=0",
+    ]
+    if args.module:
+        launch_args.append("--module")
+    if args.no_python:
+        launch_args.append("--no_python")
+    launch_args.append(args.user_script)
+    launch_args += args.user_args
+    parsed = launch_mod.parse_args(launch_args)
+    env = launch_mod.build_worker_env(parsed)
+    cmd = launch_mod.build_worker_cmd(parsed)
+    logger.info(f"deepspeed_tpu launcher: single host, cmd={cmd}")
+    result = subprocess.run(cmd, env=env)
+    return result.returncode
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if args.autotuning:
+        try:
+            from deepspeed_tpu.autotuning.autotuner import run_autotuning
+        except ImportError as e:
+            raise RuntimeError(
+                "autotuning requires the deepspeed_tpu.autotuning package"
+            ) from e
+        return run_autotuning(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+    multi_node = resource_pool is not None and (
+        len(resource_pool) > 1 or args.force_multi)
+    if not multi_node:
+        return run_single_host(args)
+
+    active = parse_resource_filter(
+        {h: s for h, s in resource_pool.items()},
+        args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = collections.OrderedDict(
+            list(active.items())[:args.num_nodes])
+    world_info = {h: (len(v) if isinstance(v, list) else v)
+                  for h, v in active.items()}
+    if not args.master_addr:
+        args.master_addr = list(world_info.keys())[0]
+
+    runner_cls = RUNNERS[args.launcher]
+    runner = runner_cls(args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(
+            f"launcher backend '{args.launcher}' is not installed")
+    for var, val in _collect_exports(args).items():
+        runner.add_export(var, val)
+    env = os.environ.copy()
+    active_resources = {h: (v if isinstance(v, list) else list(range(v)))
+                        for h, v in active.items()}
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"deepspeed_tpu launcher: {args.launcher} cmd: "
+                f"{' '.join(map(str, cmd))}")
+    result = subprocess.run(cmd, env=env)
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

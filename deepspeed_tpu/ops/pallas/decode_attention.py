@@ -127,7 +127,7 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
         sm_scale = hd ** -0.5
     # pick the largest tile-aligned block that divides S_max; pad the cache
     # as a last resort (a copy — callers should size caches to a multiple of
-    # 128 to avoid it; the engine's bucketing does)
+    # 64 to avoid it; the engine aligns its cache buffer to 64)
     for cand in (block_s, 256, 128, 64, 32, 16, 8):
         if cand <= S_max and S_max % cand == 0:
             block_s = cand
