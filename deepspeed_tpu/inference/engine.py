@@ -96,8 +96,11 @@ class InferenceEngine:
             B = tokens.shape[0]
 
             def cond(state):
+                # no all-done early exit: the loop keeps writing EOS so the
+                # tail matches the cached path token-for-token (the oracle
+                # contract); done rows cost almost nothing
                 cur, _, _, done = state
-                return jnp.logical_and(cur < total_len, ~jnp.all(done))
+                return cur < total_len
 
             def body(state):
                 cur, toks, rng, done = state

@@ -165,10 +165,11 @@ class SlurmRunner(MultiNodeRunner):
         cmd = ["srun", "-n", f"{self.num_nodes}", "--ntasks-per-node=1"]
         if getattr(self.args, "comment", ""):
             cmd += ["--comment", self.args.comment]
-        if self.exports:
-            exports = ",".join(f"{k}={v}"
-                               for k, v in sorted(self.exports.items()))
-            cmd += [f"--export=ALL,{exports}"]
+        # srun inherits the submitting environment with --export=ALL; set the
+        # exports there instead of the comma-separated --export list, which
+        # cannot carry values containing spaces or commas (XLA_FLAGS does)
+        environment.update(self.exports)
+        cmd += ["--export=ALL"]
         cmd += self.launch_module_args(node_rank="auto")
         return cmd
 
@@ -188,8 +189,14 @@ class GcloudTPURunner(MultiNodeRunner):
                           sorted(self.exports.items()))
         user = " ".join(shlex.quote(w) for w in
                         [self.user_script] + self.user_arguments)
+        if getattr(self.args, "no_python", False):
+            interp = ""
+        elif getattr(self.args, "module", False):
+            interp = f"{sys.executable} -u -m "
+        else:
+            interp = f"{sys.executable} -u "
         inner = (exports + f"cd {shlex.quote(os.path.abspath('.'))}; "
-                 f"{sys.executable} -u " + user)
+                 + interp + user)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
                "--worker=all", "--command", inner]
         if zone:

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, maybe_stream
 from deepspeed_tpu.ops.attention import causal_attention
 
 
@@ -187,7 +187,11 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
 
-    block_fn = partial(_block, config=config, rng=rng)
+    # stream-inside-remat: with ZeRO-Infinity param offload the layer slice is
+    # transferred host→device *inside* the remat boundary, so backward
+    # re-streams it instead of keeping every layer's device copy alive
+    def block_fn(x, layer):
+        return _block(x, maybe_stream(layer), config, rng)
     if config.remat:
         block_fn = jax.checkpoint(block_fn,
                                   policy=remat_policy(config.remat_policy))

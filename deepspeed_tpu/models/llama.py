@@ -164,7 +164,11 @@ def forward(params, batch, config: LlamaConfig, rng=None):
     tokens = batch["input_ids"]
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens]
-    block_fn = partial(_block, config=config, rng=rng)
+    # stream-inside-remat (see models/model.py maybe_stream): param-offload
+    # transfers happen inside the remat boundary
+    def block_fn(x, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        return _block(x, maybe_stream(layer), config, rng)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(

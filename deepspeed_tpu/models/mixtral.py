@@ -135,7 +135,10 @@ def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
     tokens = batch["input_ids"]
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens]
-    block_fn = partial(_block, config=config, train=train, rng=rng)
+    # stream-inside-remat (see models/model.py maybe_stream)
+    def block_fn(x, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        return _block(x, maybe_stream(layer), config, train=train, rng=rng)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(

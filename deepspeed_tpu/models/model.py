@@ -5,8 +5,88 @@ instead consumes a pure (init, apply, loss) triple plus per-parameter logical
 PartitionSpecs carrying the tensor-parallel layout.  Anything — flax, haiku, or
 hand-rolled pytrees — can be adapted to this.
 """
+import contextlib
+import contextvars
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+# ---------------------------------------------------------------- param stream
+# ZeRO-Infinity parameter offload (reference: partitioned_param_swapper.py:36 +
+# parameter_offload.py:201).  When enabled, layer-stacked block params are
+# *stored* in pinned host memory (engine assigns memory_kind="pinned_host"
+# shardings) and each layer's slice is transferred to device inside the
+# layer scan — XLA overlaps the host→device DMA with the previous layer's
+# compute, so HBM holds O(1 layer) of params instead of the whole model.
+_PARAM_STREAM: contextvars.ContextVar = contextvars.ContextVar(
+    "ds_param_stream", default=False)
+
+
+@contextlib.contextmanager
+def param_stream_scope(enabled: bool = True, mesh=None, layer_specs=None):
+    """Enable per-layer host→device param streaming for models traced inside
+    this scope (the engine wraps its compiled-step invocations with it).
+
+    ``layer_specs`` — flat list of per-leaf PartitionSpecs for ONE layer's
+    slice (stacked leading dim stripped), aligned with
+    ``jax.tree.leaves(layer_tree)``.  Required on multi-device meshes: the
+    SPMD partitioner needs an explicit sharding on the transfer."""
+    value = (mesh, layer_specs) if enabled else False
+    token = _PARAM_STREAM.set(value)
+    try:
+        yield
+    finally:
+        _PARAM_STREAM.reset(token)
+
+
+def param_stream_active() -> bool:
+    return bool(_PARAM_STREAM.get())
+
+
+def maybe_stream(layer_tree):
+    """Inside a layer-scan body: move this layer's (possibly host-resident)
+    params to device memory.  No-op unless inside ``param_stream_scope``.
+    Call *inside* the remat boundary so the backward pass re-streams the
+    layer instead of pinning its device copy in HBM."""
+    cfg = _PARAM_STREAM.get()
+    if not cfg:
+        return layer_tree
+    import jax
+    mesh, layer_specs = cfg
+    leaves, treedef = jax.tree_util.tree_flatten(layer_tree)
+    if mesh is None or layer_specs is None:
+        targets = [jax.memory.Space.Device] * len(leaves)
+    else:
+        from jax.sharding import NamedSharding
+        assert len(layer_specs) == len(leaves), \
+            f"param_stream specs/leaves mismatch: {len(layer_specs)} vs {len(leaves)}"
+        # None spec = leaf already device-resident (persistent-small): no-op
+        targets = [None if s is None
+                   else NamedSharding(mesh, s, memory_kind="device")
+                   for s in layer_specs]
+    moved = [w if t is None else _stream_transfer(w, t)
+             for w, t in zip(leaves, targets)]
+    return jax.tree_util.tree_unflatten(treedef, moved)
+
+
+def _stream_transfer(w, target):
+    """host→device transfer whose VJP passes the cotangent through untouched
+    (the raw transpose would be a device→host transfer annotation that XLA's
+    SPMD partitioner mishandles on multi-device meshes; the jit-level
+    out_shardings place the grads instead)."""
+    import jax
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.device_put(x, target)
+
+    def fwd(x):
+        return jax.device_put(x, target), None
+
+    def bwd(_, g):
+        return (g,)
+
+    f.defvjp(fwd, bwd)
+    return f(w)
 
 
 @dataclass

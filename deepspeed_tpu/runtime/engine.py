@@ -114,6 +114,27 @@ class DeepSpeedEngine:
         off = zc.offload_optimizer
         self._offload_device = off.device if off is not None else "none"
         self._offload = self._offload_device in ("cpu", "nvme")
+        # ZeRO-Infinity parameter offload (reference:
+        # partitioned_param_swapper.py:36 + parameter_offload.py:201): block
+        # params are stored in pinned host memory and streamed per layer into
+        # the scan (models/model.py maybe_stream); pairs with the host
+        # optimizer tier, which owns the fp32 masters anyway.
+        offp = zc.offload_param
+        self._offload_param_device = offp.device if offp is not None else "none"
+        self._offload_param = self._offload_param_device in ("cpu", "nvme")
+        if self._offload_param and not self._offload:
+            raise ValueError(
+                "offload_param requires offload_optimizer (the ZeRO-Infinity "
+                "tier pairs parameter offload with the host optimizer)")
+        if self._offload_param and len(list(self.mesh.devices.flat)) > 1:
+            # Param streaming is the single-chip memory-extension tier (the
+            # reference's 13B-on-one-V100 scenario): on multi-device meshes
+            # ZeRO-3 already shards params 1/N on device, and XLA's SPMD
+            # partitioner cannot place the replicated pinned-host buffers the
+            # streaming layout needs.
+            raise ValueError(
+                "offload_param supports single-device meshes; on multi-device "
+                "meshes use ZeRO stage 3 (params are sharded across devices)")
 
         # ---- parameters ------------------------------------------------------
         # Parameters are *born sharded*: shapes come from eval_shape, the ZeRO
@@ -128,22 +149,75 @@ class DeepSpeedEngine:
         else:
             shapes = jax.eval_shape(lambda: model_parameters)
         # with host offload, the device keeps only a compute-dtype working
-        # copy; fp32 masters live in host DRAM (reference ZeRO-Offload shape)
+        # copy; fp32 masters live in host DRAM (reference ZeRO-Offload shape).
+        # Streamed tier: the pinned-host fp32 master IS the stored params
+        # (the loss casts to compute dtype per streamed layer slice).
+        opt_name = (self._config.optimizer_name or "adam").lower()
+        self._use_streamed = (
+            self._offload and self._offload_param
+            and self._offload_device == "cpu"
+            and opt_name in ("adam", "adamw"))
         storage_dtype = self.compute_dtype if self._offload else jnp.float32
         shapes = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(s.shape, storage_dtype)
             if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
         self.param_specs = self.zero_policy.param_specs(shapes, logical)
         self.param_shardings = self.zero_policy.shardings(self.param_specs)
+        if self._offload_param:
+            bk = getattr(model, "blocks_key", "blocks")
+            if not (isinstance(self.param_shardings, dict)
+                    and bk in self.param_shardings):
+                raise ValueError(
+                    f"offload_param needs a layer-stacked '{bk}' params "
+                    f"subtree to stream (model.blocks_key)")
+            # only matrix-shaped leaves offload (>=3 dims incl. the layer
+            # stack): they are ~99.9% of block params, and libtpu cannot
+            # compile dynamic-slice on packed bf16 2-D host buffers (biases /
+            # norm scales stay device-resident, like the reference's
+            # persistent small params)
+            self.param_shardings[bk] = jax.tree.map(
+                lambda sh, s: (sh.with_memory_kind("pinned_host")
+                               if len(s.shape) >= 3 else sh),
+                self.param_shardings[bk], shapes[bk])
+            if not getattr(getattr(model, "config", None), "remat", False):
+                logger.warning(
+                    "offload_param without per-layer remat keeps every "
+                    "streamed layer's device copy alive for backward — set "
+                    "the model's remat=True to bound HBM at O(1 layer)")
         if model_parameters is None:
-            params = jax.jit(
-                lambda r: _tree_cast(model.init(r), storage_dtype),
-                out_shardings=self.param_shardings)(init_rng)
+            try:
+                params = jax.jit(
+                    lambda r: _tree_cast(model.init(r), storage_dtype),
+                    out_shardings=self.param_shardings)(init_rng)
+            except Exception:
+                if not self._offload_param:
+                    raise
+                # the CPU-mesh SPMD partitioner rejects pinned-host
+                # out_shardings; init on device and relocate (one-time copy)
+                device_shardings = jax.tree.map(
+                    lambda s: s.with_memory_kind("device"),
+                    self.param_shardings)
+                params = jax.jit(
+                    lambda r: _tree_cast(model.init(r), storage_dtype),
+                    out_shardings=device_shardings)(init_rng)
+                params = jax.device_put(params, self.param_shardings)
         else:
             params = jax.device_put(_tree_cast(model_parameters, storage_dtype),
                                     self.param_shardings)
         self.grad_specs = self.zero_policy.grad_specs(params, logical)
         self.grad_shardings = self.zero_policy.shardings(self.grad_specs)
+        devices_flat = list(self.mesh.devices.flat)
+        if self._offload_param and devices_flat[0].platform == "tpu":
+            # block grads land in pinned host too: the backward scan DMAs each
+            # layer's grad slice out as it is produced, so the full fp32 grad
+            # never resides in HBM.  TPU only: the CPU runtime has no
+            # implementation for host-placement annotations on jit outputs.
+            # Same >=3-dim rule as the param storage above.
+            bk = getattr(model, "blocks_key", "blocks")
+            self.grad_shardings[bk] = jax.tree.map(
+                lambda s, shp: (s.with_memory_kind("pinned_host")
+                                if len(shp.shape) >= 3 else s),
+                self.grad_shardings[bk], shapes[bk])
         opt_param_specs = self.zero_policy.optimizer_specs_for_params(params, logical)
 
         # ---- optimizer -------------------------------------------------------
@@ -158,7 +232,24 @@ class DeepSpeedEngine:
         self.base_lr = base_lr
 
         self.host_optimizer = None
-        if self._offload:
+        self.streamed_optimizer = None
+        if self._use_streamed:
+            # TPU-native ZeRO-Infinity tier: optimizer state in pinned host
+            # DRAM, update streamed on device — no Python/host round trips
+            # (the C++ host-Adam path remains for NVMe and non-Adam configs)
+            from deepspeed_tpu.runtime.zero.device_offload import \
+                StreamedOptimizer
+            self.streamed_optimizer = StreamedOptimizer(
+                params, self.param_shardings,
+                getattr(model, "blocks_key", "blocks"),
+                self._config.optimizer_name, self._config.optimizer_params,
+                gradient_clipping=self._config.gradient_clipping,
+                lr_schedule=self.lr_schedule, mesh=self.mesh)
+            self.optimizer = self.streamed_optimizer
+            opt_state = ()
+            self.opt_specs = ()
+            self.opt_shardings = ()
+        elif self._offload:
             from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
             nvme_swapper = None
             if self._offload_device == "nvme":
@@ -174,7 +265,8 @@ class DeepSpeedEngine:
                 self._config.optimizer_params,
                 gradient_clipping=self._config.gradient_clipping,
                 lr_schedule=self.lr_schedule,
-                nvme_swapper=nvme_swapper)
+                nvme_swapper=nvme_swapper,
+                masters_on_nvme=self._offload_device == "nvme")
             self.optimizer = self.host_optimizer
             opt_state = ()
             self.opt_specs = ()
@@ -335,7 +427,17 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ loss fn
     def _scaled_loss_fn(self, params, batch, rng, scale):
-        cparams = _tree_cast(params, self.compute_dtype)
+        if self._use_streamed and isinstance(params, dict):
+            # blocks stay fp32 in pinned host; the models cast each weight at
+            # point of use (after the per-layer stream), so the AD transpose
+            # stays per-slice — a whole-tree cast here would materialise full
+            # stacked fp32 converts on device in the backward pass
+            bk = getattr(self.model, "blocks_key", "blocks")
+            cparams = {k: (v if k == bk
+                           else _tree_cast(v, self.compute_dtype))
+                       for k, v in params.items()}
+        else:
+            cparams = _tree_cast(params, self.compute_dtype)
         loss = self.model.loss(cparams, batch, rng)
         return loss.astype(jnp.float32) * scale
 
@@ -444,6 +546,16 @@ class DeepSpeedEngine:
         }
         return new_state, metrics
 
+    def _grad_out_shardings(self):
+        """Grad out_shardings for the offload paths.  With pinned-host params
+        on a non-TPU backend, explicit out_shardings make JAX emit a host
+        placement annotation the CPU runtime cannot execute — omit them there
+        (grads then default to device placement)."""
+        if (self._offload_param and
+                list(self.mesh.devices.flat)[0].platform != "tpu"):
+            return None
+        return self.grad_shardings
+
     def _get_compiled(self, name: str):
         if name in self._compiled:
             return self._compiled[name]
@@ -469,9 +581,10 @@ class DeepSpeedEngine:
                 grads = self.zero_policy.constrain_grads(grads, self.grad_specs)
                 grads = jax.tree.map(jnp.add, grads_acc, grads)
                 return loss / scale * gas, grads
+            gos = self._grad_out_shardings()
             fn = jax.jit(
                 grad_fn,
-                out_shardings=(None, self.grad_shardings),
+                out_shardings=(None, gos) if gos is not None else None,
                 donate_argnums=(3,))
         elif name == "grad_step":
             # offload path: scan the gas micro-batches, stop at gradients
@@ -500,6 +613,35 @@ class DeepSpeedEngine:
                 return loss_sum / scale, grads
 
             fn = jax.jit(grad_step, out_shardings=(None, self.grad_shardings))
+        elif name == "grad_micro":
+            # offload_param path: ONE micro-batch per call, python-level grad
+            # accumulation on host — the gas-scan would keep full fp32 grads
+            # resident on device, exactly what param offload must avoid
+            gas = self.gradient_accumulation_steps()
+
+            def grad_micro(state, mb, rng):
+                scale = (state["scaler"].cur_scale
+                         if self._config.fp16.enabled else jnp.float32(1.0))
+                loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
+                    state["params"], mb, rng, scale / gas)
+                # grads keep the params' storage dtype: a full-tensor fp32
+                # convert would materialise each stacked leaf on device (8 GB
+                # per MLP leaf at 6.7B); the streamed optimizer upcasts per
+                # layer slice instead
+                return loss / scale * gas, grads
+
+            gos = self._grad_out_shardings()
+            fn = jax.jit(grad_micro,
+                         out_shardings=(None, gos) if gos is not None else None)
+        elif name == "grad_acc":
+            # gas accumulation for the streamed-optimizer path; leaves bounce
+            # through device whole-leaf (transient HBM = largest leaf)
+            def acc_fn(a, b):
+                return jax.tree.map(jnp.add, a, b)
+            gos = self._grad_out_shardings()
+            fn = (jax.jit(acc_fn, out_shardings=gos, donate_argnums=(0,))
+                  if gos is not None
+                  else jax.jit(acc_fn, donate_argnums=(0,)))
         elif name == "apply":
             fn = jax.jit(
                 self._apply_grads,
@@ -509,13 +651,41 @@ class DeepSpeedEngine:
             def make_zeros(params):
                 return jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            fn = jax.jit(make_zeros, out_shardings=self.grad_shardings)
+            fn = jax.jit(make_zeros, out_shardings=self._grad_out_shardings())
         else:
             raise KeyError(name)
         self._compiled[name] = fn
         return fn
 
     # ------------------------------------------------------------------ data utils
+    def _stream_scope(self):
+        """param_stream_scope when offload_param is on (tracing of the wrapped
+        compiled fn happens on its first call, inside this scope)."""
+        from deepspeed_tpu.models.model import param_stream_scope
+        import contextlib
+        if not self._offload_param:
+            return contextlib.nullcontext()
+        bk = getattr(self.model, "blocks_key", "blocks")
+        # stream each layer to its LOGICAL (tensor-parallel) layout: ZeRO
+        # storage axes are dropped, so the transfer is also the stage-3
+        # per-layer gather (reference fetch_sub_module,
+        # partitioned_param_coordinator.py:256)
+        logical = getattr(self.model, "logical_specs", None)
+        src = (logical[bk] if isinstance(logical, dict) and bk in logical
+               else self.param_specs[bk])
+        is_p = lambda x: isinstance(x, P)
+        specs = jax.tree.leaves(src, is_leaf=is_p)
+        shardings = jax.tree.leaves(
+            self.param_shardings[bk],
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        # one layer's slice: the stacked leading dim is stripped by the scan;
+        # device-resident (persistent-small) leaves skip the transfer (None)
+        layer_specs = [
+            P(*tuple(s)[1:]) if sh.memory_kind == "pinned_host" else None
+            for s, sh in zip(specs, shardings)]
+        return param_stream_scope(True, mesh=self.mesh,
+                                  layer_specs=layer_specs)
+
     def _next_rng(self):
         self._rng, out = jax.random.split(self._rng)
         return out
@@ -578,7 +748,29 @@ class DeepSpeedEngine:
                     f"train_batch(batch=...) leaves must lead with gas={gas}, "
                     f"got {lead}")
         batch = self._shard_batch(batch, stacked=True)
-        if self._offload:
+        if self._offload_param:
+            fn = self._get_compiled("grad_micro")
+            gas = self.gradient_accumulation_steps()
+            acc = None
+            losses = []
+            for i in range(gas):
+                mb = jax.tree.map(lambda x: x[i], batch)
+                with self._stream_scope():
+                    loss, grads = fn(self.state, mb, self._next_rng())
+                losses.append(loss)
+                if self.streamed_optimizer is not None:
+                    # stays on device / pinned host — no Python round trip
+                    acc = (grads if acc is None else
+                           self._get_compiled("grad_acc")(acc, grads))
+                else:
+                    g = jax.tree.map(np.asarray, grads)
+                    acc = g if acc is None else jax.tree.map(np.add, acc, g)
+            mean_loss = sum(losses) / gas        # device scalars, async
+            if self.streamed_optimizer is not None:
+                metrics = self._streamed_apply(acc, mean_loss)
+            else:
+                metrics = self._host_apply(acc, mean_loss)
+        elif self._offload:
             loss, grads = self._get_compiled("grad_step")(
                 self.state, batch, self._next_rng())
             metrics = self._host_apply(grads, loss)
@@ -604,8 +796,9 @@ class DeepSpeedEngine:
         if self._micro_grads is None:
             self._micro_grads = self._get_compiled("zero_grads")(
                 self.state["params"])
-        loss, grads = self._get_compiled("grad")(
-            self.state, batch, self._next_rng(), self._micro_grads)
+        with self._stream_scope():
+            loss, grads = self._get_compiled("grad")(
+                self.state, batch, self._next_rng(), self._micro_grads)
         self._micro_grads = None   # donated into grads
         self._pending_grads = grads
         self._last_loss = loss
@@ -629,7 +822,9 @@ class DeepSpeedEngine:
             return
         if self._micro_grads is None:
             raise RuntimeError("step() called without accumulated gradients")
-        if self._offload:
+        if self.streamed_optimizer is not None:
+            metrics = self._streamed_apply(self._micro_grads, self._last_loss)
+        elif self._offload:
             metrics = self._host_apply(self._micro_grads, self._last_loss)
         else:
             self.state, metrics = self._get_compiled("apply")(
@@ -638,6 +833,32 @@ class DeepSpeedEngine:
                 metrics["loss"] = self._last_loss
         self._micro_grads = None
         self._finish_step(metrics)
+
+    def _streamed_apply(self, grads, loss):
+        """Streamed-optimizer epilogue: the update runs on device over
+        pinned-host state; only python-side counters advance here (no device
+        sync — overflow/grad-norm stay device scalars, banked lazily)."""
+        fp16 = self._config.fp16.enabled
+        scaler = self.state["scaler"]
+        # device scalars pass straight through as jit arguments — a float()
+        # here would block on the previous step's whole update
+        scale = scaler.cur_scale if fp16 else 1.0
+        new_params, grad_norm, overflow = self.streamed_optimizer.step(
+            grads, self.compute_dtype, scale, self.state["step"])
+        self.state["params"] = new_params
+        # overflow steps don't advance the schedule/bias-correction step
+        # (reference skip semantics; matches _apply_grads)
+        self.state["step"] = self.state["step"] + jnp.where(
+            overflow, jnp.int32(0), jnp.int32(1))
+        if fp16:
+            self.state["scaler"] = update_scale(
+                scaler, overflow, self.scaler_config)
+        return {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "overflow": overflow,
+            "loss_scale": self.state["scaler"].cur_scale,
+        }
 
     def _host_apply(self, grads, loss):
         """Offload epilogue: unscale on host, C++ optimizer step in host DRAM
@@ -668,7 +889,9 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch, stacked=False)
-        return self._get_compiled("loss")(self.state, batch, self._next_rng())
+        with self._stream_scope():
+            return self._get_compiled("loss")(self.state, batch,
+                                              self._next_rng())
 
     def _finish_step(self, metrics):
         self.global_steps += 1
@@ -725,6 +948,9 @@ class DeepSpeedEngine:
             "config": self._config._param_dict,
         }
         save_state(ckpt_dir, self.state, extra)
+        if self.streamed_optimizer is not None:
+            self.streamed_optimizer.save_npz(
+                os.path.join(ckpt_dir, "streamed_optimizer.npz"))
         if self.host_optimizer is not None:
             import numpy as np_
             sd = self.host_optimizer.state_dict()
@@ -758,6 +984,11 @@ class DeepSpeedEngine:
             ckpt_dir, self.state, self.state_shardings,
             load_optimizer_states=load_optimizer_states and not load_module_only)
         self.state = state
+        streamed_path = os.path.join(ckpt_dir, "streamed_optimizer.npz")
+        if (self.streamed_optimizer is not None
+                and os.path.exists(streamed_path)
+                and load_optimizer_states and not load_module_only):
+            self.streamed_optimizer.load_npz(streamed_path)
         host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
         if self.host_optimizer is not None and os.path.exists(host_path) \
                 and load_optimizer_states and not load_module_only:

@@ -1,0 +1,355 @@
+"""Streamed-state optimizer — the TPU-native ZeRO-Infinity optimizer tier.
+
+Reference capability: CPU Adam over offloaded optimizer state
+(csrc/adam/cpu_adam_impl.cpp + stage_1_and_2.py:1102).  The reference moves
+the *math* to the host CPU because the accelerator cannot hold the state.
+On TPU the idiomatic shape is different: fp32 masters and Adam moments live
+in **pinned host DRAM** (jax memory kind "pinned_host"), and the update runs
+**on device** as a ``lax.scan`` over the layer stack — each layer's slice is
+DMA-streamed in, updated on the VPU, and streamed back out.  HBM holds O(1
+layer) of optimizer state, and nothing crosses into Python (the reference
+pays a full param+grad PCIe bounce plus a host SIMD pass every step).
+
+Layout contract (matches the engine's offload_param layout):
+- layer-stacked ``blocks`` leaves with >=3 dims: storage pinned_host
+- everything else (embeddings, final norms, small block leaves): device
+
+Global-norm clipping, fp16 overflow skip, and LR schedules are folded into
+the same compiled update (three streamed passes: norm, update, working-copy
+regeneration).
+
+Measured on a single v5e chip (16 GB HBM): GPT-2 2.7B + AdamW trains at
+~6 s/step — 37 GB of fp32 master/moment state (2.4x HBM) lives in host DRAM,
+~14 bytes/param DMA-streamed per step, zero Python round trips.  All 6.7B
+programs compile; running them needs ~93 GB of pinnable host DRAM (more than
+this dev host exposes).  Known libtpu limits worked around here: bf16 host
+buffers cannot be dynamic-(update-)sliced (the bf16 working copy regenerates
+through an HBM-transient scan; 2-D bf16 leaves stay device-resident), and
+scan ys only land in host memory with per-slice placement annotations.
+"""
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _tree_zip_map(fn, *trees):
+    """tree.map over n trees where fn returns a tuple; returns a tuple of
+    trees (transposed)."""
+    flat = [jax.tree_util.tree_flatten(t) for t in trees]
+    leaves = [f[0] for f in flat]
+    treedef = flat[0][1]
+    outs = [fn(*xs) for xs in zip(*leaves)]
+    n_out = len(outs[0])
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs])
+        for i in range(n_out))
+
+
+class StreamedOptimizer:
+    """Adam/AdamW with pinned-host state and on-device streamed updates."""
+
+    def __init__(self, params, param_shardings, blocks_key: str,
+                 optimizer_name: str, optimizer_params: dict,
+                 gradient_clipping: float = 0.0,
+                 lr_schedule: Optional[Callable] = None,
+                 mesh=None):
+        optimizer_params = dict(optimizer_params or {})
+        name = (optimizer_name or C.ADAM_OPTIMIZER).lower()
+        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.FUSED_ADAM,
+                        C.CPU_ADAM):
+            raise ValueError(
+                f"streamed offload optimizer supports Adam/AdamW, got {name}")
+        self.adamw = (name == C.ADAMW_OPTIMIZER
+                      or optimizer_params.get("adam_w_mode", True))
+        self.base_lr = float(optimizer_params.get("lr", 1e-3))
+        betas = optimizer_params.get("betas", (0.9, 0.999))
+        self.beta1, self.beta2 = float(betas[0]), float(betas[1])
+        self.eps = float(optimizer_params.get("eps", 1e-8))
+        self.weight_decay = float(optimizer_params.get("weight_decay", 0.0))
+        self.gradient_clipping = float(gradient_clipping)
+        self.lr_schedule = lr_schedule
+        self.mesh = mesh
+        self.bk = blocks_key
+
+        # master/moment storage mirrors the param storage layout, in fp32.
+        # Host placement of jit outputs only works on TPU backends; the CPU
+        # runtime aborts on host-placed outputs (async, uncatchable), so gate
+        # on platform explicitly — CPU keeps state in default placement
+        # (numerics identical, memory kinds drift after the first step).
+        platform = (list(mesh.devices.flat)[0].platform
+                    if mesh is not None else jax.devices()[0].platform)
+        self.state_shardings = param_shardings if platform == "tpu" else None
+        bk = blocks_key
+
+        def _host(x):
+            if self.state_shardings is None or mesh is None:
+                return x
+            return jax.device_put(
+                x, NamedSharding(mesh, P(), memory_kind="pinned_host"))
+
+        def _dev(x):
+            if self.state_shardings is None or mesh is None:
+                return x
+            return jax.device_put(
+                x, NamedSharding(mesh, P(), memory_kind="device"))
+
+        def init_state(p):
+            """Streamed init: fp32 master + zero moments, one layer slice at
+            a time, so no full fp32 stacked tensor ever exists on device.
+            The engine's stored params stay in compute dtype (bf16) — they
+            are the working copy the forward streams; this fp32 master is
+            the optimizer's own pinned-host state."""
+            blocks = p[bk]
+
+            def cast_body(carry, xs):
+                xs_d = jax.tree.map(_dev, xs)
+                out = jax.tree.map(
+                    lambda a: _host(a.astype(jnp.float32)), xs_d)
+                return carry, out
+
+            _, mst_blocks = lax.scan(cast_body, None, blocks)
+
+            def zeros_body(carry, xs):
+                out = jax.tree.map(
+                    lambda a: _host(jnp.zeros(a.shape, jnp.float32)), xs)
+                return carry, out
+
+            _, m_blocks = lax.scan(zeros_body, None, blocks)
+            _, v_blocks = lax.scan(zeros_body, None, blocks)
+            mst = {bk: mst_blocks}
+            m = {bk: m_blocks}
+            v = {bk: v_blocks}
+            for k in p:
+                if k == bk:
+                    continue
+                mst[k] = jax.tree.map(lambda a: a.astype(jnp.float32), p[k])
+                m[k] = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), p[k])
+                v[k] = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), p[k])
+            return mst, m, v
+
+        if self.state_shardings is not None:
+            out_sh = (self.state_shardings,) * 3
+            self.master, self.m, self.v = jax.jit(
+                init_state, out_shardings=out_sh)(params)
+        else:
+            self.master, self.m, self.v = jax.jit(init_state)(params)
+        self.step_count = 0
+        self._apply = None
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self.master))
+        where = ("pinned host DRAM" if self.state_shardings is not None
+                 else "device memory")
+        log_dist(f"StreamedOptimizer: {n/1e9:.2f}B params, fp32 master + 2 "
+                 f"moments in {where}, updates streamed on device", ranks=[0])
+
+    # ------------------------------------------------------------------ update
+    def _build_apply(self, compute_dtype):
+        bk = self.bk
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        adamw, clip = self.adamw, self.gradient_clipping
+        mesh = self.mesh
+        host_state = self.state_shardings is not None
+
+        def to_device(x):
+            # always normalise to device memory space: even in the CPU
+            # fallback (state_shardings=None) the engine's param storage —
+            # aliased as the master — is pinned-host, and mixed memory
+            # spaces in one elementwise op are a type error
+            if host_state:
+                return jax.device_put(
+                    x, NamedSharding(mesh, P(), memory_kind="device"))
+            return jax.device_put(x, jax.memory.Space.Device)
+
+        def adam_leaf(mst, m, v, g, lr, t, factor, ovf):
+            """factor folds loss-scale inverse and clipping; on overflow the
+            moments and master are frozen (reference skip semantics)."""
+            g = g.astype(jnp.float32) * factor
+            if wd > 0 and not adamw:
+                g = g + wd * mst      # classic Adam: L2 folded into the grad
+            nm = b1 * m + (1 - b1) * g
+            nv = b2 * v + (1 - b2) * g * g
+            nm = jnp.where(ovf, m, nm)
+            nv = jnp.where(ovf, v, nv)
+            mhat = nm / (1 - b1 ** t)
+            vhat = nv / (1 - b2 ** t)
+            upd = mhat / (jnp.sqrt(vhat) + eps)
+            if wd > 0 and adamw:
+                upd = upd + wd * mst
+            new_mst = mst - lr * upd
+            return new_mst, nm, nv
+
+        def apply(master, m, v, grads, step_scalar, loss_scale):
+            t = step_scalar.astype(jnp.float32) + 1.0
+            lr = (self.lr_schedule(step_scalar)
+                  if self.lr_schedule is not None
+                  else jnp.float32(self.base_lr))
+            lr = jnp.asarray(lr, jnp.float32)
+            inv_scale = 1.0 / loss_scale
+
+            block_gs = grads[bk]
+            other_keys = [k for k in grads if k != bk]
+
+            # ---- pass 1: streamed global grad norm + overflow ------------
+            def leaf_sq(g):
+                g32 = g.astype(jnp.float32) * inv_scale
+                return (jnp.sum(g32 * g32),
+                        jnp.any(~jnp.isfinite(g32)))
+
+            def norm_body(carry, g_slice):
+                acc, ovf = carry
+                for leaf in jax.tree.leaves(g_slice):
+                    s, o = leaf_sq(to_device(leaf))
+                    acc = acc + s
+                    ovf = jnp.logical_or(ovf, o)
+                return (acc, ovf), None
+
+            (total_sq, overflow), _ = lax.scan(
+                norm_body, (jnp.float32(0.0), jnp.bool_(False)), block_gs)
+            for k in other_keys:
+                for leaf in jax.tree.leaves(grads[k]):
+                    s, o = leaf_sq(leaf)
+                    total_sq = total_sq + s
+                    overflow = jnp.logical_or(overflow, o)
+            grad_norm = jnp.sqrt(total_sq)
+            # the host tier reports 0.0 on overflow; keep the two tiers'
+            # grad-norm contract identical
+            grad_norm = jnp.where(overflow, 0.0, grad_norm)
+
+            factor = jnp.float32(inv_scale)
+            if clip > 0:
+                factor = factor * jnp.minimum(
+                    1.0, clip / (grad_norm + 1e-6))
+            eff_lr = jnp.where(overflow, 0.0, lr)
+
+            # ---- pass 2: streamed update over the layer stack ------------
+            def to_host(x):
+                if not host_state:
+                    return x
+                return jax.device_put(
+                    x, NamedSharding(mesh, P(), memory_kind="pinned_host"))
+
+            def upd_body(carry, xs):
+                mst_s, m_s, v_s, g_s = xs
+                dev = lambda tr: jax.tree.map(to_device, tr)
+                new_mst, new_m, new_v = _tree_zip_map(
+                    lambda a, b_, c, d: adam_leaf(a, b_, c, d, eff_lr, t,
+                                                  factor, overflow),
+                    dev(mst_s), dev(m_s), dev(v_s), dev(g_s))
+                # per-slice host placement: fp32 slices DMA straight into the
+                # host ys buffers (without this XLA allocates the stacked
+                # outputs as HBM temps — 80 GB at 6.7B).  Works for fp32
+                # only; bf16 host dynamic-update-slice aborts this libtpu.
+                host = lambda tr: jax.tree.map(to_host, tr)
+                return carry, (host(new_mst), host(new_m), host(new_v))
+
+            _, (bm, bmm, bmv) = lax.scan(
+                upd_body, None, (master[bk], m[bk], v[bk], block_gs))
+
+            # ---- pass 3: regenerate the bf16 working copy ----------------
+            # bf16 slices cannot DMA per-slice into host buffers (libtpu
+            # bug), so this scan's ys live in HBM (one bf16 model copy —
+            # fits: the grads/activations of the backward are gone by now)
+            # and move to pinned host in bulk via out_shardings.
+            def work_body(carry, mst_s):
+                mst_d = jax.tree.map(to_device, mst_s)
+                return carry, jax.tree.map(
+                    lambda a: a.astype(compute_dtype), mst_d)
+
+            _, bwork = lax.scan(work_body, None, bm)
+
+            new_master = {bk: bm}
+            new_m = {bk: bmm}
+            new_v = {bk: bmv}
+            new_work = {bk: bwork}
+            for k in other_keys:
+                nm, nmm, nmv = _tree_zip_map(
+                    lambda a, b_, c, d: adam_leaf(a, b_, c, d, eff_lr, t,
+                                                  factor, overflow),
+                    master[k], m[k], v[k], grads[k])
+                new_master[k] = nm
+                new_m[k] = nmm
+                new_v[k] = nmv
+                new_work[k] = jax.tree.map(
+                    lambda a: a.astype(compute_dtype), nm)
+            return (new_master, new_m, new_v, new_work, grad_norm, overflow)
+
+        return apply
+
+    def step(self, grads, compute_dtype, loss_scale: float,
+             step_index: int):
+        """Run the streamed update.  grads: device/pinned-host pytree (same
+        top-level dict layout as params).  Returns (new_working_params
+        [compute dtype], grad_norm, overflow) — the scalars stay on
+        device."""
+        if self._apply is None:
+            apply = self._build_apply(compute_dtype)
+            if self.state_shardings is not None:
+                out_sh = (self.state_shardings,) * 4 + (None, None)
+                # donate the fp32 state + grads: without donation the step
+                # transiently doubles ~14 bytes/param of host DRAM (OOM on
+                # the TPU host at 6.7B).  Placement is explicit per slice
+                # (to_host above), so donation no longer confuses XLA's
+                # memory-space propagation.
+                self._apply = jax.jit(apply, out_shardings=out_sh,
+                                      donate_argnums=(0, 1, 2, 3))
+            else:
+                # no donation here either: the engine's param storage is
+                # pinned-host even on CPU, and donating a host buffer into a
+                # device-placed output aborts the runtime
+                self._apply = jax.jit(apply)
+        (self.master, self.m, self.v, new_work, grad_norm,
+         overflow) = self._apply(self.master, self.m, self.v, grads,
+                                 jnp.int32(step_index),
+                                 jnp.float32(loss_scale))
+        self.step_count += 1
+        return new_work, grad_norm, overflow
+
+    # ------------------------------------------------------------------ ckpt
+    def state_dict(self):
+        to_np = lambda t: jax.tree.map(lambda x: np.asarray(x), t)
+        return {"master": to_np(self.master), "m": to_np(self.m),
+                "v": to_np(self.v), "step_count": self.step_count}
+
+    def load_state_dict(self, sd):
+        def put(t):
+            if self.state_shardings is not None:
+                return jax.device_put(t, self.state_shardings)
+            return jax.tree.map(jnp.asarray, t)
+        self.master = put(sd["master"])
+        self.m = put(sd["m"])
+        self.v = put(sd["v"])
+        self.step_count = int(sd.get("step_count", 0))
+
+    # npz persistence for the engine's checkpoint format
+    def save_npz(self, path: str):
+        flat = {"step_count": np.int64(self.step_count)}
+        for tag, tree in (("master", self.master), ("m", self.m),
+                          ("v", self.v)):
+            pairs, _ = jax.tree_util.tree_flatten_with_path(tree)
+            for kp, leaf in pairs:
+                key = tag + "::" + "/".join(
+                    str(getattr(k, "key", k)) for k in kp)
+                flat[key] = np.asarray(leaf)
+        np.savez(path, **flat)
+
+    def load_npz(self, path: str):
+        flat = np.load(path)
+        sd = {"step_count": int(flat["step_count"])}
+        for tag, tree in (("master", self.master), ("m", self.m),
+                          ("v", self.v)):
+            pairs, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            leaves = []
+            for kp, _ in pairs:
+                key = tag + "::" + "/".join(
+                    str(getattr(k, "key", k)) for k in kp)
+                leaves.append(flat[key])
+            sd[tag] = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.load_state_dict(sd)

@@ -32,7 +32,7 @@ class HostOffloadOptimizer:
     def __init__(self, params_tree, optimizer_name: str, optimizer_params: dict,
                  gradient_clipping: float = 0.0,
                  lr_schedule: Optional[Callable] = None,
-                 nvme_swapper=None):
+                 nvme_swapper=None, masters_on_nvme: bool = False):
         optimizer_params = dict(optimizer_params or {})
         self.base_lr = float(optimizer_params.get("lr", 1e-3))
         self.lr_schedule = lr_schedule
@@ -61,20 +61,32 @@ class HostOffloadOptimizer:
         else:
             raise ValueError(f"host offload does not support optimizer {name}")
 
-        # host master copies (flat fp32 per leaf)
-        self.master: Dict[str, np.ndarray] = {}
+        # host master copies (flat fp32 per leaf); with masters_on_nvme the
+        # fp32 master tier streams through the aio op like the moments
+        # (reference: NVMe optimizer offload swaps fp32 params + moments,
+        # partitioned_optimizer_swapper.py)
+        self.masters_on_nvme = bool(masters_on_nvme and nvme_swapper is not None)
+        self.master: Dict[str, Optional[np.ndarray]] = {}
         self.shapes: Dict[str, tuple] = {}
         self.treedef = jax.tree_util.tree_structure(params_tree)
         self.paths = []
         for path, leaf in _flatten_with_paths(params_tree):
-            arr = np.asarray(jax.device_get(leaf)).astype(np.float32).ravel()
-            arr = np.ascontiguousarray(arr)
+            host_leaf = jax.device_get(leaf)
+            arr = np.ascontiguousarray(
+                np.asarray(host_leaf).astype(np.float32).ravel())
             self.paths.append(path)
-            self.shapes[path] = tuple(np.shape(jax.device_get(leaf)))
-            self.master[path] = arr
+            self.shapes[path] = tuple(np.shape(host_leaf))
+            if self.masters_on_nvme:
+                self.nvme.swap_out(f"{path}.w", arr)
+                self.master[path] = None
+            else:
+                self.master[path] = arr
+        if self.masters_on_nvme:
+            self.nvme.drain()
         self.moments: Dict[str, list] = {}
         for path in self.paths:
-            bufs = [np.zeros_like(self.master[path])
+            numel = int(np.prod(self.shapes[path])) if self.shapes[path] else 1
+            bufs = [np.zeros(numel, np.float32)
                     for _ in range(self.n_moments)]
             if self.nvme is not None:
                 for j, b in enumerate(bufs):
@@ -83,11 +95,15 @@ class HostOffloadOptimizer:
                 self.moments[path] = None
             else:
                 self.moments[path] = bufs
-        n_bytes = sum(a.nbytes for a in self.master.values()) * (
-            1 + (0 if self.nvme is not None else self.n_moments))
+        master_bytes = sum(4 * int(np.prod(s) if s else 1)
+                           for s in self.shapes.values())
+        dram_copies = ((0 if self.masters_on_nvme else 1) +
+                       (0 if self.nvme is not None else self.n_moments))
         log_dist(f"HostOffloadOptimizer: {len(self.paths)} tensors, "
-                 f"{n_bytes / 1e9:.2f} GB host DRAM"
-                 + (", moments on NVMe" if self.nvme is not None else ""),
+                 f"{master_bytes * dram_copies / 1e9:.2f} GB host DRAM"
+                 + (", masters+moments on NVMe" if self.masters_on_nvme
+                    else (", moments on NVMe" if self.nvme is not None
+                          else "")),
                  ranks=[0])
 
     # ------------------------------------------------------------------ step
@@ -107,7 +123,7 @@ class HostOffloadOptimizer:
         gn_sq = sum(float(np.dot(g, g)) for g in grads) if not overflow else 0.0
         grad_norm = float(np.sqrt(gn_sq))
         if overflow:
-            new_leaves = [self.master[p].reshape(self.shapes[p])
+            new_leaves = [self._get_master(p).reshape(self.shapes[p])
                           .astype(compute_dtype) for p in self.paths]
             return (jax.tree_util.tree_unflatten(self.treedef, new_leaves),
                     grad_norm, True)
@@ -121,15 +137,19 @@ class HostOffloadOptimizer:
         nvme_names = [[f"{p}.m{j}" for j in range(self.n_moments)]
                       for p in self.paths]
         for i, (path, g) in enumerate(zip(self.paths, grads)):
-            p = self.master[path]
             if self.nvme is not None:
-                # prefetch next tensor's moments while this one updates
+                # prefetch next tensor's state while this one updates
                 moments = [self.nvme.swap_in(nm) for nm in nvme_names[i]]
+                p = (self.nvme.swap_in(f"{path}.w") if self.masters_on_nvme
+                     else self.master[path])
                 if i + 1 < len(self.paths):
                     for nm in nvme_names[i + 1]:
                         self.nvme.prefetch(nm)
+                    if self.masters_on_nvme:
+                        self.nvme.prefetch(f"{self.paths[i + 1]}.w")
             else:
                 moments = self.moments[path]
+                p = self.master[path]
             g = np.ascontiguousarray(g)
             if self.n_moments == 2:
                 self.opt.step(p, g, moments[0], moments[1], lr=lr,
@@ -139,11 +159,20 @@ class HostOffloadOptimizer:
             if self.nvme is not None:
                 for nm, mbuf in zip(nvme_names[i], moments):
                     self.nvme.swap_out(nm, mbuf)
+                if self.masters_on_nvme:
+                    self.nvme.swap_out(f"{path}.w", p)
             new_leaves.append(p.reshape(self.shapes[path]).astype(compute_dtype))
         if self.nvme is not None:
             self.nvme.drain()
         return (jax.tree_util.tree_unflatten(self.treedef, new_leaves),
                 grad_norm, False)
+
+    def _get_master(self, path: str) -> np.ndarray:
+        """Master fp32 buffer for `path`, reading through NVMe if needed
+        (read-only access: the buffer is written straight back)."""
+        if self.masters_on_nvme:
+            return self.nvme.swap_in(f"{path}.w")   # file stays valid on disk
+        return self.master[path]
 
     # ------------------------------------------------------------------ ckpt
     def state_dict(self) -> dict:
@@ -158,16 +187,21 @@ class HostOffloadOptimizer:
                 moments[path] = self.moments[path]
         if self.nvme is not None:
             self.nvme.drain()
+        master = {p: np.array(self._get_master(p)) for p in self.paths}
         return {
-            "master": dict(self.master),
+            "master": master,
             "moments": {p: list(m) for p, m in moments.items()},
             "step_count": getattr(self.opt, "step_count", 0),
         }
 
     def load_state_dict(self, sd: dict):
         for path in self.paths:
-            self.master[path][:] = np.asarray(sd["master"][path],
-                                              dtype=np.float32).ravel()
+            loaded_master = np.ascontiguousarray(
+                np.asarray(sd["master"][path], dtype=np.float32).ravel())
+            if self.masters_on_nvme:
+                self.nvme.swap_out(f"{path}.w", loaded_master)
+            else:
+                self.master[path][:] = loaded_master
             loaded = sd["moments"][path]
             if self.nvme is not None:
                 for j in range(self.n_moments):
@@ -183,6 +217,6 @@ class HostOffloadOptimizer:
             self.opt.step_count = int(sd.get("step_count", 0))
 
     def params_in_compute_dtype(self, compute_dtype):
-        leaves = [self.master[p].reshape(self.shapes[p]).astype(compute_dtype)
-                  for p in self.paths]
+        leaves = [self._get_master(p).reshape(self.shapes[p])
+                  .astype(compute_dtype) for p in self.paths]
         return jax.tree_util.tree_unflatten(self.treedef, leaves)

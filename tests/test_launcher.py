@@ -88,6 +88,21 @@ def test_module_flag_forwarded(runner_args):
                 SlurmRunner):
         joined = " ".join(cls(runner_args, WORLD).get_cmd({}, {}))
         assert "--module" in joined, cls
+    # gcloud builds a raw shell command: module mode = `python -m`
+    joined = " ".join(GcloudTPURunner(runner_args, WORLD).get_cmd({}, {}))
+    assert "-m train.py" in joined
+
+
+def test_slurm_exports_via_environment(runner_args):
+    r = SlurmRunner(runner_args, WORLD)
+    r.add_export("XLA_FLAGS", "--xla_a --xla_b")
+    env = {}
+    cmd = r.get_cmd(env, {})
+    # values with spaces cannot ride the comma-separated --export list;
+    # they go through the inherited environment instead
+    assert "--export=ALL" in cmd
+    assert env["XLA_FLAGS"] == "--xla_a --xla_b"
+    assert not any("--xla_a" in c for c in cmd)
 
 
 def test_gcloud_cmd(runner_args):
