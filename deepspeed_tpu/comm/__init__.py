@@ -24,7 +24,8 @@ from jax import lax
 
 from deepspeed_tpu.comm.mesh import (  # noqa: F401
     MeshTopology, get_topology, set_topology, reset_topology,
-    PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS, MESH_AXIS_ORDER,
+    PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, HPZ_AXIS, SEQ_AXIS, MODEL_AXIS,
+    MESH_AXIS_ORDER,
 )
 from deepspeed_tpu.utils.logging import logger
 

@@ -35,10 +35,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 DATA_AXIS = "data"
+HPZ_AXIS = "hpz"          # ZeRO++ hpZ secondary-shard axis (reference
+                          # groups.py:473 intra-node param group); size 1
+                          # unless zero_hpz_partition_size is set
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
-MESH_AXIS_ORDER = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+MESH_AXIS_ORDER = (PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, HPZ_AXIS, SEQ_AXIS,
+                   MODEL_AXIS)
 
 
 @dataclass
@@ -54,6 +58,7 @@ class MeshTopology:
     pipe_parallel_size: int = 1
     sequence_parallel_size: int = 1
     expert_parallel_size: int = 1
+    hpz_partition_size: int = 1                   # ZeRO++ hpZ group size
     devices: Optional[Sequence] = None
     mesh: Mesh = field(init=False, default=None)
 
@@ -72,10 +77,17 @@ class MeshTopology:
         if dp % ep != 0:
             raise ValueError(
                 f"expert_parallel_size {ep} must divide data_parallel_size {dp}")
+        hpz = self.hpz_partition_size
+        if (dp // ep) % hpz != 0:
+            raise ValueError(
+                f"zero_hpz_partition_size {hpz} must divide the data axis "
+                f"{dp // ep}")
         if pp * ep * (dp // ep) * sp * tp != n:
             raise ValueError(
                 f"mesh {pp}×{ep}×{dp // ep}×{sp}×{tp} != {n} devices")
-        shape = (pp, ep, dp // ep, sp, tp)
+        # the innermost chunk of the data dimension becomes the hpz axis so
+        # hpZ groups sit on adjacent (intra-host) devices
+        shape = (pp, ep, dp // ep // hpz, hpz, sp, tp)
         device_array = np.asarray(devices).reshape(shape)
         self.mesh = Mesh(device_array, MESH_AXIS_ORDER)
 
@@ -85,13 +97,20 @@ class MeshTopology:
     @property
     def data_parallel_axes(self) -> Tuple[str, ...]:
         """Full DP group (reference groups._get_data_parallel_group)."""
-        return (EXPERT_AXIS, DATA_AXIS)
+        return (EXPERT_AXIS, DATA_AXIS, HPZ_AXIS)
 
     @property
     def zero_shard_axes(self) -> Tuple[str, ...]:
         """Axes ZeRO shards dense state over (seq-data combined group,
         reference groups.py:459)."""
-        return (EXPERT_AXIS, DATA_AXIS, SEQ_AXIS)
+        return (EXPERT_AXIS, DATA_AXIS, HPZ_AXIS, SEQ_AXIS)
+
+    @property
+    def hpz_axes(self) -> Tuple[str, ...]:
+        """ZeRO++ secondary-shard group (reference groups.py:473): params
+        shard over this intra-host axis only, so forward all-gathers never
+        cross hosts."""
+        return (HPZ_AXIS,)
 
     @property
     def expert_parallel_axes(self) -> Tuple[str, ...]:
@@ -101,7 +120,7 @@ class MeshTopology:
     def expert_data_parallel_axes(self) -> Tuple[str, ...]:
         """DP group for one expert's replicas (reference
         groups._get_expert_data_parallel_group)."""
-        return (DATA_AXIS,)
+        return (DATA_AXIS, HPZ_AXIS)
 
     @property
     def model_parallel_axes(self) -> Tuple[str, ...]:

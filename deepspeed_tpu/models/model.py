@@ -22,15 +22,21 @@ _PARAM_STREAM: contextvars.ContextVar = contextvars.ContextVar(
 
 
 @contextlib.contextmanager
-def param_stream_scope(enabled: bool = True, mesh=None, layer_specs=None):
-    """Enable per-layer host→device param streaming for models traced inside
-    this scope (the engine wraps its compiled-step invocations with it).
+def param_stream_scope(enabled: bool = True, mesh=None, layer_specs=None,
+                       mode: str = "stream"):
+    """Enable a per-layer param transform for models traced inside this
+    scope (the engine wraps its compiled-step invocations with it).
 
-    ``layer_specs`` — flat list of per-leaf PartitionSpecs for ONE layer's
-    slice (stacked leading dim stripped), aligned with
-    ``jax.tree.leaves(layer_tree)``.  Required on multi-device meshes: the
-    SPMD partitioner needs an explicit sharding on the transfer."""
-    value = (mesh, layer_specs) if enabled else False
+    Modes:
+    - ``stream`` — ZeRO-Infinity host→device streaming.  ``layer_specs`` is
+      a flat list of per-leaf target PartitionSpecs for ONE layer's slice
+      (stacked leading dim stripped; None = leaf skips the transfer),
+      aligned with ``jax.tree.leaves(layer_tree)``.
+    - ``qwz`` — ZeRO++ quantized weight gather.  ``layer_specs`` is a flat
+      list of (storage_spec, target_spec) pairs (None = leaf skips): the
+      leaf quantizes to int8, all-gathers in the target layout, and
+      dequantizes (runtime/zero/zeropp.py)."""
+    value = (mode, mesh, layer_specs) if enabled else False
     token = _PARAM_STREAM.set(value)
     try:
         yield
@@ -51,8 +57,15 @@ def maybe_stream(layer_tree):
     if not cfg:
         return layer_tree
     import jax
-    mesh, layer_specs = cfg
+    mode, mesh, layer_specs = cfg
     leaves, treedef = jax.tree_util.tree_flatten(layer_tree)
+    if mode == "qwz":
+        from deepspeed_tpu.runtime.zero.zeropp import quantized_weight_gather
+        assert layer_specs is not None and len(layer_specs) == len(leaves)
+        moved = [w if sp is None
+                 else quantized_weight_gather(w, mesh, sp[0], sp[1])
+                 for w, sp in zip(leaves, layer_specs)]
+        return jax.tree_util.tree_unflatten(treedef, moved)
     if mesh is None or layer_specs is None:
         targets = [jax.memory.Space.Device] * len(leaves)
     else:

@@ -96,6 +96,17 @@ class ZeroConfig(DeepSpeedConfigModel):
             logger.warning("zero_optimization.cpu_offload is deprecated; use "
                            "offload_optimizer: {device: cpu}")
             data["offload_optimizer"] = {"device": "cpu"}
+        # reference JSON spells the stage-3 knobs with a stage3_ prefix
+        # (runtime/zero/config.py aliases)
+        for ref_key in ("prefetch_bucket_size", "param_persistence_threshold",
+                        "model_persistence_threshold", "max_live_parameters",
+                        "max_reuse_distance",
+                        "gather_16bit_weights_on_model_save"):
+            alias = f"stage3_{ref_key}"
+            if alias in data and ref_key not in data:
+                data[ref_key] = data.pop(alias)
+            else:
+                data.pop(alias, None)
         super().__init__(**data)
 
 

@@ -108,22 +108,32 @@ class ZeroShardingPolicy:
     stage: int
     topology: MeshTopology
     param_persistence_threshold: int = 0
+    hpz_partition_size: int = 1
 
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise ValueError(f"invalid ZeRO stage {self.stage}")
         self.zero_axes = self.topology.zero_shard_axes
+        # ZeRO++ hpZ (reference partition_parameters.py:1488 secondary
+        # partition + groups.py:473): param STORAGE shards only over the
+        # intra-host hpz axis, so the forward all-gather never crosses hosts;
+        # grads/optimizer state keep the full zero sharding.
+        self.param_axes = (self.topology.hpz_axes
+                           if self.stage >= 3 and self.hpz_partition_size > 1
+                           else self.zero_axes)
         self.mesh = self.topology.mesh
 
     # -- per-leaf specs -------------------------------------------------------
-    def _sharded_spec(self, shape, logical_spec) -> P:
-        return add_zero_axes_to_spec(shape, logical_spec, self.zero_axes,
+    def _sharded_spec(self, shape, logical_spec, axes=None) -> P:
+        return add_zero_axes_to_spec(shape, logical_spec,
+                                     axes or self.zero_axes,
                                      self.mesh, self.param_persistence_threshold)
 
     def param_spec(self, shape, logical_spec=None) -> P:
         """Storage sharding of master params between steps."""
         if self.stage >= 3:
-            return self._sharded_spec(shape, logical_spec)
+            return self._sharded_spec(shape, logical_spec,
+                                      axes=self.param_axes)
         return logical_spec if logical_spec is not None else P()
 
     def grad_spec(self, shape, logical_spec=None) -> P:

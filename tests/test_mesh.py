@@ -10,7 +10,7 @@ def test_default_topology_all_data(devices8):
     assert t.world_size == 8
     assert t.dp_world_size == 8
     assert t.zero_world_size == 8
-    assert dict(t.mesh.shape) == {"pipe": 1, "expert": 1, "data": 8,
+    assert dict(t.mesh.shape) == {"pipe": 1, "expert": 1, "data": 8, "hpz": 1,
                                   "seq": 1, "model": 1}
 
 
@@ -24,7 +24,7 @@ def test_full_5d(devices8):
     t = MeshTopology(model_parallel_size=2, pipe_parallel_size=2,
                      sequence_parallel_size=2)
     assert t.dp_world_size == 1
-    assert dict(t.mesh.shape) == {"pipe": 2, "expert": 1, "data": 1,
+    assert dict(t.mesh.shape) == {"pipe": 2, "expert": 1, "data": 1, "hpz": 1,
                                   "seq": 2, "model": 2}
 
 

@@ -26,19 +26,19 @@ def test_stage1_shards_optimizer_only(devices8):
     pol = ZeroShardingPolicy(1, MeshTopology())
     assert pol.param_spec((16, 8)) == P()
     assert pol.grad_spec((16, 8)) == P()
-    assert pol.optimizer_spec((16, 8)) == P(("expert", "data", "seq"))
+    assert pol.optimizer_spec((16, 8)) == P(("expert", "data", "hpz", "seq"))
 
 
 def test_stage2_shards_grads(devices8):
     pol = ZeroShardingPolicy(2, MeshTopology())
     assert pol.param_spec((16, 8)) == P()
-    assert pol.grad_spec((16, 8)) == P(("expert", "data", "seq"))
-    assert pol.optimizer_spec((16, 8)) == P(("expert", "data", "seq"))
+    assert pol.grad_spec((16, 8)) == P(("expert", "data", "hpz", "seq"))
+    assert pol.optimizer_spec((16, 8)) == P(("expert", "data", "hpz", "seq"))
 
 
 def test_stage3_shards_params(devices8):
     pol = ZeroShardingPolicy(3, MeshTopology())
-    assert pol.param_spec((16, 8)) == P(("expert", "data", "seq"))
+    assert pol.param_spec((16, 8)) == P(("expert", "data", "hpz", "seq"))
 
 
 def test_indivisible_stays_replicated(devices8):
@@ -48,7 +48,7 @@ def test_indivisible_stays_replicated(devices8):
 
 def test_second_dim_used_when_first_indivisible(devices8):
     pol = ZeroShardingPolicy(3, MeshTopology())
-    assert pol.param_spec((3, 16)) == P(None, ("expert", "data", "seq"))
+    assert pol.param_spec((3, 16)) == P(None, ("expert", "data", "hpz", "seq"))
 
 
 def test_composes_with_tp_spec(devices8):
@@ -56,7 +56,7 @@ def test_composes_with_tp_spec(devices8):
     pol = ZeroShardingPolicy(3, topo)
     # TP shards dim1; zero axes (4-way here) land on free dim0
     spec = pol.param_spec((16, 8), P(None, "model"))
-    assert spec == P(("expert", "data", "seq"), "model")
+    assert spec == P(("expert", "data", "hpz", "seq"), "model")
 
 
 def test_tp_dim_compose_when_no_free_dim(devices8):
@@ -64,10 +64,10 @@ def test_tp_dim_compose_when_no_free_dim(devices8):
     pol = ZeroShardingPolicy(3, topo)
     # 1-d vector sharded by TP: zero world 4 composes on the same dim (8/2/4=1)
     spec = pol.param_spec((8,), P("model"))
-    assert spec == P(("model", "expert", "data", "seq"))
+    assert spec == P(("model", "expert", "data", "hpz", "seq"))
 
 
 def test_persistence_threshold(devices8):
     pol = ZeroShardingPolicy(3, MeshTopology(), param_persistence_threshold=1000)
     assert pol.param_spec((16, 8)) == P()       # 128 elems < threshold
-    assert pol.param_spec((64, 64)) == P(("expert", "data", "seq"))
+    assert pol.param_spec((64, 64)) == P(("expert", "data", "hpz", "seq"))
