@@ -60,6 +60,29 @@ class RandomLTDScheduler:
         self.current = sd["current"]
 
 
+# trace-time keep-count scope: the engine sets it per step (one compile per
+# distinct value), models' layer scans read it (reference wires
+# RandomLayerTokenDrop wrappers around layers, data_routing/basic_layer.py:14)
+import contextlib
+import contextvars
+
+_LTD_KEEP: contextvars.ContextVar = contextvars.ContextVar(
+    "ds_random_ltd_keep", default=None)
+
+
+@contextlib.contextmanager
+def ltd_scope(keep):
+    token = _LTD_KEEP.set(keep)
+    try:
+        yield
+    finally:
+        _LTD_KEEP.reset(token)
+
+
+def get_ltd_keep():
+    return _LTD_KEEP.get()
+
+
 def random_ltd_block(block_fn, rng, x, keep: int):
     """Apply ``block_fn`` to a random ``keep``-token subset, pass the rest
     through (the RandomLayerTokenDrop wrapper's forward)."""

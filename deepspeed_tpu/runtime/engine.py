@@ -395,6 +395,55 @@ class DeepSpeedEngine:
         self.monitor = self._build_monitor()
         self.last_metrics: Dict[str, float] = {}
 
+        self._ltd_keep = None
+        self._last_seq_len = 0
+        # ---- aux subsystems (reference engine call sites) --------------------
+        # flops profiler (reference engine.py:1734 flops_profiler_profile_step)
+        fpc = self._config.flops_profiler_config
+        self.flops_profiler = None
+        if fpc.enabled:
+            from deepspeed_tpu.profiling.flops_profiler.profiler import \
+                FlopsProfiler
+            self.flops_profiler = FlopsProfiler(model, fpc)
+        # comms logger wiring (reference comm.configure(comms_logger=...))
+        if self._config.comms_config.enabled:
+            from deepspeed_tpu import comm as _comm
+            from deepspeed_tpu.utils.comms_logging import CommsLogger
+            _comm.configure(comms_logger=CommsLogger(self._config.comms_config))
+        # legacy curriculum learning (reference engine.py:1761 seqlen kwarg)
+        self.curriculum_scheduler = None
+        cl = self._config.curriculum_learning
+        if cl.enabled:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_params_legacy)
+        # progressive layer drop (reference engine.py:1755 PLD theta kwarg)
+        self.progressive_layer_drop = None
+        pld = self._config.pld_config
+        if pld.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld.theta, gamma=pld.gamma)
+        # random-LTD token-drop schedule (reference data_routing; models
+        # consume the keep count through the ltd scope in their layer scan)
+        self.random_ltd_scheduler = None
+        de = self._config.data_efficiency_config or {}
+        ltd = de.get("data_routing", {}).get("random_ltd", {})
+        if ltd.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.random_ltd import \
+                RandomLTDScheduler
+            sched = ltd.get("random_ltd_schedule", {})
+            sched_cfg = sched.get("schedule_config", {})
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                total_layer_token_steps=int(
+                    sched_cfg.get("require_steps",
+                                  sched.get("require_steps", 1000))),
+                min_tokens=int(sched.get("min_value", 128)),
+                max_tokens=int(sched.get("max_value", 2048)),
+                step_size=int(sched_cfg.get("seq_per_step", 16)))
+
         if training_data is not None:
             from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
             self.training_dataloader = DeepSpeedDataLoader(
@@ -601,9 +650,17 @@ class DeepSpeedEngine:
             return None
         return self.grad_shardings
 
+    #: compiled fns that trace the model's layer scan (and therefore read
+    #: the random-LTD keep count at trace time)
+    _LTD_SENSITIVE = ("train_step", "grad_step", "grad_micro", "grad", "loss")
+
     def _get_compiled(self, name: str):
-        if name in self._compiled:
-            return self._compiled[name]
+        # random-LTD changes the traced keep count: one compile per value,
+        # only for functions that actually trace the model
+        key = (f"{name}@ltd{self._ltd_keep}"
+               if self._ltd_keep and name in self._LTD_SENSITIVE else name)
+        if key in self._compiled:
+            return self._compiled[key]
         # batch args are pre-placed by _shard_batch (per-leaf ndim-aware
         # shardings), so jit infers their shardings from the arguments.
         if name == "train_step":
@@ -699,7 +756,7 @@ class DeepSpeedEngine:
             fn = jax.jit(make_zeros, out_shardings=self._grad_out_shardings())
         else:
             raise KeyError(name)
-        self._compiled[name] = fn
+        self._compiled[key] = fn
         return fn
 
     # ------------------------------------------------------------------ data utils
@@ -767,6 +824,38 @@ class DeepSpeedEngine:
         return param_stream_scope(True, mesh=self.mesh, layer_specs=pairs,
                                   mode="qwz")
 
+    def _apply_curriculum(self, batch):
+        """Legacy seqlen curriculum (reference engine.py:1761): truncate the
+        batch's sequence dim to the scheduled difficulty.  Each new
+        difficulty value compiles a fresh step — schedules should move in
+        coarse increments on TPU."""
+        if self.curriculum_scheduler is None:
+            return batch
+        difficulty = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        cl = self._config.curriculum_learning
+        if cl.curriculum_type != "seqlen":
+            return batch
+
+        def trunc(x):
+            x = np.asarray(x)
+            if x.ndim >= 2 and x.shape[-1] > difficulty:
+                return x[..., :difficulty]
+            return x
+
+        return jax.tree.map(trunc, batch)
+
+    def _ltd_scope(self):
+        """Random-LTD token-drop scope: models' layer scans read the keep
+        count at trace time (data_pipeline/random_ltd.ltd_scope).  The
+        schedule advances once per train_batch, before compile-cache lookup,
+        so the cache key and the traced value always agree."""
+        import contextlib
+        if not self._ltd_keep:
+            return contextlib.nullcontext()
+        from deepspeed_tpu.runtime.data_pipeline.random_ltd import ltd_scope
+        return ltd_scope(self._ltd_keep)
+
     def _next_rng(self):
         self._rng, out = jax.random.split(self._rng)
         return out
@@ -828,6 +917,15 @@ class DeepSpeedEngine:
                 raise ValueError(
                     f"train_batch(batch=...) leaves must lead with gas={gas}, "
                     f"got {lead}")
+        batch = self._apply_curriculum(batch)
+        if self.random_ltd_scheduler is not None:
+            self._ltd_keep = self.random_ltd_scheduler.update_seq(
+                self.global_steps)
+        self._last_seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
+        if self.flops_profiler is not None and (
+                self.global_steps + 1 ==
+                self._config.flops_profiler_config.profile_step):
+            self.flops_profiler.start_profile()
         batch = self._shard_batch(batch, stacked=True)
         if self._offload_param:
             fn = self._get_compiled("grad_micro")
@@ -836,7 +934,7 @@ class DeepSpeedEngine:
             losses = []
             for i in range(gas):
                 mb = jax.tree.map(lambda x: x[i], batch)
-                with self._stream_scope():
+                with self._stream_scope(), self._ltd_scope():
                     loss, grads = fn(self.state, mb, self._next_rng())
                 losses.append(loss)
                 if self.streamed_optimizer is not None:
@@ -852,13 +950,13 @@ class DeepSpeedEngine:
             else:
                 metrics = self._host_apply(acc, mean_loss)
         elif self._offload:
-            with self._stream_scope():
+            with self._stream_scope(), self._ltd_scope():
                 loss, grads = self._get_compiled("grad_step")(
                     self.state, batch, self._next_rng())
             metrics = self._host_apply(grads, loss)
         else:
             fn = self._get_compiled("train_step")
-            with self._stream_scope():
+            with self._stream_scope(), self._ltd_scope():
                 self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
         # syncing on the loss every step costs a device->host round trip
@@ -879,7 +977,7 @@ class DeepSpeedEngine:
         if self._micro_grads is None:
             self._micro_grads = self._get_compiled("zero_grads")(
                 self.state["params"])
-        with self._stream_scope():
+        with self._stream_scope(), self._ltd_scope():
             loss, grads = self._get_compiled("grad")(
                 self.state, batch, self._next_rng(), self._micro_grads)
         self._micro_grads = None   # donated into grads
@@ -979,6 +1077,20 @@ class DeepSpeedEngine:
     def _finish_step(self, metrics):
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if self.progressive_layer_drop is not None:
+            # reference engine.py:1755: PLD theta advances per step; models
+            # that take a pld kwarg consume engine.progressive_layer_drop
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.flops_profiler is not None and self.flops_profiler.started:
+            fpc = self._config.flops_profiler_config
+            tokens = self.train_batch_size() * self._last_seq_len
+            fpt = self.model.flops_per_token or 0.0
+            self.flops_profiler.set_flops(fpt * tokens)
+            self.flops_profiler.stop_profile(sync_obj=metrics.get("loss"))
+            self.flops_profiler.print_model_profile(
+                profile_step=self.global_steps,
+                module_depth=fpc.module_depth, top_modules=fpc.top_modules,
+                detailed=fpc.detailed, output_file=fpc.output_file)
         if self._config.fp16.enabled:
             # don't force a device->host fetch of the overflow flag every
             # step — bank it and resolve at report boundaries / on access
@@ -1097,6 +1209,22 @@ class DeepSpeedEngine:
         return ckpt_dir, extra.get("client_state", {})
 
     # ------------------------------------------------------------------ misc api
+    def compute_eigenvalue(self, batch, rng=None):
+        """Top Hessian eigenvalue of the loss (reference engine.py:2085,
+        scheduled by the eigenvalue config for MoQ)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        ec = self._config.eigenvalue_config
+        ev = Eigenvalue(verbose=ec.verbose, max_iter=ec.max_iter, tol=ec.tol,
+                        stability=ec.stability,
+                        gas_boundary_resolution=ec.gas_boundary_resolution)
+        batch = self._shard_batch(batch, stacked=False)
+        rng = rng if rng is not None else self._next_rng()
+
+        def loss_fn(p):
+            return self._scaled_loss_fn(p, batch, rng, jnp.float32(1.0))
+
+        return ev.compute_eigenvalue(loss_fn, self.state["params"])
+
     def get_global_grad_norm(self):
         gn = self.last_metrics.get("grad_norm")
         return float(gn) if gn is not None else None

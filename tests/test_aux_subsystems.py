@@ -1,0 +1,211 @@
+"""Aux-subsystem wiring tests (VERDICT round-1 item 7): flops profiler,
+curriculum, PLD, comms logger, random-LTD, eigenvalue, elasticity, tensor
+fragments, data sampler — each exercised through its ENGINE call site, not
+just its module (the reference triggers them at engine.py:1734/:1755/:1761).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from tests.util import tiny_gpt2, base_config, random_batches
+
+
+def _batch(seed=0, batch_size=8, seq_len=16):
+    b = random_batches(1, batch_size=batch_size, seq_len=seq_len,
+                       seed=seed)[0]
+    return {"input_ids": b["input_ids"][None]}
+
+
+# ------------------------------------------------------------- flops profiler
+
+def test_flops_profiler_triggers_at_profile_step(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            flops_profiler={"enabled": True, "profile_step": 2}))
+    engine.train_batch(batch=_batch(0))
+    assert engine.flops_profiler.total_flops == 0.0
+    engine.train_batch(batch=_batch(1))
+    assert engine.flops_profiler.total_flops > 0
+    assert engine.flops_profiler.total_duration > 0
+    text = engine.flops_profiler.print_model_profile(profile_step=2)
+    assert "Flops Profiler" in text and "achieved FLOPS" in text
+
+
+def test_flops_profiler_output_file(devices8, tmp_path):
+    out_file = str(tmp_path / "profile.txt")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            flops_profiler={"enabled": True, "profile_step": 1,
+                            "output_file": out_file}))
+    engine.train_batch(batch=_batch(0))
+    assert "profile step" in open(out_file).read()
+
+
+# ----------------------------------------------------------------- curriculum
+
+def test_curriculum_seqlen_truncates_batch(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            curriculum_learning={
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}}))
+    engine.train_batch(batch=_batch(0, seq_len=16))
+    assert engine.curriculum_scheduler is not None
+    # early step: truncated to min difficulty
+    assert engine._last_seq_len == 8
+    for i in range(4):
+        engine.train_batch(batch=_batch(i + 1, seq_len=16))
+    # past the schedule: full length
+    assert engine._last_seq_len == 16
+
+
+# ------------------------------------------------------------------------ PLD
+
+def test_pld_theta_advances(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            progressive_layer_drop={"enabled": True, "theta": 0.5,
+                                    "gamma": 0.01}))
+    t0 = engine.progressive_layer_drop.get_theta()
+    for i in range(3):
+        engine.train_batch(batch=_batch(i))
+    t1 = engine.progressive_layer_drop.get_theta()
+    assert t1 < t0        # keep-prob decays from 1.0 toward theta
+    assert engine.progressive_layer_drop.get_state()
+
+
+# ---------------------------------------------------------------- comms logger
+
+def test_comms_logger_configured_from_config(devices8):
+    from deepspeed_tpu import comm
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            comms_logger={"enabled": True}))
+    assert comm._COMMS_LOGGER is not None and comm._COMMS_LOGGER.enabled
+    comm.configure(comms_logger=None)    # reset global for other tests
+
+
+# ------------------------------------------------------------------ random-LTD
+
+def test_random_ltd_schedules_and_trains(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            data_efficiency={
+                "data_routing": {"random_ltd": {
+                    "enabled": True,
+                    "random_ltd_schedule": {
+                        "min_value": 8, "max_value": 16,
+                        "schedule_config": {"require_steps": 4,
+                                            "seq_per_step": 4}}}}}))
+    assert engine.random_ltd_scheduler is not None
+    l0 = float(engine.train_batch(batch=_batch(0)))
+    assert np.isfinite(l0)
+    assert engine._ltd_keep == 8           # min at step 0
+    for i in range(5):
+        engine.train_batch(batch=_batch(i + 1))
+    assert engine._ltd_keep == 16          # ramped to max (full seq)
+
+
+def test_random_ltd_block_passthrough_and_subset():
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+        random_ltd_block, ltd_scope, get_ltd_keep)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    # keep >= seq: identity wrapper
+    out = random_ltd_block(lambda h: h * 2, jax.random.PRNGKey(0), x, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2)
+    # keep < seq: kept tokens transformed, the rest pass through
+    out = np.asarray(random_ltd_block(
+        lambda h: h * 2, jax.random.PRNGKey(0), x, 4))
+    doubled = np.isclose(out, np.asarray(x) * 2).all(-1)
+    kept_counts = doubled.sum(1)
+    assert (kept_counts == 4).all()
+    with ltd_scope(12):
+        assert get_ltd_keep() == 12
+    assert get_ltd_keep() is None
+
+
+# ------------------------------------------------------------------ eigenvalue
+
+def test_engine_eigenvalue(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            eigenvalue={"enabled": True, "max_iter": 4, "tol": 0.5}))
+    b = random_batches(1, batch_size=8, seed=0)[0]
+    eig = engine.compute_eigenvalue(b)
+    assert np.isfinite(eig)
+
+
+# ------------------------------------------------------------------ elasticity
+
+def test_elasticity_v01_candidates():
+    from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
+                          "micro_batch_sizes": [8, 12, 16, 17],
+                          "min_gpus": 32, "max_gpus": 1500,
+                          "prefer_larger_batch": True, "version": 0.1}}
+    final_batch, valid_gpus = compute_elastic_config(cfg)
+    assert final_batch <= 10000
+    assert all(32 <= g <= 1500 for g in valid_gpus)
+    assert final_batch % 8 == 0 or final_batch % 12 == 0
+
+
+def test_elasticity_v02_with_mp():
+    from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 64, "version": 0.2,
+                          "num_gpus_per_node": 4, "model_parallel_size": 2}}
+    final_batch, valid_gpus, micro = compute_elastic_config(
+        cfg, world_size=8, return_microbatch=True)
+    assert 8 in valid_gpus
+    assert micro in (2, 4)
+
+
+def test_elasticity_incompatible_world_size():
+    from deepspeed_tpu.elasticity.elasticity import (
+        compute_elastic_config, ElasticityIncompatibleWorldSize)
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [10], "min_gpus": 1,
+                          "max_gpus": 10, "version": 0.1}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=7)
+
+
+# ------------------------------------------------------------ tensor fragments
+
+def test_tensor_fragment_get_set(devices8):
+    from deepspeed_tpu.utils.tensor_fragment import (
+        safe_get_full_fp32_param, safe_set_full_fp32_param)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 2}))
+    w = safe_get_full_fp32_param(engine, "lnf_scale")
+    assert w is not None and w.dtype == np.float32
+    safe_set_full_fp32_param(engine, "lnf_scale", np.full_like(w, 2.0))
+    w2 = safe_get_full_fp32_param(engine, "lnf_scale")
+    np.testing.assert_allclose(w2, 2.0)
+
+
+# --------------------------------------------------------------- data sampler
+
+def test_data_sampler_difficulty_filtering():
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+        DeepSpeedDataSampler
+    diffs = {"seqlen": np.arange(100)}
+    cfg = {"seqlen": {
+        "min_difficulty": 10, "max_difficulty": 100,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10,
+                            "difficulty_step": 10}}}
+    sampler = DeepSpeedDataSampler(
+        difficulties=diffs, curriculum_configs=cfg,
+        total_samples=100, batch_size=8, seed=0)
+    batch = sampler.next_batch()
+    assert len(batch) == 8
+    assert (diffs["seqlen"][batch] <= 10).all()
