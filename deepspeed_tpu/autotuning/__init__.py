@@ -1,0 +1,3 @@
+"""Autotuning subsystem (reference: deepspeed/autotuning/)."""
+from deepspeed_tpu.autotuning.autotuner import (  # noqa: F401
+    Autotuner, TrialResult, run_autotuning)

@@ -1,0 +1,197 @@
+"""Autotuner (reference: deepspeed/autotuning/autotuner.py:42 + scheduler +
+tuner/{index_based_tuner,model_based_tuner}.py, entered from
+launcher/runner.py:358 ``run_autotuning``).
+
+The reference forks ``deepspeed`` jobs per candidate config and scrapes their
+metrics.  On TPU a fresh process per trial would pay a full XLA compile each
+time with no isolation benefit (no CUDA context to corrupt), so trials run
+in-process: build an engine per candidate {zero stage × micro-batch × remat
+policy}, run measured steps, rank by throughput.  OOM/compile failures mark
+the candidate infeasible, and micro-batch exploration stops growing once a
+size fails (the reference's ``max_train_micro_batch_size_per_gpu`` probe).
+
+Outputs the reference's artifact shape: a ranked ``autotuning_results`` list
+plus the best config JSON (``autotuning_exps``-style).
+"""
+import copy
+import itertools
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+DEFAULT_STAGES = (0, 1, 2, 3)
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16, 32)
+DEFAULT_REMAT = ("nothing", "save_attn", "dots")
+
+
+@dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    micro_batch: int
+    stage: int
+    remat: str
+    ok: bool
+    samples_per_sec: float = 0.0
+    step_time_s: float = 0.0
+    error: str = ""
+
+    def row(self):
+        return {
+            "zero_stage": self.stage, "micro_batch": self.micro_batch,
+            "remat": self.remat, "ok": self.ok,
+            "samples_per_sec": round(self.samples_per_sec, 2),
+            "step_time_s": round(self.step_time_s, 4),
+            "error": self.error[:200],
+        }
+
+
+class Autotuner:
+    """Grid tuner over {zero stage, micro batch, remat policy}."""
+
+    def __init__(self, base_config: dict, model_factory,
+                 stages=DEFAULT_STAGES, micro_batches=DEFAULT_MICRO_BATCHES,
+                 remat_policies=DEFAULT_REMAT, steps: int = 3,
+                 warmup_steps: int = 1, seq_len: Optional[int] = None,
+                 results_dir: str = "autotuning_results"):
+        self.base_config = dict(base_config)
+        self.model_factory = model_factory
+        self.stages = tuple(stages)
+        self.micro_batches = tuple(sorted(micro_batches))
+        self.remat_policies = tuple(remat_policies)
+        self.steps = steps
+        self.warmup_steps = warmup_steps
+        self.seq_len = seq_len
+        self.results_dir = results_dir
+        self.results: List[TrialResult] = []
+
+    # ------------------------------------------------------------------ trial
+    def _candidate_config(self, stage: int, micro_batch: int) -> dict:
+        cfg = copy.deepcopy(self.base_config)
+        cfg.pop("train_batch_size", None)
+        cfg["train_micro_batch_size_per_gpu"] = micro_batch
+        cfg.setdefault("gradient_accumulation_steps", 1)
+        zo = dict(cfg.get("zero_optimization", {}))
+        zo["stage"] = stage
+        cfg["zero_optimization"] = zo
+        cfg.setdefault("steps_per_print", 0)
+        return cfg
+
+    def _run_trial(self, stage: int, micro_batch: int, remat: str
+                   ) -> TrialResult:
+        import jax
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import reset_topology
+        cfg = self._candidate_config(stage, micro_batch)
+        try:
+            reset_topology()
+            model = self.model_factory(remat=remat != "nothing",
+                                       remat_policy=remat)
+            engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+            seq = self.seq_len or getattr(model.config, "max_seq_len", 128)
+            vocab = getattr(model.config, "vocab_size", 1024)
+            rng = np.random.default_rng(0)
+            dp = engine.topology.dp_world_size
+            gas = engine.gradient_accumulation_steps()
+
+            def batch():
+                return {"input_ids": rng.integers(
+                    0, vocab, (gas, micro_batch * dp, seq), dtype=np.int32)}
+
+            for _ in range(self.warmup_steps):
+                engine.train_batch(batch=batch())
+            t0 = time.time()
+            loss = None
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch=batch())
+            jax.block_until_ready(loss)
+            dt = (time.time() - t0) / self.steps
+            if not np.isfinite(float(loss)):
+                raise FloatingPointError("non-finite loss")
+            sps = engine.train_batch_size() / dt
+            return TrialResult(cfg, micro_batch, stage, remat, True,
+                               samples_per_sec=sps, step_time_s=dt)
+        except Exception as e:  # OOM / compile failure => infeasible
+            return TrialResult(cfg, micro_batch, stage, remat, False,
+                               error=f"{type(e).__name__}: {e}")
+        finally:
+            # drop the trial engine's params/optimizer buffers before the
+            # next candidate, or earlier trials' HBM makes later ones OOM
+            import gc
+            engine = None
+            model = None
+            gc.collect()
+
+    # ------------------------------------------------------------------ tune
+    def tune(self) -> Optional[TrialResult]:
+        """Run the grid; returns the best feasible trial (highest
+        samples/sec) and writes ranked results + best config JSON."""
+        for stage, remat in itertools.product(self.stages,
+                                              self.remat_policies):
+            for mb in self.micro_batches:
+                r = self._run_trial(stage, mb, remat)
+                self.results.append(r)
+                log_dist(
+                    f"autotune: stage={stage} micro={mb} remat={remat} -> "
+                    + (f"{r.samples_per_sec:.1f} samples/s" if r.ok
+                       else f"FAIL ({r.error[:80]})"), ranks=[0])
+                if not r.ok:
+                    # larger micro batches only cost more memory: stop probing
+                    break
+        best = self.best()
+        self._write_results(best)
+        return best
+
+    def best(self) -> Optional[TrialResult]:
+        ok = [r for r in self.results if r.ok]
+        return max(ok, key=lambda r: r.samples_per_sec) if ok else None
+
+    def _write_results(self, best: Optional[TrialResult]):
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "results.json"), "w") as f:
+            json.dump([r.row() for r in self.results], f, indent=2)
+        if best is not None:
+            cfg = dict(best.config)
+            cfg["zero_optimization"]["stage"] = best.stage
+            cfg["_autotuning"] = {"remat_policy": best.remat,
+                                  "samples_per_sec": best.samples_per_sec}
+            with open(os.path.join(self.results_dir, "best_config.json"),
+                      "w") as f:
+                json.dump(cfg, f, indent=2)
+            log_dist(
+                f"autotune: best = stage {best.stage}, micro "
+                f"{best.micro_batch}, remat {best.remat} "
+                f"({best.samples_per_sec:.1f} samples/s) -> "
+                f"{self.results_dir}/best_config.json", ranks=[0])
+
+
+def run_autotuning(args):
+    """Launcher entry (reference runner.py:358): tune for the user script's
+    config, then print the best config path.  The user script is expected to
+    read the emitted best_config.json."""
+    config_path = None
+    for i, a in enumerate(args.user_args):
+        if a in ("--deepspeed_config", "--config") and i + 1 < len(args.user_args):
+            config_path = args.user_args[i + 1]
+    if config_path is None or not os.path.isfile(config_path):
+        raise RuntimeError(
+            "autotuning needs --deepspeed_config <file> among the user args")
+    with open(config_path) as f:
+        base = json.load(f)
+    tuning = base.pop("autotuning", {})
+    from deepspeed_tpu.models import gpt2_model
+    size = tuning.get("model", "125m")
+    tuner = Autotuner(
+        base, lambda **kw: gpt2_model(size, **kw),
+        stages=tuning.get("stages", DEFAULT_STAGES),
+        micro_batches=tuning.get("micro_batches", DEFAULT_MICRO_BATCHES),
+        remat_policies=tuning.get("remat_policies", DEFAULT_REMAT),
+        steps=int(tuning.get("steps", 3)),
+        results_dir=tuning.get("results_dir", "autotuning_results"))
+    best = tuner.tune()
+    return 0 if best is not None else 1

@@ -319,7 +319,8 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,
-        meta={"name": f"gpt2-{size}", "n_params": n_params},
+        meta={"name": f"gpt2-{size}", "n_params": n_params,
+              "supports_random_ltd": True},
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),

@@ -1,0 +1,264 @@
+"""Block-sparse attention (reference: deepspeed/ops/sparse_attention/ —
+``SparsityConfig`` hierarchy in sparsity_config.py, ``SparseSelfAttention``,
+Triton block matmul/softmax kernels).
+
+The layouts (fixed / bigbird / bslongformer / variable) are faithful
+reimplementations of the reference's mask construction.  Compute is a
+block-masked dense attention: on TPU the [S, S] score tile is MXU-friendly
+and XLA folds the block mask into the softmax fusion, which is the right
+trade below ~16k tokens; the mask drops attention FLOPs' *numerical* effect
+(and is bit-compatible with a gather-based sparse kernel), while a Pallas
+block-skipping kernel remains the long-sequence upgrade path.
+"""
+import random
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SparsityConfig:
+    """Base layout builder (reference sparsity_config.py:22)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block != 0:
+            raise ValueError(
+                f"seq_len {seq_len} not divisible by block {self.block}")
+        n = seq_len // self.block
+        return np.zeros((self.num_heads, n, n), dtype=np.int64)
+
+    def check_and_propagate_first_head_layout(self, layout: np.ndarray):
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks attended — dense baseline (reference :105)."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + fixed global columns (reference :135
+    FixedSparsityConfig: num_local_blocks window, num_global_blocks summary
+    columns chosen from each window's tail)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_local_blocks: int = 4, num_global_blocks: int = 1,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        for h in range(self.num_heads if self.different_layout_per_head
+                       else 1):
+            # local windows
+            for start in range(0, n, self.num_local_blocks):
+                end = min(start + self.num_local_blocks, n)
+                layout[h, start:end, start:end] = 1
+            # global columns: last num_global_blocks of each window
+            for start in range(0, n, self.num_local_blocks):
+                end = min(start + self.num_local_blocks, n)
+                g0 = max(end - self.num_global_blocks, start)
+                layout[h, :, g0:end] = 1
+                if self.horizontal_global_attention:
+                    layout[h, g0:end, :] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + sliding window + global blocks (reference :375)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 1, num_sliding_window_blocks: int = 3,
+                 num_global_blocks: int = 1, attention: str = "bidirectional",
+                 seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        rng = np.random.default_rng(self.seed)
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_heads if self.different_layout_per_head
+                       else 1):
+            for i in range(n):
+                lo, hi = max(0, i - w), min(n, i + w + 1)
+                layout[h, i, lo:hi] = 1                       # sliding window
+                choices = rng.choice(n, size=min(self.num_random_blocks, n),
+                                     replace=False)
+                layout[h, i, choices] = 1                     # random blocks
+            g = min(self.num_global_blocks, n)
+            layout[h, :g, :] = 1                              # global rows
+            layout[h, :, :g] = 1                              # global cols
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Sliding window + selected global-attention block indices (reference
+    :558)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_sliding_window_blocks: int = 3,
+                 global_block_indices: Optional[List[int]] = None,
+                 global_block_end_indices: Optional[List[int]] = None,
+                 attention: str = "bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_heads if self.different_layout_per_head
+                       else 1):
+            for i in range(n):
+                lo, hi = max(0, i - w), min(n, i + w + 1)
+                layout[h, i, lo:hi] = 1
+            if self.global_block_end_indices is None:
+                for idx in self.global_block_indices:
+                    if idx < n:
+                        layout[h, idx, :] = 1
+                        layout[h, :, idx] = 1
+            else:
+                for s, e in zip(self.global_block_indices,
+                                self.global_block_end_indices):
+                    layout[h, s:e, :] = 1
+                    layout[h, :, s:e] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Variable local window sizes + global blocks (reference :232)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 0,
+                 local_window_blocks: Optional[List[int]] = None,
+                 global_block_indices: Optional[List[int]] = None,
+                 global_block_end_indices: Optional[List[int]] = None,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False, seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        rng = np.random.default_rng(self.seed)
+        for h in range(self.num_heads if self.different_layout_per_head
+                       else 1):
+            start = 0
+            wi = 0
+            while start < n:
+                w = self.local_window_blocks[
+                    min(wi, len(self.local_window_blocks) - 1)]
+                end = min(start + w, n)
+                layout[h, start:end, start:end] = 1
+                start = end
+                wi += 1
+            if self.num_random_blocks:
+                for i in range(n):
+                    choices = rng.choice(
+                        n, size=min(self.num_random_blocks, n),
+                        replace=False)
+                    layout[h, i, choices] = 1
+            if self.global_block_end_indices is None:
+                for idx in self.global_block_indices:
+                    if idx < n:
+                        layout[h, :, idx] = 1
+                        if self.horizontal_global_attention:
+                            layout[h, idx, :] = 1
+            else:
+                for s, e in zip(self.global_block_indices,
+                                self.global_block_end_indices):
+                    layout[h, :, s:e] = 1
+                    if self.horizontal_global_attention:
+                        layout[h, s:e, :] = 1
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+# ------------------------------------------------------------------- compute
+
+def layout_to_mask(layout: np.ndarray, seq_len: int) -> jnp.ndarray:
+    """[H, n, n] block layout -> [H, S, S] boolean attention mask."""
+    block = seq_len // layout.shape[1]
+    mask = np.repeat(np.repeat(layout, block, axis=1), block, axis=2)
+    return jnp.asarray(mask.astype(bool))
+
+
+def sparse_self_attention(q, k, v, sparsity_config: SparsityConfig,
+                          causal: bool = False, sm_scale=None):
+    """q/k/v [B, S, H, hd] -> [B, S, H, hd] under the config's block layout
+    (reference SparseSelfAttention.forward)."""
+    B, S, H, hd = q.shape
+    scale = sm_scale if sm_scale is not None else hd ** -0.5
+    layout = sparsity_config.make_layout(S)
+    mask = layout_to_mask(layout, S)                     # [H, S, S]
+    if causal:
+        mask = jnp.logical_and(mask, jnp.tril(jnp.ones((S, S), bool)))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask[None], s, NEG_INF)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class SparseSelfAttention:
+    """Module shim mirroring the reference class."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config
+
+    def __call__(self, query, key, value, causal=False):
+        return sparse_self_attention(query, key, value,
+                                     self.sparsity_config, causal=causal)

@@ -405,6 +405,10 @@ class DeepSpeedEngine:
             from deepspeed_tpu.profiling.flops_profiler.profiler import \
                 FlopsProfiler
             self.flops_profiler = FlopsProfiler(model, fpc)
+            if not getattr(model, "flops_per_token", None):
+                logger.warning(
+                    "flops_profiler: model.flops_per_token is unset — the "
+                    "profile will report 0 FLOPS")
         # comms logger wiring (reference comm.configure(comms_logger=...))
         if self._config.comms_config.enabled:
             from deepspeed_tpu import comm as _comm
@@ -426,6 +430,10 @@ class DeepSpeedEngine:
                 ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=pld.theta, gamma=pld.gamma)
+            logger.warning(
+                "progressive_layer_drop: theta advances per step; models "
+                "must consume engine.progressive_layer_drop.get_theta() — "
+                "no in-tree model does yet")
         # random-LTD token-drop schedule (reference data_routing; models
         # consume the keep count through the ltd scope in their layer scan)
         self.random_ltd_scheduler = None
@@ -443,6 +451,11 @@ class DeepSpeedEngine:
                 min_tokens=int(sched.get("min_value", 128)),
                 max_tokens=int(sched.get("max_value", 2048)),
                 step_size=int(sched_cfg.get("seq_per_step", 16)))
+            if not getattr(model, "meta", {}).get("supports_random_ltd"):
+                logger.warning(
+                    "random_ltd: this model does not read the LTD keep scope "
+                    "(models/gpt2.py, llama.py do) — token dropping will be "
+                    "a no-op")
 
         if training_data is not None:
             from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
@@ -651,8 +664,9 @@ class DeepSpeedEngine:
         return self.grad_shardings
 
     #: compiled fns that trace the model's layer scan (and therefore read
-    #: the random-LTD keep count at trace time)
-    _LTD_SENSITIVE = ("train_step", "grad_step", "grad_micro", "grad", "loss")
+    #: the random-LTD keep count at trace time); eval ("loss") never enters
+    #: the LTD scope, so it must not fork per keep value
+    _LTD_SENSITIVE = ("train_step", "grad_step", "grad_micro", "grad")
 
     def _get_compiled(self, name: str):
         # random-LTD changes the traced keep count: one compile per value,
@@ -824,6 +838,9 @@ class DeepSpeedEngine:
         return param_stream_scope(True, mesh=self.mesh, layer_specs=pairs,
                                   mode="qwz")
 
+    #: batch keys carrying a trailing sequence dim (safe to truncate)
+    _SEQ_KEYS = ("input_ids", "labels", "attention_mask", "position_ids")
+
     def _apply_curriculum(self, batch):
         """Legacy seqlen curriculum (reference engine.py:1761): truncate the
         batch's sequence dim to the scheduled difficulty.  Each new
@@ -834,16 +851,24 @@ class DeepSpeedEngine:
         difficulty = self.curriculum_scheduler.update_difficulty(
             self.global_steps + 1)
         cl = self._config.curriculum_learning
-        if cl.curriculum_type != "seqlen":
+        if cl.curriculum_type != "seqlen" or not isinstance(batch, dict):
             return batch
+        seq = max((np.shape(v)[-1] for k, v in batch.items()
+                   if k in self._SEQ_KEYS), default=0)
+        if seq <= difficulty:
+            return batch                       # schedule saturated: no copies
+        return {k: (np.asarray(v)[..., :difficulty]
+                    if k in self._SEQ_KEYS else v)
+                for k, v in batch.items()}
 
-        def trunc(x):
-            x = np.asarray(x)
-            if x.ndim >= 2 and x.shape[-1] > difficulty:
-                return x[..., :difficulty]
-            return x
-
-        return jax.tree.map(trunc, batch)
+    def _advance_ltd(self):
+        """Advance the random-LTD keep schedule (once per optimizer batch).
+        A keep >= the current sequence length is a no-op: clear it so no
+        ltd-suffixed recompiles happen."""
+        if self.random_ltd_scheduler is None:
+            return
+        keep = self.random_ltd_scheduler.update_seq(self.global_steps)
+        self._ltd_keep = keep if keep < self._last_seq_len else None
 
     def _ltd_scope(self):
         """Random-LTD token-drop scope: models' layer scans read the keep
@@ -918,10 +943,8 @@ class DeepSpeedEngine:
                     f"train_batch(batch=...) leaves must lead with gas={gas}, "
                     f"got {lead}")
         batch = self._apply_curriculum(batch)
-        if self.random_ltd_scheduler is not None:
-            self._ltd_keep = self.random_ltd_scheduler.update_seq(
-                self.global_steps)
         self._last_seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
+        self._advance_ltd()
         if self.flops_profiler is not None and (
                 self.global_steps + 1 ==
                 self._config.flops_profiler_config.profile_step):
@@ -973,6 +996,12 @@ class DeepSpeedEngine:
         ``value_and_grad`` once — the loss returned here and the gradients
         ``backward()`` accumulates come from the same evaluation (same RNG,
         no double forward cost)."""
+        if self._micro_grads is None and self._pending_grads is None:
+            # fresh accumulation window: advance the schedules (reference
+            # triggers curriculum/LTD in forward, engine.py:1722/:1761)
+            batch = self._apply_curriculum(batch)
+            self._last_seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
+            self._advance_ltd()
         batch = self._shard_batch(batch, stacked=False)
         if self._micro_grads is None:
             self._micro_grads = self._get_compiled("zero_grads")(

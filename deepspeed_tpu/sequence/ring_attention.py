@@ -1,0 +1,106 @@
+"""Ring attention — blockwise context parallelism over the ``seq`` mesh axis.
+
+The reference (DeepSpeed v0.10.2) has no ring attention; SURVEY §2.3 requires
+it as the TPU-idiomatic long-context path alongside Ulysses.  Design follows
+the public ring-attention recipe (blockwise online-softmax attention with K/V
+rotating around the ring): q stays put, each of the ``sp`` steps processes
+the resident K/V block and ``ppermute``s it to the next neighbour — ICI
+traffic overlaps with the block attention matmuls, and per-device memory is
+O(S/sp) instead of O(S).
+
+Causality is handled at block granularity via global position ids: a query
+attends to a key iff q_pos >= k_pos, so warm-up steps where the whole
+incoming block is in the future contribute nothing (their weights mask to
+-inf and the online-softmax max keeps them out).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from deepspeed_tpu.comm.mesh import get_topology, SEQ_AXIS, MODEL_AXIS
+
+NEG_INF = -1e30
+
+
+def _block_attn_update(q, k, v, q_pos, k_pos, m, l, o, scale, causal):
+    """One online-softmax update with the resident K/V block.
+    q [B,Sq,H,hd], k/v [B,Sk,H,hd], positions [Sq]/[Sk], running (m,l,o)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale       # [B,H,Sq,Sk]
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]           # [Sq,Sk]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))           # [B,H,Sq]
+    # guard fully-masked rows (m_new == NEG_INF): exp(0)=1 would pollute l
+    safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - safe_m)
+    corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr[..., None] +
+             jnp.einsum("bhqk,bkhd->bhqd", p, v))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, causal: bool = True, sm_scale=None):
+    """q/k/v: [B, S, H, hd] with S sharded over the ``seq`` mesh axis.
+    Returns [B, S, H, hd] with the same sharding.  Falls back to a single
+    dense block when the seq axis has size 1."""
+    topo = get_topology()
+    mesh = topo.mesh
+    sp = mesh.shape[SEQ_AXIS]
+    B, S, H, hd = q.shape
+    scale = sm_scale if sm_scale is not None else hd ** -0.5
+    dp = tuple(topo.data_parallel_axes)
+    spec = P(dp, SEQ_AXIS, MODEL_AXIS, None)
+    s_local = S // sp
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def inner(ql, kl, vl):
+        my = lax.axis_index(SEQ_AXIS)
+        q_pos = my * s_local + jnp.arange(s_local)
+        b, _, h, _ = ql.shape
+        m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+        l = jnp.zeros((b, h, s_local), jnp.float32)
+        o = jnp.zeros((b, h, s_local, hd), jnp.float32)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def step(carry, i):
+            k_blk, v_blk, m, l, o = carry
+            # K/V block currently resident came from device (my - i) % sp
+            src = (my - i) % sp
+            k_pos = src * s_local + jnp.arange(s_local)
+            m, l, o = _block_attn_update(
+                ql.astype(jnp.float32), k_blk.astype(jnp.float32),
+                v_blk.astype(jnp.float32), q_pos, k_pos, m, l, o, scale,
+                causal)
+            # rotate K/V around the ring (skipped after the last step by scan
+            # structure — one extra permute is harmless and keeps the body
+            # uniform)
+            k_blk = lax.ppermute(k_blk, SEQ_AXIS, perm)
+            v_blk = lax.ppermute(v_blk, SEQ_AXIS, perm)
+            return (k_blk, v_blk, m, l, o), None
+
+        (_, _, m, l, o), _ = lax.scan(
+            step, (kl, vl, m, l, o), jnp.arange(sp))
+        out = o / jnp.maximum(l, 1e-30)[..., None]        # [b,h,Sq,hd]
+        return out.transpose(0, 2, 1, 3).astype(ql.dtype)
+
+    return inner(q, k, v)
+
+
+class DistributedRingAttention:
+    """Module-style wrapper mirroring DistributedAttention's interface."""
+
+    def __init__(self, causal: bool = True, sm_scale=None):
+        self.causal = causal
+        self.sm_scale = sm_scale
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        return ring_attention(query, key, value, causal=self.causal,
+                              sm_scale=self.sm_scale)

@@ -1,0 +1,98 @@
+"""zero_to_fp32 — offline fp32 consolidation of a framework checkpoint
+(reference: deepspeed/utils/zero_to_fp32.py:194
+``convert_zero_checkpoint_to_fp32_state_dict`` + engine._zero3_consolidated_
+16bit_state_dict, engine.py:3355).
+
+The reference stitches per-rank flat partitions back together.  Here the
+checkpoint is an Orbax tree (sharding-aware by construction), so
+consolidation = restore to host numpy + overlay the fp32 masters from the
+host/streamed optimizer sidecar when one exists (offload tiers store
+compute-dtype working params only).
+
+CLI:
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_root> <out.npz> \
+        [--tag TAG]
+
+The output is a flat npz: one entry per parameter leaf keyed by its tree
+path ("blocks/qkv_w", ...), all fp32 — loadable with numpy alone, no jax.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _flatten_with_paths(tree, prefix=""):
+    import jax
+    pairs, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in pairs:
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        out[key] = leaf
+    return out
+
+
+def _resolve_tag(checkpoint_root: str, tag=None) -> str:
+    if tag is None:
+        latest = os.path.join(checkpoint_root, "latest")
+        if not os.path.exists(latest):
+            raise FileNotFoundError(
+                f"no 'latest' file in {checkpoint_root}; pass --tag")
+        with open(latest) as f:
+            tag = f.read().strip()
+    return os.path.join(checkpoint_root, str(tag))
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_root: str, tag=None):
+    """Returns {param_path: fp32 ndarray} for the checkpoint (reference
+    get_fp32_state_dict_from_zero_checkpoint)."""
+    import orbax.checkpoint as ocp
+    ckpt_dir = _resolve_tag(checkpoint_root, tag)
+    state = ocp.PyTreeCheckpointer().restore(
+        os.path.abspath(os.path.join(ckpt_dir, "state")))
+    params = state["params"]
+    flat = {k: np.asarray(v).astype(np.float32)
+            for k, v in _flatten_with_paths(params).items()}
+
+    # offload tiers: the true fp32 masters live in the optimizer sidecar
+    for sidecar, master_key in (("host_optimizer.npz", "master::"),
+                                ("streamed_optimizer.npz", "master::")):
+        path = os.path.join(ckpt_dir, sidecar)
+        if not os.path.exists(path):
+            continue
+        data = np.load(path)
+        for key in data.files:
+            if key.startswith(master_key):
+                pkey = key[len(master_key):]
+                if pkey in flat:
+                    flat[pkey] = np.asarray(data[key], np.float32).reshape(
+                        flat[pkey].shape)
+        break
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_root: str,
+                                               output_file: str, tag=None):
+    flat = get_fp32_state_dict_from_zero_checkpoint(checkpoint_root, tag)
+    np.savez(output_file, **flat)
+    total = sum(int(np.prod(v.shape)) for v in flat.values())
+    print(f"zero_to_fp32: wrote {len(flat)} tensors ({total / 1e6:.1f}M "
+          f"params, fp32) to {output_file}")
+    return flat
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("checkpoint_root")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_root, args.output_file, args.tag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Long-context paths: ring attention (context parallelism) and block-sparse
+attention (reference: ops/sparse_attention/ + the ring/blockwise CP that
+SURVEY §2.3 requires beyond the reference)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm.mesh import MeshTopology, set_topology
+from deepspeed_tpu.sequence.ring_attention import (ring_attention,
+                                                   DistributedRingAttention)
+from deepspeed_tpu.ops.sparse_attention import (
+    DenseSparsityConfig, FixedSparsityConfig, BigBirdSparsityConfig,
+    BSLongformerSparsityConfig, VariableSparsityConfig, layout_to_mask,
+    sparse_self_attention)
+
+
+def _dense_causal(q, k, v):
+    B, S, H, hd = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# -------------------------------------------------------------- ring attention
+
+def test_ring_attention_matches_dense(devices8):
+    """sp=8 ring attention must equal single-device dense causal attention."""
+    set_topology(MeshTopology(sequence_parallel_size=8))
+    rng = np.random.default_rng(0)
+    B, S, H, hd = 2, 64, 4, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    out = ring_attention(q, k, v, causal=True)
+    want = _dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_non_causal(devices8):
+    set_topology(MeshTopology(sequence_parallel_size=4))
+    rng = np.random.default_rng(1)
+    B, S, H, hd = 2, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    out = ring_attention(q, k, v, causal=False)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd ** -0.5
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grad_flows(devices8):
+    set_topology(MeshTopology(sequence_parallel_size=8))
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+
+    def loss(q):
+        return jnp.sum(ring_attention(q, q, q, causal=True) ** 2)
+
+    g = jax.grad(loss)(q)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_distributed_ring_attention_wrapper(devices8):
+    set_topology(MeshTopology(sequence_parallel_size=2))
+    attn = DistributedRingAttention(causal=True)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(4, 16, 2, 8)), jnp.float32)
+    out = attn(q, q, q)
+    assert out.shape == q.shape
+
+
+# ------------------------------------------------------------ sparse attention
+
+def test_dense_config_equals_full_attention():
+    rng = np.random.default_rng(4)
+    B, S, H, hd = 2, 64, 4, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = DenseSparsityConfig(num_heads=H, block=16)
+    out = sparse_self_attention(q, k, v, cfg, causal=True)
+    want = _dense_causal(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(128)       # 8x8 blocks
+    assert layout.shape == (2, 8, 8)
+    assert (layout[0] == layout[1]).all()       # propagated first head
+    assert layout[0, 0, 0] == 1                 # local window
+    assert layout[0, 0, 1] == 1                 # global col (end of window 0)
+    assert layout[0, 0, 2] == 0                 # outside window+globals
+    assert layout[0, 7, 7] == 1
+
+
+def test_fixed_unidirectional_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert (np.triu(layout[0], 1) == 0).all()
+
+
+def test_bigbird_layout_has_window_random_global():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    layout = cfg.make_layout(256)       # 16x16
+    n = layout.shape[1]
+    assert (layout[0, 0, :] == 1).all()          # global row
+    assert (layout[0, :, 0] == 1).all()          # global col
+    for i in range(1, n - 1):
+        assert layout[0, i, i - 1] and layout[0, i, i] and layout[0, i, i + 1]
+    density = layout[0].mean()
+    assert density < 0.5                         # actually sparse
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 5])
+    layout = cfg.make_layout(128)
+    assert (layout[0, 0, :] == 1).all() and (layout[0, :, 5] == 1).all()
+
+
+def test_variable_layout_windows():
+    cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                 local_window_blocks=[1, 2, 4],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(256)
+    assert layout[0, 0, 0] == 1
+    assert layout[0, 1, 2] == 1 and layout[0, 2, 1] == 1    # window of 2
+    assert (layout[0][:, 0] == 1).all()                     # global col
+
+
+def test_layout_to_mask_expands_blocks():
+    cfg = FixedSparsityConfig(num_heads=1, block=4, num_local_blocks=1,
+                              num_global_blocks=0)
+    layout = cfg.make_layout(16)
+    mask = layout_to_mask(layout, 16)
+    assert mask.shape == (1, 16, 16)
+    assert bool(mask[0, 0, 3]) and not bool(mask[0, 0, 4])
+
+
+def test_sparse_attention_masks_forbidden_positions():
+    """A token outside every allowed block must not influence the output."""
+    rng = np.random.default_rng(5)
+    B, S, H, hd = 1, 64, 1, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=1,
+                              num_global_blocks=0)
+    out1 = sparse_self_attention(q, k, v, cfg)
+    # perturb keys/values in a block the first window cannot see
+    k2 = k.at[:, 48:].set(rng.normal(size=(B, 16, H, hd)))
+    v2 = v.at[:, 48:].set(rng.normal(size=(B, 16, H, hd)))
+    out2 = sparse_self_attention(q, k2, v2, cfg)
+    np.testing.assert_allclose(np.asarray(out1[:, :16]),
+                               np.asarray(out2[:, :16]), rtol=1e-6)
+    assert not np.allclose(np.asarray(out1[:, 48:]), np.asarray(out2[:, 48:]))
