@@ -1,0 +1,4 @@
+"""Compression library (reference: deepspeed/compression/)."""
+from deepspeed_tpu.compression.compress import (  # noqa: F401
+    init_compression, compress_params, redundancy_clean,
+    parse_compression_config, CompressionScheduler)

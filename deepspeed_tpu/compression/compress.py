@@ -1,0 +1,155 @@
+"""Compression library (reference: deepspeed/compression/compress.py:100
+``init_compression`` + :148 ``redundancy_clean``, basic_layer.py:121
+``LinearLayer_Compress``, scheduler.py).
+
+The reference swaps nn.Linear modules for compressed variants that maintain
+quantization/pruning state.  Functionally, compression over a params pytree
+is a *transform*: ``init_compression`` parses the reference's config schema
+into per-leaf plans (matched by the same ``modules``/pattern lists),
+``compress_params`` applies fake weight quantization (straight-through int
+quantization at the configured bits) and magnitude pruning masks each time
+it is called, and ``redundancy_clean`` makes the compression permanent
+(hard zeros + quantized values baked into the weights).
+
+A ``CompressionScheduler`` mirrors the reference's offset/schedule gating
+(engine.py:2044 calls it every step).
+"""
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class LeafPlan:
+    quantize_bits: int = 0          # 0 = off
+    prune_ratio: float = 0.0        # fraction of weights zeroed
+    start_step: int = 0
+
+
+def _match_any(path: str, patterns: List[str]) -> bool:
+    return any(fnmatch.fnmatch(path, p) or p in path for p in patterns)
+
+
+def parse_compression_config(config: dict) -> Dict[str, LeafPlan]:
+    """Reference schema (compression/config.py): weight_quantization +
+    sparse_pruning sections with shared_parameters / different_groups, each
+    group naming target modules."""
+    plans: Dict[str, LeafPlan] = {}
+    wq = (config or {}).get("weight_quantization", {})
+    if wq.get("shared_parameters", {}).get("enabled"):
+        shared = wq["shared_parameters"]
+        for gname, group in wq.get("different_groups", {}).items():
+            bits = int(group.get("params", {}).get("target_bits", 8))
+            start = int(group.get("params", {}).get(
+                "start_bits", bits))  # schedule collapsing: use target
+            for pat in group.get("modules", ["*"]):
+                plans.setdefault(pat, LeafPlan()).quantize_bits = bits
+                plans[pat].start_step = int(
+                    shared.get("schedule_offset", 0))
+    sp = (config or {}).get("sparse_pruning", {})
+    if sp.get("shared_parameters", {}).get("enabled"):
+        shared = sp["shared_parameters"]
+        for gname, group in sp.get("different_groups", {}).items():
+            ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
+            for pat in group.get("modules", ["*"]):
+                plans.setdefault(pat, LeafPlan()).prune_ratio = 1.0 - ratio
+                plans[pat].start_step = max(
+                    plans[pat].start_step,
+                    int(shared.get("schedule_offset", 0)))
+    return plans
+
+
+def _fake_quantize(w, bits: int):
+    """Symmetric per-tensor fake quantization with a straight-through
+    estimator (reference Quantizer in basic_layer.py): the backward passes
+    the cotangent through unchanged, so quantization-aware training keeps
+    full gradients (jnp.round alone would zero them)."""
+
+    @jax.custom_vjp
+    def ste(x):
+        return _quantize_vals(x)
+
+    def fwd(x):
+        return _quantize_vals(x), None
+
+    def bwd(_, g):
+        return (g,)
+
+    def _quantize_vals(x):
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(x.astype(jnp.float32))) / qmax
+        scale = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+        return (q * scale).astype(x.dtype)
+
+    ste.defvjp(fwd, bwd)
+    return ste(w)
+
+
+def _prune_mask(w, ratio: float):
+    """Magnitude pruning mask keeping the top (1-ratio) fraction."""
+    flat = jnp.abs(w.astype(jnp.float32)).ravel()
+    k = int(round(flat.size * ratio))
+    if k <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(flat)[k - 1]
+    return jnp.abs(w.astype(jnp.float32)) > thresh
+
+
+class CompressionScheduler:
+    """Step-gated application (reference compression/scheduler.py, driven at
+    engine.py:2044)."""
+
+    def __init__(self, plans: Dict[str, LeafPlan]):
+        self.plans = plans
+        self.step = 0
+
+    def advance(self):
+        self.step += 1
+
+    def active_plans(self) -> Dict[str, LeafPlan]:
+        return {p: pl for p, pl in self.plans.items()
+                if self.step >= pl.start_step}
+
+
+def init_compression(params, config: dict):
+    """-> (params, CompressionScheduler).  Reference compress.py:100 (module
+    swap collapses to plan parsing in the functional formulation)."""
+    return params, CompressionScheduler(parse_compression_config(config))
+
+
+def compress_params(params, scheduler: CompressionScheduler):
+    """Apply the active quantization/pruning plans to matching leaves."""
+    active = scheduler.active_plans()
+    if not active:
+        return params
+    pairs, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in pairs:
+        pstr = "/".join(str(getattr(k, "key", k)) for k in path)
+        plan = next((pl for pat, pl in active.items()
+                     if _match_any(pstr, [pat])), None)
+        if plan is None or np.ndim(leaf) < 2:
+            out.append(leaf)
+            continue
+        w = leaf
+        if plan.prune_ratio > 0:
+            w = jnp.where(_prune_mask(w, plan.prune_ratio), w,
+                          jnp.zeros_like(w))
+        if plan.quantize_bits:
+            w = _fake_quantize(w, plan.quantize_bits)
+        out.append(w)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def redundancy_clean(params, config: dict):
+    """Bake the compression into the weights permanently (reference
+    compress.py:148 — the post-training export step)."""
+    _, scheduler = init_compression(params, config)
+    scheduler.step = 2 ** 31 - 1        # all schedules elapsed
+    return compress_params(params, scheduler)

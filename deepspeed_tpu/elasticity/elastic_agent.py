@@ -1,0 +1,80 @@
+"""Elastic agent — restart-on-failure worker supervision (reference:
+deepspeed/elasticity/elastic_agent.py:28 ``DSElasticAgent`` extending
+torch-elastic's LocalElasticAgent with the :118 ``_invoke_run`` monitor
+loop).
+
+The torch-elastic machinery maps to a plain supervisor around the per-node
+launcher: start the worker process with the JAX coordination env, poll it,
+and on failure restart (up to ``max_restarts``), re-deriving a valid world
+size from the elasticity config each round so the job continues when hosts
+come or go."""
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 ElasticityError)
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class AgentResult:
+    success: bool
+    restarts: int
+    return_code: int
+    history: List[int] = field(default_factory=list)
+
+
+class DSElasticAgent:
+    """Supervise a worker command with bounded restarts (reference :28)."""
+
+    def __init__(self, cmd: List[str], max_restarts: int = 3,
+                 restart_delay_s: float = 0.5, env: Optional[dict] = None,
+                 ds_config: Optional[dict] = None,
+                 monitor_interval_s: float = 0.1,
+                 on_restart: Optional[Callable[[int], None]] = None):
+        self.cmd = list(cmd)
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.env = env
+        self.ds_config = ds_config
+        self.monitor_interval_s = monitor_interval_s
+        self.on_restart = on_restart
+
+    def _validate_world(self, world_size: int):
+        """Re-derive a compatible batch config for the current world
+        (reference DSElasticAgent wires compute_elastic_config into the
+        rendezvous)."""
+        if not self.ds_config or not self.ds_config.get(
+                "elasticity", {}).get("enabled"):
+            return
+        compute_elastic_config(self.ds_config, world_size=world_size)
+
+    def run(self, world_size: int = 1) -> AgentResult:
+        """The reference's _invoke_run loop (:118): run → monitor → on
+        failure restart within budget."""
+        self._validate_world(world_size)
+        history: List[int] = []
+        restarts = 0
+        while True:
+            proc = subprocess.Popen(self.cmd, env=self.env)
+            while proc.poll() is None:
+                time.sleep(self.monitor_interval_s)
+            rc = proc.returncode
+            history.append(rc)
+            if rc == 0:
+                return AgentResult(True, restarts, 0, history)
+            if restarts >= self.max_restarts:
+                logger.error(
+                    f"elastic agent: worker failed rc={rc}; restart budget "
+                    f"({self.max_restarts}) exhausted")
+                return AgentResult(False, restarts, rc, history)
+            restarts += 1
+            logger.warning(
+                f"elastic agent: worker failed rc={rc}; restart "
+                f"{restarts}/{self.max_restarts}")
+            if self.on_restart is not None:
+                self.on_restart(restarts)
+            time.sleep(self.restart_delay_s)

@@ -1,0 +1,3 @@
+"""Module injection / AutoTP (reference: deepspeed/module_inject/)."""
+from deepspeed_tpu.module_inject.auto_tp import (  # noqa: F401
+    AutoTP, auto_tp_specs, auto_tp_spec_for_leaf, inject_tp)

@@ -1,0 +1,3 @@
+"""Compressed communication backends (reference: deepspeed/runtime/comm/)."""
+from deepspeed_tpu.runtime.comm.compressed import (  # noqa: F401
+    compress, compressed_allreduce)

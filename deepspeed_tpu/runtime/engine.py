@@ -72,8 +72,17 @@ class DeepSpeedEngine:
         else:
             raw_dict = dict(raw)
         mesh_cfg = MeshConfig(**raw_dict.get("mesh", {}))
-        hpz_size = int(raw_dict.get("zero_optimization", {})
-                       .get("zero_hpz_partition_size", 1) or 1)
+        zo_raw = raw_dict.get("zero_optimization", {})
+        hpz_size = int(zo_raw.get("zero_hpz_partition_size", 1) or 1)
+        # MiCS (reference runtime/zero/mics.py:55): ALL zero state shards
+        # within sub-groups of mics_shard_size, replicated across groups —
+        # the same sub-axis mechanism as hpZ, applied to params+grads+opt
+        mics_size = int(zo_raw.get("mics_shard_size", -1) or -1)
+        if mics_size > 0:
+            if hpz_size > 1 and hpz_size != mics_size:
+                raise ValueError("mics_shard_size and zero_hpz_partition_size "
+                                 "cannot differ")
+            hpz_size = mics_size
         topo_kwargs = dict(
             data_parallel_size=mesh_cfg.data_parallel_size,
             model_parallel_size=mesh_cfg.model_parallel_size,
@@ -108,7 +117,8 @@ class DeepSpeedEngine:
             stage=zc.stage, topology=self.topology,
             param_persistence_threshold=(zc.param_persistence_threshold
                                          if zc.stage >= 3 else 0),
-            hpz_partition_size=zc.zero_hpz_partition_size)
+            hpz_partition_size=zc.zero_hpz_partition_size,
+            mics_shard_size=zc.mics_shard_size)
         off = zc.offload_optimizer
         self._offload_device = off.device if off is not None else "none"
         self._offload = self._offload_device in ("cpu", "nvme")
@@ -586,24 +596,72 @@ class DeepSpeedEngine:
         return train_step
 
     def _build_pipeline_train_step(self):
-        """Pipelined models consume the whole [gas, micro, ...] stack in one
-        compiled schedule (gas ≙ the pipeline's microbatch count; reference
-        PipelineEngine.train_batch, runtime/pipe/engine.py:297) — no
-        sequential accumulation scan."""
+        """Pipelined models consume the [gas, micro, ...] stack (gas ≙ the
+        pipeline's microbatch count; reference PipelineEngine.train_batch,
+        runtime/pipe/engine.py:297).
+
+        Memory profile: with ``pipeline.num_pipe_buffers = N`` the stack is
+        processed in chunks of N microbatches inside a grad-accumulation
+        scan, so only one chunk's activations are live for backward — the
+        1F1B memory bound (reference schedule.py:176 ``num_pipe_buffers``).
+        The trade is the reference's too: each chunk pays its own
+        fill/drain bubble, (S-1)/(N+S-1) vs (S-1)/(M+S-1) for the all-live
+        schedule (num_pipe_buffers unset/M keeps the old behaviour)."""
         fp16 = self._config.fp16.enabled
+        gas = self.gradient_accumulation_steps()
+        n_buffers = int(
+            (self._config._param_dict.get("pipeline", {}) or {})
+            .get("num_pipe_buffers", 0) or 0)
+        policy, grad_specs = self.zero_policy, self.grad_specs
+        n_stages = int(self.model.meta.get("num_stages", 1))
+        chunked = 0 < n_buffers < gas and gas % n_buffers == 0
+        if chunked and n_buffers < n_stages:
+            logger.warning(
+                f"pipeline.num_pipe_buffers={n_buffers} < pipeline stages "
+                f"{n_stages}: a chunk cannot fill the pipeline; running "
+                f"all-live")
+            chunked = False
+        elif n_buffers and not chunked and n_buffers < gas:
+            logger.warning(
+                f"pipeline.num_pipe_buffers={n_buffers} does not divide "
+                f"gradient_accumulation_steps={gas}; running all-live")
+
+        def loss_of_chunk(params, chunk_batch, rng, scale):
+            cparams = _tree_cast(params, self.compute_dtype)
+            loss = self.model.loss(cparams, chunk_batch, rng)
+            return loss.astype(jnp.float32) * scale
 
         def train_step(state, stacked_batch, rng):
             params = state["params"]
             scale = state["scaler"].cur_scale if fp16 else jnp.float32(1.0)
 
-            def loss_fn(p):
-                cparams = _tree_cast(p, self.compute_dtype)
-                loss = self.model.loss(cparams, stacked_batch, rng)
-                return loss.astype(jnp.float32) * scale
+            if not chunked:
+                loss, grads = jax.value_and_grad(loss_of_chunk)(
+                    params, stacked_batch, rng, scale)
+            else:
+                n_chunks = gas // n_buffers
+                chunks = jax.tree.map(
+                    lambda x: x.reshape(n_chunks, n_buffers, *x.shape[1:]),
+                    stacked_batch)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+                def body(carry, chunk):
+                    g_acc, l_acc = carry
+                    l, g = jax.value_and_grad(loss_of_chunk)(
+                        params, chunk, rng, scale / n_chunks)
+                    g = _tree_cast(g, jnp.float32)
+                    g = policy.constrain_grads(g, grad_specs)
+                    return (jax.tree.map(jnp.add, g_acc, g), l_acc + l), None
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zeros = policy.constrain_grads(zeros, grad_specs)
+                # each chunk is already weighted by scale/n_chunks, so the
+                # sum over chunks is the full-batch mean at full scale
+                (grads, loss), _ = jax.lax.scan(
+                    body, (zeros, jnp.float32(0.0)), chunks)
+
             grads = _tree_cast(grads, jnp.float32)
-            grads = self.zero_policy.constrain_grads(grads, self.grad_specs)
+            grads = policy.constrain_grads(grads, grad_specs)
             new_state, metrics = self._apply_grads(state, grads)
             metrics["loss"] = loss / scale
             return new_state, metrics

@@ -1,0 +1,3 @@
+"""1-bit optimizers (reference: deepspeed/runtime/fp16/onebit/)."""
+from deepspeed_tpu.runtime.fp16.onebit.adam import (  # noqa: F401
+    OnebitAdam, onebit_adam, OnebitAdamState)

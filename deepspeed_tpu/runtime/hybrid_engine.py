@@ -1,0 +1,60 @@
+"""Hybrid engine — RLHF train↔generate flip (reference:
+deepspeed/runtime/hybrid_engine.py:32 ``DeepSpeedHybridEngine``).
+
+The reference rebuilds inference containers that alias the training weights
+and fuses/unfuses LoRA around each generate call.  Functionally the flip is
+free: training params are a pytree the inference engine can consume
+directly, so ``generate()`` runs the KV-cache decode path against the LIVE
+training weights — no copy, no re-shard (both sides read the same arrays;
+only the compute dtype view is materialised per call).
+"""
+from typing import Optional
+
+import jax
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + inference fast path over shared weights."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._infer_engine = None
+        self._infer_params_step = -1
+        log_dist("DeepSpeedHybridEngine: train<->generate over shared "
+                 "weights", ranks=[0])
+
+    def _inference_view(self):
+        """(Re)bind the inference engine to the current training params.
+        Rebinding is a pytree pointer swap — the reference's
+        fuse/unfuse + container refresh (hybrid_engine.py:138-174)
+        collapses to this."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        if self._infer_engine is None:
+            cfg = DeepSpeedInferenceConfig(
+                dtype=str(jax.numpy.dtype(self.compute_dtype)))
+            self._infer_engine = InferenceEngine(
+                self.model, cfg, model_parameters=self.state["params"],
+                mesh=self.mesh)
+        if self._infer_params_step != self.global_steps:
+            import jax.numpy as jnp
+            self._infer_engine.params = jax.tree.map(
+                lambda x: (x.astype(self.compute_dtype)
+                           if jnp.issubdtype(x.dtype, jnp.floating) else x),
+                self.state["params"])
+            self._infer_params_step = self.global_steps
+        return self._infer_engine
+
+    def generate(self, input_ids, **kwargs):
+        """Generate with the current training weights (reference
+        hybrid_engine.py:174)."""
+        return self._inference_view().generate(input_ids, **kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self

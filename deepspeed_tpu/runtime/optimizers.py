@@ -51,15 +51,22 @@ def build_optimizer(name: Optional[str], params: Optional[dict],
         betas = params.get("betas", (0.9, 0.99))
         return optax.lion(lr, b1=float(betas[0]), b2=float(betas[1]),
                           weight_decay=wd)
-    if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER,
-                C.ZERO_ONE_ADAM_OPTIMIZER):
-        # 1-bit error-feedback compression targets bandwidth-limited
-        # interconnects; on ICI the uncompressed collective is faster.  Keep the
-        # math (Adam/LAMB) and note the compression tier is not yet wired.
+    if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+        # two-phase 1-bit Adam: exact Adam through freeze_step, then frozen
+        # variance (runtime/fp16/onebit/adam.py).  The sign-compressed
+        # exchange itself (runtime/comm/compressed.py) engages when gradients
+        # flow through a shard_map with an axis name; in the engine's
+        # sharding-constraint flow XLA reduces in full precision — compression
+        # targets DCN-bound multi-slice runs, not single-slice ICI.
+        from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
+        adam_args = _adam_args(params)
+        return onebit_adam(
+            learning_rate=lr,   # schedule-aware, like every other branch
+            b1=adam_args["b1"], b2=adam_args["b2"], eps=adam_args["eps"],
+            weight_decay=wd,
+            freeze_step=int(params.get("freeze_step", 100)))
+    if name == C.ONEBIT_LAMB_OPTIMIZER:
         from deepspeed_tpu.utils.logging import warning_once
-        warning_once(f"{name}: compressed-communication variant runs as its "
-                     "uncompressed base optimizer on TPU")
-        if "lamb" in name:
-            return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
-        return optax.adam(lr, **_adam_args(params))
+        warning_once(f"{name}: runs as uncompressed LAMB on TPU")
+        return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
     raise ValueError(f"Unknown optimizer {name!r} in DeepSpeed config")

@@ -109,11 +109,18 @@ class ZeroShardingPolicy:
     topology: MeshTopology
     param_persistence_threshold: int = 0
     hpz_partition_size: int = 1
+    mics_shard_size: int = -1
 
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise ValueError(f"invalid ZeRO stage {self.stage}")
-        self.zero_axes = self.topology.zero_shard_axes
+        if self.mics_shard_size > 0:
+            # MiCS (reference mics.py:55): every ZeRO axis collapses to the
+            # sub-group axis; state replicates across groups so collectives
+            # stay inside the (intra-host-sized) group
+            self.zero_axes = self.topology.hpz_axes
+        else:
+            self.zero_axes = self.topology.zero_shard_axes
         # ZeRO++ hpZ (reference partition_parameters.py:1488 secondary
         # partition + groups.py:473): param STORAGE shards only over the
         # intra-host hpz axis, so the forward all-gather never crosses hosts;

@@ -1,0 +1,147 @@
+"""Capability-tail tests: compression library, hybrid (RLHF) engine, elastic
+agent (reference: compression/test_compression.py, hybrid_engine tests,
+elasticity/test_elastic.py agent paths)."""
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from tests.util import tiny_gpt2, base_config, random_batches
+
+
+# ---------------------------------------------------------------- compression
+
+WQ_CFG = {"compression_training": None}   # placeholder, see below
+
+
+def _compression_cfg():
+    return {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8},
+                        "modules": ["qkv_w", "mlp_in_w"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["mlp_out_w"]}}},
+    }
+
+
+def test_compression_plans_parse():
+    from deepspeed_tpu.compression import parse_compression_config
+    plans = parse_compression_config(_compression_cfg())
+    assert plans["qkv_w"].quantize_bits == 8
+    assert plans["mlp_out_w"].prune_ratio == 0.5
+    assert plans["mlp_out_w"].start_step == 2
+
+
+def test_compression_quantizes_and_prunes():
+    from deepspeed_tpu.compression import (init_compression, compress_params,
+                                           CompressionScheduler)
+    m = tiny_gpt2()
+    params = jax.jit(m.init)(jax.random.PRNGKey(0))
+    params, sched = init_compression(params, _compression_cfg())
+    out = compress_params(params, sched)
+    q = np.asarray(out["blocks"]["qkv_w"])
+    w = np.asarray(params["blocks"]["qkv_w"])
+    assert not np.allclose(q, w)                 # quantized
+    # 8-bit symmetric: at most 255 distinct values
+    assert len(np.unique(q)) <= 256
+    # pruning gated behind schedule_offset=2
+    np.testing.assert_allclose(np.asarray(out["blocks"]["mlp_out_w"]),
+                               np.asarray(params["blocks"]["mlp_out_w"]))
+    sched.advance(); sched.advance()
+    out2 = compress_params(params, sched)
+    pruned = np.asarray(out2["blocks"]["mlp_out_w"])
+    frac_zero = (pruned == 0).mean()
+    assert 0.4 < frac_zero < 0.6                 # ~50% magnitude-pruned
+
+
+def test_redundancy_clean_bakes_compression():
+    from deepspeed_tpu.compression import redundancy_clean
+    m = tiny_gpt2()
+    params = jax.jit(m.init)(jax.random.PRNGKey(0))
+    out = redundancy_clean(params, _compression_cfg())
+    assert (np.asarray(out["blocks"]["mlp_out_w"]) == 0).mean() > 0.4
+    # untargeted leaves untouched
+    np.testing.assert_allclose(np.asarray(out["wte"]),
+                               np.asarray(params["wte"]))
+
+
+# -------------------------------------------------------------- hybrid engine
+
+def test_hybrid_engine_train_generate_flip(devices8):
+    """train -> generate -> train -> generate with shared weights: the
+    generations must change as training updates the params (reference
+    hybrid_engine.py train<->generate RLHF loop)."""
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+    engine = DeepSpeedHybridEngine(
+        config=base_config(optimizer={"type": "Adam",
+                                      "params": {"lr": 5e-2}}),
+        model=tiny_gpt2())
+    ids = np.arange(1, 9, dtype=np.int32)[None]
+    gen0 = engine.generate(ids, max_new_tokens=6)
+    assert gen0.shape == (1, 14)
+    for i in range(3):
+        b = random_batches(1, batch_size=8, seed=70 + i)[0]
+        engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    gen1 = engine.generate(ids, max_new_tokens=6)
+    # big-lr updates must change the continuation; prompt echoed unchanged
+    np.testing.assert_array_equal(gen0[:, :8], gen1[:, :8])
+    assert not np.array_equal(gen0, gen1)
+
+
+# -------------------------------------------------------------- elastic agent
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    marker = sys.argv[1]
+    # fail the first two runs, succeed on the third
+    n = 0
+    if os.path.exists(marker):
+        n = int(open(marker).read())
+    open(marker, "w").write(str(n + 1))
+    sys.exit(0 if n >= 2 else 1)
+""")
+
+
+def test_elastic_agent_restarts_until_success(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    marker = tmp_path / "count"
+    agent = DSElasticAgent([sys.executable, str(script), str(marker)],
+                           max_restarts=3, restart_delay_s=0.01)
+    result = agent.run()
+    assert result.success and result.restarts == 2
+    assert result.history == [1, 1, 0]
+
+
+def test_elastic_agent_budget_exhausted(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    script = tmp_path / "worker.py"
+    script.write_text("import sys; sys.exit(3)")
+    agent = DSElasticAgent([sys.executable, str(script)], max_restarts=2,
+                           restart_delay_s=0.01)
+    result = agent.run()
+    assert not result.success
+    assert result.restarts == 2 and result.return_code == 3
+
+
+def test_elastic_agent_validates_world():
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.elasticity.elasticity import \
+        ElasticityIncompatibleWorldSize
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 100,
+                          "micro_batch_sizes": [10], "min_gpus": 1,
+                          "max_gpus": 10, "version": 0.1}}
+    agent = DSElasticAgent([sys.executable, "-c", "pass"], ds_config=cfg)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        agent.run(world_size=7)

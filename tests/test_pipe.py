@@ -172,3 +172,74 @@ def test_pipeline_requires_enough_microbatches(devices8):
     batch = {"input_ids": np.zeros((1, 4, 16), dtype=np.int32)}
     with pytest.raises(AssertionError, match="microbatches"):
         engine.train_batch(batch=batch)
+
+
+def test_pipeline_bounded_buffers_parity(devices8):
+    """pp=4, M=8 with num_pipe_buffers=4 (the 1F1B memory bound,
+    reference schedule.py:176) must match the all-live schedule's losses
+    (VERDICT round-1 item 8)."""
+    gas = 8
+    mesh = {"pipe_parallel_size": 4, "data_parallel_size": 2}
+    model4 = tiny_gpt2(num_layers=4)
+    base = dict(train_micro_batch_size_per_gpu=1,
+                gradient_accumulation_steps=gas, mesh=mesh)
+    cfg_all = base_config(**base)
+    cfg_bound = base_config(**base, pipeline={"num_pipe_buffers": 4})
+
+    e_all, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(num_layers=4), num_stages=4),
+        config=cfg_all)
+    e_bound, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(num_layers=4), num_stages=4),
+        config=cfg_bound)
+
+    rng = np.random.default_rng(23)
+    for step in range(2):
+        batch = {"input_ids": rng.integers(0, 128, size=(gas, 8, 16),
+                                           dtype=np.int32)}
+        l_a = float(e_all.train_batch(batch=batch))
+        l_b = float(e_bound.train_batch(batch=batch))
+        assert abs(l_a - l_b) < 2e-4, f"step {step}: {l_a} vs {l_b}"
+
+
+def test_pipeline_bounded_buffers_memory(devices8):
+    """The bounded schedule's compiled step must allocate less temp memory
+    than the all-live schedule (activations live per chunk, not per M)."""
+    import jax
+    gas = 8
+    mesh = {"pipe_parallel_size": 4, "data_parallel_size": 2}
+    base = dict(train_micro_batch_size_per_gpu=2,
+                gradient_accumulation_steps=gas, mesh=mesh)
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": rng.integers(0, 128, size=(gas, 16, 64),
+                                       dtype=np.int32)}
+
+    def temp_bytes(cfg):
+        eng, *_ = deepspeed_tpu.initialize(
+            model=pipeline_model(
+                tiny_gpt2(num_layers=4, max_seq_len=64), num_stages=4),
+            config=cfg)
+        sharded = eng._shard_batch(batch, stacked=True)
+        fn = eng._get_compiled("train_step")
+        compiled = fn.lower(eng.state, sharded, eng._next_rng()).compile()
+        mem = compiled.memory_analysis()
+        return int(getattr(mem, "temp_size_in_bytes", 0))
+
+    all_live = temp_bytes(base_config(**base))
+    bounded = temp_bytes(base_config(**base,
+                                     pipeline={"num_pipe_buffers": 4}))
+    assert bounded < all_live, (bounded, all_live)
+
+
+def test_pipeline_bad_buffer_count_warns_and_runs(devices8):
+    gas = 4
+    mesh = {"pipe_parallel_size": 2, "data_parallel_size": 4}
+    cfg = base_config(train_micro_batch_size_per_gpu=1,
+                      gradient_accumulation_steps=gas, mesh=mesh,
+                      pipeline={"num_pipe_buffers": 3})   # does not divide 4
+    eng, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2), config=cfg)
+    rng = np.random.default_rng(7)
+    batch = {"input_ids": rng.integers(0, 128, size=(gas, 4, 16),
+                                       dtype=np.int32)}
+    assert np.isfinite(float(eng.train_batch(batch=batch)))
