@@ -15,7 +15,6 @@ A ``CompressionScheduler`` mirrors the reference's offset/schedule gating
 (engine.py:2044 calls it every step).
 """
 import fnmatch
-import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -28,7 +27,8 @@ import jax.numpy as jnp
 class LeafPlan:
     quantize_bits: int = 0          # 0 = off
     prune_ratio: float = 0.0        # fraction of weights zeroed
-    start_step: int = 0
+    quantize_start: int = 0         # independent schedule gates (the
+    prune_start: int = 0            # reference gates each group separately)
 
 
 def _match_any(path: str, patterns: List[str]) -> bool:
@@ -45,11 +45,9 @@ def parse_compression_config(config: dict) -> Dict[str, LeafPlan]:
         shared = wq["shared_parameters"]
         for gname, group in wq.get("different_groups", {}).items():
             bits = int(group.get("params", {}).get("target_bits", 8))
-            start = int(group.get("params", {}).get(
-                "start_bits", bits))  # schedule collapsing: use target
             for pat in group.get("modules", ["*"]):
                 plans.setdefault(pat, LeafPlan()).quantize_bits = bits
-                plans[pat].start_step = int(
+                plans[pat].quantize_start = int(
                     shared.get("schedule_offset", 0))
     sp = (config or {}).get("sparse_pruning", {})
     if sp.get("shared_parameters", {}).get("enabled"):
@@ -58,9 +56,8 @@ def parse_compression_config(config: dict) -> Dict[str, LeafPlan]:
             ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
             for pat in group.get("modules", ["*"]):
                 plans.setdefault(pat, LeafPlan()).prune_ratio = 1.0 - ratio
-                plans[pat].start_step = max(
-                    plans[pat].start_step,
-                    int(shared.get("schedule_offset", 0)))
+                plans[pat].prune_start = int(
+                    shared.get("schedule_offset", 0))
     return plans
 
 
@@ -113,8 +110,17 @@ class CompressionScheduler:
         self.step += 1
 
     def active_plans(self) -> Dict[str, LeafPlan]:
-        return {p: pl for p, pl in self.plans.items()
-                if self.step >= pl.start_step}
+        """Plans with at least one gate elapsed, with un-elapsed parts
+        masked out (each compression group schedules independently)."""
+        out = {}
+        for p, pl in self.plans.items():
+            q = pl.quantize_bits if (pl.quantize_bits
+                                     and self.step >= pl.quantize_start) else 0
+            r = pl.prune_ratio if (pl.prune_ratio
+                                   and self.step >= pl.prune_start) else 0.0
+            if q or r:
+                out[p] = LeafPlan(quantize_bits=q, prune_ratio=r)
+        return out
 
 
 def init_compression(params, config: dict):

@@ -19,7 +19,6 @@ the partitioner walks leaf *paths* instead of modules:
 The result is a ``logical_specs`` pytree the engine/inference layers accept
 for any model, including ones without hand-written specs.
 """
-import re
 from typing import Optional
 
 import numpy as np
@@ -37,7 +36,7 @@ COLUMN_PATTERNS = (
 )
 ROW_PATTERNS = (
     "proj_w", "o_proj", "out_proj", "wo", "mlp_out", "fc_out", "fc2",
-    "down_proj", "w_down", "dense_4h_to_h", "c_proj", "attention.dense",
+    "down_proj", "w_down", "dense_4h_to_h", "c_proj", "attention/dense",
 )
 EMBED_PATTERNS = ("wte", "embed_tokens", "word_embeddings", "embedding",
                   "tok_embeddings", "shared")
@@ -137,7 +136,6 @@ def inject_tp(model, tp_size: int):
     """Fill in ``model.logical_specs`` automatically when the model has none
     (the reference's replace_module entry for models without a policy)."""
     import dataclasses
-    import jax
     if getattr(model, "logical_specs", None) is not None:
         return model
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))

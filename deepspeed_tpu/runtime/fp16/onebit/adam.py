@@ -58,13 +58,18 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9,
             g_red = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             new_error = state.error
         else:
+            # lax.cond, not jnp.where: a select would compile BOTH
+            # collectives into every step (XLA cannot DCE a collective
+            # behind a predicate), paying fp32 traffic after the freeze
+            def warm(g, err):
+                return (lax.pmean(g.astype(jnp.float32), axis_name),
+                        jnp.zeros_like(err))
+
+            def frozen(g, err):
+                return compressed_allreduce(g, err, axis_name)
+
             def reduce_leaf(g, err):
-                comp, new_err = compressed_allreduce(g, err, axis_name)
-                g_warm = lax.pmean(g.astype(jnp.float32), axis_name)
-                g_out = jnp.where(in_warmup, g_warm, comp)
-                new_err = jnp.where(in_warmup, jnp.zeros_like(new_err),
-                                    new_err)
-                return g_out, new_err
+                return lax.cond(in_warmup, warm, frozen, g, err)
 
             reduced = jax.tree.map(lambda g, e: reduce_leaf(g, e),
                                    grads, state.error)

@@ -39,7 +39,7 @@ def test_compression_plans_parse():
     plans = parse_compression_config(_compression_cfg())
     assert plans["qkv_w"].quantize_bits == 8
     assert plans["mlp_out_w"].prune_ratio == 0.5
-    assert plans["mlp_out_w"].start_step == 2
+    assert plans["mlp_out_w"].prune_start == 2
 
 
 def test_compression_quantizes_and_prunes():
