@@ -52,3 +52,80 @@ def load_state(ckpt_dir: str, template: Dict[str, Any], shardings,
         with open(meta_path) as f:
             extra = json.load(f)
     return restored, extra
+
+
+# ---------------------------------------------------------------- pluggable
+class CheckpointEngine:
+    """Pluggable save/load backend (reference:
+    checkpoint_engine/checkpoint_engine.py:9 — create/save/load/commit
+    surface; TorchCheckpointEngine and the async Nebula engine implement
+    it).  Subclass and pass to the engine to swap storage backends."""
+
+    def __init__(self, config_params=None):
+        self.config_params = config_params
+
+    def create(self, tag: str):
+        """Start a checkpoint under ``tag`` (async engines open a txn)."""
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, template=None, shardings=None):
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Finalize ``tag`` (async engines flush here)."""
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Default backend — sharding-aware Orbax trees (universal-checkpoint
+    restores for free)."""
+
+    def save(self, state_dict, path: str):
+        ckpt = _checkpointer()
+        ckpt.save(os.path.abspath(path), state_dict, force=True)
+
+    def load(self, path: str, template=None, shardings=None):
+        import orbax.checkpoint as ocp
+        ckpt = _checkpointer()
+        if template is None:
+            return ckpt.restore(os.path.abspath(path))
+        if shardings is None:
+            return ckpt.restore(os.path.abspath(path),
+                                args=ocp.args.PyTreeRestore(item=template))
+        restore_args = jax.tree.map(
+            lambda sh: ocp.ArrayRestoreArgs(sharding=sh), shardings)
+        return ckpt.restore(
+            os.path.abspath(path),
+            args=ocp.args.PyTreeRestore(item=template,
+                                        restore_args=restore_args))
+
+
+class NpzCheckpointEngine(CheckpointEngine):
+    """Flat-npz backend (the reference's TorchCheckpointEngine analogue:
+    single-file, host-memory, no sharding metadata — loadable anywhere)."""
+
+    def save(self, state_dict, path: str):
+        flat = {}
+        pairs, _ = jax.tree_util.tree_flatten_with_path(state_dict)
+        for kp, leaf in pairs:
+            key = "/".join(str(getattr(k, "key", k)) for k in kp)
+            flat[key] = np.asarray(leaf)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+
+    def load(self, path: str, template=None, shardings=None):
+        f = path if path.endswith(".npz") else path + ".npz"
+        data = np.load(f)
+        if template is None:
+            return dict(data)
+        pairs, treedef = jax.tree_util.tree_flatten_with_path(template)
+        leaves = []
+        for kp, _tmpl in pairs:
+            key = "/".join(str(getattr(k, "key", k)) for k in kp)
+            leaves.append(data[key])
+        out = jax.tree_util.tree_unflatten(treedef, leaves)
+        if shardings is not None:
+            out = jax.device_put(out, shardings)
+        return out

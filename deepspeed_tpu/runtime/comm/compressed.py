@@ -31,8 +31,7 @@ def compress(v: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def compressed_allreduce(v: jnp.ndarray, error: jnp.ndarray,
-                         axis_name, n: int = None
-                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                         axis_name, n: int = None, server_error=None):
     """1-bit all-reduce with error feedback (reference nccl.py:51).
 
     Two-phase exchange, the reference's shape: (1) all-to-all of int8 sign
@@ -42,18 +41,21 @@ def compressed_allreduce(v: jnp.ndarray, error: jnp.ndarray,
     all-reduce.  Falls back to a chunkless exchange (int8 all-gather) when
     the element count does not split evenly.
 
-    Error feedback covers the worker-side compression (the dominant term);
-    the server-stage re-compression residual is uncompensated here (the
-    reference carries a separate ``server_error`` buffer for it,
-    nccl.py:51 — a noted refinement).
+    Error feedback: ``error`` compensates the worker-side compression;
+    ``server_error`` (flat [numel/n], reference nccl.py's server buffer)
+    compensates the re-compression of this worker's owned chunk — with both
+    buffers the time-averaged reduction is unbiased.
 
     Args:
         v: this device's local gradient contribution.
         error: this device's error-feedback residual (same shape).
         axis_name: mesh axis name to reduce over.
-        n: number of workers on the axis (static; defaults to psum of 1s).
+        n: number of workers on the axis (static; defaults to the static
+           ``lax.axis_size`` of the axis).
+        server_error: optional flat [numel/n] residual of the server stage.
     Returns:
-        (reduced mean gradient approximation [f32], new_error)
+        (reduced mean gradient [f32], new_error) — and new_server_error as a
+        third element when ``server_error`` was given.
     """
     if n is None:
         n = int(lax.axis_size(axis_name))
@@ -62,6 +64,7 @@ def compressed_allreduce(v: jnp.ndarray, error: jnp.ndarray,
     new_error = corrected - scale * sign.astype(jnp.float32)
 
     flat = sign.ravel()
+    new_server = server_error
     if flat.shape[0] % n == 0:
         # phase 1: scatter int8 chunks; every worker averages its own chunk
         chunks = flat.reshape(n, -1)
@@ -70,17 +73,24 @@ def compressed_allreduce(v: jnp.ndarray, error: jnp.ndarray,
         scales = lax.all_gather(scale, axis_name)              # [n] scalars
         my_chunk = jnp.mean(recv.astype(jnp.float32)
                             * scales[:, None], axis=0)
+        if server_error is not None:
+            my_chunk = my_chunk + server_error
         # phase 2: re-compress the reduced chunk, gather int8 + scales
         csign, cscale = compress(my_chunk)
+        if server_error is not None:
+            new_server = my_chunk - cscale * csign.astype(jnp.float32)
         all_signs = lax.all_gather(csign, axis_name)           # int8 wire
         all_scales = lax.all_gather(cscale, axis_name)
         reduced = (all_signs.astype(jnp.float32)
                    * all_scales[:, None]).reshape(sign.shape)
     else:
         # chunkless fallback: gather int8 signs + scalar scales, average
+        # (single compression stage: the server residual does not apply)
         all_signs = lax.all_gather(sign, axis_name)            # int8 wire
         all_scales = lax.all_gather(scale, axis_name)
         shape = (n,) + (1,) * sign.ndim
         reduced = jnp.mean(all_signs.astype(jnp.float32)
                            * all_scales.reshape(shape), axis=0)
+    if server_error is not None:
+        return reduced, new_error, new_server
     return reduced, new_error

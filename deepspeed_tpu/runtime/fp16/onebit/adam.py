@@ -26,13 +26,15 @@ class OnebitAdamState(NamedTuple):
     count: jnp.ndarray
     m: optax.Updates
     v: optax.Updates
-    error: optax.Updates
+    error: optax.Updates          # worker-side compression residual
+    server_error: optax.Updates   # owned-chunk re-compression residual
 
 
 def onebit_adam(learning_rate=1e-3, b1: float = 0.9,
                 b2: float = 0.999, eps: float = 1e-8,
                 weight_decay: float = 0.0,
-                freeze_step: int = 100, axis_name=None):
+                freeze_step: int = 100, axis_name=None,
+                axis_size: int = 0):
     """1-bit Adam as an optax GradientTransformation.
 
     Before ``freeze_step``: exact Adam (grads assumed already reduced).
@@ -44,11 +46,20 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9,
     def init_fn(params):
         z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                                  params)
-        # the error-feedback tree only exists when compression is engaged
-        # (axis_name given); the engine's uncompressed path carries an empty
-        # pytree instead of a param-sized fp32 allocation
-        err = z() if axis_name is not None else ()
-        return OnebitAdamState(jnp.zeros((), jnp.int32), z(), z(), err)
+        # the error-feedback trees only exist when compression is engaged
+        # (axis_name given); the engine's uncompressed path carries empty
+        # pytrees instead of param-sized fp32 allocations
+        if axis_name is not None:
+            err = z()
+            server = jax.tree.map(
+                lambda p: jnp.zeros(
+                    (p.size // axis_size,)
+                    if axis_size and p.size % axis_size == 0 else (0,),
+                    jnp.float32), params)
+        else:
+            err, server = (), ()
+        return OnebitAdamState(jnp.zeros((), jnp.int32), z(), z(), err,
+                               server)
 
     def update_fn(grads, state, params=None):
         count = state.count + 1
@@ -57,26 +68,32 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9,
         if axis_name is None:
             g_red = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             new_error = state.error
+            new_server = state.server_error
         else:
             # lax.cond, not jnp.where: a select would compile BOTH
             # collectives into every step (XLA cannot DCE a collective
             # behind a predicate), paying fp32 traffic after the freeze
-            def warm(g, err):
+            def warm(g, err, srv):
                 return (lax.pmean(g.astype(jnp.float32), axis_name),
-                        jnp.zeros_like(err))
+                        jnp.zeros_like(err), jnp.zeros_like(srv))
 
-            def frozen(g, err):
-                return compressed_allreduce(g, err, axis_name)
+            def frozen(g, err, srv):
+                if srv.shape[0]:
+                    return compressed_allreduce(g, err, axis_name,
+                                                server_error=srv)
+                red, ne = compressed_allreduce(g, err, axis_name)
+                return red, ne, srv
 
-            def reduce_leaf(g, err):
-                return lax.cond(in_warmup, warm, frozen, g, err)
+            def reduce_leaf(g, err, srv):
+                return lax.cond(in_warmup, warm, frozen, g, err, srv)
 
-            reduced = jax.tree.map(lambda g, e: reduce_leaf(g, e),
-                                   grads, state.error)
-            g_red = jax.tree.map(lambda t: t[0], reduced,
-                                 is_leaf=lambda x: isinstance(x, tuple))
-            new_error = jax.tree.map(lambda t: t[1], reduced,
-                                     is_leaf=lambda x: isinstance(x, tuple))
+            reduced = jax.tree.map(
+                lambda g, e, sv: reduce_leaf(g, e, sv),
+                grads, state.error, state.server_error)
+            is_t = lambda x: isinstance(x, tuple)
+            g_red = jax.tree.map(lambda t: t[0], reduced, is_leaf=is_t)
+            new_error = jax.tree.map(lambda t: t[1], reduced, is_leaf=is_t)
+            new_server = jax.tree.map(lambda t: t[2], reduced, is_leaf=is_t)
 
         m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g, state.m, g_red)
         # frozen variance after freeze_step (the 1-bit Adam invariant)
@@ -99,7 +116,7 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9,
             updates = jax.tree.map(
                 lambda mh, vh: -lr * mh / (jnp.sqrt(vh) + eps),
                 mhat, vhat)
-        return updates, OnebitAdamState(count, m, v, new_error)
+        return updates, OnebitAdamState(count, m, v, new_error, new_server)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

@@ -75,15 +75,47 @@ class CommsLogger:
             return
         self.append(op_name, size, 0.0)
 
-    def log_all(self, print_log: bool = True):
+    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+        """Summary table (reference CommsLogger.log_all, comm/comm.py:422);
+        with ``show_straggler``, per-op wait times are min-reduced across
+        ranks and the difference is reported as straggler effect."""
         lines = ["Comms summary:",
                  f"{'op':<16}{'calls':>8}{'total volume':>16}{'total time':>14}"]
+        min_times = {}
+        if show_straggler:
+            import jax
+            import numpy as _np
+            try:
+                ops = sorted(self.comms_dict.keys())
+                mine = _np.array(
+                    [sum(rec[1] for rec in self.comms_dict[o].values())
+                     for o in ops], dtype=_np.float32)
+                if jax.process_count() > 1:
+                    from jax.experimental import multihost_utils
+                    # ranks must have logged the SAME op set or the column
+                    # zip mixes ops; verify via a gathered fingerprint
+                    import zlib
+                    fp = _np.int64(zlib.crc32("|".join(ops).encode()))
+                    fps = multihost_utils.process_allgather(fp)
+                    if not (_np.asarray(fps) == fp).all():
+                        raise ValueError("op sets differ across ranks")
+                    gathered = multihost_utils.process_allgather(mine)
+                    min_times = dict(zip(ops, gathered.min(axis=0)))
+                else:
+                    min_times = dict(zip(ops, mine))
+                lines[-1] += f"{'straggler':>12}"
+            except Exception:
+                show_straggler = False
         for op_name, sizes in sorted(self.comms_dict.items()):
             count = sum(rec[0] for rec in sizes.values())
             vol = sum(size * rec[0] for size, rec in sizes.items())
             t = sum(rec[1] for rec in sizes.values())
-            lines.append(f"{op_name:<16}{count:>8}{convert_size(vol):>16}"
-                         f"{t * 1e3:>12.2f}ms")
+            line = (f"{op_name:<16}{count:>8}{convert_size(vol):>16}"
+                    f"{t * 1e3:>12.2f}ms")
+            if show_straggler:
+                straggle = t - float(min_times.get(op_name, t))
+                line += f"{straggle * 1e3:>10.2f}ms"
+            lines.append(line)
         if print_log:
             log_dist("\n".join(lines), ranks=[0])
         return self.comms_dict

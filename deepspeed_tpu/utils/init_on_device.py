@@ -1,0 +1,77 @@
+"""OnDevice — deferred/abstract model initialisation (reference:
+deepspeed/utils/init_on_device.py ``OnDevice``: constructs modules on the
+meta device so multi-billion-param models never materialise on one host).
+
+JAX already separates shape from storage: ``jax.eval_shape`` runs any init
+function abstractly.  ``OnDevice`` packages that as the reference's context
+manager; route inits through ``abstract_init`` (a bare ``model.init(rng)``
+is eager regardless of the context — JAX cannot intercept it):
+
+    with OnDevice(dtype="bfloat16", device="meta"):
+        shapes = abstract_init(model.init, rng)   # ShapeDtypeStructs only
+
+    # or get real sharded params directly (each device allocates only its
+    # shard — the zero.Init property):
+    params = materialize(model.init, rng, shardings=shardings)
+"""
+import contextlib
+import contextvars
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_ON_DEVICE: contextvars.ContextVar = contextvars.ContextVar(
+    "ds_on_device", default=None)
+
+
+class OnDevice:
+    """Context manager: inside it, ``abstract_init(fn, *args)`` (and model
+    inits routed through it) return ShapeDtypeStructs instead of arrays."""
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = jnp.dtype(dtype) if dtype is not None else None
+        self.device = device
+        self.enabled = enabled
+        self._token = None
+
+    def __enter__(self):
+        self._token = _ON_DEVICE.set(self if self.enabled else None)
+        return self
+
+    def __exit__(self, *exc):
+        _ON_DEVICE.reset(self._token)
+        return False
+
+
+def current_on_device() -> Optional[OnDevice]:
+    return _ON_DEVICE.get()
+
+
+def abstract_init(init_fn, *args, dtype=None):
+    """Shapes-only init (the meta-device construction).  Honours an active
+    OnDevice context's dtype override."""
+    ctx = current_on_device()
+    shapes = jax.eval_shape(init_fn, *args)
+    dt = dtype or (ctx.dtype if ctx is not None else None)
+    if dt is not None:
+        shapes = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, dt)
+            if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
+    return shapes
+
+
+def materialize(init_fn, *args, shardings=None, dtype=None):
+    """Materialise params directly into their (sharded) storage — each
+    device only ever allocates its own shard, the zero.Init property."""
+    def fn(*a):
+        out = init_fn(*a)
+        if dtype is not None:
+            out = jax.tree.map(
+                lambda x: x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, out)
+        return out
+
+    if shardings is not None:
+        return jax.jit(fn, out_shardings=shardings)(*args)
+    return jax.jit(fn)(*args)

@@ -212,3 +212,64 @@ def test_data_sampler_difficulty_filtering():
     batch = sampler.next_batch()
     assert len(batch) == 8
     assert (diffs["seqlen"][batch] <= 10).all()
+
+
+# ----------------------------------------------------- OnDevice / meta init
+
+def test_on_device_abstract_init():
+    from deepspeed_tpu.utils.init_on_device import (OnDevice, abstract_init,
+                                                    materialize)
+    import jax
+    m = tiny_gpt2()
+    with OnDevice(dtype="bfloat16"):
+        shapes = abstract_init(m.init, jax.random.PRNGKey(0))
+    leaf = shapes["blocks"]["qkv_w"]
+    assert isinstance(leaf, jax.ShapeDtypeStruct)
+    assert leaf.dtype == jax.numpy.bfloat16           # dtype override applied
+    # nothing materialised: ShapeDtypeStructs have no buffers
+    params = materialize(m.init, jax.random.PRNGKey(0))
+    assert params["blocks"]["qkv_w"].shape == leaf.shape
+
+
+# ------------------------------------------------- comms straggler summary
+
+def test_comms_logger_straggler_summary():
+    from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+    class Cfg:
+        enabled, verbose, prof_all, debug = True, False, True, []
+        prof_ops = []
+
+    cl = CommsLogger(Cfg())
+    cl.append("all_reduce", 1024, 0.002)
+    cl.append("all_reduce", 1024, 0.003)
+    summary = cl.log_all(print_log=False, show_straggler=True)
+    assert "all_reduce" in summary
+
+
+# ------------------------------------------------ pluggable checkpoint engines
+
+def test_npz_checkpoint_engine_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+        NpzCheckpointEngine, OrbaxCheckpointEngine, CheckpointEngine)
+    assert issubclass(NpzCheckpointEngine, CheckpointEngine)
+    state = {"a": jnp.arange(4.0), "nested": {"b": jnp.ones((2, 3))}}
+    eng = NpzCheckpointEngine()
+    eng.create("tag")
+    eng.save(state, str(tmp_path / "ck"))
+    restored = eng.load(str(tmp_path / "ck"), template=state)
+    np.testing.assert_allclose(np.asarray(restored["nested"]["b"]), 1.0)
+    assert eng.commit("tag")
+
+
+def test_orbax_checkpoint_engine_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import \
+        OrbaxCheckpointEngine
+    state = {"w": jnp.full((4, 4), 3.0)}
+    eng = OrbaxCheckpointEngine()
+    eng.save(state, str(tmp_path / "ck"))
+    restored = eng.load(str(tmp_path / "ck"))
+    np.testing.assert_allclose(np.asarray(restored["w"]), 3.0)
