@@ -205,12 +205,11 @@ class DeepSpeedEngine:
                          in zip(specs_flat, shapes_flat, lg_flat)]
                 self.param_specs[bk] = jax.tree_util.tree_unflatten(
                     treedef, fixed)
-        if zc.zero_quantized_gradients:
+        if zc.zero_quantized_gradients and (self._offload or zc.stage >= 3):
             logger.warning(
-                "zero_quantized_gradients: the qgZ collective "
-                "(runtime/zero/zeropp.py quantized_psum_scatter) is "
-                "available but not yet wired into the compiled step; "
-                "gradients reduce in full precision")
+                "zero_quantized_gradients engages only in train_batch's "
+                "compiled step at ZeRO stages 0-2 without optimizer "
+                "offload; this config reduces gradients in full precision")
         if (zc.zero_hpz_partition_size > 1 and
                 self.topology.axis_size(("seq", "model")) > 1):
             logger.warning(
@@ -559,6 +558,96 @@ class DeepSpeedEngine:
         return loss.astype(jnp.float32) * scale
 
     # ------------------------------------------------------------------ train step
+    def _qgz_grad_fn(self):
+        """ZeRO++ qgZ (zero_quantized_gradients): gradients reduce through
+        the block-quantized all-to-all collective instead of the fp32
+        reduce-scatter (reference qgZ, zeropp.md:15; the collective lives in
+        runtime/zero/zeropp.py).  Pure-DP meshes only — inside the shard_map
+        each device computes LOCAL grads on its batch shard, so the
+        quantized exchange sees genuinely unreduced contributions.  Returns
+        a (params, stacked_local_batch, rng, scale) -> (loss, grads) fn to
+        splice into the train step, or None when inapplicable."""
+        from jax import shard_map
+        from deepspeed_tpu.runtime.zero.zeropp import quantized_psum_scatter
+        zc = self._config.zero_config
+        if not zc.zero_quantized_gradients:
+            return None
+        dp_axes = tuple(self.topology.data_parallel_axes)
+        n = self.topology.axis_size(dp_axes)
+        non_dp = self.topology.world_size // max(n, 1)
+        wide_axes = [a for a in dp_axes if self.mesh.shape[a] > 1]
+        if n <= 1 or non_dp != 1 or len(wide_axes) != 1:
+            # the exchange runs over ONE axis: a dp group spread over
+            # several >1 axes (hpz/expert carved out) would leave the other
+            # axes unreduced
+            logger.warning(
+                "zero_quantized_gradients requires a pure data-parallel "
+                "mesh with a single data axis (model/seq/pipe/expert/hpz "
+                "sizes 1); reducing in full precision")
+            return None
+        if zc.stage >= 3:
+            # the shard_map body sees replicated params/grads, which would
+            # gather the stage-3 param shards; reference qgZ keeps sharded
+            # state — not expressible in this formulation yet
+            logger.warning(
+                "zero_quantized_gradients supports ZeRO stages 0-2; "
+                "stage 3 reduces in full precision")
+            return None
+        gas = self.gradient_accumulation_steps()
+        mesh = self.mesh
+        from jax import lax
+        # the actual >1-sized axis inside the dp group
+        axname = wide_axes[0]
+        batch_spec = P(None, dp_axes, SEQ_AXIS)
+
+        def grad_fn(params, stacked_batch, rng, scale):
+            replicated = jax.tree.map(lambda _: P(), params)
+            b_specs = jax.tree.map(
+                lambda x: P(*tuple(batch_spec)[:x.ndim]), stacked_batch)
+
+            def body(p, b, r, s):
+                # independent dropout/noise per DP rank (the jit path draws
+                # one mask over the global batch; replicated keys would give
+                # every shard an identical mask)
+                from jax import lax as _lax
+                r = jax.random.fold_in(r, _lax.axis_index(axname))
+
+                def micro(carry, mb):
+                    g_acc, l_acc = carry
+                    loss, g = jax.value_and_grad(self._scaled_loss_fn)(
+                        p, mb, r, s / gas)
+                    g = _tree_cast(g, jnp.float32)
+                    return (jax.tree.map(jnp.add, g_acc, g),
+                            l_acc + loss), None
+
+                zeros = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), p)
+                (local_g, local_l), _ = jax.lax.scan(
+                    micro, (zeros, jnp.float32(0.0)), b)
+
+                # quantized exchange: each leaf reduce-scatters its int8
+                # chunks over dim 0 and re-gathers; / n for the mean over
+                # devices.  Tiny/ragged leaves take the exact pmean.
+                def reduce_leaf(g):
+                    if g.ndim >= 1 and g.shape[0] % n == 0 and g.size > n:
+                        chunk = quantized_psum_scatter(g, axname, n=n,
+                                                       scatter_dim=0)
+                        return lax.all_gather(chunk, axname, axis=0,
+                                              tiled=True) / n
+                    return lax.pmean(g, axname)
+
+                g_red = jax.tree.map(reduce_leaf, local_g)
+                loss = lax.pmean(local_l, axname)
+                return loss, g_red
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(replicated, b_specs, P(), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P(), params)),
+                check_vma=False)(params, stacked_batch, rng, scale)
+
+        return grad_fn
+
     def _build_train_step(self):
         if self.model.meta.get("pipeline"):
             return self._build_pipeline_train_step()
@@ -567,26 +656,32 @@ class DeepSpeedEngine:
         grad_specs = self.grad_specs
         policy = self.zero_policy
 
+        qgz_fn = self._qgz_grad_fn()
+
         def train_step(state, stacked_batch, rng):
             """stacked_batch leaves: [gas, global_micro, ...]."""
             params, opt_state = state["params"], state["opt_state"]
             scaler = state["scaler"]
             scale = scaler.cur_scale if fp16 else jnp.float32(1.0)
 
-            def micro(carry, mb):
-                grads_acc, loss_acc = carry
-                loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
-                    params, mb, rng, scale / gas)
-                grads = _tree_cast(grads, jnp.float32)
+            if qgz_fn is not None:
+                loss_sum, grads = qgz_fn(params, stacked_batch, rng, scale)
                 grads = policy.constrain_grads(grads, grad_specs)
-                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-                return (grads_acc, loss_acc + loss), None
+            else:
+                def micro(carry, mb):
+                    grads_acc, loss_acc = carry
+                    loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
+                        params, mb, rng, scale / gas)
+                    grads = _tree_cast(grads, jnp.float32)
+                    grads = policy.constrain_grads(grads, grad_specs)
+                    grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                    return (grads_acc, loss_acc + loss), None
 
-            zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            zero_grads = policy.constrain_grads(zero_grads, grad_specs)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero_grads, jnp.float32(0.0)), stacked_batch)
+                zero_grads = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zero_grads = policy.constrain_grads(zero_grads, grad_specs)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zero_grads, jnp.float32(0.0)), stacked_batch)
 
             new_state, metrics = self._apply_grads(state, grads)
             # undo loss scaling for the reported loss; mean over micro steps

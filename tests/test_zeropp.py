@@ -220,3 +220,51 @@ def test_mics_trains_to_parity(devices8):
     l_ref = _train(ref, steps=3, seed=41)
     l_mics = _train(mics, steps=3, seed=41)
     np.testing.assert_allclose(l_mics, l_ref, rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------------------ qgZ
+
+def test_qgz_trains_to_parity(devices8):
+    """Pure-DP mesh + zero_quantized_gradients: training through the
+    quantized grad exchange tracks the exact-reduction run (lossy but
+    convergent)."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 1}))
+    qgz, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 1,
+                               "zero_quantized_gradients": True}))
+    l_ref = _train(ref, steps=4, seed=83)
+    l_qgz = _train(qgz, steps=4, seed=83)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+
+
+def test_qgz_int8_on_the_wire(devices8):
+    """The compiled step's gradient exchange must move int8 (all-to-all or
+    all-gather of s8), not fp32."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 1,
+                               "zero_quantized_gradients": True}))
+    b = random_batches(1, batch_size=8, seed=2)[0]
+    batch = engine._shard_batch({"input_ids": b["input_ids"][None]},
+                                stacked=True)
+    fn = engine._get_compiled("train_step")
+    hlo = fn.lower(engine.state, batch,
+                   engine._next_rng()).compile().as_text()
+    comm_lines = [l for l in hlo.splitlines()
+                  if "all-to-all" in l or "all-gather" in l]
+    assert any("s8[" in l for l in comm_lines), comm_lines[:5]
+
+
+def test_qgz_falls_back_on_non_dp_mesh(devices8):
+    """TP in the mesh: qgZ must warn and reduce exactly (not crash)."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            mesh={"model_parallel_size": 2},
+            zero_optimization={"stage": 1,
+                               "zero_quantized_gradients": True}))
+    b = random_batches(1, batch_size=8, seed=3)[0]
+    loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    assert np.isfinite(float(loss))
