@@ -196,27 +196,11 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
         block_fn = jax.checkpoint(block_fn,
                                   policy=remat_policy(config.remat_policy))
 
-    # random-LTD (reference data_routing/basic_layer.py:14): each layer runs
-    # on a random keep-token subset; trace-time keep comes from the engine's
-    # ltd scope, per-layer rng from folding the layer index
-    from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
-        get_ltd_keep, random_ltd_block)
-    ltd_keep = get_ltd_keep()
-
-    if ltd_keep and rng is not None and ltd_keep < S:
-        def body(carry, layer):
-            x, idx = carry
-            layer_rng = jax.random.fold_in(rng, idx)
-            out = random_ltd_block(lambda h: block_fn(h, layer),
-                                   layer_rng, x, ltd_keep)
-            return (out, idx + 1), None
-
-        (x, _), _ = lax.scan(body, (x, jnp.int32(0)), params["blocks"])
-    else:
-        def body(carry, layer):
-            return block_fn(carry, layer), None
-
-        x, _ = lax.scan(body, x, params["blocks"])
+    # layer scan with random-LTD + progressive-layer-drop hooks (see
+    # models/model.py scan_blocks)
+    from deepspeed_tpu.models.model import scan_blocks
+    x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
+                    config.num_layers)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                     config.layer_norm_eps)
     logits = x @ params["wte"].astype(dtype).T   # tied embedding
@@ -320,7 +304,7 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,
         meta={"name": f"gpt2-{size}", "n_params": n_params,
-              "supports_random_ltd": True},
+              "supports_random_ltd": True, "supports_pld": True},
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),

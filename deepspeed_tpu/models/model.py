@@ -102,6 +102,56 @@ def _stream_transfer(w, target):
     return f(w)
 
 
+def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int):
+    """Layer scan with the engine's data-efficiency hooks applied.
+
+    - **random-LTD**: trace-time keep-token count from the engine's ltd
+      scope (runtime/data_pipeline/random_ltd.py).
+    - **progressive layer drop** (reference engine.py:1755 PLD theta kwarg):
+      when the engine injects ``batch["pld_theta"]`` (a *traced* scalar, so
+      the per-step theta schedule never recompiles), layer ``l`` is skipped
+      with probability ``(l+1)/L * (1 - theta)`` — the PLD paper's
+      depth-scaled schedule; kept outputs are not rescaled, matching the
+      reference's convention (LayerNorm absorbs the scale).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from deepspeed_tpu.runtime.data_pipeline.random_ltd import (
+        get_ltd_keep, random_ltd_block)
+
+    ltd_keep = get_ltd_keep()
+    S = x.shape[1]
+    use_ltd = bool(ltd_keep) and rng is not None and ltd_keep < S
+    theta = batch.get("pld_theta") if isinstance(batch, dict) else None
+    use_pld = theta is not None and rng is not None
+
+    if not (use_ltd or use_pld):
+        def plain(carry, layer):
+            return block_fn(carry, layer), None
+        out, _ = lax.scan(plain, x, blocks)
+        return out
+
+    def body(carry, layer):
+        h, idx = carry
+        layer_rng = jax.random.fold_in(rng, idx)
+        if use_ltd:
+            out = random_ltd_block(lambda t: block_fn(t, layer), layer_rng,
+                                   h, ltd_keep)
+        else:
+            out = block_fn(h, layer)
+        if use_pld:
+            keep_p = 1.0 - (idx.astype(jnp.float32) + 1.0) / num_layers * (
+                1.0 - theta)
+            gate = jax.random.bernoulli(jax.random.fold_in(layer_rng, 1),
+                                        keep_p)
+            out = jnp.where(gate, out, h)
+        return (out, idx + 1), None
+
+    (out, _), _ = lax.scan(body, (x, jnp.int32(0)), blocks)
+    return out
+
+
 @dataclass
 class Model:
     config: Any = None

@@ -3,12 +3,15 @@
 Triton block matmul/softmax kernels).
 
 The layouts (fixed / bigbird / bslongformer / variable) are faithful
-reimplementations of the reference's mask construction.  Compute is a
-block-masked dense attention: on TPU the [S, S] score tile is MXU-friendly
-and XLA folds the block mask into the softmax fusion, which is the right
-trade below ~16k tokens; the mask drops attention FLOPs' *numerical* effect
-(and is bit-compatible with a gather-based sparse kernel), while a Pallas
-block-skipping kernel remains the long-sequence upgrade path.
+reimplementations of the reference's mask construction.  Two compute
+paths, selected by ``impl``:
+
+* ``dense`` — block-masked dense attention: the [S, S] score tile is
+  MXU-friendly and XLA folds the block mask into the softmax fusion; the
+  right trade below ~16k tokens.
+* ``pallas`` — the from-scratch block-skipping kernel
+  (ops/pallas/block_sparse_attention.py): masked blocks are never DMA'd or
+  multiplied, so cost scales with layout density — the long-sequence path.
 """
 import random
 from typing import List, Optional
@@ -234,12 +237,25 @@ def layout_to_mask(layout: np.ndarray, seq_len: int) -> jnp.ndarray:
 
 
 def sparse_self_attention(q, k, v, sparsity_config: SparsityConfig,
-                          causal: bool = False, sm_scale=None):
+                          causal: bool = False, sm_scale=None,
+                          impl: str = "dense"):
     """q/k/v [B, S, H, hd] -> [B, S, H, hd] under the config's block layout
-    (reference SparseSelfAttention.forward)."""
+    (reference SparseSelfAttention.forward).
+
+    ``impl="pallas"`` routes to the block-skipping Pallas kernel
+    (ops/pallas/block_sparse_attention.py): identical numerics, compute and
+    HBM traffic scale with layout density instead of S² — the long-sequence
+    path.  ``dense`` keeps the block-masked XLA softmax fusion (the right
+    trade below ~16k tokens)."""
     B, S, H, hd = q.shape
     scale = sm_scale if sm_scale is not None else hd ** -0.5
     layout = sparsity_config.make_layout(S)
+    if impl == "pallas":
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+            block_sparse_attention_trainable)
+        return block_sparse_attention_trainable(q, k, v, layout,
+                                                causal=causal,
+                                                sm_scale=sm_scale)
     mask = layout_to_mask(layout, S)                     # [H, S, S]
     if causal:
         mask = jnp.logical_and(mask, jnp.tril(jnp.ones((S, S), bool)))
@@ -249,6 +265,11 @@ def sparse_self_attention(q, k, v, sparsity_config: SparsityConfig,
     p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
     p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # fully-masked rows emit 0 (flash convention, shared with the Pallas
+    # block-skipping kernel) — a uniform softmax over -1e30 scores would
+    # leak masked V into the output
+    row_any = mask.any(-1)                               # [H, S] (mask is
+    out = jnp.where(row_any.T[None, :, :, None], out, 0.0)  # already causal)
     return out.astype(q.dtype)
 
 
@@ -256,9 +277,11 @@ class SparseSelfAttention:
     """Module shim mirroring the reference class."""
 
     def __init__(self, sparsity_config: SparsityConfig,
-                 attn_mask_mode: str = "mul"):
+                 attn_mask_mode: str = "mul", impl: str = "dense"):
         self.sparsity_config = sparsity_config
+        self.impl = impl
 
     def __call__(self, query, key, value, causal=False):
         return sparse_self_attention(query, key, value,
-                                     self.sparsity_config, causal=causal)
+                                     self.sparsity_config, causal=causal,
+                                     impl=self.impl)

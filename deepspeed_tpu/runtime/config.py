@@ -216,6 +216,18 @@ class PLDConfig(DeepSpeedConfigModel):
     gamma: float = 0.001
 
 
+class DebugConfig(DeepSpeedConfigModel):
+    """Sanitizer tier (SURVEY §5 race-detection/sanitizers row): TPU has no
+    CUDA memcheck equivalent; the failure class that matters under XLA is
+    numerics (NaN/Inf born inside a fused kernel).  ``debug_nans`` flips
+    ``jax_debug_nans`` — every primitive re-checks and the faulting op is
+    reported (compile-time cost: functions re-run eagerly on failure).
+    ``sanitize_gradients`` adds a per-step device-side finite check on the
+    global grad norm and raises with step context on failure."""
+    debug_nans: bool = False
+    sanitize_gradients: bool = False
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -304,6 +316,7 @@ class DeepSpeedConfig:
         self.data_efficiency_config = d.get("data_efficiency", {})
         self.eigenvalue_config = EigenvalueConfig(**d.get("eigenvalue", {}))
         self.pld_config = PLDConfig(**d.get("progressive_layer_drop", {}))
+        self.debug_config = DebugConfig(**d.get("debug", {}))
         self.elasticity_config = ElasticityConfig(**d.get("elasticity", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.data_types_config = DataTypesConfig(**d.get("data_types", {}))

@@ -346,6 +346,16 @@ class DeepSpeedEngine:
                 lambda _, spec: spec,
                 opt_state, opt_param_specs,
                 transform_non_params=lambda _: P())
+            # param-shaped specs only apply to param-shaped state; optimizer
+            # states may carry per-leaf scalars in params-shaped subtrees
+            # (e.g. OnebitLamb's coeff_freeze) — replicate anything whose
+            # rank can't carry the param's spec
+            treedef = jax.tree.structure(opt_state)
+            spec_leaves = treedef.flatten_up_to(self.opt_specs)
+            self.opt_specs = jax.tree.unflatten(treedef, [
+                spec if len(spec) <= leaf.ndim else P()
+                for leaf, spec in zip(jax.tree.leaves(opt_state),
+                                      spec_leaves)])
             self.opt_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), self.opt_specs,
                 is_leaf=lambda x: isinstance(x, P))
@@ -423,6 +433,14 @@ class DeepSpeedEngine:
             from deepspeed_tpu import comm as _comm
             from deepspeed_tpu.utils.comms_logging import CommsLogger
             _comm.configure(comms_logger=CommsLogger(self._config.comms_config))
+        # sanitizer tier (SURVEY §5: race detection / sanitizers)
+        dbg = self._config.debug_config
+        self._sanitize_gradients = dbg.sanitize_gradients
+        if dbg.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+            logger.warning("debug.debug_nans: jax_debug_nans enabled — "
+                           "faulting primitives re-run eagerly; expect "
+                           "slower failing steps")
         # legacy curriculum learning (reference engine.py:1761 seqlen kwarg)
         self.curriculum_scheduler = None
         cl = self._config.curriculum_learning
@@ -439,10 +457,14 @@ class DeepSpeedEngine:
                 ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=pld.theta, gamma=pld.gamma)
-            logger.warning(
-                "progressive_layer_drop: theta advances per step; models "
-                "must consume engine.progressive_layer_drop.get_theta() — "
-                "no in-tree model does yet")
+            # theta reaches the models as a traced batch scalar
+            # ("pld_theta", injected in train_batch/forward); in-tree layer
+            # scans gate each block on it (models/model.py scan_blocks)
+            if not self.model.meta.get("supports_pld"):
+                logger.warning(
+                    "progressive_layer_drop: this model does not declare "
+                    "supports_pld — the injected pld_theta batch scalar "
+                    "will be ignored and PLD is a no-op")
         # random-LTD token-drop schedule (reference data_routing; models
         # consume the keep count through the ltd scope in their layer scan)
         self.random_ltd_scheduler = None
@@ -800,7 +822,9 @@ class DeepSpeedEngine:
             "scaler": new_scaler,
         }
         metrics = {
-            "grad_norm": grad_norm,
+            # contract (both execution tiers, see zero/offload.py): a skipped
+            # overflow step reports grad_norm 0.0, not the meaningless inf
+            "grad_norm": jnp.where(overflow, jnp.float32(0.0), grad_norm),
             "overflow": overflow,
             "loss_scale": new_scaler.cur_scale,
         }
@@ -1098,6 +1122,17 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         self._last_seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
         self._advance_ltd()
+        if self.progressive_layer_drop is not None:
+            if isinstance(batch, dict):
+                # traced scalar per micro-batch: the theta schedule advances
+                # every step without recompiling (reference engine.py:1755)
+                batch = dict(batch, pld_theta=np.full(
+                    (self.gradient_accumulation_steps(),),
+                    self.progressive_layer_drop.get_theta(), np.float32))
+            else:
+                from deepspeed_tpu.utils.logging import warning_once
+                warning_once("progressive_layer_drop: batch is not a dict; "
+                             "pld_theta cannot be injected — PLD is a no-op")
         if self.flops_profiler is not None and (
                 self.global_steps + 1 ==
                 self._config.flops_profiler_config.profile_step):
@@ -1155,6 +1190,9 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch)
             self._last_seq_len = int(jax.tree.leaves(batch)[0].shape[-1])
             self._advance_ltd()
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            batch = dict(batch, pld_theta=np.float32(
+                self.progressive_layer_drop.get_theta()))
         batch = self._shard_batch(batch, stacked=False)
         if self._micro_grads is None:
             self._micro_grads = self._get_compiled("zero_grads")(
@@ -1257,6 +1295,18 @@ class DeepSpeedEngine:
                                               self._next_rng())
 
     def _finish_step(self, metrics):
+        if self._sanitize_gradients:
+            # debug tier: sync and verify the global grad norm.  A loss-scaler
+            # overflow is the *handled* non-finite path (the step was skipped
+            # and the scale backed off) — only unexpected NaN/Inf raises.
+            overflow = bool(np.asarray(metrics.get("overflow", False)))
+            gn = float(np.asarray(metrics["grad_norm"]))
+            if not overflow and not np.isfinite(gn):
+                raise FloatingPointError(
+                    f"sanitize_gradients: non-finite gradient norm {gn} at "
+                    f"step {self.global_steps + 1} (loss="
+                    f"{float(np.asarray(metrics['loss']))}); enable "
+                    "debug.debug_nans to locate the faulting primitive")
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         if self.progressive_layer_drop is not None:

@@ -1,0 +1,185 @@
+"""OnebitLamb (reference: deepspeed/runtime/fp16/onebit/lamb.py:15, the
+1-bit LAMB paper arXiv:2104.06069).
+
+LAMB's per-tensor trust ratio needs uncompressed norms, so 1-bit LAMB runs
+two phases:
+
+* **Warmup** (steps <= ``freeze_step``): exact LAMB — trust ratio
+  ``||p|| / ||update||`` clipped to [min_coeff, max_coeff] — while an EMA
+  (``coeff_beta``) of each tensor's ratio accumulates into
+  ``coeff_freeze``.
+* **Compression** (after ``freeze_step``): the variance freezes and the
+  *momentum* is what travels through the error-feedback sign-compressed
+  all-reduce (runtime/comm/compressed.py).  The frozen trust ratio is
+  reused, scaled per step by ``factor = max(denom_frozen / denom_fresh)``
+  clamped to [factor_min, factor_max] and rate-limited so consecutive
+  factors differ by at most ``factor_threshold`` (reference lamb.py:343-356)
+  — ``denom_fresh`` comes from a fresh variance estimate rebuilt from the
+  reconstructed gradient ``(m_t - b1 m_{t-1}) / (1 - b1)``.
+
+Functional/optax formulation mirroring fp16/onebit/adam.py: the state
+carries (m, v, v_fresh, coeff_freeze, last_factor, error, server_error);
+``axis_name`` engages the compressed momentum exchange inside shard_map.
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+
+class OnebitLambState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Updates
+    v: optax.Updates
+    v_fresh: optax.Updates        # rebuilt from reconstructed grads post-freeze
+    coeff_freeze: optax.Updates   # per-leaf EMA of the warmup trust ratio
+    last_factor: optax.Updates    # per-leaf rate-limit memory
+    error: optax.Updates
+    server_error: optax.Updates
+
+
+def _norm(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def onebit_lamb(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100, max_coeff: float = 10.0,
+                min_coeff: float = 0.01, coeff_beta: float = 0.9,
+                factor_max: float = 4.0, factor_min: float = 0.5,
+                factor_threshold: float = 0.1, axis_name=None,
+                axis_size: int = 0):
+    """1-bit LAMB as an optax GradientTransformation.
+
+    Before ``freeze_step``: exact LAMB (grads assumed already reduced).
+    After: variance freezes, the locally-updated momentum passes through the
+    compressed all-reduce when ``axis_name`` is given, and the frozen trust
+    ratio is factor-scaled.
+    """
+
+    def init_fn(params):
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                 params)
+        scal = lambda val: jax.tree.map(
+            lambda p: jnp.full((), val, jnp.float32), params)
+        if axis_name is not None:
+            err = z()
+            server = jax.tree.map(
+                lambda p: jnp.zeros(
+                    (p.size // axis_size,)
+                    if axis_size and p.size % axis_size == 0 else (0,),
+                    jnp.float32), params)
+        else:
+            err, server = (), ()
+        return OnebitLambState(jnp.zeros((), jnp.int32), z(), z(), z(),
+                               scal(1.0), scal(1.0), err, server)
+
+    def update_fn(grads, state, params=None):
+        assert params is not None, "onebit_lamb needs params (trust ratio)"
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+        c = count.astype(jnp.float32)
+
+        # ---- momentum update (+ compressed exchange after the freeze) ----
+        if axis_name is None:
+            g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g,
+                             state.m, g32)
+            new_error, new_server = state.error, state.server_error
+        else:
+            def warm(g, mm, errr, srv):
+                g_red = lax.pmean(g.astype(jnp.float32), axis_name)
+                return (b1 * mm + (1 - b1) * g_red, jnp.zeros_like(errr),
+                        jnp.zeros_like(srv))
+
+            def frozen(g, mm, errr, srv):
+                # reference lamb.py:316-321: momentum absorbs the LOCAL grad,
+                # then the momentum itself is sign-compressed and reduced
+                m_local = b1 * mm + (1 - b1) * g.astype(jnp.float32)
+                if srv.shape[0]:
+                    red, ne, ns = compressed_allreduce(
+                        m_local, errr, axis_name, server_error=srv)
+                    return red, ne, ns
+                red, ne = compressed_allreduce(m_local, errr, axis_name)
+                return red, ne, srv
+
+            merged = jax.tree.map(
+                lambda g, mm, e, sv: lax.cond(in_warmup, warm, frozen,
+                                              g, mm, e, sv),
+                grads, state.m, state.error, state.server_error)
+            is_t = lambda x: isinstance(x, tuple)
+            m = jax.tree.map(lambda t: t[0], merged, is_leaf=is_t)
+            new_error = jax.tree.map(lambda t: t[1], merged, is_leaf=is_t)
+            new_server = jax.tree.map(lambda t: t[2], merged, is_leaf=is_t)
+
+        # ---- variance: live during warmup, frozen after ------------------
+        # grad reconstruction for the fresh estimate (paper eq. for v_fresh)
+        g_recon = jax.tree.map(lambda mm, mp: (mm - b1 * mp) / (1 - b1),
+                               m, state.m)
+        v = jax.tree.map(
+            lambda vv, gr: jnp.where(in_warmup,
+                                     b2 * vv + (1 - b2) * gr * gr, vv),
+            state.v, g_recon)
+        v_fresh = jax.tree.map(
+            lambda vf, vv, gr: jnp.where(
+                in_warmup, vv, b2 * vf + (1 - b2) * gr * gr),
+            state.v_fresh, v, g_recon)
+
+        bias1 = 1 - b1 ** c
+        bias2 = 1 - b2 ** jnp.minimum(c, float(freeze_step))
+        lr = (learning_rate(count) if callable(learning_rate)
+              else learning_rate)
+
+        def leaf_update(mm, vv, vf, p, cf, lastf):
+            mhat = mm / bias1
+            denom = jnp.sqrt(vv / bias2) + eps
+            upd = mhat / denom + weight_decay * p.astype(jnp.float32)
+            # warmup trust ratio (reference lamb.py:235-241)
+            wn, un = _norm(p), _norm(upd)
+            ratio = jnp.where((wn > 0) & (un > 0),
+                              jnp.clip(wn / un, min_coeff, max_coeff), 1.0)
+            new_cf = jnp.where(in_warmup,
+                               coeff_beta * cf + (1 - coeff_beta) * ratio, cf)
+            # compression-phase factor (reference lamb.py:343-356)
+            denom_real = jnp.sqrt(vf / bias2) + eps
+            factor = jnp.clip(jnp.max(denom / denom_real),
+                              factor_min, factor_max)
+            factor = jnp.clip(factor, lastf * (1 - factor_threshold),
+                              lastf * (1 + factor_threshold))
+            new_lastf = jnp.where(in_warmup, lastf, factor)
+            coeff = jnp.where(in_warmup, ratio, factor * cf)
+            return (-lr * coeff * upd).astype(p.dtype), new_cf, new_lastf
+
+        out = jax.tree.map(leaf_update, m, v, v_fresh, params,
+                           state.coeff_freeze, state.last_factor)
+        is_t = lambda x: isinstance(x, tuple)
+        updates = jax.tree.map(lambda t: t[0], out, is_leaf=is_t)
+        new_cf = jax.tree.map(lambda t: t[1], out, is_leaf=is_t)
+        new_lastf = jax.tree.map(lambda t: t[2], out, is_leaf=is_t)
+        return updates, OnebitLambState(count, m, v, v_fresh, new_cf,
+                                        new_lastf, new_error, new_server)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class OnebitLamb:
+    """Class shim with the reference's constructor surface."""
+
+    def __init__(self, params=None, deepspeed=None, lr: float = 1e-3,
+                 freeze_step: int = 100, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 max_coeff: float = 10.0, min_coeff: float = 0.01,
+                 cuda_aware: bool = False, comm_backend_name: str = "jax",
+                 coeff_beta: float = 0.9, factor_max: float = 4.0,
+                 factor_min: float = 0.5, factor_threshold: float = 0.1,
+                 **kw):
+        self.transform = onebit_lamb(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay, freeze_step=freeze_step,
+            max_coeff=max_coeff, min_coeff=min_coeff, coeff_beta=coeff_beta,
+            factor_max=factor_max, factor_min=factor_min,
+            factor_threshold=factor_threshold)

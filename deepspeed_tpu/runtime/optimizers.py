@@ -66,7 +66,21 @@ def build_optimizer(name: Optional[str], params: Optional[dict],
             weight_decay=wd,
             freeze_step=int(params.get("freeze_step", 100)))
     if name == C.ONEBIT_LAMB_OPTIMIZER:
-        from deepspeed_tpu.utils.logging import warning_once
-        warning_once(f"{name}: runs as uncompressed LAMB on TPU")
-        return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
+        # two-phase 1-bit LAMB (runtime/fp16/onebit/lamb.py): exact LAMB with
+        # a trust-ratio EMA through freeze_step, then frozen variance +
+        # factor-scaled frozen coefficient; compressed momentum exchange
+        # engages under shard_map, same contract as OnebitAdam above.
+        from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+        adam_args = _adam_args(params)
+        return onebit_lamb(
+            learning_rate=lr,
+            b1=adam_args["b1"], b2=adam_args["b2"], eps=adam_args["eps"],
+            weight_decay=wd,
+            freeze_step=int(params.get("freeze_step", 100)),
+            max_coeff=float(params.get("max_coeff", 10.0)),
+            min_coeff=float(params.get("min_coeff", 0.01)),
+            coeff_beta=float(params.get("coeff_beta", 0.9)),
+            factor_max=float(params.get("factor_max", 4.0)),
+            factor_min=float(params.get("factor_min", 0.5)),
+            factor_threshold=float(params.get("factor_threshold", 0.1)))
     raise ValueError(f"Unknown optimizer {name!r} in DeepSpeed config")

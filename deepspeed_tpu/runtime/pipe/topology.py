@@ -24,6 +24,9 @@ class ProcessTopology:
         for coord in product(*[range(d) for d in dims]):
             key = dict(zip(axes, coord))
             self.mapping[self.ProcessCoord(**key)] = len(self.mapping)
+        # O(1) reverse lookup (rank -> coord); world sizes reach 10^3-10^4
+        # and per-rank naming (launcher, checkpoint paths) hits this per rank
+        self._coords = list(self.mapping)
 
     def get_rank(self, **coord_kwargs) -> int:
         key = self.ProcessCoord(**coord_kwargs)
@@ -31,9 +34,8 @@ class ProcessTopology:
         return self.mapping[key]
 
     def get_coord(self, rank: int):
-        for coord, r in self.mapping.items():
-            if r == rank:
-                return coord
+        if 0 <= rank < len(self._coords):
+            return self._coords[rank]
         raise ValueError(f"rank {rank} not in topology")
 
     def get_axis_names(self) -> List[str]:

@@ -19,6 +19,13 @@ import pytest  # noqa: E402
 # from the outer env; override it before any backend initialises.
 jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: the suite compiles many near-identical
+# engine steps on the virtual CPU mesh; caching keeps the full-suite wall
+# time inside the driver's budget (and repeat runs mostly free)
+jax.config.update("jax_compilation_cache_dir", "/tmp/ds_tpu_test_jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 
 @pytest.fixture(autouse=True)
 def _reset_topology():

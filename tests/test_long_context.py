@@ -166,3 +166,97 @@ def test_sparse_attention_masks_forbidden_positions():
     np.testing.assert_allclose(np.asarray(out1[:, :16]),
                                np.asarray(out2[:, :16]), rtol=1e-6)
     assert not np.allclose(np.asarray(out1[:, 48:]), np.asarray(out2[:, 48:]))
+
+
+# ---------------------------------------------- pallas block-skipping kernel
+
+def test_pallas_block_sparse_matches_dense():
+    """The block-skipping kernel reproduces the dense block-masked path
+    (both causal and bidirectional) to fp32 tolerance."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+    rng = np.random.default_rng(7)
+    B, S, H, hd = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(S)
+    for causal in (False, True):
+        dense = sparse_self_attention(q, k, v, cfg, causal=causal)
+        kern = block_sparse_attention(q, k, v, layout, causal=causal)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(kern),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_block_sparse_skips_masked_blocks():
+    """Poison KV in blocks outside the layout with huge values: the kernel
+    output must be bit-insensitive — those blocks are never loaded (the
+    dense path merely masks them after multiplying)."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+    rng = np.random.default_rng(8)
+    B, S, H, hd = 1, 64, 1, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=1,
+                              num_global_blocks=0)
+    layout = cfg.make_layout(S)
+    out1 = block_sparse_attention(q, k, v, layout)
+    # block rows 0 can only see kv block 0: poison kv blocks 2-3 with inf
+    bad = jnp.float32(np.inf)
+    k2 = k.at[:, 32:].set(bad)
+    v2 = v.at[:, 32:].set(bad)
+    out2 = block_sparse_attention(q, k2, v2, layout)
+    np.testing.assert_array_equal(np.asarray(out1[:, :32]),
+                                  np.asarray(out2[:, :32]))
+
+
+def test_pallas_block_sparse_trainable_grads_match_dense():
+    """Gradients through the trainable wrapper equal the dense path's."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention_trainable)
+    rng = np.random.default_rng(9)
+    B, S, H, hd = 1, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=2,
+                              num_global_blocks=0)
+    layout = cfg.make_layout(S)
+
+    def loss_kernel(q, k, v):
+        return block_sparse_attention_trainable(q, k, v, layout,
+                                                causal=True).sum()
+
+    def loss_dense(q, k, v):
+        return sparse_self_attention(q, k, v, cfg, causal=True).sum()
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fully_masked_rows_emit_zero_both_paths():
+    """A causal layout whose first block-row only sees an above-diagonal
+    block leaves those rows fully masked: both paths emit exactly 0 (flash
+    convention) instead of a masked-V average."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+    rng = np.random.default_rng(11)
+    B, S, H, hd = 1, 32, 1, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    layout = np.array([[[0, 1], [1, 1]]])          # row 0: above-diag only
+
+    class Cfg:
+        def make_layout(self, seq_len):
+            return layout
+
+    dense = np.asarray(sparse_self_attention(q, k, v, Cfg(), causal=True))
+    kern = np.asarray(block_sparse_attention(q, k, v, layout, causal=True))
+    np.testing.assert_array_equal(dense[:, :16], np.zeros_like(dense[:, :16]))
+    np.testing.assert_array_equal(kern[:, :16], np.zeros_like(kern[:, :16]))
+    np.testing.assert_allclose(dense[:, 16:], kern[:, 16:], rtol=2e-5,
+                               atol=2e-5)

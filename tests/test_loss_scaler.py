@@ -54,3 +54,19 @@ def test_has_overflow_detects_nan_inf():
     assert bool(has_overflow(bad_nan))
     bad_inf = {"a": jnp.array([1.0, np.inf]), "b": jnp.zeros((2,))}
     assert bool(has_overflow(bad_inf))
+
+
+def test_overflow_step_reports_zero_grad_norm(devices8):
+    """Contract shared by the jitted and host-offload tiers: a skipped
+    (overflow) step reports grad_norm 0.0, never inf."""
+    import deepspeed_tpu
+    from tests.util import tiny_gpt2, base_config, random_batches
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(dtype="float16"), config=base_config(
+            fp16={"enabled": True, "loss_scale": 0,
+                  "initial_scale_power": 32}))
+    b = random_batches(1, batch_size=8, seed=0)[0]
+    engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    assert bool(np.asarray(engine.last_metrics["overflow"]))
+    assert float(np.asarray(engine.last_metrics["grad_norm"])) == 0.0
+    assert engine.skipped_steps == 1

@@ -115,6 +115,105 @@ def test_onebit_adam_freezes_variance():
     np.testing.assert_allclose(np.asarray(s.v["w"]), v_frozen)
 
 
+def test_onebit_lamb_matches_lamb_during_warmup():
+    """During warmup 1-bit LAMB is exact LAMB (same trust-ratio clipping)."""
+    import optax
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+    rng = np.random.default_rng(4)
+    params = {"w": jnp.asarray(rng.normal(size=(16,)), jnp.float32)}
+    g = {"w": jnp.asarray(rng.normal(size=(16,)) * 0.1, jnp.float32)}
+    ob = onebit_lamb(learning_rate=0.01, freeze_step=100)
+    ref = optax.lamb(0.01)
+    s1, s2 = ob.init(params), ref.init(params)
+    p1, p2 = params, params
+    for _ in range(3):
+        u1, s1 = ob.update(g, s1, p1)
+        u2, s2 = ref.update(g, s2, p2)
+        p1 = optax.apply_updates(p1, u1)
+        p2 = optax.apply_updates(p2, u2)
+    # same algorithm family: both apply trust-ratio-scaled adam updates; the
+    # directions must agree (optax.lamb has no coeff clipping, so exact
+    # equality is not the contract — cosine similarity is)
+    d1 = np.asarray(p1["w"]) - np.asarray(params["w"])
+    d2 = np.asarray(p2["w"]) - np.asarray(params["w"])
+    cos = d1 @ d2 / (np.linalg.norm(d1) * np.linalg.norm(d2))
+    assert cos > 0.999, cos
+
+
+def test_onebit_lamb_freezes_variance_and_scales_coeff():
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLambState, \
+        onebit_lamb
+    rng = np.random.default_rng(5)
+    params = {"w": jnp.asarray(rng.normal(size=(8,)), jnp.float32)}
+    ob = onebit_lamb(learning_rate=0.01, freeze_step=2, factor_threshold=0.5)
+    s = ob.init(params)
+    g1 = {"w": jnp.ones((8,), jnp.float32) * 0.1}
+    g2 = {"w": jnp.full((8,), 10.0, jnp.float32)}
+    _, s = ob.update(g1, s, params)
+    _, s = ob.update(g1, s, params)
+    v_frozen = np.asarray(s.v["w"]).copy()
+    cf_frozen = float(s.coeff_freeze["w"])
+    u, s = ob.update(g2, s, params)       # past freeze_step
+    # frozen variance unchanged; coeff_freeze EMA stops
+    np.testing.assert_allclose(np.asarray(s.v["w"]), v_frozen)
+    assert float(s.coeff_freeze["w"]) == cf_frozen
+    # the fresh variance moved (absorbed the reconstructed big grad), and the
+    # rate-limited factor departed from 1.0 toward factor_min
+    assert float(np.max(np.asarray(s.v_fresh["w"]))) > float(
+        np.max(v_frozen))
+    assert float(s.last_factor["w"]) < 1.0
+    assert np.all(np.isfinite(np.asarray(u["w"])))
+
+
+def test_onebit_lamb_compressed_momentum_exchange(devices8):
+    """Past freeze_step with an axis name, the momentum travels through the
+    compressed all-reduce: states stay finite, the error-feedback residual
+    becomes non-zero, and the variance stays frozen."""
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import onebit_lamb
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    rng = np.random.default_rng(6)
+    params = {"w": jnp.asarray(rng.normal(size=(64,)), jnp.float32)}
+    local_g = rng.normal(size=(8, 64)).astype(np.float32)
+    gsh = jax.device_put(jnp.asarray(local_g),
+                         NamedSharding(mesh, P("dp", None)))
+    ob = onebit_lamb(learning_rate=0.01, freeze_step=2, axis_name="dp",
+                     axis_size=8)
+
+    def body(g):
+        g = {"w": g[0]}
+        s = ob.init(params)
+        p = params
+
+        def step(carry, _):
+            p, s = carry
+            u, s = ob.update(g, s, p)
+            import optax
+            return (optax.apply_updates(p, u), s), None
+
+        (p, s), _ = jax.lax.scan(step, (p, s), None, length=4)  # crosses 2
+        return (p["w"][None], s.v["w"][None], s.error["w"][None],
+                jnp.reshape(s.count, (1,)))
+
+    p, v, err, count = shard_map(
+        body, mesh=mesh, in_specs=P("dp", None),
+        out_specs=(P(None, None), P(None, None), P("dp", None), P(None)),
+        check_vma=False)(gsh)
+    assert int(count[0]) == 4
+    assert np.all(np.isfinite(np.asarray(p)))
+    # the frozen phase ran the compressed exchange: worker residual non-zero
+    assert float(np.abs(np.asarray(err)).max()) > 0
+
+
+def test_engine_accepts_onebit_lamb(devices8):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitLamb",
+                       "params": {"lr": 1e-3, "freeze_step": 10}}))
+    b = random_batches(1, batch_size=8, seed=0)[0]
+    loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    assert np.isfinite(float(loss))
+
+
 def test_engine_accepts_onebit_adam(devices8):
     engine, *_ = deepspeed_tpu.initialize(
         model=tiny_gpt2(), config=base_config(
