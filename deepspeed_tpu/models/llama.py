@@ -279,7 +279,10 @@ def llama_model(size: str = "7b", **overrides) -> Model:
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,
         meta={"name": f"llama-{size}", "n_params": n_params,
-              "supports_random_ltd": True, "supports_pld": True},
+              "supports_random_ltd": True, "supports_pld": True,
+              # wte grads come solely from input_ids lookups (untied
+              # lm_head): eligible for the sparse_gradients exchange
+              "sparse_grad_params": {"wte": "input_ids"}},
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),

@@ -581,18 +581,30 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ train step
     def _qgz_grad_fn(self):
-        """ZeRO++ qgZ (zero_quantized_gradients): gradients reduce through
-        the block-quantized all-to-all collective instead of the fp32
-        reduce-scatter (reference qgZ, zeropp.md:15; the collective lives in
-        runtime/zero/zeropp.py).  Pure-DP meshes only — inside the shard_map
-        each device computes LOCAL grads on its batch shard, so the
-        quantized exchange sees genuinely unreduced contributions.  Returns
-        a (params, stacked_local_batch, rng, scale) -> (loss, grads) fn to
-        splice into the train step, or None when inapplicable."""
+        """Custom gradient-reduction tier: ZeRO++ qgZ
+        (zero_quantized_gradients — block-quantized all-to-all instead of
+        the fp32 reduce-scatter, reference zeropp.md:15) and/or sparse
+        embedding gradients (sparse_gradients — touched-rows exchange,
+        reference runtime/sparse_tensor.py).  Pure-DP meshes only — inside
+        the shard_map each device computes LOCAL grads on its batch shard,
+        so the custom exchanges see genuinely unreduced contributions.
+        Returns a (params, stacked_local_batch, rng, scale) -> (loss, grads)
+        fn to splice into the train step, or None when inapplicable."""
         from jax import shard_map
         from deepspeed_tpu.runtime.zero.zeropp import quantized_psum_scatter
+        from deepspeed_tpu.runtime.sparse_tensor import (
+            sparse_embedding_allreduce)
         zc = self._config.zero_config
-        if not zc.zero_quantized_gradients:
+        declared = self.model.meta.get("sparse_grad_params", {})
+        if not isinstance(declared, dict):     # list shorthand -> input_ids
+            declared = {k: "input_ids" for k in declared}
+        sparse_leaves = (dict(declared)
+                         if self._config.sparse_gradients_enabled else {})
+        if self._config.sparse_gradients_enabled and not sparse_leaves:
+            logger.warning(
+                "sparse_gradients: model declares no sparse_grad_params "
+                "(tied embeddings get dense head contributions); ignoring")
+        if not zc.zero_quantized_gradients and not sparse_leaves:
             return None
         dp_axes = tuple(self.topology.data_parallel_axes)
         n = self.topology.axis_size(dp_axes)
@@ -603,17 +615,17 @@ class DeepSpeedEngine:
             # several >1 axes (hpz/expert carved out) would leave the other
             # axes unreduced
             logger.warning(
-                "zero_quantized_gradients requires a pure data-parallel "
-                "mesh with a single data axis (model/seq/pipe/expert/hpz "
-                "sizes 1); reducing in full precision")
+                "zero_quantized_gradients/sparse_gradients require a pure "
+                "data-parallel mesh with a single data axis (model/seq/"
+                "pipe/expert/hpz sizes 1); reducing dense in full precision")
             return None
         if zc.stage >= 3:
             # the shard_map body sees replicated params/grads, which would
             # gather the stage-3 param shards; reference qgZ keeps sharded
             # state — not expressible in this formulation yet
             logger.warning(
-                "zero_quantized_gradients supports ZeRO stages 0-2; "
-                "stage 3 reduces in full precision")
+                "zero_quantized_gradients/sparse_gradients support ZeRO "
+                "stages 0-2; stage 3 reduces dense in full precision")
             return None
         gas = self.gradient_accumulation_steps()
         mesh = self.mesh
@@ -647,18 +659,26 @@ class DeepSpeedEngine:
                 (local_g, local_l), _ = jax.lax.scan(
                     micro, (zeros, jnp.float32(0.0)), b)
 
-                # quantized exchange: each leaf reduce-scatters its int8
-                # chunks over dim 0 and re-gathers; / n for the mean over
-                # devices.  Tiny/ragged leaves take the exact pmean.
-                def reduce_leaf(g):
-                    if g.ndim >= 1 and g.shape[0] % n == 0 and g.size > n:
+                # per-leaf exchange: declared embedding leaves move only the
+                # rows touched by their declared batch ids field; with qgZ
+                # the rest reduce-scatter int8 chunks over dim 0 and
+                # re-gather (/ n = mean over devices); tiny/ragged leaves
+                # take the exact pmean
+                def reduce_leaf(path, g):
+                    top = getattr(path[0], "key", None) if path else None
+                    if top in sparse_leaves and g.ndim == 2:
+                        return sparse_embedding_allreduce(
+                            g, b[sparse_leaves[top]], axname, n)
+                    if (zc.zero_quantized_gradients and g.ndim >= 1
+                            and g.shape[0] % n == 0 and g.size > n):
                         chunk = quantized_psum_scatter(g, axname, n=n,
                                                        scatter_dim=0)
                         return lax.all_gather(chunk, axname, axis=0,
                                               tiled=True) / n
                     return lax.pmean(g, axname)
 
-                g_red = jax.tree.map(reduce_leaf, local_g)
+                g_red = jax.tree_util.tree_map_with_path(reduce_leaf,
+                                                         local_g)
                 loss = lax.pmean(local_l, axname)
                 return loss, g_red
 

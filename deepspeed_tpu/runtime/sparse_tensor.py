@@ -1,0 +1,50 @@
+"""Sparse embedding gradients (reference: deepspeed/runtime/sparse_tensor.py
+``SparseTensor`` + engine.py sparse allreduce path, config key
+``sparse_gradients``).
+
+The reference wraps torch's sparse COO embedding grads so DP all-reduce
+moves (indices, values) instead of the dense [V, D] table.  TPU-native
+formulation: under jit shapes are static, so the exchange keys off the
+*batch token ids* (exactly the rows a lookup-only embedding grad can
+touch).  Each device normalises its dense local grad rows by their local
+occurrence count, all-gathers (ids, rows) — O(tokens·D) wire traffic — and
+scatter-adds into the [V, D] table, reproducing the dense mean exactly.
+
+Only correct for params whose gradient comes *solely* from gather-style
+lookups of the ids.  Models declare them via
+``meta["sparse_grad_params"]`` — a mapping ``{param_key: batch_ids_key}``
+naming which batch field feeds the lookup (a list is accepted as shorthand
+for ``input_ids``).  A tied embedding+head like GPT-2's wte gets dense head
+contributions on every row and must NOT be declared.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def sparse_embedding_allreduce(g, ids, axis_name, n: int):
+    """Mean-reduce a lookup-embedding gradient over the DP axis by
+    exchanging only the touched rows.
+
+    **Collective — call inside a shard_map body.**
+
+    Args:
+        g: [V, D] this device's local dense embedding gradient (rows
+           non-zero only at ``ids``).
+        ids: [T] int32 token ids of this device's batch window (with
+           duplicates; every id whose row is non-zero must appear).
+        axis_name: DP mesh axis.
+        n: axis size.
+    Returns:
+        [V, D] the exact mean gradient over the axis.
+    """
+    ids = ids.reshape(-1)
+    # counts in f32 regardless of g.dtype: a bf16 accumulator saturates its
+    # integer range at 256 and high-frequency tokens would mis-normalise
+    counts = jnp.zeros((g.shape[0],), jnp.float32).at[ids].add(1.0)
+    # each occurrence carries row/count so duplicates sum back to the row
+    rows = (g[ids].astype(jnp.float32)
+            / jnp.maximum(counts, 1.0)[ids][:, None])           # [T, D]
+    all_ids = lax.all_gather(ids, axis_name, tiled=True)        # [n*T]
+    all_rows = lax.all_gather(rows, axis_name, tiled=True)      # [n*T, D]
+    out = jnp.zeros(g.shape, jnp.float32).at[all_ids].add(all_rows) / n
+    return out.astype(g.dtype)
