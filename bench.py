@@ -46,9 +46,17 @@ def chip_peak_tflops() -> float:
 
 def main():
     n_chips = jax.device_count()
-    model = gpt2_model(MODEL_SIZE, max_seq_len=SEQ, dtype="bfloat16",
-                       remat=bool(int(os.environ.get("BENCH_REMAT", "1"))),
-                       remat_policy=REMAT_POLICY)
+    remat = bool(int(os.environ.get("BENCH_REMAT", "1")))
+    if MODEL_SIZE.startswith("bert"):
+        # BASELINE row 1 (fastest-BERT): BENCH_MODEL=bert-large BENCH_SEQ=128
+        # BENCH_MICRO=128 / BENCH_SEQ=512 BENCH_MICRO=32
+        from deepspeed_tpu.models.bert import bert_model
+        model = bert_model(MODEL_SIZE.split("-", 1)[1], max_seq_len=SEQ,
+                           dtype="bfloat16", remat=remat,
+                           remat_policy=REMAT_POLICY)
+    else:
+        model = gpt2_model(MODEL_SIZE, max_seq_len=SEQ, dtype="bfloat16",
+                           remat=remat, remat_policy=REMAT_POLICY)
     n_params = model.meta["n_params"]
     cfg = model.config
     # MFU accounting: 6N matmul flops/token + causal attention
@@ -75,8 +83,13 @@ def main():
     global_batch = MICRO * engine.topology.dp_world_size
 
     def batch():
-        return {"input_ids": rng.integers(
-            0, cfg.vocab_size, size=(1, global_batch, SEQ), dtype=np.int32)}
+        ids = rng.integers(0, cfg.vocab_size, size=(1, global_batch, SEQ),
+                           dtype=np.int32)
+        if MODEL_SIZE.startswith("bert"):     # 15% MLM objective
+            labels = np.where(rng.random(ids.shape) < 0.15, ids,
+                              -100).astype(np.int32)
+            return {"input_ids": ids, "labels": labels}
+        return {"input_ids": ids}
 
     for _ in range(WARMUP):
         loss = engine.train_batch(batch=batch())
@@ -94,7 +107,9 @@ def main():
     mfu = tokens_per_sec_chip * flops_per_token / (chip_peak_tflops() * 1e12)
 
     print(json.dumps({
-        "metric": (f"gpt2_{MODEL_SIZE}_bf16_zero{ZERO_STAGE}"
+        "metric": ((MODEL_SIZE if MODEL_SIZE.startswith("bert")
+                    else f"gpt2_{MODEL_SIZE}")
+                   + f"_bf16_zero{ZERO_STAGE}"
                    + ("_offload" if OFFLOAD else "") + "_mfu"),
         "value": round(mfu, 4),
         "unit": "MFU_fraction",

@@ -2,3 +2,4 @@ from deepspeed_tpu.models.model import Model
 from deepspeed_tpu.models.gpt2 import gpt2_model, GPT2Config
 from deepspeed_tpu.models.llama import llama_model, LlamaConfig
 from deepspeed_tpu.models.mixtral import mixtral_model, MixtralConfig
+from deepspeed_tpu.models.bert import bert_model, BertConfig

@@ -102,11 +102,15 @@ def _stream_transfer(w, target):
     return f(w)
 
 
-def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int):
+def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int,
+                allow_ltd: bool = True):
     """Layer scan with the engine's data-efficiency hooks applied.
 
     - **random-LTD**: trace-time keep-token count from the engine's ltd
-      scope (runtime/data_pipeline/random_ltd.py).
+      scope (runtime/data_pipeline/random_ltd.py).  Models whose block
+      closes over per-position state (e.g. an encoder padding mask) pass
+      ``allow_ltd=False`` — the gathered token subset would misalign with
+      that state.
     - **progressive layer drop** (reference engine.py:1755 PLD theta kwarg):
       when the engine injects ``batch["pld_theta"]`` (a *traced* scalar, so
       the per-step theta schedule never recompiles), layer ``l`` is skipped
@@ -122,7 +126,13 @@ def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int):
 
     ltd_keep = get_ltd_keep()
     S = x.shape[1]
-    use_ltd = bool(ltd_keep) and rng is not None and ltd_keep < S
+    use_ltd = (allow_ltd and bool(ltd_keep) and rng is not None
+               and ltd_keep < S)
+    if not allow_ltd and bool(ltd_keep) and ltd_keep < S:
+        from deepspeed_tpu.utils.logging import warning_once
+        warning_once("random-LTD: skipped — this model's blocks close over "
+                     "per-position state (padding mask) that a token "
+                     "subset would misalign with")
     theta = batch.get("pld_theta") if isinstance(batch, dict) else None
     use_pld = theta is not None and rng is not None
 

@@ -42,16 +42,17 @@ def flash_causal_attention(q, k, v):
 _FLASH_STATUS = {}  # probe result per (S, hd): True usable / exception string
 
 
-def _flash_usable(q) -> bool:
+def _flash_usable(q, fn=None) -> bool:
     """Probe the Pallas flash path once per shape class and remember the
     outcome.  A failure is logged loudly (never silently degraded — VERDICT
     round 1 flagged the silent except here) so a bench run on a slow fallback
     is visible in the logs."""
     from deepspeed_tpu.utils.logging import logger
-    key = (q.shape[1], q.shape[3])
+    fn = fn or flash_causal_attention
+    key = (q.shape[1], q.shape[3], getattr(fn, "__name__", "bidirectional"))
     if key not in _FLASH_STATUS:
         try:
-            jax.eval_shape(flash_causal_attention, q, q, q)
+            jax.eval_shape(fn, q, q, q)
             _FLASH_STATUS[key] = True
             logger.info(f"attention: Pallas flash selected for S={key[0]} "
                         f"head_dim={key[1]}")
@@ -71,6 +72,43 @@ def _local_causal_attention(q, k, v, impl: str = "auto"):
     if impl == "auto" and _on_tpu() and q.shape[1] >= 256 and _flash_usable(q):
         return flash_causal_attention(q, k, v)
     return xla_causal_attention(q, k, v)
+
+
+def xla_bidirectional_attention(q, k, v, pad_mask=None):
+    """Encoder (BERT-style) attention; optional key padding mask [B, S]
+    (1 = real token).  fp32 softmax accumulation."""
+    B, S, H, hd = q.shape
+    scale = hd ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if pad_mask is not None:
+        scores = jnp.where(pad_mask[:, None, None, :].astype(bool), scores,
+                           jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
+    """q/k/v: [B, S, H, hd] -> [B, S, H, hd], no causal mask.
+
+    Unpadded batches (``pad_mask=None``) ride the Pallas flash kernel on
+    TPU at S>=256; a padding mask forces the XLA path (the flash wrapper
+    carries no segment ids yet) — omit the mask when nothing is padded, an
+    all-ones mask still pays the masked path.
+    """
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    noncausal = partial(flash_attention, causal=False)
+    if impl == "flash":
+        if pad_mask is not None:
+            raise NotImplementedError(
+                "impl='flash' cannot honour a padding mask (no segment-id "
+                "support in the flash wrapper yet); drop the mask or use "
+                "impl='auto'/'xla'")
+        # explicit request: no fallback — surface the real error
+        return noncausal(q, k, v)
+    if (pad_mask is None and impl == "auto" and _on_tpu()
+            and q.shape[1] >= 256 and _flash_usable(q, fn=noncausal)):
+        return noncausal(q, k, v)
+    return xla_bidirectional_attention(q, k, v, pad_mask)
 
 
 def causal_attention(q, k, v, impl: str = "auto"):

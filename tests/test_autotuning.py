@@ -23,14 +23,18 @@ def test_autotuner_picks_fastest_feasible(devices8, tmp_path):
         results_dir=str(tmp_path / "autotune"))
     best = tuner.tune()
     assert best is not None and best.ok
-    # larger micro batch on this toy always wins on samples/sec
-    assert best.micro_batch == 2
     rows = json.load(open(tmp_path / "autotune" / "results.json"))
     assert len(rows) == 4
     assert all(r["ok"] for r in rows)
+    # the emitted best is the argmax of the *measured* throughputs (which
+    # config wins on a loaded CI box is timing noise, not the contract)
+    fastest = max(rows, key=lambda r: r["samples_per_sec"])
+    assert round(best.samples_per_sec, 2) == fastest["samples_per_sec"]
+    assert (best.stage, best.micro_batch) == (fastest["zero_stage"],
+                                              fastest["micro_batch"])
     best_cfg = json.load(open(tmp_path / "autotune" / "best_config.json"))
     assert best_cfg["zero_optimization"]["stage"] == best.stage
-    assert best_cfg["train_micro_batch_size_per_gpu"] == 2
+    assert best_cfg["train_micro_batch_size_per_gpu"] == best.micro_batch
     assert best_cfg["_autotuning"]["samples_per_sec"] > 0
 
 

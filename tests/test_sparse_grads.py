@@ -69,12 +69,25 @@ def test_sparse_gradients_training_matches_dense(devices8):
     np.testing.assert_allclose(sparse_wte, dense_wte, rtol=1e-4, atol=1e-6)
 
 
-def test_sparse_gradients_warns_on_tied_embedding(devices8, caplog):
+def test_sparse_gradients_warns_on_tied_embedding(devices8):
     """GPT-2's tied wte must not engage the sparse path (no
-    sparse_grad_params declared) — warn and fall back."""
+    sparse_grad_params declared) — warn and fall back to dense."""
+    import logging
     from tests.util import tiny_gpt2, base_config, random_batches
-    engine, *_ = deepspeed_tpu.initialize(
-        model=tiny_gpt2(), config=base_config(sparse_gradients=True))
-    b = random_batches(1, batch_size=8, seed=0)[0]
-    loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logging.getLogger("deepspeed_tpu").addHandler(handler)
+    try:
+        engine, *_ = deepspeed_tpu.initialize(
+            model=tiny_gpt2(), config=base_config(sparse_gradients=True))
+        b = random_batches(1, batch_size=8, seed=0)[0]
+        loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    finally:
+        logging.getLogger("deepspeed_tpu").removeHandler(handler)
     assert np.isfinite(float(loss))
+    assert any("sparse_grad_params" in m for m in records), records
