@@ -242,11 +242,12 @@ def sparse_self_attention(q, k, v, sparsity_config: SparsityConfig,
     """q/k/v [B, S, H, hd] -> [B, S, H, hd] under the config's block layout
     (reference SparseSelfAttention.forward).
 
-    ``impl="pallas"`` routes to the block-skipping Pallas kernel
-    (ops/pallas/block_sparse_attention.py): identical numerics, compute and
-    HBM traffic scale with layout density instead of S² — the long-sequence
-    path.  ``dense`` keeps the block-masked XLA softmax fusion (the right
-    trade below ~16k tokens)."""
+    ``impl="pallas"`` routes to the block-skipping Pallas kernels
+    (ops/pallas/block_sparse_attention.py, fused forward AND backward):
+    identical numerics and gradients, compute and HBM traffic scale with
+    layout density instead of S² — the long-sequence path.  ``dense``
+    keeps the block-masked XLA softmax fusion (the right trade below ~16k
+    tokens)."""
     B, S, H, hd = q.shape
     scale = sm_scale if sm_scale is not None else hd ** -0.5
     layout = sparsity_config.make_layout(S)

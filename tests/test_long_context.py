@@ -260,3 +260,40 @@ def test_fully_masked_rows_emit_zero_both_paths():
     np.testing.assert_array_equal(kern[:, :16], np.zeros_like(kern[:, :16]))
     np.testing.assert_allclose(dense[:, 16:], kern[:, 16:], rtol=2e-5,
                                atol=2e-5)
+
+
+def test_pallas_block_sparse_bwd_noncausal_and_empty_rows():
+    """Fused backward: non-causal grads match dense, and rows left empty by
+    the causal tril get exactly zero dq (their forward emits 0)."""
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention_trainable)
+    rng = np.random.default_rng(12)
+    B, S, H, hd = 2, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+               for _ in range(3))
+    cfg = FixedSparsityConfig(num_heads=H, block=16, num_local_blocks=1,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(S)
+
+    def loss_k(q, k, v, causal):
+        return block_sparse_attention_trainable(q, k, v, layout,
+                                                causal=causal).sum()
+
+    def loss_d(q, k, v, causal):
+        return sparse_self_attention(q, k, v, cfg, causal=causal).sum()
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v, False)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v, False)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    # causal + above-diagonal-only first row block -> empty rows, zero dq
+    layout2 = np.array([[[0, 1], [1, 1]]] * H)
+
+    def loss2(q, k, v):
+        return block_sparse_attention_trainable(q, k, v, layout2,
+                                                causal=True).sum()
+
+    dq = jax.grad(loss2)(q, k, v)
+    np.testing.assert_array_equal(np.asarray(dq[:, :16]),
+                                  np.zeros_like(np.asarray(dq[:, :16])))
