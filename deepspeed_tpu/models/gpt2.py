@@ -12,6 +12,8 @@ from dataclasses import dataclass
 from functools import partial
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.ad_checkpoint
 import jax.numpy as jnp
@@ -90,6 +92,81 @@ def init_params(config: GPT2Config, rng) -> dict:
         "lnf_bias": jnp.zeros((D,)),
     }
     return params
+
+
+def init_layer_slice(config: GPT2Config, rng, i) -> dict:
+    """ONE layer's block params (no leading L), distributions matching
+    ``init_params``.  Jittable with a traced layer index — the engine's
+    offload tier generates layers on device and DMAs each slice to pinned
+    host, so neither HBM nor the (slow, single-core) host RNG ever holds
+    the full stacked tensors."""
+    D, M, L = config.d_model, config.d_mlp, config.num_layers
+    r = jax.random.fold_in(rng, i)
+    k = iter(jax.random.split(r, 8))
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "ln1_scale": jnp.ones((D,)), "ln1_bias": jnp.zeros((D,)),
+        "qkv_w": norm(next(k), (D, 3 * D)) * std,
+        "qkv_b": jnp.zeros((3 * D,)),
+        "proj_w": norm(next(k), (D, D)) * res_std,
+        "proj_b": jnp.zeros((D,)),
+        "ln2_scale": jnp.ones((D,)), "ln2_bias": jnp.zeros((D,)),
+        "mlp_in_w": norm(next(k), (D, M)) * std,
+        "mlp_in_b": jnp.zeros((M,)),
+        "mlp_out_w": norm(next(k), (M, D)) * res_std,
+        "mlp_out_b": jnp.zeros((D,)),
+    }
+
+
+def init_nonblock(config: GPT2Config, rng) -> dict:
+    """Everything outside the stacked blocks (small), same distributions."""
+    D, V, S = config.d_model, config.vocab_size, config.max_seq_len
+    k = iter(jax.random.split(rng, 4))
+    std = 0.02
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "wte": norm(next(k), (V, D)) * std,
+        "wpe": norm(next(k), (S, D)) * std,
+        "lnf_scale": jnp.ones((D,)), "lnf_bias": jnp.zeros((D,)),
+    }
+
+
+def numpy_init_params(config: GPT2Config, seed: int = 0) -> dict:
+    """Host-side init mirroring ``init_params``'s distributions with
+    numpy's PCG64 (~3.5x the single-core throughput of jax-cpu threefry).
+    Used by the engine's ZeRO-Infinity tier, where params are *stored* in
+    host memory and a multi-GB device init would exhaust HBM."""
+    D, V, S, L, M = (config.d_model, config.vocab_size, config.max_seq_len,
+                     config.num_layers, config.d_mlp)
+    rng = np.random.default_rng(seed)
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+
+    def norm(shape, scale):
+        return rng.standard_normal(shape, dtype=np.float32) * scale
+
+    return {
+        "wte": norm((V, D), std),
+        "wpe": norm((S, D), std),
+        "blocks": {
+            "ln1_scale": np.ones((L, D), np.float32),
+            "ln1_bias": np.zeros((L, D), np.float32),
+            "qkv_w": norm((L, D, 3 * D), std),
+            "qkv_b": np.zeros((L, 3 * D), np.float32),
+            "proj_w": norm((L, D, D), res_std),
+            "proj_b": np.zeros((L, D), np.float32),
+            "ln2_scale": np.ones((L, D), np.float32),
+            "ln2_bias": np.zeros((L, D), np.float32),
+            "mlp_in_w": norm((L, D, M), std),
+            "mlp_in_b": np.zeros((L, M), np.float32),
+            "mlp_out_w": norm((L, M, D), res_std),
+            "mlp_out_b": np.zeros((L, D), np.float32),
+        },
+        "lnf_scale": np.ones((D,), np.float32),
+        "lnf_bias": np.zeros((D,), np.float32),
+    }
 
 
 def logical_specs(config: GPT2Config) -> dict:
@@ -300,6 +377,9 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
     return Model(
         config=config,
         init_fn=partial(init_params, config),
+        numpy_init_fn=partial(numpy_init_params, config),
+        layer_init_fn=partial(init_layer_slice, config),
+        nonblock_init_fn=partial(init_nonblock, config),
         apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,

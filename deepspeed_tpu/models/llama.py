@@ -78,6 +78,38 @@ def init_params(config: LlamaConfig, rng) -> dict:
     }
 
 
+def numpy_init_params(config: LlamaConfig, seed: int = 0) -> dict:
+    """Host-side init mirroring ``init_params``'s distributions with numpy
+    (the offload tier's fast init — see models/gpt2.py numpy_init_params)."""
+    import numpy as np
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    rng = np.random.default_rng(seed)
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+
+    def norm(shape, scale):
+        return rng.standard_normal(shape, dtype=np.float32) * scale
+
+    return {
+        "wte": norm((V, D), std),
+        "blocks": {
+            "attn_norm": np.ones((L, D), np.float32),
+            "wq": norm((L, D, H * hd), std),
+            "wk": norm((L, D, KV * hd), std),
+            "wv": norm((L, D, KV * hd), std),
+            "wo": norm((L, H * hd, D), res_std),
+            "mlp_norm": np.ones((L, D), np.float32),
+            "w_gate": norm((L, D, M), std),
+            "w_up": norm((L, D, M), std),
+            "w_down": norm((L, M, D), res_std),
+        },
+        "final_norm": np.ones((D,), np.float32),
+        "lm_head": norm((D, V), std),
+    }
+
+
 def logical_specs(config: LlamaConfig) -> dict:
     return {
         "wte": P("model", None),
@@ -275,6 +307,7 @@ def llama_model(size: str = "7b", **overrides) -> Model:
     return Model(
         config=config,
         init_fn=partial(init_params, config),
+        numpy_init_fn=partial(numpy_init_params, config),
         apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,

@@ -167,6 +167,17 @@ class Model:
     config: Any = None
     #: rng -> params pytree (fp32)
     init_fn: Callable = None
+    #: optional host-side initializer (seed=0) -> numpy params pytree with
+    #: init_fn's distributions; the offload tier prefers it (fast host init,
+    #: no HBM involvement)
+    numpy_init_fn: Optional[Callable] = None
+    #: optional sliced device init for the offload tier: layer_init_fn(rng,
+    #: i) -> ONE layer's block params (no leading L); nonblock_init_fn(rng)
+    #: -> everything else.  The engine generates layers on device (fast TPU
+    #: RNG) and DMAs each slice to pinned host — O(1 layer) HBM, no
+    #: single-core host RNG/cast bottleneck.
+    layer_init_fn: Optional[Callable] = None
+    nonblock_init_fn: Optional[Callable] = None
     #: (params, batch, rng) -> logits
     apply_fn: Callable = None
     #: (params, batch, rng) -> scalar loss; defaults to causal-LM cross-entropy

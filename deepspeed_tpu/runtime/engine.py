@@ -45,6 +45,22 @@ def _tree_cast(tree, dtype):
         tree)
 
 
+def _np_fast_cast(x: np.ndarray, dtype):
+    """Host-side cast for big numpy trees.  ml_dtypes' scalar astype loop
+    runs at ~0.01 GB/s on one core — a 6.7B init would sit in the cast for
+    the better part of an hour; the vectorised uint round-to-nearest-even
+    below does bf16 at memory bandwidth."""
+    dtype = jnp.dtype(dtype)
+    if x.dtype == dtype or not np.issubdtype(x.dtype, np.floating):
+        return x
+    if dtype == jnp.bfloat16 and x.dtype == np.float32:
+        b = x.view(np.uint32)
+        rounded = b + np.uint32(0x7FFF) + ((b >> np.uint32(16))
+                                           & np.uint32(1))
+        return (rounded >> np.uint32(16)).astype(np.uint16).view(dtype)
+    return x.astype(dtype)
+
+
 def _global_norm(tree):
     leaves = [jnp.sum(jnp.square(l.astype(jnp.float32)))
               for l in jax.tree.leaves(tree)]
@@ -239,22 +255,68 @@ class DeepSpeedEngine:
                     "streamed layer's device copy alive for backward — set "
                     "the model's remat=True to bound HBM at O(1 layer)")
         if model_parameters is None:
-            try:
+            if self._offload_param:
+                # host-side init: params are *stored* in pinned host memory,
+                # so generate them on the host and move once — a device init
+                # of e.g. 6.7B holds several multi-GB stacked fp32 leaves in
+                # HBM at once and exhausts a 16 GB chip before the host copy
+                # can begin
+                n_params = model.meta.get("n_params", 0) or 0
+                sliced = (getattr(model, "layer_init_fn", None) is not None
+                          and getattr(model, "nonblock_init_fn", None)
+                          is not None)
+                on_tpu = list(self.mesh.devices.flat)[0].platform == "tpu"
+                if n_params >= 1e8 and sliced and on_tpu:
+                    # per-layer device init, assembled IN PLACE in the
+                    # pinned-host stacked buffers: the TPU RNG generates one
+                    # layer's slice (sub-GB HBM) and a donated
+                    # dynamic-update-slice writes it into the host-resident
+                    # param storage — nothing crosses the host↔VM tunnel, no
+                    # single-core host RNG/cast bottleneck (measured 189
+                    # ms/layer at 34 MB slices)
+                    bk = getattr(model, "blocks_key", "blocks")
+                    bshapes = shapes[bk]
+                    L = next(iter(jax.tree.leaves(bshapes))).shape[0]
+                    blk_sh = self.param_shardings[bk]
+                    blocks = jax.jit(
+                        lambda: jax.tree.map(
+                            lambda s: jnp.zeros(s.shape, storage_dtype),
+                            bshapes),
+                        out_shardings=blk_sh)()
+                    write = jax.jit(
+                        lambda b, r, i: jax.tree.map(
+                            lambda bb, ss: bb.at[i].set(
+                                ss.astype(storage_dtype)),
+                            b, model.layer_init_fn(r, i)),
+                        donate_argnums=(0,), out_shardings=blk_sh)
+                    for i in range(L):
+                        blocks = write(blocks, init_rng, i)
+                    nb_sh = {k: v for k, v in self.param_shardings.items()
+                             if k != bk}
+                    params = jax.jit(
+                        lambda r: _tree_cast(model.nonblock_init_fn(r),
+                                             storage_dtype),
+                        out_shardings=nb_sh)(init_rng)
+                    params[bk] = blocks
+                elif (n_params >= 1e9
+                      and getattr(model, "numpy_init_fn", None) is not None):
+                    # numpy PCG64 is ~3.5x jax-cpu threefry per core: worth
+                    # the init-value difference only at billions of params
+                    # (small models keep the rng-exact jax init for parity).
+                    # Seeded from config so replicates differ (the fn's
+                    # numpy rng cannot consume the jax key directly).
+                    params = jax.tree.map(
+                        lambda x: _np_fast_cast(x, storage_dtype),
+                        model.numpy_init_fn(seed=self._config.seed))
+                else:
+                    with jax.default_device(jax.devices("cpu")[0]):
+                        params = _tree_cast(model.init(init_rng),
+                                            storage_dtype)
+                params = jax.device_put(params, self.param_shardings)
+            else:
                 params = jax.jit(
                     lambda r: _tree_cast(model.init(r), storage_dtype),
                     out_shardings=self.param_shardings)(init_rng)
-            except Exception:
-                if not self._offload_param:
-                    raise
-                # the CPU-mesh SPMD partitioner rejects pinned-host
-                # out_shardings; init on device and relocate (one-time copy)
-                device_shardings = jax.tree.map(
-                    lambda s: s.with_memory_kind("device"),
-                    self.param_shardings)
-                params = jax.jit(
-                    lambda r: _tree_cast(model.init(r), storage_dtype),
-                    out_shardings=device_shardings)(init_rng)
-                params = jax.device_put(params, self.param_shardings)
         else:
             params = jax.device_put(_tree_cast(model_parameters, storage_dtype),
                                     self.param_shardings)

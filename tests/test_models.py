@@ -64,3 +64,23 @@ def test_remat_matches():
     l1 = float(m1.loss(params, b))
     l2 = float(m2.loss(params, b))
     assert abs(l1 - l2) < 1e-6
+
+
+def test_numpy_init_matches_jax_init_distributions():
+    """The host-side numpy initializer mirrors init_params: same tree
+    structure/shapes/dtypes and matching per-leaf std within sampling
+    error (it is the offload tier's fast init for billion-param models)."""
+    import jax
+    from deepspeed_tpu.models.gpt2 import (gpt2_model, numpy_init_params)
+    model = gpt2_model("custom", vocab_size=512, max_seq_len=64,
+                       num_layers=3, num_heads=4, d_model=64,
+                       dtype="float32")
+    jp = model.init(jax.random.PRNGKey(0))
+    npp = numpy_init_params(model.config, seed=0)
+    assert jax.tree.structure(jp) == jax.tree.structure(npp)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(jp)[0],
+            jax.tree_util.tree_flatten_with_path(npp)[0]):
+        assert a.shape == b.shape, path
+        sa, sb = float(np.std(np.asarray(a))), float(np.std(b))
+        assert abs(sa - sb) <= 0.1 * max(sa, sb, 1e-3), (path, sa, sb)
