@@ -306,6 +306,7 @@ def prefill(params, batch, cache, config: GPT2Config):
     x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
 
     def body(carry, layer):
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry, layer, config)
         attn = causal_attention(q, kk, v, impl=config.attention_impl)
         out = _block_finish(carry, attn.reshape(B, S, -1), layer, config)
@@ -335,6 +336,7 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config):
 
     def body(carry, layer_kv):
         layer, kc, vc = layer_kv
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config)
         kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
         vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))

@@ -233,6 +233,8 @@ def prefill(params, batch, cache, config: LlamaConfig):
     x = params["wte"].astype(dtype)[tokens]
 
     def body(carry, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry, layer, config)
         ka, va = kk, v
         if KV != H:
@@ -266,6 +268,8 @@ def decode_step(params, tokens, cache, lengths, config: LlamaConfig):
 
     def body(carry, layer_kv):
         layer, kc, vc = layer_kv
+        from deepspeed_tpu.models.model import maybe_stream
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config,
                               positions=lengths[:, None])
         kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))

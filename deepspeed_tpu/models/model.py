@@ -10,6 +10,8 @@ import contextvars
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+import jax
+
 # ---------------------------------------------------------------- param stream
 # ZeRO-Infinity parameter offload (reference: partitioned_param_swapper.py:36 +
 # parameter_offload.py:201).  When enabled, layer-stacked block params are
@@ -48,11 +50,49 @@ def param_stream_active() -> bool:
     return bool(_PARAM_STREAM.get())
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Weight-only int8 storage for serving (reference capability: inference
+    quantization / MoQ, deepspeed/inference config ``quant`` +
+    compression/).  Holds per-block symmetric int8 values + fp32 scales
+    (ops/pallas/quantization.py layout); ``maybe_stream`` reconstructs the
+    compute-dtype weight per layer inside the scan, so HBM holds 1
+    byte/param for the stacked blocks."""
+
+    def __init__(self, q, s, dtype: str = "bfloat16"):
+        self.q, self.s, self.dtype = q, s, dtype
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, children):
+        return cls(children[0], children[1], dtype)
+
+
+def _maybe_dequant(tree):
+    is_q = lambda x: isinstance(x, QuantizedTensor)
+    if not any(map(is_q, jax.tree_util.tree_leaves(tree, is_leaf=is_q))):
+        return tree
+    from deepspeed_tpu.ops.pallas.quantization import block_dequantize_int8
+
+    def dq(x):
+        if is_q(x):
+            import jax.numpy as jnp
+            return block_dequantize_int8(x.q, x.s).astype(
+                jnp.dtype(x.dtype))
+        return x
+
+    return jax.tree_util.tree_map(dq, tree, is_leaf=is_q)
+
+
 def maybe_stream(layer_tree):
     """Inside a layer-scan body: move this layer's (possibly host-resident)
-    params to device memory.  No-op unless inside ``param_stream_scope``.
+    params to device memory, and/or reconstruct int8-quantized weights
+    (``QuantizedTensor`` leaves) in compute dtype.  No-op otherwise.
     Call *inside* the remat boundary so the backward pass re-streams the
     layer instead of pinning its device copy in HBM."""
+    layer_tree = _maybe_dequant(layer_tree)
     cfg = _PARAM_STREAM.get()
     if not cfg:
         return layer_tree
