@@ -1,0 +1,155 @@
+"""Hugging Face interop: convert `transformers` checkpoints to the native
+param pytrees (reference capability: DeepSpeed wraps HF modules directly
+— init_inference(model=AutoModel...) + AutoTP; in the functional design
+the equivalent is a weight conversion into the in-tree models, after
+which every engine feature — ZeRO, TP via the hand specs, KV-cache
+serving, int8 quantization — applies unchanged).
+
+Converters accept a live `transformers` model OR its ``state_dict()``
+(anything indexable by parameter name whose values have ``.numpy()`` or
+are array-like).  Logits parity against transformers' own forward is
+asserted in tests/test_hf_interop.py.
+"""
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach()
+    if hasattr(t, "cpu"):
+        t = t.cpu()
+    if hasattr(t, "float"):
+        # torch bf16/fp16 tensors refuse .numpy(); widen first (real HF
+        # checkpoints load as bf16 with torch_dtype="auto")
+        t = t.float()
+    if hasattr(t, "numpy"):
+        return np.asarray(t.numpy(), dtype=np.float32)
+    return np.asarray(t, dtype=np.float32)
+
+
+def _state_dict(model_or_sd) -> Dict[str, Any]:
+    if hasattr(model_or_sd, "state_dict"):
+        return model_or_sd.state_dict()
+    return model_or_sd
+
+
+def gpt2_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF GPT2LMHeadModel (or its state_dict) -> (Model, params).
+
+    HF's Conv1D already stores weights [in, out] — the same layout as the
+    native blocks — so the mapping is a rename + per-layer stack."""
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"transformer.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("transformer.h."))
+    D = g("wte.weight").shape[1]
+    cfg = dict(vocab_size=g("wte.weight").shape[0],
+               max_seq_len=g("wpe.weight").shape[0],
+               num_layers=n_layers, d_model=D,
+               num_heads=overrides.pop("num_heads", None)
+               or _gpt2_heads(model_or_sd, D))
+    cfg.update(overrides)
+    model = gpt2_model("custom", **cfg)
+
+    def stack(fmt):
+        return np.stack([g(fmt.format(i)) for i in range(n_layers)])
+
+    params = {
+        "wte": g("wte.weight"),
+        "wpe": g("wpe.weight"),
+        "blocks": {
+            "ln1_scale": stack("h.{}.ln_1.weight"),
+            "ln1_bias": stack("h.{}.ln_1.bias"),
+            "qkv_w": stack("h.{}.attn.c_attn.weight"),
+            "qkv_b": stack("h.{}.attn.c_attn.bias"),
+            "proj_w": stack("h.{}.attn.c_proj.weight"),
+            "proj_b": stack("h.{}.attn.c_proj.bias"),
+            "ln2_scale": stack("h.{}.ln_2.weight"),
+            "ln2_bias": stack("h.{}.ln_2.bias"),
+            "mlp_in_w": stack("h.{}.mlp.c_fc.weight"),
+            "mlp_in_b": stack("h.{}.mlp.c_fc.bias"),
+            "mlp_out_w": stack("h.{}.mlp.c_proj.weight"),
+            "mlp_out_b": stack("h.{}.mlp.c_proj.bias"),
+        },
+        "lnf_scale": g("ln_f.weight"),
+        "lnf_bias": g("ln_f.bias"),
+    }
+    return model, params
+
+
+def _gpt2_heads(model_or_sd, d_model: int) -> int:
+    cfg = getattr(model_or_sd, "config", None)
+    if cfg is not None and getattr(cfg, "n_head", None):
+        return int(cfg.n_head)
+    # head count is not recoverable from a bare state_dict; GPT-2 family
+    # convention is hd=64
+    return max(1, d_model // 64)
+
+
+def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF LlamaForCausalLM (or its state_dict) -> (Model, params).
+
+    torch Linear stores [out, in]; the native layout is [in, out], so the
+    projection weights transpose."""
+    from deepspeed_tpu.models.llama import llama_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"model.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("model.layers."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None:
+        from deepspeed_tpu.utils.logging import warning_once
+        warning_once(
+            "llama_from_hf: bare state_dict has no config — guessing "
+            "rope_theta=10000, head_dim=64, max_seq_len=4096; pass the "
+            "transformers model (or num_heads/rope_theta overrides) for "
+            "Llama-3-family checkpoints (rope_theta=500000, hd=128)")
+    D = g("embed_tokens.weight").shape[1]
+    kv_rows = g("layers.0.self_attn.k_proj.weight").shape[0]
+    q_rows = g("layers.0.self_attn.q_proj.weight").shape[0]
+    heads = (int(hf_cfg.num_attention_heads) if hf_cfg is not None
+             else max(1, q_rows // 64))
+    hd = q_rows // heads
+    cfg = dict(vocab_size=g("embed_tokens.weight").shape[0],
+               num_layers=n_layers, d_model=D, num_heads=heads,
+               num_kv_heads=kv_rows // hd,
+               d_mlp=g("layers.0.mlp.gate_proj.weight").shape[0])
+    if hf_cfg is not None:
+        cfg["rope_theta"] = float(getattr(hf_cfg, "rope_theta", 10000.0))
+        cfg["rms_norm_eps"] = float(getattr(hf_cfg, "rms_norm_eps", 1e-5))
+        cfg["max_seq_len"] = int(getattr(hf_cfg, "max_position_embeddings",
+                                         4096))
+    cfg.update(overrides)
+    model = llama_model("custom", **cfg)
+
+    def stack_t(fmt):
+        return np.stack([g(fmt.format(i)).T for i in range(n_layers)])
+
+    def stack(fmt):
+        return np.stack([g(fmt.format(i)) for i in range(n_layers)])
+
+    params = {
+        "wte": g("embed_tokens.weight"),
+        "blocks": {
+            "attn_norm": stack("layers.{}.input_layernorm.weight"),
+            "wq": stack_t("layers.{}.self_attn.q_proj.weight"),
+            "wk": stack_t("layers.{}.self_attn.k_proj.weight"),
+            "wv": stack_t("layers.{}.self_attn.v_proj.weight"),
+            "wo": stack_t("layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("layers.{}.post_attention_layernorm.weight"),
+            "w_gate": stack_t("layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack_t("layers.{}.mlp.up_proj.weight"),
+            "w_down": stack_t("layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": g("norm.weight"),
+        # tied-embedding checkpoints (safetensors drops the shared tensor)
+        # reuse the embedding matrix as the head
+        "lm_head": _to_np(sd["lm_head.weight"]).T
+        if "lm_head.weight" in sd else g("embed_tokens.weight").T,
+    }
+    return model, params
