@@ -32,6 +32,7 @@ class BertConfig:
     num_heads: int = 12
     d_model: int = 768
     layer_norm_eps: float = 1e-12
+    gelu_approximate: bool = True   # False = erf gelu (HF BERT default)
     dtype: str = "float32"
     remat: bool = False
     remat_policy: str = "nothing"
@@ -137,7 +138,7 @@ def _block(x, layer, pad_mask, config: BertConfig):
         + layer["proj_b"].astype(x.dtype),
         layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
     h = x @ layer["mlp_in_w"].astype(x.dtype) + layer["mlp_in_b"].astype(x.dtype)
-    h = jax.nn.gelu(h, approximate=True)
+    h = jax.nn.gelu(h, approximate=config.gelu_approximate)
     return _layer_norm(
         x + h @ layer["mlp_out_w"].astype(x.dtype)
         + layer["mlp_out_b"].astype(x.dtype),
@@ -174,7 +175,7 @@ def forward(params, batch, config: BertConfig, rng=None):
 def head(params, x, config: BertConfig):
     dtype = jnp.dtype(config.dtype)
     h = x @ params["mlm_dense_w"].astype(dtype) + params["mlm_dense_b"].astype(dtype)
-    h = jax.nn.gelu(h, approximate=True)
+    h = jax.nn.gelu(h, approximate=config.gelu_approximate)
     h = _layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
                     config.layer_norm_eps)
     return (h @ params["wte"].astype(dtype).T

@@ -54,6 +54,12 @@ def gpt2_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
                or _gpt2_heads(model_or_sd, D))
     cfg.update(overrides)
     model = gpt2_model("custom", **cfg)
+    if "lm_head.weight" in sd and not np.allclose(
+            _to_np(sd["lm_head.weight"]), g("wte.weight")):
+        raise ValueError(
+            "gpt2_from_hf: checkpoint has an UNTIED lm_head; the native "
+            "gpt2 ties the head to the embedding by construction and "
+            "cannot represent it")
 
     def stack(fmt):
         return np.stack([g(fmt.format(i)) for i in range(n_layers)])
@@ -90,6 +96,77 @@ def _gpt2_heads(model_or_sd, d_model: int) -> int:
     return max(1, d_model // 64)
 
 
+def bert_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF BertForMaskedLM (or its state_dict) -> (Model, params).
+    torch Linear stores [out, in] — projections transpose; Q/K/V concat
+    into the fused qkv matrices; the MLM decoder ties to the embedding."""
+    from deepspeed_tpu.models.bert import bert_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"bert.{k}"])
+    n_layers = 1 + max(int(k.split(".")[3]) for k in sd
+                       if k.startswith("bert.encoder.layer."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    D = g("embeddings.word_embeddings.weight").shape[1]
+    cfg = dict(vocab_size=g("embeddings.word_embeddings.weight").shape[0],
+               max_seq_len=g("embeddings.position_embeddings.weight").shape[0],
+               type_vocab_size=g(
+                   "embeddings.token_type_embeddings.weight").shape[0],
+               num_layers=n_layers, d_model=D,
+               num_heads=(int(hf_cfg.num_attention_heads)
+                          if hf_cfg is not None else max(1, D // 64)),
+               # HF default act = erf gelu; gelu_new/tanh variants map to
+               # the approximate form
+               gelu_approximate=(
+                   getattr(hf_cfg, "hidden_act", "gelu")
+                   in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast")
+                   if hf_cfg is not None else False))
+    cfg.update(overrides)
+    model = bert_model("custom", **cfg)
+
+    def lay(i, k):
+        return _to_np(sd[f"bert.encoder.layer.{i}.{k}"])
+
+    def stack(k, transpose=False):
+        return np.stack([lay(i, k).T if transpose else lay(i, k)
+                         for i in range(n_layers)])
+
+    qkv_w = np.concatenate([stack("attention.self.query.weight", True),
+                            stack("attention.self.key.weight", True),
+                            stack("attention.self.value.weight", True)],
+                           axis=-1)
+    qkv_b = np.concatenate([stack("attention.self.query.bias"),
+                            stack("attention.self.key.bias"),
+                            stack("attention.self.value.bias")], axis=-1)
+    params = {
+        "wte": g("embeddings.word_embeddings.weight"),
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "wtype": g("embeddings.token_type_embeddings.weight"),
+        "emb_ln_scale": g("embeddings.LayerNorm.weight"),
+        "emb_ln_bias": g("embeddings.LayerNorm.bias"),
+        "blocks": {
+            "qkv_w": qkv_w, "qkv_b": qkv_b,
+            "proj_w": stack("attention.output.dense.weight", True),
+            "proj_b": stack("attention.output.dense.bias"),
+            "ln1_scale": stack("attention.output.LayerNorm.weight"),
+            "ln1_bias": stack("attention.output.LayerNorm.bias"),
+            "mlp_in_w": stack("intermediate.dense.weight", True),
+            "mlp_in_b": stack("intermediate.dense.bias"),
+            "mlp_out_w": stack("output.dense.weight", True),
+            "mlp_out_b": stack("output.dense.bias"),
+            "ln2_scale": stack("output.LayerNorm.weight"),
+            "ln2_bias": stack("output.LayerNorm.bias"),
+        },
+        "mlm_dense_w": _to_np(sd["cls.predictions.transform.dense.weight"]).T,
+        "mlm_dense_b": _to_np(sd["cls.predictions.transform.dense.bias"]),
+        "mlm_ln_scale": _to_np(
+            sd["cls.predictions.transform.LayerNorm.weight"]),
+        "mlm_ln_bias": _to_np(sd["cls.predictions.transform.LayerNorm.bias"]),
+        "mlm_bias": _to_np(sd["cls.predictions.bias"]),
+    }
+    return model, params
+
+
 def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
     """HF LlamaForCausalLM (or its state_dict) -> (Model, params).
 
@@ -120,6 +197,12 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
                num_kv_heads=kv_rows // hd,
                d_mlp=g("layers.0.mlp.gate_proj.weight").shape[0])
     if hf_cfg is not None:
+        if getattr(hf_cfg, "rope_scaling", None):
+            raise NotImplementedError(
+                "llama_from_hf: checkpoint uses rope_scaling="
+                f"{hf_cfg.rope_scaling!r} (Llama-3.1+ style); the native "
+                "rope() applies plain theta only — converting would "
+                "produce wrong logits at every position")
         cfg["rope_theta"] = float(getattr(hf_cfg, "rope_theta", 10000.0))
         cfg["rms_norm_eps"] = float(getattr(hf_cfg, "rms_norm_eps", 1e-5))
         cfg["max_seq_len"] = int(getattr(hf_cfg, "max_position_embeddings",

@@ -81,3 +81,30 @@ def test_bf16_checkpoint_and_tied_embeddings_convert():
     ids = np.random.default_rng(3).integers(0, 64, (1, 8)).astype(np.int32)
     out = np.asarray(model.apply(params, {"input_ids": ids}))
     assert np.all(np.isfinite(out))
+
+
+def test_bert_from_hf_logits_match():
+    from transformers import BertConfig, BertForMaskedLM
+    from deepspeed_tpu.models.hf import bert_from_hf
+    torch.manual_seed(4)
+    hf = BertForMaskedLM(BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)).eval()
+    model, params = bert_from_hf(hf, dtype="float32", attention_impl="xla")
+    rng = np.random.default_rng(4)
+    ids = rng.integers(0, 64, (2, 16)).astype(np.int32)
+    am = np.ones((2, 16), np.int32)
+    am[1, 12:] = 0                        # padded row
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64)),
+                 attention_mask=torch.tensor(am.astype(np.int64))
+                 ).logits.numpy()
+    got = np.asarray(model.apply(
+        params, {"input_ids": ids, "attention_mask": am}))
+    # compare only non-padded positions (HF still computes padded rows but
+    # their values are influenced by masked self-attention the same way)
+    np.testing.assert_allclose(got[0], ref[0], rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(got[1, :12], ref[1, :12], rtol=3e-4,
+                               atol=3e-4)
