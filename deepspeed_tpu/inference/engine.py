@@ -74,24 +74,69 @@ class InferenceEngine:
             if isinstance(params, dict) and bk in params:
                 from deepspeed_tpu.models.model import QuantizedTensor
                 from deepspeed_tpu.ops.pallas.quantization import (
-                    block_quantize_int8)
+                    BLOCK, block_quantize_int8)
                 dt = str(jnp.dtype(self.dtype))
-                pack = jax.jit(
-                    lambda x: block_quantize_int8(x.astype(self.dtype)),
-                    donate_argnums=(0,))
+                blk_logical = (logical.get(bk)
+                               if isinstance(logical, dict) else None)
 
-                def pack_leaf(x):
+                def _shard_for(spec, x, is_scales):
+                    if spec is None:
+                        return NamedSharding(self.mesh, P())
+                    if is_scales:
+                        # scales share the weight's layout when the grouped
+                        # last dim still divides over its axis; otherwise
+                        # replicate that dim (tiny tensor)
+                        C = x.shape[-1]
+                        nb = C // BLOCK if C % BLOCK == 0 else 1
+                        last = tuple(spec)[-1] if len(spec) else None
+                        tp_n = (int(np.prod([self.mesh.shape[a] for a in
+                                             ((last,) if isinstance(
+                                                 last, str) else last)]))
+                                if last else 1)
+                        if nb % max(tp_n, 1) != 0:
+                            spec = P(*tuple(spec)[:-1], None)
+                    return NamedSharding(self.mesh, spec)
+
+                import functools
+
+                @functools.lru_cache(maxsize=None)
+                def _packer(out_shardings):
+                    # one trace per unique (shape→sharding) class: llama's
+                    # wq/wk/wv etc. share a compiled quantization program
+                    return jax.jit(
+                        lambda v: block_quantize_int8(v.astype(self.dtype)),
+                        donate_argnums=(0,), out_shardings=out_shardings)
+
+                def pack_leaf(x, spec):
                     # >=3-dim floating = the stacked [L, in, out] weight
                     # mats (2-dim biases/norms stay full precision:
-                    # negligible bytes, free accuracy)
-                    if (jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                            and np.ndim(x) >= 3):
-                        q, s = pack(jnp.asarray(x))
+                    # negligible bytes, free accuracy).  q/s inherit the
+                    # weight's TP layout so int8 serving composes with
+                    # tensor parallelism.
+                    x = jnp.asarray(x)
+                    if not jnp.issubdtype(x.dtype, jnp.floating):
+                        return x        # non-float buffers pass through
+                    if x.ndim >= 3:
+                        fn = _packer((_shard_for(spec, x, False),
+                                      _shard_for(spec, x, True)))
+                        q, s = fn(x)
                         return QuantizedTensor(q, s, dt)
+                    if spec is not None:
+                        return jax.device_put(
+                            x.astype(self.dtype),
+                            NamedSharding(self.mesh, spec))
                     return x
 
                 params = dict(params)
-                quant_blocks = jax.tree.map(pack_leaf, params.pop(bk))
+                blk = params.pop(bk)
+                leaves, treedef = jax.tree_util.tree_flatten(blk)
+                if blk_logical is not None:
+                    spec_leaves = treedef.flatten_up_to(blk_logical)
+                else:
+                    spec_leaves = [None] * len(leaves)
+                quant_blocks = jax.tree_util.tree_unflatten(
+                    treedef, [pack_leaf(x, sp)
+                              for x, sp in zip(leaves, spec_leaves)])
             else:
                 warning_once(
                     f"quant.enabled: params tree has no {bk!r} subtree — "
@@ -102,8 +147,7 @@ class InferenceEngine:
                 lambda s: NamedSharding(self.mesh, s), logical,
                 is_leaf=lambda x: isinstance(x, P))
             if quant_blocks is not None and isinstance(shardings, dict):
-                # quantized blocks were placed at pack time (replicated;
-                # TP-sharded int8 layouts are a follow-up)
+                # quantized blocks were placed (TP-sharded) at pack time
                 shardings = {k: v for k, v in shardings.items() if k != bk}
             params = jax.device_put(params, shardings)
         else:
