@@ -26,6 +26,9 @@ class QuantizationConfig(DeepSpeedConfigModel):
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     dtype: str = "bfloat16"
+    #: "int8" = quantized KV cache (per-vector scales): half the HBM bytes
+    #: the bandwidth-bound decode kernel streams; None = compute dtype
+    kv_cache_dtype: Optional[str] = None
     tensor_parallel: DeepSpeedTPConfig = Field(
         default_factory=DeepSpeedTPConfig, alias="tp")
     moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)

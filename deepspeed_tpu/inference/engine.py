@@ -240,9 +240,11 @@ class InferenceEngine:
         # to a 64 multiple (positions never exceed `total`; the tail is dead)
         cache_size = -(-total // 64) * 64
 
+        cache_dtype = self._config.kv_cache_dtype or dtype
+
         def gen(params, tokens_padded, lengths, rng, temperature):
             B = tokens_padded.shape[0]
-            cache = model.init_cache_fn(B, cache_size, dtype)
+            cache = model.init_cache_fn(B, cache_size, cache_dtype)
             logits, cache = model.prefill_fn(
                 params, {"input_ids": tokens_padded}, cache)
             last = logits[jnp.arange(B), lengths - 1]       # [B, V]

@@ -291,9 +291,18 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
 # decode is a lax.scan over layers with a single-token decode-attention kernel.
 
 def init_cache(config: GPT2Config, batch_size: int, max_len: int, dtype=None):
-    dtype = jnp.dtype(dtype or config.dtype)
+    """``dtype="int8"`` selects the quantized cache: int8 payload + one
+    fp32 scale per cached head-vector — half the HBM bytes the
+    bandwidth-bound decode kernel must stream."""
     L, H, hd = config.num_layers, config.num_heads, config.head_dim
     shape = (L, batch_size, max_len, H, hd)
+    if str(dtype) == "int8":
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.ones(sshape, jnp.float32),
+                "v_s": jnp.ones(sshape, jnp.float32)}
+    dtype = jnp.dtype(dtype or config.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -313,6 +322,19 @@ def prefill(params, batch, cache, config: GPT2Config):
         return out, (kk, v)
 
     x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    if "k_s" in cache:      # int8 cache: quantize the prefill block
+        from deepspeed_tpu.ops.pallas.decode_attention import quantize_kv
+        kq, ksc = quantize_kv(ks)
+        vq, vsc = quantize_kv(vs)
+        cache = {
+            "k": lax.dynamic_update_slice(cache["k"], kq, (0, 0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(cache["v"], vq, (0, 0, 0, 0, 0)),
+            "k_s": lax.dynamic_update_slice(cache["k_s"], ksc,
+                                            (0, 0, 0, 0)),
+            "v_s": lax.dynamic_update_slice(cache["v_s"], vsc,
+                                            (0, 0, 0, 0)),
+        }
+        return head(params, x, config), cache
     cache = {
         "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
                                       (0, 0, 0, 0, 0)),
@@ -334,19 +356,42 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config):
          params["wpe"].astype(dtype)[lengths])              # [B, D]
     rows = jnp.arange(B)
 
+    quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
+
     def body(carry, layer_kv):
-        layer, kc, vc = layer_kv
+        if quantized:
+            layer, kc, vc, ksc, vsc = layer_kv
+        else:
+            layer, kc, vc = layer_kv
+            ksc = vsc = None
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config)
-        kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
-        vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-        attn = decode_attention(q[:, 0], kc, vc, lengths + 1)
+        if quantized:
+            from deepspeed_tpu.ops.pallas.decode_attention import quantize_kv
+            kq, ks1 = quantize_kv(kk[:, 0])
+            vq, vs1 = quantize_kv(v[:, 0])
+            kc = kc.at[rows, lengths].set(kq)
+            vc = vc.at[rows, lengths].set(vq)
+            ksc = ksc.at[rows, lengths].set(ks1)
+            vsc = vsc.at[rows, lengths].set(vs1)
+        else:
+            kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
+                                k_scale=ksc, v_scale=vsc)
         out = _block_finish(carry, attn.reshape(B, D).astype(carry.dtype),
                             layer, config)
-        return out, (kc, vc)
+        return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    xs = (params["blocks"], cache["k"], cache["v"])
+    if quantized:
+        xs += (cache["k_s"], cache["v_s"])
+    x, ys = lax.scan(body, x, xs)
     logits = head(params, x[:, None, :], config)[:, 0]
+    if quantized:
+        ks, vs, kss, vss = ys
+        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    ks, vs = ys
     return logits, {"k": ks, "v": vs}
 
 

@@ -217,6 +217,10 @@ def forward(params, batch, config: LlamaConfig, rng=None):
 
 # --------------------------------------------------------------------- decode
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None):
+    if str(dtype) == "int8":
+        raise NotImplementedError(
+            "llama: int8 KV cache is not wired yet (gpt2 has it); "
+            "kv_cache_dtype='int8' would silently truncate bf16 K/V here")
     dtype = jnp.dtype(dtype or config.dtype)
     L, KV, hd = config.num_layers, config.num_kv_heads, config.head_dim
     shape = (L, batch_size, max_len, KV, hd)

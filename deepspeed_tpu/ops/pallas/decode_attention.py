@@ -37,11 +37,18 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, block_s, kv_heads, head_dim,
-                   rep, sm_scale, precision):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
+                   head_dim, rep, sm_scale, precision, quantized):
     """Grid: (B, num_s_blocks); S is the minor (sequential) dimension so the
-    online-softmax state in scratch carries across S-blocks of one row."""
+    online-softmax state in scratch carries across S-blocks of one row.
+
+    ``quantized``: k/v blocks are int8 with per-(position, kv-head) fp32
+    scales (two extra inputs) — the cache stream halves its HBM bytes and
+    dequantizes on the VPU in VMEM."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     s_idx = pl.program_id(1)
     n_s = pl.num_programs(1)
     cache_len = len_ref[pl.program_id(0)]
@@ -57,19 +64,32 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     # entire block beyond this row's cache: skip the compute
     @pl.when(s_start < cache_len)
     def _compute():
-        k = k_ref[:]                               # [bs, KV*hd]
-        v = v_ref[:]
-        # validity mask for positions inside this block
-        pos = s_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_s, kv_heads), 0)     # [bs, KV]
-        valid = pos < cache_len
-
-        # block-diagonal expansion masks (built once per block; VPU-cheap)
+        # block-diagonal expansion mask (built once per block; VPU-cheap):
+        # the ONE source of the lane-packing layout — scale expansion and
+        # prob expansion both derive from it
         row_group = jax.lax.broadcasted_iota(
             jnp.int32, (Dk, kv_heads), 0) // head_dim       # [Dk, KV]
         col_head = jax.lax.broadcasted_iota(
             jnp.int32, (Dk, kv_heads), 1)                   # [Dk, KV]
         blockdiag = (row_group == col_head)                 # [Dk, KV] bool
+
+        if quantized:
+            # expand per-kv-head scales onto the packed lanes with one
+            # [bs, KV] x [KV, Dk] matmul
+            expand = blockdiag.astype(jnp.float32).T        # [KV, Dk]
+            k_sc = jax.lax.dot(ks_ref[:], expand,
+                               preferred_element_type=jnp.float32)
+            v_sc = jax.lax.dot(vs_ref[:], expand,
+                               preferred_element_type=jnp.float32)
+            k = k_ref[:].astype(jnp.float32) * k_sc          # [bs, Dk]
+            v = v_ref[:].astype(jnp.float32) * v_sc
+        else:
+            k = k_ref[:]                           # [bs, KV*hd]
+            v = v_ref[:]
+        # validity mask for positions inside this block
+        pos = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, kv_heads), 0)     # [bs, KV]
+        valid = pos < cache_len
 
         for r in range(rep):
             # minor-dim insertion on bf16 vectors is unsupported by Mosaic;
@@ -116,13 +136,30 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
                            jnp.maximum(l_exp, 1e-30)).astype(o_ref.dtype)
 
 
+def quantize_kv(x):
+    """[..., KV, hd] -> (int8 [..., KV, hd], fp32 scales [..., KV]): one
+    symmetric scale per cached head-vector (the int8 KV-cache layout)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale):
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 def decode_attention_pallas(q, k_cache, v_cache, cache_len,
-                            sm_scale=None, block_s: int = 512):
+                            sm_scale=None, block_s: int = 512,
+                            k_scale=None, v_scale=None):
     """q: [B, H, hd]; k/v_cache: [B, S_max, KV, hd]; cache_len: [B] int32.
-    Returns [B, H, hd]."""
+    int8 caches pass their per-vector fp32 ``k_scale``/``v_scale``
+    [B, S_max, KV].  Returns [B, H, hd]."""
     B, H, hd = q.shape
     _, S_max, KV, _ = k_cache.shape
     rep = H // KV
+    quantized = k_scale is not None
     if sm_scale is None:
         sm_scale = hd ** -0.5
     # pick the largest tile-aligned block that divides S_max; pad the cache
@@ -136,6 +173,9 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
         pad = -S_max % 128
         k_cache = jnp.pad(k_cache, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v_cache = jnp.pad(v_cache, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if quantized:
+            k_scale = jnp.pad(k_scale, ((0, 0), (0, pad), (0, 0)))
+            v_scale = jnp.pad(v_scale, ((0, 0), (0, pad), (0, 0)))
         S_max += pad
         block_s = min(block_s, S_max)
         while S_max % block_s:
@@ -154,21 +194,29 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                  else None)
     kernel = partial(_decode_kernel, block_s=block_s, kv_heads=KV,
                      head_dim=hd, rep=rep, sm_scale=sm_scale,
-                     precision=precision)
+                     precision=precision, quantized=quantized)
+    cache_spec = pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
+                              memory_space=pltpu.VMEM)
+    in_specs = [
+        # whole cache_len vector in SMEM (TPU lowering rejects 1-element
+        # rank-1 blocks); the kernel indexes it by program_id
+        pl.BlockSpec((B,), lambda b, s: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((None, rep, Dk), lambda b, s: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        cache_spec,
+        cache_spec,
+    ]
+    args = [cache_len.astype(jnp.int32), qp, kp, vp]
+    if quantized:
+        scale_spec = pl.BlockSpec((None, block_s, KV),
+                                  lambda b, s: (b, s, 0),
+                                  memory_space=pltpu.VMEM)
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         kernel,
         grid=(B, S_max // block_s),
-        in_specs=[
-            # whole cache_len vector in SMEM (TPU lowering rejects 1-element
-            # rank-1 blocks); the kernel indexes it by program_id
-            pl.BlockSpec((B,), lambda b, s: (0,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, rep, Dk), lambda b, s: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, rep, Dk), lambda b, s: (b, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, rep, Dk), q.dtype),
@@ -177,14 +225,18 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
             pltpu.VMEM((rep, KV), jnp.float32),   # l
             pltpu.VMEM((rep, Dk), jnp.float32),   # acc
         ],
-    )(cache_len.astype(jnp.int32), qp, kp, vp)
+    )(*args)
     # unpack group-major -> head-major
     return out.reshape(B, rep, KV, hd).transpose(0, 2, 1, 3).reshape(B, H, hd)
 
 
-def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None):
+def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
+                         k_scale=None, v_scale=None):
     """Reference/fallback implementation (CPU meshes, numeric tests).
     Same signature as the Pallas kernel."""
+    if k_scale is not None:
+        k_cache = dequantize_kv(k_cache, k_scale).astype(q.dtype)
+        v_cache = dequantize_kv(v_cache, v_scale).astype(q.dtype)
     B, H, hd = q.shape
     _, S_max, KV, _ = k_cache.shape
     if sm_scale is None:
@@ -203,11 +255,15 @@ def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None):
     return jnp.einsum("bhs,bshd->bhd", probs, v_cache, precision=prec)
 
 
-def decode_attention(q, k_cache, v_cache, cache_len, sm_scale=None):
-    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere."""
+def decode_attention(q, k_cache, v_cache, cache_len, sm_scale=None,
+                     k_scale=None, v_scale=None):
+    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.  int8
+    caches pass per-vector fp32 scales (see ``quantize_kv``)."""
     from deepspeed_tpu.ops.attention import _on_tpu
     if _on_tpu():
         return decode_attention_pallas(q, k_cache, v_cache, cache_len,
-                                       sm_scale=sm_scale)
+                                       sm_scale=sm_scale, k_scale=k_scale,
+                                       v_scale=v_scale)
     return decode_attention_xla(q, k_cache, v_cache, cache_len,
-                                sm_scale=sm_scale)
+                                sm_scale=sm_scale, k_scale=k_scale,
+                                v_scale=v_scale)

@@ -193,3 +193,54 @@ def test_cached_decode_is_o1_per_token():
     # a decode step touches one token's activations + the cache: it must be
     # a small fraction of re-running the whole forward
     assert per_token < f_forward / 8, (per_token, f_forward)
+
+
+# ----------------------------------------------------------- int8 KV cache
+
+def test_quantize_kv_roundtrip():
+    from deepspeed_tpu.ops.pallas.decode_attention import (quantize_kv,
+                                                           dequantize_kv)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 4, 8)), jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 16, 4)
+    back = dequantize_kv(q, s)
+    # symmetric per-vector int8: <1% of the vector's amax
+    err = np.abs(np.asarray(back - x))
+    amax = np.abs(np.asarray(x)).max(-1, keepdims=True)
+    assert float((err / np.maximum(amax, 1e-6)).max()) < 0.01
+
+
+def test_decode_attention_int8_cache_close_to_fp():
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, quantize_kv)
+    rng = np.random.default_rng(1)
+    B, S, H, hd = 2, 64, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    lens = jnp.asarray([48, 64], jnp.int32)
+    ref = decode_attention(q, k, v, lens)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    got = decode_attention(q, kq, vq, lens, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.03)
+
+
+def test_generate_with_int8_kv_cache(devices8):
+    """kv_cache_dtype='int8': the cache stores int8 + scales, generations
+    track the full-precision cache closely."""
+    import deepspeed_tpu
+    from tests.util import tiny_gpt2, random_batch
+    m = tiny_gpt2(d_model=64, num_heads=4)
+    params = m.init(jax.random.PRNGKey(0))
+    ref = deepspeed_tpu.init_inference(model=m, config={"dtype": "float32"},
+                                       model_parameters=params)
+    q8 = deepspeed_tpu.init_inference(
+        model=m, config={"dtype": "float32", "kv_cache_dtype": "int8"},
+        model_parameters=params)
+    b = random_batch(batch_size=2, seq_len=12)
+    o1 = np.asarray(ref.generate(b["input_ids"], max_new_tokens=10))
+    o2 = np.asarray(q8.generate(b["input_ids"], max_new_tokens=10))
+    agree = (o1[:, -10:] == o2[:, -10:]).mean()
+    assert agree >= 0.7, agree
