@@ -323,18 +323,10 @@ def prefill(params, batch, cache, config: GPT2Config):
 
     x, (ks, vs) = lax.scan(body, x, params["blocks"])
     if "k_s" in cache:      # int8 cache: quantize the prefill block
-        from deepspeed_tpu.ops.pallas.decode_attention import quantize_kv
-        kq, ksc = quantize_kv(ks)
-        vq, vsc = quantize_kv(vs)
-        cache = {
-            "k": lax.dynamic_update_slice(cache["k"], kq, (0, 0, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(cache["v"], vq, (0, 0, 0, 0, 0)),
-            "k_s": lax.dynamic_update_slice(cache["k_s"], ksc,
-                                            (0, 0, 0, 0)),
-            "v_s": lax.dynamic_update_slice(cache["v_s"], vsc,
-                                            (0, 0, 0, 0)),
-        }
-        return head(params, x, config), cache
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            quantize_prefill_into_cache)
+        return (head(params, x, config),
+                quantize_prefill_into_cache(cache, ks, vs))
     cache = {
         "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
                                       (0, 0, 0, 0, 0)),
@@ -367,13 +359,10 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config):
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config)
         if quantized:
-            from deepspeed_tpu.ops.pallas.decode_attention import quantize_kv
-            kq, ks1 = quantize_kv(kk[:, 0])
-            vq, vs1 = quantize_kv(v[:, 0])
-            kc = kc.at[rows, lengths].set(kq)
-            vc = vc.at[rows, lengths].set(vq)
-            ksc = ksc.at[rows, lengths].set(ks1)
-            vsc = vsc.at[rows, lengths].set(vs1)
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                quantize_token_into_cache)
+            kc, vc, ksc, vsc = quantize_token_into_cache(
+                kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
         else:
             kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
             vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))

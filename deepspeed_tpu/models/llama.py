@@ -217,13 +217,16 @@ def forward(params, batch, config: LlamaConfig, rng=None):
 
 # --------------------------------------------------------------------- decode
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None):
-    if str(dtype) == "int8":
-        raise NotImplementedError(
-            "llama: int8 KV cache is not wired yet (gpt2 has it); "
-            "kv_cache_dtype='int8' would silently truncate bf16 K/V here")
-    dtype = jnp.dtype(dtype or config.dtype)
+    """``dtype="int8"``: quantized cache (int8 payload + one fp32 scale per
+    cached KV-head vector) — see models/gpt2.py init_cache."""
     L, KV, hd = config.num_layers, config.num_kv_heads, config.head_dim
     shape = (L, batch_size, max_len, KV, hd)
+    if str(dtype) == "int8":
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.ones(shape[:-1], jnp.float32),
+                "v_s": jnp.ones(shape[:-1], jnp.float32)}
+    dtype = jnp.dtype(dtype or config.dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -250,6 +253,11 @@ def prefill(params, batch, cache, config: LlamaConfig):
         return out, (kk, v)
 
     x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    if "k_s" in cache:      # int8 cache: quantize the prefill block
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            quantize_prefill_into_cache)
+        return (head(params, x, config),
+                quantize_prefill_into_cache(cache, ks, vs))
     cache = {
         "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
                                       (0, 0, 0, 0, 0)),
@@ -270,21 +278,41 @@ def decode_step(params, tokens, cache, lengths, config: LlamaConfig):
     x = params["wte"].astype(dtype)[tokens]                 # [B, D]
     rows = jnp.arange(B)
 
+    quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
+
     def body(carry, layer_kv):
-        layer, kc, vc = layer_kv
+        if quantized:
+            layer, kc, vc, ksc, vsc = layer_kv
+        else:
+            layer, kc, vc = layer_kv
+            ksc = vsc = None
         from deepspeed_tpu.models.model import maybe_stream
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config,
                               positions=lengths[:, None])
-        kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
-        vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-        attn = decode_attention(q[:, 0], kc, vc, lengths + 1)
+        if quantized:
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                quantize_token_into_cache)
+            kc, vc, ksc, vsc = quantize_token_into_cache(
+                kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
+        else:
+            kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
+                                k_scale=ksc, v_scale=vsc)
         out = _block_finish(carry, attn.reshape(B, H * hd).astype(carry.dtype),
                             layer, config)
-        return out, (kc, vc)
+        return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    xs = (params["blocks"], cache["k"], cache["v"])
+    if quantized:
+        xs += (cache["k_s"], cache["v_s"])
+    x, ys = lax.scan(body, x, xs)
     logits = head(params, x[:, None, :], config)[:, 0]
+    if quantized:
+        ks, vs, kss, vss = ys
+        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    ks, vs = ys
     return logits, {"k": ks, "v": vs}
 
 

@@ -150,6 +150,30 @@ def dequantize_kv(q, scale):
     return q.astype(jnp.float32) * scale[..., None]
 
 
+def quantize_prefill_into_cache(cache, ks, vs):
+    """Quantize a prefill's stacked K/V ([L, B, S, KV, hd]) and write them
+    into the int8 cache dict (shared by every KV-cache model)."""
+    kq, ksc = quantize_kv(ks)
+    vq, vsc = quantize_kv(vs)
+    return {
+        "k": jax.lax.dynamic_update_slice(cache["k"], kq, (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vq, (0, 0, 0, 0, 0)),
+        "k_s": jax.lax.dynamic_update_slice(cache["k_s"], ksc,
+                                            (0, 0, 0, 0)),
+        "v_s": jax.lax.dynamic_update_slice(cache["v_s"], vsc,
+                                            (0, 0, 0, 0)),
+    }
+
+
+def quantize_token_into_cache(kc, vc, ksc, vsc, rows, lengths, k_new, v_new):
+    """Quantize one decode step's K/V vectors ([B, KV, hd]) and write them
+    at each row's fill position (shared by every KV-cache model)."""
+    kq, ks1 = quantize_kv(k_new)
+    vq, vs1 = quantize_kv(v_new)
+    return (kc.at[rows, lengths].set(kq), vc.at[rows, lengths].set(vq),
+            ksc.at[rows, lengths].set(ks1), vsc.at[rows, lengths].set(vs1))
+
+
 def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                             sm_scale=None, block_s: int = 512,
                             k_scale=None, v_scale=None):

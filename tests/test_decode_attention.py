@@ -244,3 +244,22 @@ def test_generate_with_int8_kv_cache(devices8):
     o2 = np.asarray(q8.generate(b["input_ids"], max_new_tokens=10))
     agree = (o1[:, -10:] == o2[:, -10:]).mean()
     assert agree >= 0.7, agree
+
+
+def test_generate_with_int8_kv_cache_llama_gqa(devices8):
+    """int8 KV cache on llama: the compact GQA cache quantizes per KV-head
+    vector and generations track the full-precision cache."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    m = llama_model("tiny", attention_impl="xla", dtype="float32")
+    params = m.init(jax.random.PRNGKey(0))
+    ref = deepspeed_tpu.init_inference(model=m, config={"dtype": "float32"},
+                                       model_parameters=params)
+    q8 = deepspeed_tpu.init_inference(
+        model=m, config={"dtype": "float32", "kv_cache_dtype": "int8"},
+        model_parameters=params)
+    ids = np.random.default_rng(5).integers(0, 256, (2, 12)).astype(np.int32)
+    o1 = np.asarray(ref.generate(ids, max_new_tokens=10))
+    o2 = np.asarray(q8.generate(ids, max_new_tokens=10))
+    agree = (o1[:, -10:] == o2[:, -10:]).mean()
+    assert agree >= 0.7, agree
