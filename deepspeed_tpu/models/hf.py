@@ -179,18 +179,20 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
     n_layers = 1 + max(int(k.split(".")[2]) for k in sd
                        if k.startswith("model.layers."))
     hf_cfg = getattr(model_or_sd, "config", None)
-    if hf_cfg is None:
-        from deepspeed_tpu.utils.logging import warning_once
-        warning_once(
-            "llama_from_hf: bare state_dict has no config — guessing "
-            "rope_theta=10000, head_dim=64, max_seq_len=4096; pass the "
-            "transformers model (or num_heads/rope_theta overrides) for "
-            "Llama-3-family checkpoints (rope_theta=500000, hd=128)")
+    if hf_cfg is None and not {"num_heads", "rope_theta"} <= set(overrides):
+        # same reject-what-you-cannot-represent policy as the rope_scaling
+        # check below: head_dim/theta are not recoverable from a bare state
+        # dict, and guessed values silently corrupt every position for
+        # Llama-3-family checkpoints (rope_theta=500000, hd=128)
+        raise ValueError(
+            "llama_from_hf: bare state_dict carries no config — pass the "
+            "transformers model, or supply both num_heads= and rope_theta= "
+            "overrides (and max_seq_len= if not 4096)")
     D = g("embed_tokens.weight").shape[1]
     kv_rows = g("layers.0.self_attn.k_proj.weight").shape[0]
     q_rows = g("layers.0.self_attn.q_proj.weight").shape[0]
     heads = (int(hf_cfg.num_attention_heads) if hf_cfg is not None
-             else max(1, q_rows // 64))
+             else int(overrides["num_heads"]))
     hd = q_rows // heads
     cfg = dict(vocab_size=g("embed_tokens.weight").shape[0],
                num_layers=n_layers, d_model=D, num_heads=heads,

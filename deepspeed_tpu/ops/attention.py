@@ -25,7 +25,8 @@ def xla_causal_attention(q, k, v):
     fp32 softmax accumulation for bf16 inputs."""
     B, S, H, hd = q.shape
     scale = hd ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     mask = jnp.tril(jnp.ones((S, S), dtype=bool))
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -79,7 +80,8 @@ def xla_bidirectional_attention(q, k, v, pad_mask=None):
     (1 = real token).  fp32 softmax accumulation."""
     B, S, H, hd = q.shape
     scale = hd ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if pad_mask is not None:
         scores = jnp.where(pad_mask[:, None, None, :].astype(bool), scores,
                            jnp.finfo(jnp.float32).min)

@@ -57,7 +57,19 @@ def _np_fast_cast(x: np.ndarray, dtype):
         b = x.view(np.uint32)
         rounded = b + np.uint32(0x7FFF) + ((b >> np.uint32(16))
                                            & np.uint32(1))
-        return (rounded >> np.uint32(16)).astype(np.uint16).view(dtype)
+        out = (rounded >> np.uint32(16)).astype(np.uint16)
+        # the rounding increment wraps for NaN/Inf payloads (a negative NaN
+        # like 0xFFFF8001 would come out +0.0); pass non-finite bits through
+        # truncated instead of rounded, forcing a quiet bit for NaNs whose
+        # payload lives only in the truncated low 16 bits (else they'd
+        # become Inf)
+        nonfinite = (b & np.uint32(0x7F800000)) == np.uint32(0x7F800000)
+        if nonfinite.any():
+            trunc = (b >> np.uint32(16)).astype(np.uint16)
+            is_nan = nonfinite & ((b & np.uint32(0x007FFFFF)) != 0)
+            trunc = np.where(is_nan, trunc | np.uint16(0x0040), trunc)
+            out = np.where(nonfinite, trunc, out)
+        return out.view(dtype)
     return x.astype(dtype)
 
 

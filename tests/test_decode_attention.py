@@ -227,6 +227,28 @@ def test_decode_attention_int8_cache_close_to_fp():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.03)
 
 
+@pytest.mark.parametrize("B,H,KV,hd,Smax,bs", [
+    (2, 4, 4, 64, 256, 128),     # MHA, multi-block
+    (2, 8, 2, 64, 256, 256),     # GQA rep=4, single block
+])
+def test_decode_kernel_int8_matches_xla(interpret_pallas, B, H, KV, hd,
+                                        Smax, bs):
+    """Quantized branch of the Pallas kernel (scale BlockSpecs + the
+    block-diagonal scale-expansion matmuls in _decode_kernel) in interpret
+    mode — CI otherwise only exercises it on real TPU (ADVICE r2)."""
+    rng = np.random.default_rng(7)
+    q = jnp.array(rng.standard_normal((B, H, hd)), jnp.float32)
+    k = jnp.array(rng.standard_normal((B, Smax, KV, hd)), jnp.float32)
+    v = jnp.array(rng.standard_normal((B, Smax, KV, hd)), jnp.float32)
+    lens = jnp.array(rng.integers(1, Smax + 1, B), jnp.int32)
+    kq, ks = da.quantize_kv(k)
+    vq, vs = da.quantize_kv(v)
+    ref = da.decode_attention_xla(q, kq, vq, lens, k_scale=ks, v_scale=vs)
+    out = da.decode_attention_pallas(q, kq, vq, lens, block_s=bs,
+                                     k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_generate_with_int8_kv_cache(devices8):
     """kv_cache_dtype='int8': the cache stores int8 + scales, generations
     track the full-precision cache closely."""
