@@ -37,7 +37,13 @@ def param_stream_scope(enabled: bool = True, mesh=None, layer_specs=None,
     - ``qwz`` — ZeRO++ quantized weight gather.  ``layer_specs`` is a flat
       list of (storage_spec, target_spec) pairs (None = leaf skips): the
       leaf quantizes to int8, all-gathers in the target layout, and
-      dequantizes (runtime/zero/zeropp.py)."""
+      dequantizes (runtime/zero/zeropp.py).
+    - ``qgz`` — ZeRO++ quantized-gradient shard_map tier: ``layer_specs``
+      is a flat list of kwargs dicts for
+      ``runtime/zero/zeropp.gather_with_quantized_grad`` (None = leaf
+      skips).  Each layer slice all-gathers over the manual zero axes in
+      the forward (int8 wire when qwZ is also on) and its cotangent
+      reduce-scatters as int8 chunks in the backward."""
     value = (mode, mesh, layer_specs) if enabled else False
     token = _PARAM_STREAM.set(value)
     try:
@@ -105,6 +111,13 @@ def maybe_stream(layer_tree):
         moved = [w if sp is None
                  else quantized_weight_gather(w, mesh, sp[0], sp[1])
                  for w, sp in zip(leaves, layer_specs)]
+        return jax.tree_util.tree_unflatten(treedef, moved)
+    if mode == "qgz":
+        from deepspeed_tpu.runtime.zero.zeropp import \
+            gather_with_quantized_grad
+        assert layer_specs is not None and len(layer_specs) == len(leaves)
+        moved = [w if kw is None else gather_with_quantized_grad(w, **kw)
+                 for w, kw in zip(leaves, layer_specs)]
         return jax.tree_util.tree_unflatten(treedef, moved)
     if mesh is None or layer_specs is None:
         targets = [jax.memory.Space.Device] * len(leaves)

@@ -199,7 +199,8 @@ class DeepSpeedEngine:
             if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
         self.param_specs = self.zero_policy.param_specs(shapes, logical)
         self._warned_qwz_no_blocks = False
-        if zc.zero_quantized_weights and zc.stage == 3:
+        if (zc.zero_quantized_weights or zc.zero_quantized_gradients) \
+                and zc.stage == 3:
             bk = getattr(model, "blocks_key", "blocks")
             if isinstance(self.param_specs, dict) and bk in self.param_specs:
                 # qwZ quantizes each LAYER slice before its gather, so the
@@ -233,11 +234,12 @@ class DeepSpeedEngine:
                          in zip(specs_flat, shapes_flat, lg_flat)]
                 self.param_specs[bk] = jax.tree_util.tree_unflatten(
                     treedef, fixed)
-        if zc.zero_quantized_gradients and (self._offload or zc.stage >= 3):
+        if zc.zero_quantized_gradients and (self._offload
+                                            or self._offload_param):
             logger.warning(
                 "zero_quantized_gradients engages only in train_batch's "
-                "compiled step at ZeRO stages 0-2 without optimizer "
-                "offload; this config reduces gradients in full precision")
+                "compiled step without optimizer/param offload; this "
+                "config reduces gradients in full precision")
         if (zc.zero_hpz_partition_size > 1 and
                 self.topology.axis_size(("seq", "model")) > 1):
             logger.warning(
@@ -332,6 +334,8 @@ class DeepSpeedEngine:
         else:
             params = jax.device_put(_tree_cast(model_parameters, storage_dtype),
                                     self.param_shardings)
+        self._param_shapes = shapes
+        self._qgz_plan = "unbuilt"
         self.grad_specs = self.zero_policy.grad_specs(params, logical)
         self.grad_shardings = self.zero_policy.shardings(self.grad_specs)
         devices_flat = list(self.mesh.devices.flat)
@@ -654,20 +658,60 @@ class DeepSpeedEngine:
         return loss.astype(jnp.float32) * scale
 
     # ------------------------------------------------------------------ train step
-    def _qgz_grad_fn(self):
-        """Custom gradient-reduction tier: ZeRO++ qgZ
-        (zero_quantized_gradients — block-quantized all-to-all instead of
-        the fp32 reduce-scatter, reference zeropp.md:15) and/or sparse
-        embedding gradients (sparse_gradients — touched-rows exchange,
-        reference runtime/sparse_tensor.py).  Pure-DP meshes only — inside
-        the shard_map each device computes LOCAL grads on its batch shard,
-        so the custom exchanges see genuinely unreduced contributions.
-        Returns a (params, stacked_local_batch, rng, scale) -> (loss, grads)
-        fn to splice into the train step, or None when inapplicable."""
-        from jax import shard_map
-        from deepspeed_tpu.runtime.zero.zeropp import quantized_psum_scatter
-        from deepspeed_tpu.runtime.sparse_tensor import (
-            sparse_embedding_allreduce)
+    @staticmethod
+    def _restrict_spec(spec, keep) -> P:
+        """Drop every axis not in ``keep`` from a PartitionSpec."""
+        entries = []
+        for e in tuple(spec):
+            if e is None:
+                entries.append(None)
+                continue
+            axes = e if isinstance(e, (tuple, list)) else (e,)
+            kept = tuple(a for a in axes if a in keep)
+            entries.append(kept if kept else None)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    @staticmethod
+    def _manual_dims(spec, ndim, manual):
+        """[(dim, axes)] for every dim of ``spec`` carrying manual axes."""
+        out = []
+        for d, e in enumerate(tuple(spec)[:ndim]):
+            if e is None:
+                continue
+            axes = e if isinstance(e, (tuple, list)) else (e,)
+            hit = tuple(a for a in axes if a in manual)
+            if hit:
+                out.append((d, hit))
+        return out
+
+    def _get_qgz_plan(self):
+        """Static plan for the generalized qgZ / sparse-gradient tier
+        (reference ZeRO++ qgZ, docs/_tutorials/zeropp.md:15 + stage3.py:84
+        ctor args): a partially-manual shard_map — manual over the wide
+        ``data``/``hpz`` axes, auto over expert/seq/model/pipe — where
+
+        - stage-3 zero-sharded params enter as shards and all-gather at
+          point of use (per layer inside the scan via the model's
+          ``maybe_stream`` hook; int8 wire when qwZ is also on), with a
+          custom VJP that reduce-scatters the cotangent as int8 chunks —
+          gradients accumulate *sharded*;
+        - replicated-over-manual leaves reduce once per step in a
+          post-accumulation epilogue: touched-rows exchange for declared
+          sparse embeddings, hierarchical int8 reduce-scatter for dense
+          leaves, exact psum for tiny/ragged ones.
+
+        Reductions over the auto axes (expert/seq/model) stay XLA-inserted
+        full-precision collectives.  Returns None when the tier cannot
+        engage (no wide data/hpz axis, offload tiers, nothing enabled)."""
+        if self._qgz_plan != "unbuilt":
+            return self._qgz_plan
+        self._qgz_plan = self._build_qgz_plan()
+        return self._qgz_plan
+
+    def _build_qgz_plan(self):
+        from deepspeed_tpu.comm.mesh import DATA_AXIS, HPZ_AXIS
         zc = self._config.zero_config
         declared = self.model.meta.get("sparse_grad_params", {})
         if not isinstance(declared, dict):     # list shorthand -> input_ids
@@ -678,52 +722,214 @@ class DeepSpeedEngine:
             logger.warning(
                 "sparse_gradients: model declares no sparse_grad_params "
                 "(tied embeddings get dense head contributions); ignoring")
-        if not zc.zero_quantized_gradients and not sparse_leaves:
+        qgz = bool(zc.zero_quantized_gradients)
+        if not qgz and not sparse_leaves:
             return None
-        dp_axes = tuple(self.topology.data_parallel_axes)
-        n = self.topology.axis_size(dp_axes)
-        non_dp = self.topology.world_size // max(n, 1)
-        wide_axes = [a for a in dp_axes if self.mesh.shape[a] > 1]
-        if n <= 1 or non_dp != 1 or len(wide_axes) != 1:
-            # the exchange runs over ONE axis: a dp group spread over
-            # several >1 axes (hpz/expert carved out) would leave the other
-            # axes unreduced
+        if self._offload or self._offload_param:
+            return None                      # warned at init (both tiers)
+        if self.model.meta.get("pipeline"):
             logger.warning(
-                "zero_quantized_gradients/sparse_gradients require a pure "
-                "data-parallel mesh with a single data axis (model/seq/"
-                "pipe/expert/hpz sizes 1); reducing dense in full precision")
+                "zero_quantized_gradients/sparse_gradients do not apply to "
+                "the pipeline train step; reducing dense in full precision")
             return None
-        if zc.stage >= 3:
-            # the shard_map body sees replicated params/grads, which would
-            # gather the stage-3 param shards; reference qgZ keeps sharded
-            # state — not expressible in this formulation yet
+        mesh = self.mesh
+        manual = tuple(a for a in (DATA_AXIS, HPZ_AXIS)
+                       if mesh.shape[a] > 1)
+        if not manual:
             logger.warning(
-                "zero_quantized_gradients/sparse_gradients support ZeRO "
-                "stages 0-2; stage 3 reduces dense in full precision")
+                "zero_quantized_gradients/sparse_gradients: no wide "
+                "data/hpz mesh axis to exchange over; reducing dense in "
+                "full precision")
+            return None
+        n_manual = 1
+        for a in manual:
+            n_manual *= mesh.shape[a]
+        if sparse_leaves and zc.stage >= 3:
+            logger.warning(
+                "sparse_gradients: ZeRO stage 3 shards embedding storage; "
+                "declared sparse params use the dense quantized exchange")
+            sparse_leaves = {}
+
+        shapes = self._param_shapes
+        bk = getattr(self.model, "blocks_key", "blocks")
+        keyed = jax.tree_util.tree_flatten_with_path(shapes)
+        paths = [p for p, _ in keyed[0]]
+        shape_leaves = [l for _, l in keyed[0]]
+        treedef = keyed[1]
+        pspec_leaves = jax.tree.leaves(self.param_specs,
+                                       is_leaf=lambda x: isinstance(x, P))
+        gspec_leaves = jax.tree.leaves(self.grad_specs,
+                                       is_leaf=lambda x: isinstance(x, P))
+        mesh_shape = dict(mesh.shape)
+
+        in_spec_leaves, out_spec_leaves = [], []
+        wrap_leaves, epilogue = [], []
+        for path, shp, pspec, gspec in zip(paths, shape_leaves,
+                                           pspec_leaves, gspec_leaves):
+            ndim = len(shp.shape)
+            top = getattr(path[0], "key", None) if path else None
+            is_block = top == bk
+            wrapped = self._manual_dims(pspec, ndim, manual)
+            in_spec_leaves.append(self._restrict_spec(pspec, manual))
+            wrapped_axes = {a for _, axes in wrapped for a in axes}
+            remaining = [a for a in manual if a not in wrapped_axes]
+            if wrapped:
+                wrap_leaves.append(dict(
+                    dims_axes=tuple(wrapped),
+                    mesh_shape=mesh_shape,
+                    quantize_fwd=bool(zc.zero_quantized_weights)))
+            else:
+                wrap_leaves.append(None)
+            # epilogue plan for the axes no wrapper reduced
+            produced = [[] for _ in range(ndim)]
+            for d, axes in wrapped:
+                produced[d] = list(axes)
+            local_dims = list(shp.shape)
+            for d, axes in wrapped:
+                for a in axes:
+                    local_dims[d] //= mesh_shape[a]
+            plan = ("none", None)
+            if remaining:
+                total = 1
+                for s in shp.shape:
+                    total *= s
+                if (top in sparse_leaves and ndim == 2
+                        and not wrapped_axes):
+                    plan = ("sparse", sparse_leaves[top], tuple(remaining))
+                elif not qgz or total <= n_manual * 8:
+                    plan = ("psum", tuple(remaining))
+                else:
+                    # place remaining axes where the grad spec wants them
+                    # (stage >= 2), else dim 0 with a gather-back (stage
+                    # 0/1 keeps replicated grads)
+                    target = self._manual_dims(gspec, ndim, remaining)
+                    ops, placed = [], set()
+                    for d, axes in target:
+                        for a in axes:
+                            if a in placed:
+                                continue
+                            if local_dims[d] % mesh_shape[a] == 0 \
+                                    and local_dims[d] >= mesh_shape[a]:
+                                ops.append((d, a))
+                                produced[d].append(a)
+                                local_dims[d] //= mesh_shape[a]
+                                placed.add(a)
+                    leftover = [a for a in remaining if a not in placed]
+                    for a in leftover:
+                        for d in range(ndim):
+                            if local_dims[d] % mesh_shape[a] == 0 \
+                                    and local_dims[d] >= mesh_shape[a]:
+                                ops.append((d, a))
+                                produced[d].append(a)
+                                local_dims[d] //= mesh_shape[a]
+                                placed.add(a)
+                                break
+                    still = tuple(a for a in remaining if a not in placed)
+                    if ops and not still and not wrapped and \
+                            not self._manual_dims(gspec, ndim, manual):
+                        # grads replicated over manual (stage 0/1):
+                        # exchange int8 but hand back the full leaf
+                        plan = ("scatter_gather", tuple(ops))
+                        for d, a in ops:
+                            produced[d].remove(a)
+                    elif ops:
+                        plan = ("scatter", tuple(ops), still)
+                    else:
+                        plan = ("psum", tuple(remaining))
+            epilogue.append(plan)
+            out_spec_leaves.append(P(*[
+                tuple(e) if len(e) > 1 else (e[0] if e else None)
+                for e in produced]))
+
+        # block layer slices: scope kwargs with the stacked dim stripped
+        block_scope = None
+        if isinstance(shapes, dict) and bk in shapes and any(
+                w is not None and getattr(p[0], "key", None) == bk
+                for w, p in zip(wrap_leaves, paths)):
+            blk_keyed = jax.tree_util.tree_flatten_with_path(shapes[bk])
+            block_scope = []
+            for w, p in zip(wrap_leaves, paths):
+                if getattr(p[0], "key", None) != bk:
+                    continue
+                if w is None:
+                    block_scope.append(None)
+                else:
+                    da = tuple((d - 1, axes) for d, axes in w["dims_axes"]
+                               if d >= 1)
+                    if any(d == 0 for d, _ in w["dims_axes"]):
+                        raise ValueError(
+                            "qgZ: stacked layer dim still zero-sharded "
+                            "for a blocks leaf — storage spec rewrite "
+                            "failed")
+                    block_scope.append(dict(
+                        dims_axes=da, mesh_shape=mesh_shape,
+                        quantize_fwd=w["quantize_fwd"]) if da else None)
+            assert len(block_scope) == len(blk_keyed[0])
+
+        nonblock_wrap = [None if (getattr(p[0], "key", None) == bk) else w
+                         for w, p in zip(wrap_leaves, paths)]
+        return dict(
+            manual=manual, n_manual=n_manual, qgz=qgz,
+            sparse=sparse_leaves, treedef=treedef,
+            in_specs=in_spec_leaves, out_specs=out_spec_leaves,
+            nonblock_wrap=nonblock_wrap, block_scope=block_scope,
+            epilogue=epilogue, paths=paths)
+
+    def _qgz_grad_fn(self):
+        """(params, stacked_local_batch, rng, scale) -> (loss, grads) via
+        the generalized quantized/sparse gradient exchange (see
+        ``_get_qgz_plan``), or None when the tier cannot engage."""
+        from jax import shard_map, lax
+        from deepspeed_tpu.runtime.zero.zeropp import (
+            gather_with_quantized_grad, quantized_psum_scatter)
+        from deepspeed_tpu.runtime.sparse_tensor import (
+            sparse_embedding_allreduce)
+        plan = self._get_qgz_plan()
+        if plan is None:
             return None
         gas = self.gradient_accumulation_steps()
         mesh = self.mesh
-        from jax import lax
-        # the actual >1-sized axis inside the dp group
-        axname = wide_axes[0]
-        batch_spec = P(None, dp_axes, SEQ_AXIS)
+        manual, n_manual = plan["manual"], plan["n_manual"]
+        mesh_shape = dict(mesh.shape)
+        treedef = plan["treedef"]
+        dp_axes = tuple(self.topology.data_parallel_axes)
+        batch_dp = tuple(a for a in dp_axes if a in manual)
+        batch_entries = (None, batch_dp if len(batch_dp) > 1
+                         else (batch_dp[0] if batch_dp else None))
+        wrap_any = any(w is not None for w in plan["nonblock_wrap"])
 
         def grad_fn(params, stacked_batch, rng, scale):
-            replicated = jax.tree.map(lambda _: P(), params)
+            p_specs = jax.tree.unflatten(treedef, plan["in_specs"])
             b_specs = jax.tree.map(
-                lambda x: P(*tuple(batch_spec)[:x.ndim]), stacked_batch)
+                lambda x: P(*batch_entries[:x.ndim]), stacked_batch)
+            g_specs = jax.tree.unflatten(treedef, plan["out_specs"])
 
             def body(p, b, r, s):
-                # independent dropout/noise per DP rank (the jit path draws
-                # one mask over the global batch; replicated keys would give
-                # every shard an identical mask)
-                from jax import lax as _lax
-                r = jax.random.fold_in(r, _lax.axis_index(axname))
+                # independent dropout/noise per manual shard (a replicated
+                # key would give every shard an identical mask)
+                for a in manual:
+                    r = jax.random.fold_in(r, lax.axis_index(a))
+
+                def loss_fn(prm, mb, rng_, sc):
+                    cparams = _tree_cast(prm, self.compute_dtype)
+                    if wrap_any:
+                        leaves = jax.tree.leaves(cparams)
+                        leaves = [
+                            lf if kw is None
+                            else gather_with_quantized_grad(lf, **kw)
+                            for lf, kw in zip(leaves,
+                                              plan["nonblock_wrap"])]
+                        cparams = jax.tree.unflatten(treedef, leaves)
+                    loss = self.model.loss(cparams, mb, rng_)
+                    return loss.astype(jnp.float32) * sc
 
                 def micro(carry, mb):
                     g_acc, l_acc = carry
-                    loss, g = jax.value_and_grad(self._scaled_loss_fn)(
-                        p, mb, r, s / gas)
+                    # loss pre-scaled by 1/n_manual: every exchange below
+                    # (and the wrapper VJPs) SUMS over the manual axes, so
+                    # the sum lands on the global-batch mean
+                    loss, g = jax.value_and_grad(loss_fn)(
+                        p, mb, r, s / (gas * n_manual))
                     g = _tree_cast(g, jnp.float32)
                     return (jax.tree.map(jnp.add, g_acc, g),
                             l_acc + loss), None
@@ -733,33 +939,47 @@ class DeepSpeedEngine:
                 (local_g, local_l), _ = jax.lax.scan(
                     micro, (zeros, jnp.float32(0.0)), b)
 
-                # per-leaf exchange: declared embedding leaves move only the
-                # rows touched by their declared batch ids field; with qgZ
-                # the rest reduce-scatter int8 chunks over dim 0 and
-                # re-gather (/ n = mean over devices); tiny/ragged leaves
-                # take the exact pmean
-                def reduce_leaf(path, g):
-                    top = getattr(path[0], "key", None) if path else None
-                    if top in sparse_leaves and g.ndim == 2:
-                        return sparse_embedding_allreduce(
-                            g, b[sparse_leaves[top]], axname, n)
-                    if (zc.zero_quantized_gradients and g.ndim >= 1
-                            and g.shape[0] % n == 0 and g.size > n):
-                        chunk = quantized_psum_scatter(g, axname, n=n,
-                                                       scatter_dim=0)
-                        return lax.all_gather(chunk, axname, axis=0,
-                                              tiled=True) / n
-                    return lax.pmean(g, axname)
-
-                g_red = jax.tree_util.tree_map_with_path(reduce_leaf,
-                                                         local_g)
-                loss = lax.pmean(local_l, axname)
+                g_leaves = jax.tree.leaves(local_g)
+                out = []
+                for g, ep in zip(g_leaves, plan["epilogue"]):
+                    kind = ep[0]
+                    if kind == "none":
+                        out.append(g)
+                    elif kind == "sparse":
+                        _, ids_key, axes = ep
+                        na = 1
+                        for a in axes:
+                            na *= mesh_shape[a]
+                        out.append(sparse_embedding_allreduce(
+                            g, b[ids_key], axes, na, mean=False))
+                    elif kind == "psum":
+                        out.append(lax.psum(g, ep[1]))
+                    elif kind == "scatter_gather":
+                        full = g
+                        for d, a in ep[1]:
+                            full = quantized_psum_scatter(
+                                full, a, n=mesh_shape[a], scatter_dim=d)
+                        for d, a in reversed(ep[1]):
+                            full = lax.all_gather(full, a, axis=d,
+                                                  tiled=True)
+                        out.append(full)
+                    else:                      # "scatter"
+                        _, ops, still = ep
+                        for d, a in ops:
+                            g = quantized_psum_scatter(
+                                g, a, n=mesh_shape[a], scatter_dim=d)
+                        if still:
+                            g = lax.psum(g, still)
+                        out.append(g)
+                g_red = jax.tree.unflatten(treedef, out)
+                loss = lax.psum(local_l, manual)
                 return loss, g_red
 
             return shard_map(
                 body, mesh=mesh,
-                in_specs=(replicated, b_specs, P(), P()),
-                out_specs=(P(), jax.tree.map(lambda _: P(), params)),
+                in_specs=(p_specs, b_specs, P(), P()),
+                out_specs=(P(), g_specs),
+                axis_names=set(manual),
                 check_vma=False)(params, stacked_batch, rng, scale)
 
         return grad_fn
@@ -1045,6 +1265,19 @@ class DeepSpeedEngine:
         return fn
 
     # ------------------------------------------------------------------ data utils
+    def _train_scope(self):
+        """Scope for the compiled train step.  When the generalized qgZ
+        tier engages with stage-3 block wrappers, models must gather each
+        layer slice through the quantized-VJP wrapper (maybe_stream mode
+        "qgz") instead of the jit-path qwZ/stream scopes."""
+        plan = self._get_qgz_plan()
+        if plan is not None and plan["block_scope"] is not None:
+            from deepspeed_tpu.models.model import param_stream_scope
+            return param_stream_scope(True, mesh=self.mesh,
+                                      layer_specs=plan["block_scope"],
+                                      mode="qgz")
+        return self._stream_scope()
+
     def _stream_scope(self):
         """param_stream_scope when offload_param is on (tracing of the wrapped
         compiled fn happens on its first call, inside this scope)."""
@@ -1261,7 +1494,7 @@ class DeepSpeedEngine:
             metrics = self._host_apply(grads, loss)
         else:
             fn = self._get_compiled("train_step")
-            with self._stream_scope(), self._ltd_scope():
+            with self._train_scope(), self._ltd_scope():
                 self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
         # syncing on the loss every step costs a device->host round trip

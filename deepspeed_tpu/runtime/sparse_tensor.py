@@ -21,9 +21,9 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def sparse_embedding_allreduce(g, ids, axis_name, n: int):
-    """Mean-reduce a lookup-embedding gradient over the DP axis by
-    exchanging only the touched rows.
+def sparse_embedding_allreduce(g, ids, axis_name, n: int, mean: bool = True):
+    """Reduce a lookup-embedding gradient over DP axes by exchanging only
+    the touched rows.
 
     **Collective — call inside a shard_map body.**
 
@@ -32,10 +32,15 @@ def sparse_embedding_allreduce(g, ids, axis_name, n: int):
            non-zero only at ``ids``).
         ids: [T] int32 token ids of this device's batch window (with
            duplicates; every id whose row is non-zero must appear).
-        axis_name: DP mesh axis.
-        n: axis size.
+        axis_name: DP mesh axis name, or a tuple of names — a tuple runs
+           the exchange hierarchically (axis by axis), the touched-id set
+           widening per hop, matching the multi-axis manual meshes of the
+           generalized qgZ tier.
+        n: total size across the named axes.
+        mean: divide the reduced rows by ``n`` (set False when the caller
+           pre-scaled the loss by 1/n so the sum is already the mean).
     Returns:
-        [V, D] the exact mean gradient over the axis.
+        [V, D] the exact mean (or sum) gradient over the axes.
     """
     ids = ids.reshape(-1)
     # counts in f32 regardless of g.dtype: a bf16 accumulator saturates its
@@ -44,7 +49,12 @@ def sparse_embedding_allreduce(g, ids, axis_name, n: int):
     # each occurrence carries row/count so duplicates sum back to the row
     rows = (g[ids].astype(jnp.float32)
             / jnp.maximum(counts, 1.0)[ids][:, None])           # [T, D]
-    all_ids = lax.all_gather(ids, axis_name, tiled=True)        # [n*T]
-    all_rows = lax.all_gather(rows, axis_name, tiled=True)      # [n*T, D]
-    out = jnp.zeros(g.shape, jnp.float32).at[all_ids].add(all_rows) / n
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    for a in axes:
+        # each hop widens the (ids, rows) set to the whole group's
+        ids = lax.all_gather(ids, a, tiled=True)                # [na*T]
+        rows = lax.all_gather(rows, a, tiled=True)              # [na*T, D]
+    out = jnp.zeros(g.shape, jnp.float32).at[ids].add(rows)
+    if mean:
+        out = out / n
     return out.astype(g.dtype)

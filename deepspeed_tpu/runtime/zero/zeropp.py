@@ -54,6 +54,71 @@ def quantized_weight_gather(w, mesh, storage_spec: P, target_spec: P):
     return f(w)
 
 
+def _allgather_dims(x, dims_axes):
+    """Rebuild the axes listed in ``dims_axes`` ([(dim, (axis, ...)), ...]).
+    Gathers innermost-first per dim so the concatenation order matches the
+    PartitionSpec entry order (leftmost axis = major)."""
+    for dim, axes in dims_axes:
+        for a in reversed(tuple(axes)):
+            x = lax.all_gather(x, a, axis=dim, tiled=True)
+    return x
+
+
+def quantized_scatter_dims(g, dims_axes, mesh_shape):
+    """Hierarchical quantized reduce-scatter: for each (dim, axes) apply
+    :func:`quantized_psum_scatter` per axis in spec order (outer axis first),
+    so the final chunk layout matches ``P(axes)`` on that dim.  Two-hop
+    meshes (data, hpz) thus reproduce the reference qgZ's hierarchical
+    all-to-all (docs/_tutorials/zeropp.md:15)."""
+    for dim, axes in dims_axes:
+        for a in tuple(axes):
+            g = quantized_psum_scatter(g, a, n=mesh_shape[a],
+                                       scatter_dim=dim)
+    return g
+
+
+def gather_with_quantized_grad(w, dims_axes, mesh_shape,
+                               quantize_fwd: bool = False,
+                               wsc=None):
+    """ZeRO-3 param gather whose backward is the qgZ quantized
+    reduce-scatter (reference stage3.py:84 ``zero_quantized_gradients``).
+
+    **Call inside a shard_map body** manual over every axis in
+    ``dims_axes``.  Forward rebuilds the full array (int8-quantized gather
+    when ``quantize_fwd`` — the qwZ wire format, partition_parameters.py:652);
+    backward block-quantizes the cotangent and all-to-alls int8 chunks back
+    to the storage layout, summing (callers pre-scale the loss by the
+    reciprocal axis size so the sum is the mean).
+    """
+
+    def _fwd_impl(x):
+        if quantize_fwd:
+            q, s = block_quantize_int8(x)
+            q = _allgather_dims(q, dims_axes)
+            s = _allgather_dims(s, dims_axes)
+            out = block_dequantize_int8(q, s).astype(x.dtype)
+        else:
+            out = _allgather_dims(x, dims_axes)
+        if wsc is not None:
+            out = lax.with_sharding_constraint(out, wsc)
+        return out
+
+    @jax.custom_vjp
+    def f(x):
+        return _fwd_impl(x)
+
+    def fwd(x):
+        return _fwd_impl(x), None
+
+    def bwd(_, g):
+        red = quantized_scatter_dims(g.astype(jnp.float32), dims_axes,
+                                     mesh_shape)
+        return (red.astype(g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return f(w)
+
+
 def quantized_psum_scatter(v, axis_name, n: int, scatter_dim: int = 0):
     """qgZ: block-quantized gradient reduce-scatter.
 

@@ -258,13 +258,111 @@ def test_qgz_int8_on_the_wire(devices8):
     assert any("s8[" in l for l in comm_lines), comm_lines[:5]
 
 
-def test_qgz_falls_back_on_non_dp_mesh(devices8):
-    """TP in the mesh: qgZ must warn and reduce exactly (not crash)."""
+def test_qgz_engages_on_hybrid_tp_mesh(devices8):
+    """TP×DP mesh: the generalized tier is manual over the data axis and
+    auto over model — qgZ engages (round-2 VERDICT item 1: no more
+    single-axis pure-DP restriction) and tracks the exact-reduction run."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            mesh={"model_parallel_size": 2},
+            zero_optimization={"stage": 2}))
     engine, *_ = deepspeed_tpu.initialize(
         model=tiny_gpt2(), config=base_config(
             mesh={"model_parallel_size": 2},
+            zero_optimization={"stage": 2,
+                               "zero_quantized_gradients": True}))
+    assert engine._get_qgz_plan() is not None, "qgZ did not engage on TP mesh"
+    l_ref = _train(ref, steps=4, seed=3)
+    l_qgz = _train(engine, steps=4, seed=3)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+
+
+def test_qgz_falls_back_without_wide_data_axis(devices8):
+    """A mesh whose data/hpz axes are all size 1 (everything in model×seq)
+    has nothing to exchange over: qgZ must warn, return no plan, and train
+    with exact reduction."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(num_heads=8), config=base_config(
+            mesh={"model_parallel_size": 4, "sequence_parallel_size": 2},
             zero_optimization={"stage": 1,
                                "zero_quantized_gradients": True}))
+    assert engine._get_qgz_plan() is None
     b = random_batches(1, batch_size=8, seed=3)[0]
     loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
     assert np.isfinite(float(loss))
+
+
+def test_qgz_stage3_trains_to_parity(devices8):
+    """stage-3 + zero_quantized_gradients (round-2 VERDICT item 1): the
+    per-layer gather carries a quantized-reduce-scatter VJP; training
+    tracks plain stage 3."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3,
+                               "stage3_param_persistence_threshold": 0}))
+    qgz, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3,
+                               "zero_quantized_gradients": True,
+                               "stage3_param_persistence_threshold": 0}))
+    plan = qgz._get_qgz_plan()
+    assert plan is not None and plan["block_scope"] is not None
+    l_ref = _train(ref, steps=4, seed=59)
+    l_qgz = _train(qgz, steps=4, seed=59)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+
+
+def test_qgz_stage3_int8_on_the_wire(devices8):
+    """The stage-3 compiled step's gradient exchange must move s8 chunks
+    (the 'int8 asserted in the dryrun HLO' done-criterion)."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3,
+                               "zero_quantized_gradients": True,
+                               "stage3_param_persistence_threshold": 0}))
+    b = random_batches(1, batch_size=8, seed=5)[0]
+    batch = engine._shard_batch({"input_ids": b["input_ids"][None]},
+                                stacked=True)
+    fn = engine._get_compiled("train_step")
+    with engine._train_scope():
+        lowered = fn.lower(engine.state, batch, engine._next_rng())
+    hlo = lowered.compile().as_text()
+    comm_lines = [l for l in hlo.splitlines()
+                  if "all-to-all" in l or "all-gather" in l]
+    assert any("s8[" in l for l in comm_lines), comm_lines[:5]
+
+
+def test_qgz_stage3_with_hpz(devices8):
+    """qgZ composes with the hpZ secondary shard: params gather over hpz
+    (wrapper), the data-axis reduction runs in the epilogue."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3, "zero_hpz_partition_size": 2,
+                               "stage3_param_persistence_threshold": 0}))
+    qgz, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3, "zero_hpz_partition_size": 2,
+                               "zero_quantized_gradients": True,
+                               "stage3_param_persistence_threshold": 0}))
+    assert qgz._get_qgz_plan() is not None
+    l_ref = _train(ref, steps=3, seed=67)
+    l_qgz = _train(qgz, steps=3, seed=67)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+
+
+def test_qgz_with_qwz_combined(devices8):
+    """qwZ + qgZ together (full ZeRO++): the layer gather moves int8 both
+    ways — forward weight gather and backward gradient scatter."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3,
+                               "stage3_param_persistence_threshold": 0}))
+    zpp, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 3,
+                               "zero_quantized_weights": True,
+                               "zero_quantized_gradients": True,
+                               "stage3_param_persistence_threshold": 0}))
+    l_ref = _train(ref, steps=4, seed=71)
+    l_zpp = _train(zpp, steps=4, seed=71)
+    np.testing.assert_allclose(l_zpp, l_ref, rtol=0.08, atol=0.08)
