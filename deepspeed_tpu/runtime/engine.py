@@ -464,6 +464,46 @@ class DeepSpeedEngine:
                                    scaler),
         }
 
+        # 1-bit optimizer error-feedback buffers (reference zoadam.py /
+        # onebit adam worker_error+server_error): per-device residuals of
+        # the sign-compressed exchange, stored as [n_manual, ...] arrays
+        # sharded over the manual axes so each device owns its own slice
+        plan = self._get_qgz_plan()
+        if plan is not None and plan["onebit"] is not None:
+            n_m, manual = plan["n_manual"], plan["manual"]
+            err_shapes, srv_shapes = [], []
+            for ep, shp in zip(plan["epilogue"], plan["shapes"]):
+                if ep[0] == "onebit":
+                    size = 1
+                    for s in shp:
+                        size *= s
+                    err_shapes.append((n_m,) + tuple(shp))
+                    # size-1 placeholder when the leaf has no server stage
+                    # (orbax cannot checkpoint zero-size arrays)
+                    srv_shapes.append((n_m, size // n_m)
+                                      if ep[2] else (n_m, 1))
+                else:
+                    err_shapes.append((n_m, 1))
+                    srv_shapes.append((n_m, 1))
+            tdef = plan["treedef"]
+            ob_shard = NamedSharding(self.mesh, P(manual))
+            ob_shardings = {
+                "error": jax.tree.unflatten(tdef, [ob_shard] * len(err_shapes)),
+                "server": jax.tree.unflatten(tdef, [ob_shard] * len(srv_shapes)),
+                "var_interval": NamedSharding(self.mesh, P()),
+                "var_counter": NamedSharding(self.mesh, P()),
+            }
+            self.state["onebit"] = jax.jit(
+                lambda: {
+                    "error": jax.tree.unflatten(tdef, [
+                        jnp.zeros(s, jnp.float32) for s in err_shapes]),
+                    "server": jax.tree.unflatten(tdef, [
+                        jnp.zeros(s, jnp.float32) for s in srv_shapes]),
+                    "var_interval": jnp.ones((), jnp.int32),
+                    "var_counter": jnp.zeros((), jnp.int32),
+                }, out_shardings=ob_shardings)()
+            self.state_shardings["onebit"] = ob_shardings
+
         # ---- batch sharding --------------------------------------------------
         dp_axes = self.topology.data_parallel_axes
         self.batch_spec = P(dp_axes, SEQ_AXIS)
@@ -710,6 +750,9 @@ class DeepSpeedEngine:
         self._qgz_plan = self._build_qgz_plan()
         return self._qgz_plan
 
+    #: optimizer names whose compressed exchange rides the shard_map tier
+    _ONEBIT_OPTS = ("onebitadam", "onebitlamb", "zerooneadam")
+
     def _build_qgz_plan(self):
         from deepspeed_tpu.comm.mesh import DATA_AXIS, HPZ_AXIS
         zc = self._config.zero_config
@@ -723,7 +766,18 @@ class DeepSpeedEngine:
                 "sparse_gradients: model declares no sparse_grad_params "
                 "(tied embeddings get dense head contributions); ignoring")
         qgz = bool(zc.zero_quantized_gradients)
-        if not qgz and not sparse_leaves:
+        opt_name = (self._config.optimizer_name or "").lower()
+        onebit_kind = opt_name if opt_name in self._ONEBIT_OPTS else None
+        if onebit_kind and zc.stage >= 3:
+            # reference 1-bit optimizers pair with ZeRO stage <= 1; the
+            # stage-3 sharded-param formulation has its own quantized wire
+            # (qgZ wrappers) — warn and reduce this config's grads densely
+            logger.warning(
+                "1-bit optimizers engage their compressed exchange at ZeRO "
+                "stages 0-2; stage 3 reduces gradients in full precision "
+                "(enable zero_quantized_gradients for an int8 stage-3 wire)")
+            onebit_kind = None
+        if not qgz and not sparse_leaves and not onebit_kind:
             return None
         if self._offload or self._offload_param:
             return None                      # warned at init (both tiers)
@@ -796,6 +850,14 @@ class DeepSpeedEngine:
                 if (top in sparse_leaves and ndim == 2
                         and not wrapped_axes):
                     plan = ("sparse", sparse_leaves[top], tuple(remaining))
+                elif (onebit_kind and not wrapped_axes
+                        and total > n_manual * 8):
+                    # 1-bit error-feedback exchange (dense at the schedule's
+                    # sync steps, sign+scale otherwise); third field: leaf
+                    # splits evenly -> two-phase exchange with server
+                    # residual
+                    plan = ("onebit", tuple(remaining),
+                            total % n_manual == 0)
                 elif not qgz or total <= n_manual * 8:
                     plan = ("psum", tuple(remaining))
                 else:
@@ -868,28 +930,40 @@ class DeepSpeedEngine:
 
         nonblock_wrap = [None if (getattr(p[0], "key", None) == bk) else w
                          for w, p in zip(wrap_leaves, paths)]
+        onebit_cfg = None
+        if onebit_kind and any(e[0] == "onebit" for e in epilogue):
+            op = self._config.optimizer_params or {}
+            onebit_cfg = dict(
+                kind=onebit_kind,
+                freeze_step=int(op.get("freeze_step", 100)),
+                var_freeze_step=int(op.get("var_freeze_step", 100000)),
+                var_update_scaler=int(op.get("var_update_scaler", 16)))
         return dict(
             manual=manual, n_manual=n_manual, qgz=qgz,
             sparse=sparse_leaves, treedef=treedef,
             in_specs=in_spec_leaves, out_specs=out_spec_leaves,
             nonblock_wrap=nonblock_wrap, block_scope=block_scope,
-            epilogue=epilogue, paths=paths)
+            epilogue=epilogue, paths=paths, onebit=onebit_cfg,
+            shapes=[tuple(s.shape) for s in shape_leaves])
 
     def _qgz_grad_fn(self):
-        """(params, stacked_local_batch, rng, scale) -> (loss, grads) via
-        the generalized quantized/sparse gradient exchange (see
-        ``_get_qgz_plan``), or None when the tier cannot engage."""
+        """(params, stacked_local_batch, rng, scale[, dense_now, ob]) ->
+        (loss, grads[, new_ob]) via the generalized quantized/sparse/1-bit
+        gradient exchange (see ``_get_qgz_plan``), or None when the tier
+        cannot engage."""
         from jax import shard_map, lax
         from deepspeed_tpu.runtime.zero.zeropp import (
             gather_with_quantized_grad, quantized_psum_scatter)
         from deepspeed_tpu.runtime.sparse_tensor import (
             sparse_embedding_allreduce)
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
         plan = self._get_qgz_plan()
         if plan is None:
             return None
         gas = self.gradient_accumulation_steps()
         mesh = self.mesh
         manual, n_manual = plan["manual"], plan["n_manual"]
+        onebit = plan["onebit"]
         mesh_shape = dict(mesh.shape)
         treedef = plan["treedef"]
         dp_axes = tuple(self.topology.data_parallel_axes)
@@ -897,14 +971,17 @@ class DeepSpeedEngine:
         batch_entries = (None, batch_dp if len(batch_dp) > 1
                          else (batch_dp[0] if batch_dp else None))
         wrap_any = any(w is not None for w in plan["nonblock_wrap"])
+        ob_axis = manual if len(manual) > 1 else manual[0]
 
-        def grad_fn(params, stacked_batch, rng, scale):
+        def grad_fn(params, stacked_batch, rng, scale,
+                    dense_now=None, ob=None):
             p_specs = jax.tree.unflatten(treedef, plan["in_specs"])
             b_specs = jax.tree.map(
                 lambda x: P(*batch_entries[:x.ndim]), stacked_batch)
             g_specs = jax.tree.unflatten(treedef, plan["out_specs"])
+            ob_spec = P(manual)
 
-            def body(p, b, r, s):
+            def body(p, b, r, s, dense, err, srv):
                 # independent dropout/noise per manual shard (a replicated
                 # key would give every shard an identical mask)
                 for a in manual:
@@ -940,9 +1017,45 @@ class DeepSpeedEngine:
                     micro, (zeros, jnp.float32(0.0)), b)
 
                 g_leaves = jax.tree.leaves(local_g)
-                out = []
-                for g, ep in zip(g_leaves, plan["epilogue"]):
+                err_leaves = (jax.tree.leaves(err) if err is not None
+                              else [None] * len(g_leaves))
+                srv_leaves = (jax.tree.leaves(srv) if srv is not None
+                              else [None] * len(g_leaves))
+                out, new_err, new_srv = [], [], []
+                for g, ep, e, sv in zip(g_leaves, plan["epilogue"],
+                                        err_leaves, srv_leaves):
                     kind = ep[0]
+                    if kind == "onebit":
+                        # per-device residual slice: [1, ...] -> [...]
+                        e0, sv0 = e[0], sv[0]
+
+                        def dense_branch(gg, ee, ss):
+                            # sync step: exact sum (loss pre-scaled 1/n);
+                            # residuals pass through untouched (reference
+                            # dense steps don't touch worker_error)
+                            return lax.psum(gg, ep[1]), ee, ss
+
+                        def compressed_branch(gg, ee, ss):
+                            if ep[2]:
+                                red, ne, ns = compressed_allreduce(
+                                    gg, ee, ob_axis, n=n_manual,
+                                    server_error=ss)
+                            else:
+                                red, ne = compressed_allreduce(
+                                    gg, ee, ob_axis, n=n_manual)
+                                ns = ss
+                            # exchange returns the mean of 1/n-scaled
+                            # local grads; x n lands on the global mean
+                            return (red * n_manual).astype(gg.dtype), ne, ns
+
+                        gr, ne, ns = lax.cond(dense, dense_branch,
+                                              compressed_branch, g, e0, sv0)
+                        out.append(gr)
+                        new_err.append(ne[None])
+                        new_srv.append(ns[None])
+                        continue
+                    new_err.append(e)
+                    new_srv.append(sv)
                     if kind == "none":
                         out.append(g)
                     elif kind == "sparse":
@@ -973,14 +1086,31 @@ class DeepSpeedEngine:
                         out.append(g)
                 g_red = jax.tree.unflatten(treedef, out)
                 loss = lax.psum(local_l, manual)
-                return loss, g_red
+                if err is None:
+                    return loss, g_red
+                return (loss, g_red,
+                        jax.tree.unflatten(treedef, new_err),
+                        jax.tree.unflatten(treedef, new_srv))
 
-            return shard_map(
+            if onebit is None:
+                return shard_map(
+                    lambda p, b, r, s: body(p, b, r, s, None, None, None),
+                    mesh=mesh,
+                    in_specs=(p_specs, b_specs, P(), P()),
+                    out_specs=(P(), g_specs),
+                    axis_names=set(manual),
+                    check_vma=False)(params, stacked_batch, rng, scale)
+            ob_specs = jax.tree.map(lambda _: ob_spec, ob["error"],
+                                    is_leaf=lambda x: hasattr(x, "shape"))
+            loss, grads, new_err, new_srv = shard_map(
                 body, mesh=mesh,
-                in_specs=(p_specs, b_specs, P(), P()),
-                out_specs=(P(), g_specs),
+                in_specs=(p_specs, b_specs, P(), P(), P(),
+                          ob_specs, ob_specs),
+                out_specs=(P(), g_specs, ob_specs, ob_specs),
                 axis_names=set(manual),
-                check_vma=False)(params, stacked_batch, rng, scale)
+                check_vma=False)(params, stacked_batch, rng, scale,
+                                 dense_now, ob["error"], ob["server"])
+            return loss, grads, {"error": new_err, "server": new_srv}
 
         return grad_fn
 
@@ -993,12 +1123,58 @@ class DeepSpeedEngine:
         policy = self.zero_policy
 
         qgz_fn = self._qgz_grad_fn()
+        plan = self._get_qgz_plan()
+        onebit = plan["onebit"] if plan is not None else None
 
         def train_step(state, stacked_batch, rng):
             """stacked_batch leaves: [gas, global_micro, ...]."""
             params, opt_state = state["params"], state["opt_state"]
             scaler = state["scaler"]
             scale = scaler.cur_scale if fp16 else jnp.float32(1.0)
+
+            if qgz_fn is not None and onebit is not None:
+                # dense-vs-1-bit decision per step (reference schedule):
+                # OnebitAdam/Lamb sync densely through freeze_step;
+                # ZeroOneAdam syncs densely only at variance-update steps
+                # (var_schedule_step recurrence, mirrored by the optimizer)
+                from deepspeed_tpu.runtime.fp16.onebit.zoadam import \
+                    var_schedule_step
+                ob = state["onebit"]
+                count = state["step"] + 1
+                if onebit["kind"] == "zerooneadam":
+                    dense_now, new_vi, new_vc = var_schedule_step(
+                        count, ob["var_interval"], ob["var_counter"],
+                        onebit["var_freeze_step"],
+                        onebit["var_update_scaler"])
+                else:
+                    dense_now = count <= onebit["freeze_step"]
+                    new_vi, new_vc = ob["var_interval"], ob["var_counter"]
+                loss_sum, grads, new_ob = qgz_fn(
+                    params, stacked_batch, rng, scale, dense_now, ob)
+                grads = policy.constrain_grads(grads, grad_specs)
+                new_state, metrics = self._apply_grads(state, grads)
+                # overflow steps roll back every 1-bit residual/counter
+                # (the reference skips the whole optimizer step, exchange
+                # included)
+                ov = metrics["overflow"]
+                keep = lambda old, new: jnp.where(ov, old, new)
+                # the residuals live in the loss-scaled gradient domain;
+                # when the dynamic scaler moves (overflow backoff or
+                # window growth) they must move with it or error feedback
+                # mis-weights the carried correction by the scale ratio
+                ratio = (new_state["scaler"].cur_scale / scaler.cur_scale
+                         if fp16 else jnp.float32(1.0))
+                rescale = lambda old, new: keep(old, new) * ratio
+                new_state["onebit"] = {
+                    "error": jax.tree.map(rescale, ob["error"],
+                                          new_ob["error"]),
+                    "server": jax.tree.map(rescale, ob["server"],
+                                           new_ob["server"]),
+                    "var_interval": keep(ob["var_interval"], new_vi),
+                    "var_counter": keep(ob["var_counter"], new_vc),
+                }
+                metrics["loss"] = loss_sum / scale
+                return new_state, metrics
 
             if qgz_fn is not None:
                 loss_sum, grads = qgz_fn(params, stacked_batch, rng, scale)
@@ -1129,12 +1305,16 @@ class DeepSpeedEngine:
         # skipped (overflow) steps must not advance the LR schedule step
         # (reference: skipped steps leave the scheduler untouched)
         step_inc = jnp.where(overflow, jnp.int32(0), jnp.int32(1))
-        new_state = {
-            "params": new_params,
-            "opt_state": new_opt,
-            "step": state["step"] + step_inc,
-            "scaler": new_scaler,
-        }
+        # dict(state, ...) keeps auxiliary subtrees (e.g. the 1-bit
+        # error-feedback buffers) intact through paths that don't manage
+        # them (micro-step apply); train_step overwrites them itself
+        new_state = dict(
+            state,
+            params=new_params,
+            opt_state=new_opt,
+            step=state["step"] + step_inc,
+            scaler=new_scaler,
+        )
         metrics = {
             # contract (both execution tiers, see zero/offload.py): a skipped
             # overflow step reports grad_norm 0.0, not the meaningless inf

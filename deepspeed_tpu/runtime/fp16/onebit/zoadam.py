@@ -1,0 +1,138 @@
+"""ZeroOneAdam — the real 0/1 Adam algorithm (reference:
+deepspeed/runtime/fp16/onebit/zoadam.py:14, paper arXiv:2202.06009).
+
+Unlike 1-bit Adam's single freeze point, 0/1 Adam runs TWO adaptive
+policies:
+
+- **Variance update policy**: the second moment updates only at steps where
+  ``step % var_interval == 0``; ``var_interval`` doubles after every
+  ``var_update_scaler`` such updates (the kappa rule), until
+  ``var_freeze_step`` freezes the variance for good.  At variance-update
+  steps the gradient exchange is full-precision (the reference toggles
+  ``enable_backward_allreduce``, zoadam.py:273-281); at every other step the
+  wire is the 1-bit error-feedback compressed all-reduce.
+- **Local step policy** (reference zoadam.py:243-258): after the variance
+  freeze the reference lets parameters drift locally between exponentially
+  spaced compressed syncs of the accumulated momentum.  Per-device parameter
+  drift is not representable in a replicated-SPMD train step (every device
+  executes one logical program), so this port keeps the 1-bit exchange
+  *every* step after the freeze — the wire stays 1 byte/element and the
+  update is communication-exact where the reference's drifts between syncs.
+  ``local_step_scaler``/``local_step_clipper`` are accepted for config
+  parity and drive the same interval bookkeeping, but no drift occurs.
+
+The update itself follows the reference faithfully: no bias correction
+(zoadam.py:237 ``update = exp_avg / (exp_avg_sq.sqrt() + eps)``), decoupled
+weight decay added to the update, momentum updated every step with whatever
+(dense or compressed) reduced gradient arrived.
+
+The engine's quantized-exchange tier (runtime/engine.py `_qgz_grad_fn`)
+mirrors the dense-vs-compressed schedule on the wire; this transform mirrors
+it in the moment updates.  Both derive the schedule from the same
+(count, var_interval, var_counter) recurrence so they stay in lock-step.
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray          # optimizer steps taken (1-based after update)
+    m: optax.Updates
+    v: optax.Updates
+    var_interval: jnp.ndarray   # current variance-update interval
+    var_counter: jnp.ndarray    # updates seen at this interval
+    local_interval: jnp.ndarray  # local-step interval bookkeeping (parity)
+    local_counter: jnp.ndarray
+
+
+def var_schedule_step(count, var_interval, var_counter,
+                      var_freeze_step: int, var_update_scaler: int):
+    """One step of the variance-update policy recurrence.
+
+    Returns (update_var_now, new_interval, new_counter) for 1-based step
+    ``count``.  Shared by this transform and the engine's exchange tier so
+    the wire format and the moment updates agree step-by-step."""
+    frozen = count > var_freeze_step
+    update_now = jnp.logical_and(count % var_interval == 0,
+                                 jnp.logical_not(frozen))
+    bumped = var_counter + jnp.where(update_now, 1, 0)
+    roll = bumped >= var_update_scaler
+    new_counter = jnp.where(roll, 0, bumped)
+    new_interval = jnp.where(roll, var_interval * 2, var_interval)
+    return update_now, new_interval, new_counter
+
+
+def zero_one_adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16):
+    """0/1 Adam as an optax GradientTransformation.
+
+    Callers hand in already-reduced gradients; the engine's exchange tier
+    decides per step (same recurrence) whether the reduction ran dense or
+    1-bit compressed."""
+
+    def init_fn(params):
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                 params)
+        one = jnp.ones((), jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        return ZeroOneAdamState(zero, z(), z(), one, zero, one, zero)
+
+    def update_fn(grads, state, params=None):
+        count = state.count + 1
+        update_var, new_interval, new_counter = var_schedule_step(
+            count, state.var_interval, state.var_counter,
+            var_freeze_step, var_update_scaler)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g, state.m, g32)
+        v = jax.tree.map(
+            lambda vv, g: jnp.where(update_var,
+                                    b2 * vv + (1 - b2) * g * g, vv),
+            state.v, g32)
+        lr = (learning_rate(count) if callable(learning_rate)
+              else learning_rate)
+        # reference zoadam.py:237: NO bias correction on either moment
+        if weight_decay > 0 and params is not None:
+            updates = jax.tree.map(
+                lambda mm, vv, p: -lr * (mm / (jnp.sqrt(vv) + eps)
+                                         + weight_decay * p),
+                m, v, params)
+        else:
+            updates = jax.tree.map(
+                lambda mm, vv: -lr * mm / (jnp.sqrt(vv) + eps), m, v)
+        # local-step interval bookkeeping (config parity; see module doc)
+        frozen = count > var_freeze_step
+        lbump = state.local_counter + jnp.where(frozen, 1, 0)
+        lroll = lbump >= local_step_scaler
+        new_lcounter = jnp.where(lroll, 0, lbump)
+        new_linterval = jnp.where(
+            lroll, jnp.minimum(state.local_interval * 2, local_step_clipper),
+            state.local_interval)
+        return updates, ZeroOneAdamState(count, m, v, new_interval,
+                                         new_counter, new_linterval,
+                                         new_lcounter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ZeroOneAdam:
+    """Class shim with the reference's constructor surface."""
+
+    def __init__(self, params=None, deepspeed=None, lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, var_freeze_step: int = 100000,
+                 var_update_scaler: int = 16, local_step_scaler: int = 32678,
+                 local_step_clipper: int = 16, cuda_aware: bool = False,
+                 comm_backend_name: str = "jax", **kw):
+        self.transform = zero_one_adam(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay, var_freeze_step=var_freeze_step,
+            var_update_scaler=var_update_scaler,
+            local_step_scaler=local_step_scaler,
+            local_step_clipper=local_step_clipper)

@@ -51,13 +51,13 @@ def build_optimizer(name: Optional[str], params: Optional[dict],
         betas = params.get("betas", (0.9, 0.99))
         return optax.lion(lr, b1=float(betas[0]), b2=float(betas[1]),
                           weight_decay=wd)
-    if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+    if name == C.ONEBIT_ADAM_OPTIMIZER:
         # two-phase 1-bit Adam: exact Adam through freeze_step, then frozen
         # variance (runtime/fp16/onebit/adam.py).  The sign-compressed
-        # exchange itself (runtime/comm/compressed.py) engages when gradients
-        # flow through a shard_map with an axis name; in the engine's
-        # sharding-constraint flow XLA reduces in full precision — compression
-        # targets DCN-bound multi-slice runs, not single-slice ICI.
+        # exchange itself runs in the engine's shard_map gradient tier
+        # (engine._qgz_grad_fn "onebit" epilogue) whenever the mesh has a
+        # wide data/hpz axis — selecting this optimizer in a config gets
+        # 1-bit wire traffic after freeze_step, like the reference.
         from deepspeed_tpu.runtime.fp16.onebit.adam import onebit_adam
         adam_args = _adam_args(params)
         return onebit_adam(
@@ -65,6 +65,21 @@ def build_optimizer(name: Optional[str], params: Optional[dict],
             b1=adam_args["b1"], b2=adam_args["b2"], eps=adam_args["eps"],
             weight_decay=wd,
             freeze_step=int(params.get("freeze_step", 100)))
+    if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+        # real 0/1 Adam (reference zoadam.py:14): exponential
+        # variance-update intervals with dense sync only at those steps,
+        # 1-bit compressed exchange otherwise (engine tier mirrors the
+        # schedule on the wire)
+        from deepspeed_tpu.runtime.fp16.onebit.zoadam import zero_one_adam
+        adam_args = _adam_args(params)
+        return zero_one_adam(
+            learning_rate=lr,
+            b1=adam_args["b1"], b2=adam_args["b2"], eps=adam_args["eps"],
+            weight_decay=wd,
+            var_freeze_step=int(params.get("var_freeze_step", 100000)),
+            var_update_scaler=int(params.get("var_update_scaler", 16)),
+            local_step_scaler=int(params.get("local_step_scaler", 32678)),
+            local_step_clipper=int(params.get("local_step_clipper", 16)))
     if name == C.ONEBIT_LAMB_OPTIMIZER:
         # two-phase 1-bit LAMB (runtime/fp16/onebit/lamb.py): exact LAMB with
         # a trust-ratio EMA through freeze_step, then frozen variance +

@@ -222,3 +222,111 @@ def test_engine_accepts_onebit_adam(devices8):
     b = random_batches(1, batch_size=8, seed=0)[0]
     loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
     assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------- engine-integrated exchange
+
+def test_engine_onebit_wire_engages(devices8):
+    """Selecting OnebitAdam in a config routes gradients through the
+    shard_map exchange tier (round-2 VERDICT item 8): the compiled step
+    carries the int8 sign wire, and the error-feedback buffers live in the
+    engine state."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 2}}))
+    plan = engine._get_qgz_plan()
+    assert plan is not None and plan["onebit"] is not None
+    assert "onebit" in engine.state
+    b = random_batches(1, batch_size=8, seed=0)[0]
+    batch = engine._shard_batch({"input_ids": b["input_ids"][None]},
+                                stacked=True)
+    fn = engine._get_compiled("train_step")
+    hlo = fn.lower(engine.state, batch,
+                   engine._next_rng()).compile().as_text()
+    comm = [l for l in hlo.splitlines()
+            if "all-to-all" in l or "all-gather" in l]
+    assert any("s8[" in l for l in comm), comm[:5]
+
+
+def test_engine_onebit_warmup_matches_dense(devices8):
+    """During warmup the exchange is an exact psum — losses must match a
+    run whose optimizer reduces densely (same math, freeze far away)."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "Adam",
+                       "params": {"lr": 1e-3, "betas": [0.9, 0.999]}}))
+    ob, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 1000}}))
+    from tests.test_zeropp import _train
+    l_ref = _train(ref, steps=3, seed=11)
+    l_ob = _train(ob, steps=3, seed=11)
+    np.testing.assert_allclose(l_ob, l_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_onebit_compressed_phase_trains(devices8):
+    """After freeze_step the 1-bit exchange takes over: training stays
+    finite and the loss keeps moving down; the error residuals become
+    non-zero (proof the compressed branch actually ran)."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 2}}))
+    from tests.test_zeropp import _train
+    losses = _train(engine, steps=10, seed=13)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[5:]) < np.mean(losses[:5]) + 0.02
+    err_mag = max(float(np.abs(np.asarray(e)).max())
+                  for e in jax.tree.leaves(engine.state["onebit"]["error"]))
+    assert err_mag > 0, "compressed branch never ran"
+
+
+def test_engine_zero_one_adam_schedule_and_wire(devices8):
+    """ZeroOneAdam: the variance-update recurrence doubles intervals, the
+    engine mirrors it on the wire (dense sync only at update steps), and
+    training through the 0/1 exchange stays finite and converges."""
+    from deepspeed_tpu.runtime.fp16.onebit.zoadam import var_schedule_step
+    vi, vc = jnp.ones((), jnp.int32), jnp.zeros((), jnp.int32)
+    intervals = []
+    for step in range(1, 8):
+        up, vi, vc = var_schedule_step(jnp.int32(step), vi, vc,
+                                       var_freeze_step=1000,
+                                       var_update_scaler=2)
+        intervals.append(int(vi))
+    # kappa=2: interval doubles after every 2 variance updates
+    # updates land at steps 1,2,4,6; kappa=2 doubles after every 2 updates
+    assert intervals == [1, 2, 2, 2, 2, 4, 4], intervals
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "ZeroOneAdam",
+                       "params": {"lr": 1e-3, "var_freeze_step": 4,
+                                  "var_update_scaler": 2}}))
+    plan = engine._get_qgz_plan()
+    assert plan is not None and plan["onebit"]["kind"] == "zerooneadam"
+    from tests.test_zeropp import _train
+    losses = _train(engine, steps=8, seed=29)
+    assert all(np.isfinite(losses))
+    assert np.mean(losses[4:]) < np.mean(losses[:4]) + 0.02
+    assert int(engine.state["onebit"]["var_interval"]) > 1
+
+
+def test_engine_onebit_checkpoint_roundtrip(devices8, tmp_path):
+    """The error-feedback buffers ride the engine checkpoint."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 1}}))
+    from tests.test_zeropp import _train
+    _train(engine, steps=3, seed=5)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    fresh, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            optimizer={"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 1}}))
+    fresh.load_checkpoint(str(tmp_path), tag="t1")
+    for a, b in zip(jax.tree.leaves(engine.state["onebit"]),
+                    jax.tree.leaves(fresh.state["onebit"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
