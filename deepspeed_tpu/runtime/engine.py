@@ -162,15 +162,16 @@ class DeepSpeedEngine:
             raise ValueError(
                 "offload_param requires offload_optimizer (the ZeRO-Infinity "
                 "tier pairs parameter offload with the host optimizer)")
-        if self._offload_param and len(list(self.mesh.devices.flat)) > 1:
-            # Param streaming is the single-chip memory-extension tier (the
-            # reference's 13B-on-one-V100 scenario): on multi-device meshes
-            # ZeRO-3 already shards params 1/N on device, and XLA's SPMD
-            # partitioner cannot place the replicated pinned-host buffers the
-            # streaming layout needs.
+        self._multi_device = len(list(self.mesh.devices.flat)) > 1
+        if self._offload_param and self._multi_device and zc.stage < 3:
+            # multi-device ZeRO-Infinity (reference partitioned_param_swapper
+            # .py:36 + parameter_offload.py:201): each device owns a
+            # pinned-host shard of the layer stack and the per-layer stream
+            # doubles as the stage-3 gather — the param shards must exist,
+            # i.e. stage 3
             raise ValueError(
-                "offload_param supports single-device meshes; on multi-device "
-                "meshes use ZeRO stage 3 (params are sharded across devices)")
+                "offload_param on a multi-device mesh requires ZeRO stage 3 "
+                "(per-device pinned-host shards of the layer stack)")
 
         # ---- parameters ------------------------------------------------------
         # Parameters are *born sharded*: shapes come from eval_shape, the ZeRO
@@ -199,41 +200,26 @@ class DeepSpeedEngine:
             if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
         self.param_specs = self.zero_policy.param_specs(shapes, logical)
         self._warned_qwz_no_blocks = False
-        if (zc.zero_quantized_weights or zc.zero_quantized_gradients) \
-                and zc.stage == 3:
-            bk = getattr(model, "blocks_key", "blocks")
-            if isinstance(self.param_specs, dict) and bk in self.param_specs:
-                # qwZ quantizes each LAYER slice before its gather, so the
-                # zero shard must not sit on the stacked layer dim (where the
-                # scan's slice — not an all-gather — would materialise the
-                # full-precision layer); move it onto the weight dims
-                zero_axes = set(self.zero_policy.zero_axes)
-
-                def _off_dim0(spec, shp, lg):
-                    t = tuple(spec)
-                    lead = t[0] if t else None
-                    lead_axes = ((lead,) if isinstance(lead, str)
-                                 else tuple(lead or ()))
-                    if not (lead_axes and set(lead_axes) & zero_axes):
-                        return spec
-                    lg_sub = (P(*tuple(lg)[1:]) if lg is not None else None)
-                    sub = self.zero_policy._sharded_spec(
-                        shp.shape[1:], lg_sub,
-                        axes=self.zero_policy.param_axes)
-                    return P(None, *tuple(sub))
-
-                is_p = lambda x: isinstance(x, P)
-                specs_flat, treedef = jax.tree_util.tree_flatten(
-                    self.param_specs[bk], is_leaf=is_p)
-                shapes_flat = jax.tree.leaves(shapes[bk])
-                if isinstance(logical, dict) and bk in logical:
-                    lg_flat = jax.tree.leaves(logical[bk], is_leaf=is_p)
-                else:
-                    lg_flat = [None] * len(specs_flat)
-                fixed = [_off_dim0(sp, shp, lg) for sp, shp, lg
-                         in zip(specs_flat, shapes_flat, lg_flat)]
-                self.param_specs[bk] = jax.tree_util.tree_unflatten(
-                    treedef, fixed)
+        bk_ = getattr(model, "blocks_key", "blocks")
+        needs_off_dim0 = (
+            ((zc.zero_quantized_weights or zc.zero_quantized_gradients)
+             and zc.stage == 3)
+            # per-layer streaming slices the stacked dim too: a zero shard
+            # on dim 0 would turn each layer access into a cross-device
+            # gather of the stack instead of a local slice
+            or (self._offload_param and self._multi_device))
+        if needs_off_dim0 and isinstance(self.param_specs, dict) \
+                and bk_ in self.param_specs:
+            # qwZ quantizes (and the streamed tier transfers) each LAYER
+            # slice before its gather, so the zero shard must not sit on
+            # the stacked layer dim (where the scan's slice — not an
+            # all-gather — would materialise the full layer); move it onto
+            # the weight dims
+            self.param_specs[bk_] = self._move_zero_off_dim0(
+                self.param_specs[bk_], shapes[bk_],
+                logical[bk_] if isinstance(logical, dict) and bk_ in logical
+                else None,
+                self.zero_policy.param_axes)
         if zc.zero_quantized_gradients and (self._offload
                                             or self._offload_param):
             logger.warning(
@@ -337,6 +323,15 @@ class DeepSpeedEngine:
         self._param_shapes = shapes
         self._qgz_plan = "unbuilt"
         self.grad_specs = self.zero_policy.grad_specs(params, logical)
+        if self._offload_param and self._multi_device and isinstance(
+                self.grad_specs, dict) and bk_ in self.grad_specs:
+            # grads DMA out per layer slice in the backward scan — same
+            # no-shard-on-dim-0 rule as the param storage
+            self.grad_specs[bk_] = self._move_zero_off_dim0(
+                self.grad_specs[bk_], shapes[bk_],
+                logical[bk_] if isinstance(logical, dict) and bk_ in logical
+                else None,
+                self.zero_policy.zero_axes)
         self.grad_shardings = self.zero_policy.shardings(self.grad_specs)
         devices_flat = list(self.mesh.devices.flat)
         if self._offload_param and devices_flat[0].platform == "tpu":
@@ -620,6 +615,35 @@ class DeepSpeedEngine:
             f"batch {self.train_batch_size()} = {self.train_micro_batch_size_per_gpu()}"
             f"×{self.gradient_accumulation_steps()}×{self.topology.dp_world_size}",
             ranks=[0])
+
+    def _move_zero_off_dim0(self, spec_tree, shape_tree, logical_tree, axes):
+        """Re-derive zero shardings for a layer-stacked subtree with the
+        stacked dim 0 forced unsharded (see call sites for why)."""
+        zero_axes = set(self.zero_policy.zero_axes)
+
+        def _off_dim0(spec, shp, lg):
+            t = tuple(spec)
+            lead = t[0] if t else None
+            lead_axes = ((lead,) if isinstance(lead, str)
+                         else tuple(lead or ()))
+            if not (lead_axes and set(lead_axes) & zero_axes):
+                return spec
+            lg_sub = (P(*tuple(lg)[1:]) if lg is not None else None)
+            sub = self.zero_policy._sharded_spec(
+                shp.shape[1:], lg_sub, axes=axes)
+            return P(None, *tuple(sub))
+
+        is_p = lambda x: isinstance(x, P)
+        specs_flat, treedef = jax.tree_util.tree_flatten(
+            spec_tree, is_leaf=is_p)
+        shapes_flat = jax.tree.leaves(shape_tree)
+        if logical_tree is not None:
+            lg_flat = jax.tree.leaves(logical_tree, is_leaf=is_p)
+        else:
+            lg_flat = [None] * len(specs_flat)
+        fixed = [_off_dim0(sp, shp, lg) for sp, shp, lg
+                 in zip(specs_flat, shapes_flat, lg_flat)]
+        return jax.tree_util.tree_unflatten(treedef, fixed)
 
     # ------------------------------------------------------------------ config api
     def train_batch_size(self) -> int:

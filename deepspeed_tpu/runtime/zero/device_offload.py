@@ -88,17 +88,33 @@ class StreamedOptimizer:
         self.state_shardings = param_shardings if platform == "tpu" else None
         bk = blocks_key
 
-        def _host(x):
-            if self.state_shardings is None or mesh is None:
-                return x
-            return jax.device_put(
-                x, NamedSharding(mesh, P(), memory_kind="pinned_host"))
+        # per-leaf LAYER-SLICE shardings (stacked dim stripped): on
+        # multi-device meshes the pinned-host state is zero-sharded, so the
+        # per-slice host/device hops must carry each leaf's own layout — a
+        # replicated placement would silently gather the shard
+        if self.state_shardings is not None:
+            def _slice(sh, kind):
+                return NamedSharding(mesh, P(*tuple(sh.spec)[1:]),
+                                     memory_kind=kind)
+            self._slice_host = jax.tree.map(
+                lambda sh: _slice(sh, sh.memory_kind),
+                param_shardings[bk])
+            self._slice_dev = jax.tree.map(
+                lambda sh: _slice(sh, "device"), param_shardings[bk])
+        else:
+            self._slice_host = self._slice_dev = None
 
-        def _dev(x):
-            if self.state_shardings is None or mesh is None:
-                return x
-            return jax.device_put(
-                x, NamedSharding(mesh, P(), memory_kind="device"))
+        def _host_tree(tr):
+            if self._slice_host is None:
+                return tr
+            return jax.tree.map(jax.device_put, tr, self._slice_host)
+
+        def _dev_tree(tr):
+            if self._slice_dev is None:
+                return tr
+            return jax.tree.map(jax.device_put, tr, self._slice_dev)
+
+        self._host_tree, self._dev_tree = _host_tree, _dev_tree
 
         def init_state(p):
             """Streamed init: fp32 master + zero moments, one layer slice at
@@ -109,16 +125,16 @@ class StreamedOptimizer:
             blocks = p[bk]
 
             def cast_body(carry, xs):
-                xs_d = jax.tree.map(_dev, xs)
-                out = jax.tree.map(
-                    lambda a: _host(a.astype(jnp.float32)), xs_d)
+                xs_d = _dev_tree(xs)
+                out = _host_tree(jax.tree.map(
+                    lambda a: a.astype(jnp.float32), xs_d))
                 return carry, out
 
             _, mst_blocks = lax.scan(cast_body, None, blocks)
 
             def zeros_body(carry, xs):
-                out = jax.tree.map(
-                    lambda a: _host(jnp.zeros(a.shape, jnp.float32)), xs)
+                out = _host_tree(jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), xs))
                 return carry, out
 
             _, m_blocks = lax.scan(zeros_body, None, blocks)
@@ -158,15 +174,16 @@ class StreamedOptimizer:
         mesh = self.mesh
         host_state = self.state_shardings is not None
 
-        def to_device(x):
+        def dev_tree(tr):
             # always normalise to device memory space: even in the CPU
             # fallback (state_shardings=None) the engine's param storage —
             # aliased as the master — is pinned-host, and mixed memory
-            # spaces in one elementwise op are a type error
+            # spaces in one elementwise op are a type error.  On TPU the
+            # per-leaf slice shardings keep zero-sharded layouts intact.
             if host_state:
-                return jax.device_put(
-                    x, NamedSharding(mesh, P(), memory_kind="device"))
-            return jax.device_put(x, jax.memory.Space.Device)
+                return self._dev_tree(tr)
+            return jax.tree.map(
+                lambda x: jax.device_put(x, jax.memory.Space.Device), tr)
 
         def adam_leaf(mst, m, v, g, lr, t, factor, ovf):
             """factor folds loss-scale inverse and clipping; on overflow the
@@ -205,8 +222,8 @@ class StreamedOptimizer:
 
             def norm_body(carry, g_slice):
                 acc, ovf = carry
-                for leaf in jax.tree.leaves(g_slice):
-                    s, o = leaf_sq(to_device(leaf))
+                for leaf in jax.tree.leaves(dev_tree(g_slice)):
+                    s, o = leaf_sq(leaf)
                     acc = acc + s
                     ovf = jnp.logical_or(ovf, o)
                 return (acc, ovf), None
@@ -230,25 +247,24 @@ class StreamedOptimizer:
             eff_lr = jnp.where(overflow, 0.0, lr)
 
             # ---- pass 2: streamed update over the layer stack ------------
-            def to_host(x):
+            def host_tree(tr):
                 if not host_state:
-                    return x
-                return jax.device_put(
-                    x, NamedSharding(mesh, P(), memory_kind="pinned_host"))
+                    return tr
+                return self._host_tree(tr)
 
             def upd_body(carry, xs):
                 mst_s, m_s, v_s, g_s = xs
-                dev = lambda tr: jax.tree.map(to_device, tr)
                 new_mst, new_m, new_v = _tree_zip_map(
                     lambda a, b_, c, d: adam_leaf(a, b_, c, d, eff_lr, t,
                                                   factor, overflow),
-                    dev(mst_s), dev(m_s), dev(v_s), dev(g_s))
+                    dev_tree(mst_s), dev_tree(m_s), dev_tree(v_s),
+                    dev_tree(g_s))
                 # per-slice host placement: fp32 slices DMA straight into the
                 # host ys buffers (without this XLA allocates the stacked
                 # outputs as HBM temps — 80 GB at 6.7B).  Works for fp32
                 # only; bf16 host dynamic-update-slice aborts this libtpu.
-                host = lambda tr: jax.tree.map(to_host, tr)
-                return carry, (host(new_mst), host(new_m), host(new_v))
+                return carry, (host_tree(new_mst), host_tree(new_m),
+                               host_tree(new_v))
 
             _, (bm, bmm, bmv) = lax.scan(
                 upd_body, None, (master[bk], m[bk], v[bk], block_gs))
@@ -259,7 +275,7 @@ class StreamedOptimizer:
             # fits: the grads/activations of the backward are gone by now)
             # and move to pinned host in bulk via out_shardings.
             def work_body(carry, mst_s):
-                mst_d = jax.tree.map(to_device, mst_s)
+                mst_d = dev_tree(mst_s)
                 return carry, jax.tree.map(
                     lambda a: a.astype(compute_dtype), mst_d)
 
