@@ -14,7 +14,7 @@ class DeepSpeedTPConfig(DeepSpeedConfigModel):
 
 
 class DeepSpeedMoEConfig(DeepSpeedConfigModel):
-    enabled: bool = False
+    enabled: bool = True     # reference inference/config.py:69 default
     ep_size: int = 1
     moe_experts: list = Field(default_factory=lambda: [1])
 

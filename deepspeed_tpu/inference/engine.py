@@ -43,9 +43,14 @@ class InferenceEngine:
         self.model = model
         self._config = config
         tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
-        self.topology = MeshTopology(model_parallel_size=tp) if mesh is None \
-            else MeshTopology(model_parallel_size=tp,
-                              devices=list(mesh.devices.flat))
+        # EP-sharded MoE serving (reference inference/engine.py:230 expert
+        # group creation): experts partition over the expert mesh axis
+        ep = (int(config.moe.ep_size or 1)
+              if getattr(config.moe, "enabled", True) else 1)
+        kw = dict(model_parallel_size=tp, expert_parallel_size=ep)
+        if mesh is not None:
+            kw["devices"] = list(mesh.devices.flat)
+        self.topology = MeshTopology(**kw)
         set_topology(self.topology)
         self.mesh = self.topology.mesh
         self.dtype = jnp.dtype(config.dtype)

@@ -4,4 +4,4 @@ from deepspeed_tpu.models.llama import llama_model, LlamaConfig
 from deepspeed_tpu.models.mixtral import mixtral_model, MixtralConfig
 from deepspeed_tpu.models.bert import bert_model, BertConfig
 from deepspeed_tpu.models.hf import (gpt2_from_hf, llama_from_hf,
-                                     bert_from_hf)
+                                     bert_from_hf, mixtral_from_hf)

@@ -238,3 +238,94 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         if "lm_head.weight" in sd else g("embed_tokens.weight").T,
     }
     return model, params
+
+
+def mixtral_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF MixtralForCausalLM (or its state_dict) -> (Model, params).
+
+    Expert mapping (HF MixtralSparseMoeBlock): w1 = gated (silu) proj ->
+    ``w_gate``, w3 = linear up proj -> ``w_in``, w2 = down proj ->
+    ``w_out``; ``gate.weight`` [E, D] -> router [D, E]."""
+    from deepspeed_tpu.models.mixtral import mixtral_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"model.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("model.layers."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None and not (
+            {"num_heads", "rope_theta", "top_k"} <= set(overrides)):
+        # head count, theta AND experts-per-token are unrecoverable from
+        # bare weights; a guessed top_k silently mis-routes every token
+        raise ValueError(
+            "mixtral_from_hf: bare state_dict carries no config — pass the "
+            "transformers model, or supply num_heads=, rope_theta= and "
+            "top_k= overrides")
+    D = g("embed_tokens.weight").shape[1]
+    q_rows = g("layers.0.self_attn.q_proj.weight").shape[0]
+    kv_rows = g("layers.0.self_attn.k_proj.weight").shape[0]
+    n_experts = 1 + max(
+        int(k.split(".")[5]) for k in sd
+        if ".block_sparse_moe.experts." in k)
+    heads = (int(hf_cfg.num_attention_heads) if hf_cfg is not None
+             else int(overrides["num_heads"]))
+    hd = q_rows // heads
+    cfg = dict(vocab_size=g("embed_tokens.weight").shape[0],
+               num_layers=n_layers, d_model=D, num_heads=heads,
+               num_kv_heads=kv_rows // hd,
+               d_ff=g("layers.0.block_sparse_moe.experts.0.w1.weight"
+                      ).shape[0],
+               num_experts=n_experts)
+    if hf_cfg is not None:
+        sw = getattr(hf_cfg, "sliding_window", None)
+        if sw is not None and sw < int(getattr(
+                hf_cfg, "max_position_embeddings", sw)):
+            raise NotImplementedError(
+                f"mixtral_from_hf: checkpoint uses sliding_window={sw}; "
+                "the native attention is full-context — converting would "
+                "change logits beyond the window")
+        cfg["rope_theta"] = float(getattr(hf_cfg, "rope_theta", 1e6))
+        cfg["rms_norm_eps"] = float(getattr(hf_cfg, "rms_norm_eps", 1e-5))
+        cfg["max_seq_len"] = int(getattr(hf_cfg, "max_position_embeddings",
+                                         4096))
+        cfg["top_k"] = int(getattr(hf_cfg, "num_experts_per_tok", 2))
+    cfg.update(overrides)
+    # eval/serving is drop-free by default (MixtralConfig
+    # eval_capacity_factor=None), matching HF's capacity-less routing
+    model = mixtral_model("custom", **cfg)
+
+    def stack_t(fmt):
+        return np.stack([g(fmt.format(i)).T for i in range(n_layers)])
+
+    def stack(fmt):
+        return np.stack([g(fmt.format(i)) for i in range(n_layers)])
+
+    def experts_t(w):
+        # [L, E, in, out]: per-layer stack of transposed expert mats
+        return np.stack([
+            np.stack([
+                g(f"layers.{i}.block_sparse_moe.experts.{e}.{w}.weight").T
+                for e in range(n_experts)])
+            for i in range(n_layers)])
+
+    params = {
+        "wte": g("embed_tokens.weight"),
+        "blocks": {
+            "attn_norm": stack("layers.{}.input_layernorm.weight"),
+            "wq": stack_t("layers.{}.self_attn.q_proj.weight"),
+            "wk": stack_t("layers.{}.self_attn.k_proj.weight"),
+            "wv": stack_t("layers.{}.self_attn.v_proj.weight"),
+            "wo": stack_t("layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("layers.{}.post_attention_layernorm.weight"),
+            "moe": {
+                "router": stack_t("layers.{}.block_sparse_moe.gate.weight"),
+                "w_gate": experts_t("w1"),
+                "w_in": experts_t("w3"),
+                "w_out": experts_t("w2"),
+            },
+        },
+        "final_norm": g("norm.weight"),
+        "lm_head": _to_np(sd["lm_head.weight"]).T
+        if "lm_head.weight" in sd else g("embed_tokens.weight").T,
+    }
+    return model, params

@@ -216,104 +216,43 @@ def forward(params, batch, config: LlamaConfig, rng=None):
 
 
 # --------------------------------------------------------------------- decode
-def init_cache(config: LlamaConfig, batch_size: int, max_len: int, dtype=None):
-    """``dtype="int8"``: quantized cache (int8 payload + one fp32 scale per
-    cached KV-head vector) — see models/gpt2.py init_cache."""
-    L, KV, hd = config.num_layers, config.num_kv_heads, config.head_dim
-    shape = (L, batch_size, max_len, KV, hd)
-    if str(dtype) == "int8":
-        return {"k": jnp.zeros(shape, jnp.int8),
-                "v": jnp.zeros(shape, jnp.int8),
-                "k_s": jnp.ones(shape[:-1], jnp.float32),
-                "v_s": jnp.ones(shape[:-1], jnp.float32)}
-    dtype = jnp.dtype(dtype or config.dtype)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+def _serving_fns(config: LlamaConfig):
+    """KV-cache serving via the shared rotary-GQA scaffold
+    (models/serving.py) — llama contributes its QKV projection and dense
+    SwiGLU finish."""
+    from deepspeed_tpu.models import serving
 
+    def embed_fn(params, tokens):
+        return params["wte"].astype(jnp.dtype(config.dtype))[tokens]
 
-def prefill(params, batch, cache, config: LlamaConfig):
-    """Causal forward over right-padded prompts, filling the (compact,
-    KV-head) cache.  Returns (logits [B, S, V], cache)."""
-    tokens = batch["input_ids"]
-    B, S = tokens.shape
-    dtype = jnp.dtype(config.dtype)
-    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
-    x = params["wte"].astype(dtype)[tokens]
+    def qkv_fn(x, layer, positions):
+        return _block_qkv(x, layer, config, positions)
 
-    def body(carry, layer):
-        from deepspeed_tpu.models.model import maybe_stream
-        layer = maybe_stream(layer)      # dequant / host-stream per layer
-        q, kk, v = _block_qkv(carry, layer, config)
-        ka, va = kk, v
-        if KV != H:
-            rep = H // KV
-            ka = jnp.repeat(kk, rep, axis=2)
-            va = jnp.repeat(v, rep, axis=2)
-        attn = causal_attention(q, ka, va, impl=config.attention_impl)
-        out = _block_finish(carry, attn.reshape(B, S, H * hd), layer, config)
-        return out, (kk, v)
+    def finish_fn(x, attn_flat, layer):
+        return _block_finish(x, attn_flat, layer, config)
 
-    x, (ks, vs) = lax.scan(body, x, params["blocks"])
-    if "k_s" in cache:      # int8 cache: quantize the prefill block
-        from deepspeed_tpu.ops.pallas.decode_attention import (
-            quantize_prefill_into_cache)
-        return (head(params, x, config),
-                quantize_prefill_into_cache(cache, ks, vs))
-    cache = {
-        "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
-                                      (0, 0, 0, 0, 0)),
-        "v": lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype),
-                                      (0, 0, 0, 0, 0)),
-    }
-    return head(params, x, config), cache
+    def head_fn(params, x):
+        return head(params, x, config)
 
+    def init_cache_fn(bs, max_len, dtype=None):
+        return serving.init_cache(config.num_layers, config.num_kv_heads,
+                                  config.head_dim, bs, max_len, dtype,
+                                  config.dtype)
 
-def decode_step(params, tokens, cache, lengths, config: LlamaConfig):
-    """One decode step: tokens [B], lengths [B] current fill counts.
-    Rotary uses per-row positions; the GQA cache stays compact (KV heads) —
-    the decode kernel handles the query-group mapping."""
-    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
-    B = tokens.shape[0]
-    dtype = jnp.dtype(config.dtype)
-    H, hd = config.num_heads, config.head_dim
-    x = params["wte"].astype(dtype)[tokens]                 # [B, D]
-    rows = jnp.arange(B)
+    def prefill_fn(p, b, c):
+        return serving.prefill(
+            p, b, c, embed_fn=embed_fn, qkv_fn=qkv_fn, finish_fn=finish_fn,
+            head_fn=head_fn, num_heads=config.num_heads,
+            num_kv_heads=config.num_kv_heads,
+            attention_impl=config.attention_impl)
 
-    quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
+    def decode_fn(p, t, c, l):
+        return serving.decode_step(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
 
-    def body(carry, layer_kv):
-        if quantized:
-            layer, kc, vc, ksc, vsc = layer_kv
-        else:
-            layer, kc, vc = layer_kv
-            ksc = vsc = None
-        from deepspeed_tpu.models.model import maybe_stream
-        layer = maybe_stream(layer)      # dequant / host-stream per layer
-        q, kk, v = _block_qkv(carry[:, None, :], layer, config,
-                              positions=lengths[:, None])
-        if quantized:
-            from deepspeed_tpu.ops.pallas.decode_attention import (
-                quantize_token_into_cache)
-            kc, vc, ksc, vsc = quantize_token_into_cache(
-                kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
-        else:
-            kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
-            vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
-                                k_scale=ksc, v_scale=vsc)
-        out = _block_finish(carry, attn.reshape(B, H * hd).astype(carry.dtype),
-                            layer, config)
-        return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
-
-    xs = (params["blocks"], cache["k"], cache["v"])
-    if quantized:
-        xs += (cache["k_s"], cache["v_s"])
-    x, ys = lax.scan(body, x, xs)
-    logits = head(params, x[:, None, :], config)[:, 0]
-    if quantized:
-        ks, vs, kss, vss = ys
-        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
-    ks, vs = ys
-    return logits, {"k": ks, "v": vs}
+    return init_cache_fn, prefill_fn, decode_fn
 
 
 def count_params(config: LlamaConfig) -> int:
@@ -355,7 +294,6 @@ def llama_model(size: str = "7b", **overrides) -> Model:
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),
-        init_cache_fn=lambda bs, ml, dtype=None: init_cache(config, bs, ml, dtype),
-        prefill_fn=lambda p, b, c: prefill(p, b, c, config),
-        decode_fn=lambda p, t, c, l: decode_step(p, t, c, l, config),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+                   _serving_fns(config))),
     )

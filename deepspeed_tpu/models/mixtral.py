@@ -30,6 +30,12 @@ class MixtralConfig:
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    #: None (default) = drop-free at eval/serving (capacity >= all tokens
+    #: on one expert, the HF serving semantic — keeps the cached decode,
+    #: the prefill, and the no-cache oracle token-identical regardless of
+    #: router skew).  Set a number to cap eval capacity (cheaper dispatch
+    #: for long prefills, at the cost of potential drops).
+    eval_capacity_factor: "float | None" = None
     aux_loss_coef: float = 0.01
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-5
@@ -44,9 +50,13 @@ class MixtralConfig:
 
     @property
     def moe(self) -> MoEConfig:
+        eval_cf = (self.eval_capacity_factor
+                   if self.eval_capacity_factor is not None
+                   else self.num_experts / self.top_k)
         return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
                          num_experts=self.num_experts, top_k=self.top_k,
                          capacity_factor=self.capacity_factor,
+                         eval_capacity_factor=eval_cf,
                          aux_loss_coef=self.aux_loss_coef,
                          activation="silu_glu")
 
@@ -109,25 +119,44 @@ def logical_specs(config: MixtralConfig) -> dict:
     }
 
 
-def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
-    x = carry
+def _qkv(x, layer, config: MixtralConfig, positions=None):
+    """RMSNorm + QKV + rotary; kv heads NOT repeated (compact caches)."""
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     dt = h.dtype
-    q = rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, hd), config.rope_theta)
-    kk = rope((h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd), config.rope_theta)
+    q = rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, hd),
+             config.rope_theta, positions)
+    kk = rope((h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd),
+              config.rope_theta, positions)
     v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
+    return q, kk, v
+
+
+def _moe_finish(x, attn_flat, layer, config: MixtralConfig, train: bool,
+                rng=None):
+    """Attention output projection + residual + routed-expert FFN."""
+    dt = x.dtype
+    x = x + attn_flat @ layer["wo"].astype(dt)
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    moe_out, aux = moe_layer(layer["moe"], h, config.moe, train=train,
+                             rng=rng)
+    return x + moe_out, aux
+
+
+def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
+    x = carry
+    B, S, D = x.shape
+    H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
+    q, kk, v = _qkv(x, layer, config)
     if KV != H:
         rep = H // KV
         kk = jnp.repeat(kk, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
-    x = x + attn.reshape(B, S, H * hd) @ layer["wo"].astype(dt)
-    h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    moe_out, aux = moe_layer(layer["moe"], h, config.moe, train=train, rng=rng)
-    return x + moe_out, aux
+    return _moe_finish(x, attn.reshape(B, S, H * hd), layer, config,
+                       train, rng)
 
 
 def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
@@ -146,6 +175,51 @@ def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
     x, aux = lax.scan(block_fn, x, params["blocks"])
     x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
     return x @ params["lm_head"].astype(dtype), jnp.sum(aux)
+
+
+# --------------------------------------------------------------------- decode
+# MoE serving path (reference capability:
+# ops/transformer/inference/moe_inference.py + inference/engine.py:230 EP
+# groups): the shared rotary-GQA cache scaffold (models/serving.py) with
+# the routed-expert FFN as the post-attention block — drop-free at eval by
+# default, EP-sharded when the mesh has a wide expert axis.
+
+def _serving_fns(config: MixtralConfig):
+    from deepspeed_tpu.models import serving
+
+    def embed_fn(params, tokens):
+        return params["wte"].astype(jnp.dtype(config.dtype))[tokens]
+
+    def qkv_fn(x, layer, positions):
+        return _qkv(x, layer, config, positions)
+
+    def finish_fn(x, attn_flat, layer):
+        out, _ = _moe_finish(x, attn_flat, layer, config, train=False)
+        return out
+
+    def head_fn(params, x):
+        x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
+        return x @ params["lm_head"].astype(jnp.dtype(config.dtype))
+
+    def init_cache_fn(bs, max_len, dtype=None):
+        return serving.init_cache(config.num_layers, config.num_kv_heads,
+                                  config.head_dim, bs, max_len, dtype,
+                                  config.dtype)
+
+    def prefill_fn(p, b, c):
+        return serving.prefill(
+            p, b, c, embed_fn=embed_fn, qkv_fn=qkv_fn, finish_fn=finish_fn,
+            head_fn=head_fn, num_heads=config.num_heads,
+            num_kv_heads=config.num_kv_heads,
+            attention_impl=config.attention_impl)
+
+    def decode_fn(p, t, c, l):
+        return serving.decode_step(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
+
+    return init_cache_fn, prefill_fn, decode_fn
 
 
 def count_params(config: MixtralConfig) -> int:
@@ -181,4 +255,6 @@ def mixtral_model(size: str = "8x7b", **overrides) -> Model:
         flops_per_token=6.0 * active,
         meta={"name": f"mixtral-{size}", "n_params": n_params,
               "active_params": active},
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+                   _serving_fns(config))),
     )

@@ -1,0 +1,124 @@
+"""Shared KV-cache serving scaffold for rotary GQA decoders (llama,
+mixtral).
+
+Reference capability: the fused inference path around
+``ds_softmax_context`` (csrc/transformer/inference/csrc/pt_binding.cpp) and
+its MoE variant (ops/transformer/inference/moe_inference.py).  The cache
+layout, the int8 payload+scales threading, and the per-layer scan are
+identical across the in-tree rotary decoders; each model contributes only
+its QKV projection and its post-attention block (dense SwiGLU vs routed
+experts) through callbacks:
+
+- ``qkv_fn(x, layer, positions)`` -> (q [B,S,H,hd], k/v [B,S,KV,hd],
+  kv heads NOT repeated — caches stay compact)
+- ``finish_fn(x, attn_flat, layer)`` -> x  (output proj + residual + FFN,
+  eval mode)
+
+Cache pytree: ``{"k","v": [L,B,S,KV,hd]}``, plus ``{"k_s","v_s":
+[L,B,S,KV] fp32}`` when the cache dtype is "int8" (per-vector symmetric
+scales, ops/pallas/decode_attention.py helpers).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_cache(num_layers, num_kv_heads, head_dim, batch_size, max_len,
+               dtype, default_dtype):
+    """``dtype="int8"``: quantized cache (int8 payload + one fp32 scale per
+    cached KV-head vector)."""
+    shape = (num_layers, batch_size, max_len, num_kv_heads, head_dim)
+    if str(dtype) == "int8":
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.ones(shape[:-1], jnp.float32),
+                "v_s": jnp.ones(shape[:-1], jnp.float32)}
+    dtype = jnp.dtype(dtype or default_dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
+            num_heads, num_kv_heads, attention_impl):
+    """Causal forward over right-padded prompts filling the compact cache.
+    Returns (logits [B, S, V], cache)."""
+    from deepspeed_tpu.ops.attention import causal_attention
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+    x = embed_fn(params, tokens)
+    H, KV = num_heads, num_kv_heads
+
+    def body(carry, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
+        q, kk, v = qkv_fn(carry, layer, None)
+        hd = q.shape[-1]
+        ka, va = kk, v
+        if KV != H:
+            rep = H // KV
+            ka = jnp.repeat(kk, rep, axis=2)
+            va = jnp.repeat(v, rep, axis=2)
+        attn = causal_attention(q, ka, va, impl=attention_impl)
+        out = finish_fn(carry, attn.reshape(B, S, H * hd), layer)
+        return out, (kk, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    logits = head_fn(params, x)
+    if "k_s" in cache:      # int8 cache: quantize the prefill block
+        from deepspeed_tpu.ops.pallas.decode_attention import (
+            quantize_prefill_into_cache)
+        return logits, quantize_prefill_into_cache(cache, ks, vs)
+    cache = {
+        "k": lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype),
+                                      (0, 0, 0, 0, 0)),
+        "v": lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype),
+                                      (0, 0, 0, 0, 0)),
+    }
+    return logits, cache
+
+
+def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
+                finish_fn, head_fn, num_heads):
+    """One decode step: tokens [B], lengths [B] current fill counts.
+    Rotary positions are per-row; the GQA cache stays compact (KV heads) —
+    the decode kernel handles the query-group mapping."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    B = tokens.shape[0]
+    H = num_heads
+    x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
+    rows = jnp.arange(B)
+    quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
+
+    def body(carry, layer_kv):
+        if quantized:
+            layer, kc, vc, ksc, vsc = layer_kv
+        else:
+            layer, kc, vc = layer_kv
+            ksc = vsc = None
+        from deepspeed_tpu.models.model import maybe_stream
+        layer = maybe_stream(layer)      # dequant / host-stream per layer
+        q, kk, v = qkv_fn(carry[:, None, :], layer, lengths[:, None])
+        hd = q.shape[-1]
+        if quantized:
+            from deepspeed_tpu.ops.pallas.decode_attention import (
+                quantize_token_into_cache)
+            kc, vc, ksc, vsc = quantize_token_into_cache(
+                kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
+        else:
+            kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
+                                k_scale=ksc, v_scale=vsc)
+        out = finish_fn(carry[:, None, :],
+                        attn.reshape(B, 1, H * hd).astype(carry.dtype),
+                        layer)[:, 0, :]
+        return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
+
+    xs = (params["blocks"], cache["k"], cache["v"])
+    if quantized:
+        xs += (cache["k_s"], cache["v_s"])
+    x, ys = lax.scan(body, x, xs)
+    logits = head_fn(params, x[:, None, :])[:, 0]
+    if quantized:
+        ks, vs, kss, vss = ys
+        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    ks, vs = ys
+    return logits, {"k": ks, "v": vs}

@@ -108,3 +108,23 @@ def test_bert_from_hf_logits_match():
     np.testing.assert_allclose(got[0], ref[0], rtol=3e-4, atol=3e-4)
     np.testing.assert_allclose(got[1, :12], ref[1, :12], rtol=3e-4,
                                atol=3e-4)
+
+
+def test_mixtral_from_hf_logits_match():
+    from transformers import MixtralConfig as HFMixtralConfig
+    from transformers import MixtralForCausalLM
+    from deepspeed_tpu.models.hf import mixtral_from_hf
+    torch.manual_seed(3)
+    hf = MixtralForCausalLM(HFMixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=32, sliding_window=None,
+        tie_word_embeddings=False, router_jitter_noise=0.0)).eval()
+    model, params = mixtral_from_hf(hf, dtype="float32",
+                                    attention_impl="xla")
+    ids = np.random.default_rng(3).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
