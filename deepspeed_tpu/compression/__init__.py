@@ -1,4 +1,7 @@
 """Compression library (reference: deepspeed/compression/)."""
 from deepspeed_tpu.compression.compress import (  # noqa: F401
-    init_compression, compress_params, redundancy_clean,
-    parse_compression_config, CompressionScheduler)
+    init_compression, compress_params, compress_params_traced,
+    redundancy_clean, parse_compression_config,
+    parse_activation_quantization, apply_layer_reduction,
+    activation_quant_scope, maybe_quantize_activation,
+    CompressionScheduler)

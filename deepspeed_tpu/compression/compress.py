@@ -27,18 +27,44 @@ import jax.numpy as jnp
 class LeafPlan:
     quantize_bits: int = 0          # 0 = off
     prune_ratio: float = 0.0        # fraction of weights zeroed
+    row_prune_ratio: float = 0.0    # fraction of OUTPUT rows zeroed
+    head_prune_ratio: float = 0.0   # fraction of attention heads zeroed
+    channel_prune_ratio: float = 0.0  # fraction of INPUT channels zeroed
+    num_heads: int = 0              # head pruning group geometry
     quantize_start: int = 0         # independent schedule gates (the
     prune_start: int = 0            # reference gates each group separately)
+    row_prune_start: int = 0
+    head_prune_start: int = 0
+    channel_prune_start: int = 0
 
 
 def _match_any(path: str, patterns: List[str]) -> bool:
     return any(fnmatch.fnmatch(path, p) or p in path for p in patterns)
 
 
+def _parse_pruning_section(config, key, plans, ratio_attr, start_attr,
+                           extra=None):
+    sec = (config or {}).get(key, {})
+    if not sec.get("shared_parameters", {}).get("enabled"):
+        return
+    offset = int(sec["shared_parameters"].get("schedule_offset", 0))
+    for gname, group in sec.get("different_groups", {}).items():
+        ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
+        for pat in group.get("modules", ["*"]):
+            pl = plans.setdefault(pat, LeafPlan())
+            setattr(pl, ratio_attr, 1.0 - ratio)
+            setattr(pl, start_attr, offset)
+            if extra:
+                for k, attr in extra.items():
+                    val = group.get("params", {}).get(k)
+                    if val is not None:
+                        setattr(pl, attr, int(val))
+
+
 def parse_compression_config(config: dict) -> Dict[str, LeafPlan]:
-    """Reference schema (compression/config.py): weight_quantization +
-    sparse_pruning sections with shared_parameters / different_groups, each
-    group naming target modules."""
+    """Reference schema (compression/config.py): weight_quantization,
+    sparse/row/head/channel pruning sections with shared_parameters /
+    different_groups, each group naming target modules."""
     plans: Dict[str, LeafPlan] = {}
     wq = (config or {}).get("weight_quantization", {})
     if wq.get("shared_parameters", {}).get("enabled"):
@@ -49,16 +75,39 @@ def parse_compression_config(config: dict) -> Dict[str, LeafPlan]:
                 plans.setdefault(pat, LeafPlan()).quantize_bits = bits
                 plans[pat].quantize_start = int(
                     shared.get("schedule_offset", 0))
-    sp = (config or {}).get("sparse_pruning", {})
-    if sp.get("shared_parameters", {}).get("enabled"):
-        shared = sp["shared_parameters"]
-        for gname, group in sp.get("different_groups", {}).items():
-            ratio = float(group.get("params", {}).get("dense_ratio", 0.5))
-            for pat in group.get("modules", ["*"]):
-                plans.setdefault(pat, LeafPlan()).prune_ratio = 1.0 - ratio
-                plans[pat].prune_start = int(
-                    shared.get("schedule_offset", 0))
+    _parse_pruning_section(config, "sparse_pruning", plans,
+                           "prune_ratio", "prune_start")
+    _parse_pruning_section(config, "row_pruning", plans,
+                           "row_prune_ratio", "row_prune_start")
+    _parse_pruning_section(config, "head_pruning", plans,
+                           "head_prune_ratio", "head_prune_start",
+                           extra={"num_heads": "num_heads"})
+    _parse_pruning_section(config, "channel_pruning", plans,
+                           "channel_prune_ratio", "channel_prune_start")
     return plans
+
+
+def parse_activation_quantization(config: dict):
+    """-> (bits, schedule_offset) or None (reference
+    compression/config.py activation_quantization section; consumed by the
+    engine's scan-level activation hook).
+
+    The hook quantizes every block output at ONE bit-width — per-module
+    activation groups are not representable (warned)."""
+    aq = (config or {}).get("activation_quantization", {})
+    if not aq.get("shared_parameters", {}).get("enabled"):
+        return None
+    groups = list(aq.get("different_groups", {}).values())
+    scoped = [g for g in groups
+              if g.get("modules", ["*"]) not in (["*"], "*")]
+    if len(groups) > 1 or scoped:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "activation_quantization: per-module groups collapse to one "
+            "global bit-width (the scan hook quantizes every block "
+            "output); using the first group's bits")
+    bits = int(groups[0].get("params", {}).get("bits", 8)) if groups else 8
+    return bits, int(aq["shared_parameters"].get("schedule_offset", 0))
 
 
 def _fake_quantize(w, bits: int):
@@ -98,6 +147,84 @@ def _prune_mask(w, ratio: float):
     return jnp.abs(w.astype(jnp.float32)) > thresh
 
 
+def _row_prune_mask(w, ratio: float):
+    """Structured OUTPUT-dim pruning (reference LinearLayer_Compress row
+    pruning): whole rows of the [in, out] matrix zero by L1 norm.  In the
+    native [in, out] layout an output unit is a COLUMN — mask shape
+    [1, out]."""
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=0)      # [out]
+    k = int(round(norms.size * ratio))
+    if k <= 0:
+        return jnp.ones((1, w.shape[-1]), bool)
+    thresh = jnp.sort(norms)[k - 1]
+    return (norms > thresh)[None, :]
+
+
+def _channel_prune_mask(w, ratio: float):
+    """Structured INPUT-dim pruning (reference channel pruning): whole
+    input channels (rows of [in, out]) zero by L1 norm."""
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-1)     # [in]
+    k = int(round(norms.size * ratio))
+    if k <= 0:
+        return jnp.ones((w.shape[0], 1), bool)
+    thresh = jnp.sort(norms)[k - 1]
+    return (norms > thresh)[:, None]
+
+
+def _head_prune_mask(w, ratio: float, num_heads: int):
+    """Structured attention-head pruning (reference head pruning targets
+    the attention OUTPUT projection, where the head-concatenated stream is
+    the INPUT): the [in, out] matrix's IN dim splits into ``num_heads``
+    head_dim groups; whole heads zero by group L1 norm."""
+    inp = w.shape[0]
+    if num_heads <= 0 or inp % num_heads:
+        raise ValueError(
+            f"head_pruning: num_heads={num_heads} does not divide the "
+            f"projection input dim {inp} — set the group's params.num_heads "
+            "to the model's head count")
+    hd = inp // num_heads
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)).reshape(num_heads, hd,
+                                                           -1),
+                    axis=(1, 2))                                 # [H]
+    k = int(round(num_heads * ratio))
+    if k <= 0:
+        return jnp.ones((inp, 1), bool)
+    thresh = jnp.sort(norms)[k - 1]
+    return jnp.repeat(norms > thresh, hd)[:, None]
+
+
+def _apply_plan(w, plan: LeafPlan, gates=None):
+    """Apply one leaf's active compressions.  ``gates``: optional dict of
+    traced booleans per compression kind (traced-step gating); None = all
+    active.  Stacked [L, in, out] leaves compress per layer slice."""
+    if w.ndim >= 3:
+        return jax.vmap(lambda s: _apply_plan(s, plan, gates))(w)
+    g = (lambda k: True) if gates is None else (lambda k: gates[k])
+
+    def gated(kind, new, old):
+        gk = g(kind)
+        if gk is True:
+            return new
+        return jnp.where(gk, new, old)
+
+    if plan.prune_ratio > 0:
+        w = gated("sparse",
+                  jnp.where(_prune_mask(w, plan.prune_ratio), w,
+                            jnp.zeros_like(w)), w)
+    if plan.row_prune_ratio > 0:
+        w = gated("row", w * _row_prune_mask(
+            w, plan.row_prune_ratio).astype(w.dtype), w)
+    if plan.channel_prune_ratio > 0:
+        w = gated("channel", w * _channel_prune_mask(
+            w, plan.channel_prune_ratio).astype(w.dtype), w)
+    if plan.head_prune_ratio > 0:
+        w = gated("head", w * _head_prune_mask(
+            w, plan.head_prune_ratio, plan.num_heads).astype(w.dtype), w)
+    if plan.quantize_bits:
+        w = gated("quant", _fake_quantize(w, plan.quantize_bits), w)
+    return w
+
+
 class CompressionScheduler:
     """Step-gated application (reference compression/scheduler.py, driven at
     engine.py:2044)."""
@@ -114,12 +241,22 @@ class CompressionScheduler:
         masked out (each compression group schedules independently)."""
         out = {}
         for p, pl in self.plans.items():
-            q = pl.quantize_bits if (pl.quantize_bits
-                                     and self.step >= pl.quantize_start) else 0
-            r = pl.prune_ratio if (pl.prune_ratio
-                                   and self.step >= pl.prune_start) else 0.0
-            if q or r:
-                out[p] = LeafPlan(quantize_bits=q, prune_ratio=r)
+            gate = lambda v, start: v if (v and self.step >= start) else \
+                type(v)(0)
+            active = LeafPlan(
+                quantize_bits=gate(pl.quantize_bits, pl.quantize_start),
+                prune_ratio=gate(pl.prune_ratio, pl.prune_start),
+                row_prune_ratio=gate(pl.row_prune_ratio,
+                                     pl.row_prune_start),
+                head_prune_ratio=gate(pl.head_prune_ratio,
+                                      pl.head_prune_start),
+                channel_prune_ratio=gate(pl.channel_prune_ratio,
+                                         pl.channel_prune_start),
+                num_heads=pl.num_heads)
+            if (active.quantize_bits or active.prune_ratio
+                    or active.row_prune_ratio or active.head_prune_ratio
+                    or active.channel_prune_ratio):
+                out[p] = active
         return out
 
 
@@ -129,28 +266,73 @@ def init_compression(params, config: dict):
     return params, CompressionScheduler(parse_compression_config(config))
 
 
+def _compress_tree(params, plans: Dict[str, LeafPlan], gate_fn):
+    """Shared plan-matching loop; ``gate_fn(plan) -> gates-dict or None``."""
+    pairs, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in pairs:
+        pstr = "/".join(str(getattr(k, "key", k)) for k in path)
+        plan = next((pl for pat, pl in plans.items()
+                     if _match_any(pstr, [pat])), None)
+        if plan is None or np.ndim(leaf) < 2:
+            out.append(leaf)
+            continue
+        out.append(_apply_plan(leaf, plan, gate_fn(plan)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def compress_params(params, scheduler: CompressionScheduler):
     """Apply the active quantization/pruning plans to matching leaves."""
     active = scheduler.active_plans()
     if not active:
         return params
-    pairs, treedef = jax.tree_util.tree_flatten_with_path(params)
-    out = []
-    for path, leaf in pairs:
-        pstr = "/".join(str(getattr(k, "key", k)) for k in path)
-        plan = next((pl for pat, pl in active.items()
-                     if _match_any(pstr, [pat])), None)
-        if plan is None or np.ndim(leaf) < 2:
-            out.append(leaf)
-            continue
-        w = leaf
-        if plan.prune_ratio > 0:
-            w = jnp.where(_prune_mask(w, plan.prune_ratio), w,
-                          jnp.zeros_like(w))
-        if plan.quantize_bits:
-            w = _fake_quantize(w, plan.quantize_bits)
-        out.append(w)
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return _compress_tree(params, active, lambda plan: None)
+
+
+def compress_params_traced(params, step, plans: Dict[str, LeafPlan]):
+    """Train-step variant: every schedule gate compares the TRACED ``step``
+    scalar, so one compiled program covers the whole schedule (no
+    recompile when a compression group activates).  This is the hook the
+    engine calls every step (reference engine.py:2044 drives the scheduler
+    per step)."""
+    if not plans:
+        return params
+    return _compress_tree(params, plans, lambda plan: {
+        "quant": step >= plan.quantize_start,
+        "sparse": step >= plan.prune_start,
+        "row": step >= plan.row_prune_start,
+        "head": step >= plan.head_prune_start,
+        "channel": step >= plan.channel_prune_start,
+    })
+
+
+def apply_layer_reduction(params, config: dict, blocks_key: str = "blocks"):
+    """Layer reduction / distillation init (reference
+    compression/compress.py student_initialization + config
+    ``layer_reduction``): keep only the configured teacher layers of the
+    stacked blocks.  ``teacher_layer`` lists the kept indices; absent, the
+    first ``keep_number_of_layers`` layers are kept.  Returns (params,
+    num_layers_kept) — rebuild the model config with the new depth."""
+    lr = (config or {}).get("layer_reduction", {})
+    if not lr.get("enabled"):
+        return params, None
+    blocks = params.get(blocks_key)
+    if blocks is None:
+        raise ValueError(
+            f"layer_reduction needs a stacked '{blocks_key}' subtree")
+    L = next(iter(jax.tree.leaves(blocks))).shape[0]
+    keep = lr.get("teacher_layer")
+    if keep is None:
+        n = int(lr.get("keep_number_of_layers", L))
+        keep = list(range(n))
+    keep = [int(i) for i in keep]
+    if any(i >= L for i in keep):
+        raise ValueError(f"layer_reduction: teacher_layer {keep} out of "
+                         f"range for {L} layers")
+    idx = jnp.asarray(keep)
+    params = dict(params)
+    params[blocks_key] = jax.tree.map(lambda x: x[idx], blocks)
+    return params, len(keep)
 
 
 def redundancy_clean(params, config: dict):
@@ -159,3 +341,35 @@ def redundancy_clean(params, config: dict):
     _, scheduler = init_compression(params, config)
     scheduler.step = 2 ** 31 - 1        # all schedules elapsed
     return compress_params(params, scheduler)
+
+
+# ------------------------------------------------------------ activation quant
+# (reference basic_layer.py activation quantization: inputs quantize with a
+# dynamic per-tensor range inside the compressed module's forward; here the
+# models' layer scan applies the STE quantizer to each block's output when
+# the scope is active — see models/model.py scan_blocks)
+import contextlib
+import contextvars
+
+_ACT_QUANT: contextvars.ContextVar = contextvars.ContextVar(
+    "ds_act_quant", default=0)
+
+
+@contextlib.contextmanager
+def activation_quant_scope(bits: int):
+    token = _ACT_QUANT.set(int(bits))
+    try:
+        yield
+    finally:
+        _ACT_QUANT.reset(token)
+
+
+def get_activation_quant_bits() -> int:
+    return _ACT_QUANT.get()
+
+
+def maybe_quantize_activation(x):
+    bits = _ACT_QUANT.get()
+    if not bits:
+        return x
+    return _fake_quantize(x, bits)

@@ -189,9 +189,17 @@ def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int,
     theta = batch.get("pld_theta") if isinstance(batch, dict) else None
     use_pld = theta is not None and rng is not None
 
+    # activation quantization (reference compression activation_quantization
+    # via LinearLayer_Compress; here the block output quantizes through an
+    # STE when the engine's compression scope is active)
+    from deepspeed_tpu.compression.compress import (
+        get_activation_quant_bits, maybe_quantize_activation)
+    use_aq = bool(get_activation_quant_bits())
+
     if not (use_ltd or use_pld):
         def plain(carry, layer):
-            return block_fn(carry, layer), None
+            out = block_fn(carry, layer)
+            return (maybe_quantize_activation(out) if use_aq else out), None
         out, _ = lax.scan(plain, x, blocks)
         return out
 
@@ -209,6 +217,8 @@ def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int,
             gate = jax.random.bernoulli(jax.random.fold_in(layer_rng, 1),
                                         keep_p)
             out = jnp.where(gate, out, h)
+        if use_aq:
+            out = maybe_quantize_activation(out)
         return (out, idx + 1), None
 
     (out, _), _ = lax.scan(body, (x, jnp.int32(0)), blocks)

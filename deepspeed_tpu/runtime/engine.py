@@ -546,6 +546,31 @@ class DeepSpeedEngine:
             from deepspeed_tpu import comm as _comm
             from deepspeed_tpu.utils.comms_logging import CommsLogger
             _comm.configure(comms_logger=CommsLogger(self._config.comms_config))
+        # compression-aware training (reference engine.py:2044 drives the
+        # compression scheduler every step; here the compiled step applies
+        # the plans with traced schedule gates — see compression/compress.py)
+        self._compression_plans = None
+        self._aq = None
+        cc = self._config.compression_config
+        if cc:
+            from deepspeed_tpu.compression import (
+                parse_compression_config, parse_activation_quantization)
+            plans = parse_compression_config(cc)
+            self._compression_plans = plans or None
+            self._aq = parse_activation_quantization(cc)
+            if self._compression_plans and (self._offload
+                                            or self._offload_param):
+                logger.warning(
+                    "compression_training: weight plans are not applied in "
+                    "the offload execution tiers (compressing would gather "
+                    "the streamed params); activation quantization still "
+                    "applies")
+                self._compression_plans = None
+            if (cc.get("layer_reduction", {}) or {}).get("enabled"):
+                logger.warning(
+                    "layer_reduction is an offline transform — call "
+                    "deepspeed_tpu.compression.apply_layer_reduction on "
+                    "the params BEFORE initialize(); ignoring here")
         # sanitizer tier (SURVEY §5: race detection / sanitizers)
         dbg = self._config.debug_config
         self._sanitize_gradients = dbg.sanitize_gradients
@@ -706,7 +731,16 @@ class DeepSpeedEngine:
             return None
 
     # ------------------------------------------------------------------ loss fn
-    def _scaled_loss_fn(self, params, batch, rng, scale):
+    def _compress_traced(self, params, step):
+        """Apply the compression-training plans to the compute params with
+        traced schedule gates (reference engine.py:2044 scheduler-per-step;
+        no-op without a compression config)."""
+        if self._compression_plans is None:
+            return params
+        from deepspeed_tpu.compression import compress_params_traced
+        return compress_params_traced(params, step, self._compression_plans)
+
+    def _scaled_loss_fn(self, params, batch, rng, scale, compress_step=None):
         if self._use_streamed and isinstance(params, dict):
             # blocks stay fp32 in pinned host; the models cast each weight at
             # point of use (after the per-layer stream), so the AD transpose
@@ -718,6 +752,11 @@ class DeepSpeedEngine:
                        for k, v in params.items()}
         else:
             cparams = _tree_cast(params, self.compute_dtype)
+        if compress_step is not None:
+            # INSIDE the grad: pruning masks zero the pruned positions'
+            # gradients (w*mask transpose) and the quantizer's STE backward
+            # actually runs — reference QAT/pruning semantics
+            cparams = self._compress_traced(cparams, compress_step)
         loss = self.model.loss(cparams, batch, rng)
         return loss.astype(jnp.float32) * scale
 
@@ -997,7 +1036,7 @@ class DeepSpeedEngine:
         wrap_any = any(w is not None for w in plan["nonblock_wrap"])
         ob_axis = manual if len(manual) > 1 else manual[0]
 
-        def grad_fn(params, stacked_batch, rng, scale,
+        def grad_fn(params, stacked_batch, rng, scale, compress_step=None,
                     dense_now=None, ob=None):
             p_specs = jax.tree.unflatten(treedef, plan["in_specs"])
             b_specs = jax.tree.map(
@@ -1013,6 +1052,9 @@ class DeepSpeedEngine:
 
                 def loss_fn(prm, mb, rng_, sc):
                     cparams = _tree_cast(prm, self.compute_dtype)
+                    if compress_step is not None:
+                        cparams = self._compress_traced(cparams,
+                                                        compress_step)
                     if wrap_any:
                         leaves = jax.tree.leaves(cparams)
                         leaves = [
@@ -1149,12 +1191,23 @@ class DeepSpeedEngine:
         qgz_fn = self._qgz_grad_fn()
         plan = self._get_qgz_plan()
         onebit = plan["onebit"] if plan is not None else None
+        wrapped_any = plan is not None and (
+            plan["block_scope"] is not None
+            or any(w is not None for w in plan["nonblock_wrap"]))
+        use_compress = (self._compression_plans is not None
+                        and not wrapped_any)
+        if self._compression_plans is not None and wrapped_any:
+            logger.warning(
+                "compression_training: plans are not applied in the "
+                "stage-3 quantized-exchange tier (compressing per-shard "
+                "would disagree across devices); training uncompressed")
 
         def train_step(state, stacked_batch, rng):
             """stacked_batch leaves: [gas, global_micro, ...]."""
             params, opt_state = state["params"], state["opt_state"]
             scaler = state["scaler"]
             scale = scaler.cur_scale if fp16 else jnp.float32(1.0)
+            cs = state["step"] if use_compress else None
 
             if qgz_fn is not None and onebit is not None:
                 # dense-vs-1-bit decision per step (reference schedule):
@@ -1174,7 +1227,8 @@ class DeepSpeedEngine:
                     dense_now = count <= onebit["freeze_step"]
                     new_vi, new_vc = ob["var_interval"], ob["var_counter"]
                 loss_sum, grads, new_ob = qgz_fn(
-                    params, stacked_batch, rng, scale, dense_now, ob)
+                    params, stacked_batch, rng, scale, cs,
+                    dense_now, ob)
                 grads = policy.constrain_grads(grads, grad_specs)
                 new_state, metrics = self._apply_grads(state, grads)
                 # overflow steps roll back every 1-bit residual/counter
@@ -1201,13 +1255,14 @@ class DeepSpeedEngine:
                 return new_state, metrics
 
             if qgz_fn is not None:
-                loss_sum, grads = qgz_fn(params, stacked_batch, rng, scale)
+                loss_sum, grads = qgz_fn(params, stacked_batch, rng, scale,
+                                         cs)
                 grads = policy.constrain_grads(grads, grad_specs)
             else:
                 def micro(carry, mb):
                     grads_acc, loss_acc = carry
                     loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
-                        params, mb, rng, scale / gas)
+                        params, mb, rng, scale / gas, cs)
                     grads = _tree_cast(grads, jnp.float32)
                     grads = policy.constrain_grads(grads, grad_specs)
                     grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
@@ -1257,18 +1312,22 @@ class DeepSpeedEngine:
                 f"pipeline.num_pipe_buffers={n_buffers} does not divide "
                 f"gradient_accumulation_steps={gas}; running all-live")
 
-        def loss_of_chunk(params, chunk_batch, rng, scale):
+        def loss_of_chunk(params, chunk_batch, rng, scale, cs=None):
             cparams = _tree_cast(params, self.compute_dtype)
+            if cs is not None:
+                cparams = self._compress_traced(cparams, cs)
             loss = self.model.loss(cparams, chunk_batch, rng)
             return loss.astype(jnp.float32) * scale
 
         def train_step(state, stacked_batch, rng):
             params = state["params"]
+            cs = (state["step"] if self._compression_plans is not None
+                  else None)
             scale = state["scaler"].cur_scale if fp16 else jnp.float32(1.0)
 
             if not chunked:
                 loss, grads = jax.value_and_grad(loss_of_chunk)(
-                    params, stacked_batch, rng, scale)
+                    params, stacked_batch, rng, scale, cs)
             else:
                 n_chunks = gas // n_buffers
                 chunks = jax.tree.map(
@@ -1278,7 +1337,7 @@ class DeepSpeedEngine:
                 def body(carry, chunk):
                     g_acc, l_acc = carry
                     l, g = jax.value_and_grad(loss_of_chunk)(
-                        params, chunk, rng, scale / n_chunks)
+                        params, chunk, rng, scale / n_chunks, cs)
                     g = _tree_cast(g, jnp.float32)
                     g = policy.constrain_grads(g, grad_specs)
                     return (jax.tree.map(jnp.add, g_acc, g), l_acc + l), None
@@ -1363,11 +1422,26 @@ class DeepSpeedEngine:
     #: the LTD scope, so it must not fork per keep value
     _LTD_SENSITIVE = ("train_step", "grad_step", "grad_micro", "grad")
 
+    def _aq_active(self) -> bool:
+        return self._aq is not None and self.global_steps >= self._aq[1]
+
+    def _aq_scope(self):
+        """Activation-quantization scope (compression config
+        ``activation_quantization``): models' layer scans STE-quantize each
+        block output while active.  One recompile at the schedule offset."""
+        import contextlib
+        if not self._aq_active():
+            return contextlib.nullcontext()
+        from deepspeed_tpu.compression import activation_quant_scope
+        return activation_quant_scope(self._aq[0])
+
     def _get_compiled(self, name: str):
         # random-LTD changes the traced keep count: one compile per value,
         # only for functions that actually trace the model
         key = (f"{name}@ltd{self._ltd_keep}"
                if self._ltd_keep and name in self._LTD_SENSITIVE else name)
+        if self._aq_active() and name in self._LTD_SENSITIVE + ("loss",):
+            key = f"{key}@aq"
         if key in self._compiled:
             return self._compiled[key]
         # batch args are pre-placed by _shard_batch (per-leaf ndim-aware
@@ -1380,14 +1454,18 @@ class DeepSpeedEngine:
         elif name == "loss":
             fn = jax.jit(
                 lambda state, batch, rng: self._scaled_loss_fn(
-                    state["params"], batch, rng, jnp.float32(1.0)))
+                    state["params"], batch, rng, jnp.float32(1.0),
+                    state["step"] if self._compression_plans is not None
+                    else None))
         elif name == "grad":
             def grad_fn(state, batch, rng, grads_acc):
                 scale = (state["scaler"].cur_scale
                          if self._config.fp16.enabled else jnp.float32(1.0))
                 gas = self.gradient_accumulation_steps()
                 loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
-                    state["params"], batch, rng, scale / gas)
+                    state["params"], batch, rng, scale / gas,
+                    state["step"] if self._compression_plans is not None
+                    else None)
                 grads = _tree_cast(grads, jnp.float32)
                 grads = self.zero_policy.constrain_grads(grads, self.grad_specs)
                 grads = jax.tree.map(jnp.add, grads_acc, grads)
@@ -1676,7 +1754,8 @@ class DeepSpeedEngine:
             losses = []
             for i in range(gas):
                 mb = jax.tree.map(lambda x: x[i], batch)
-                with self._stream_scope(), self._ltd_scope():
+                with self._stream_scope(), self._ltd_scope(), \
+                        self._aq_scope():
                     loss, grads = fn(self.state, mb, self._next_rng())
                 losses.append(loss)
                 if self.streamed_optimizer is not None:
@@ -1692,13 +1771,15 @@ class DeepSpeedEngine:
             else:
                 metrics = self._host_apply(acc, mean_loss)
         elif self._offload:
-            with self._stream_scope(), self._ltd_scope():
+            with self._stream_scope(), self._ltd_scope(), \
+                    self._aq_scope():
                 loss, grads = self._get_compiled("grad_step")(
                     self.state, batch, self._next_rng())
             metrics = self._host_apply(grads, loss)
         else:
             fn = self._get_compiled("train_step")
-            with self._train_scope(), self._ltd_scope():
+            with self._train_scope(), self._ltd_scope(), \
+                    self._aq_scope():
                 self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
         # syncing on the loss every step costs a device->host round trip
@@ -1728,7 +1809,7 @@ class DeepSpeedEngine:
         if self._micro_grads is None:
             self._micro_grads = self._get_compiled("zero_grads")(
                 self.state["params"])
-        with self._stream_scope(), self._ltd_scope():
+        with self._stream_scope(), self._ltd_scope(), self._aq_scope():
             loss, grads = self._get_compiled("grad")(
                 self.state, batch, self._next_rng(), self._micro_grads)
         self._micro_grads = None   # donated into grads
@@ -1821,7 +1902,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch, stacked=False)
-        with self._stream_scope():
+        with self._stream_scope(), self._aq_scope():
             return self._get_compiled("loss")(self.state, batch,
                                               self._next_rng())
 

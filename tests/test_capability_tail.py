@@ -52,8 +52,10 @@ def test_compression_quantizes_and_prunes():
     q = np.asarray(out["blocks"]["qkv_w"])
     w = np.asarray(params["blocks"]["qkv_w"])
     assert not np.allclose(q, w)                 # quantized
-    # 8-bit symmetric: at most 255 distinct values
-    assert len(np.unique(q)) <= 256
+    # 8-bit symmetric with a per-layer scale (reference quantizes per
+    # Linear module): at most 255 distinct values per layer slice
+    for l in range(q.shape[0]):
+        assert len(np.unique(q[l])) <= 256
     # pruning gated behind schedule_offset=2
     np.testing.assert_allclose(np.asarray(out["blocks"]["mlp_out_w"]),
                                np.asarray(params["blocks"]["mlp_out_w"]))
@@ -145,3 +147,140 @@ def test_elastic_agent_validates_world():
     agent = DSElasticAgent([sys.executable, "-c", "pass"], ds_config=cfg)
     with pytest.raises(ElasticityIncompatibleWorldSize):
         agent.run(world_size=7)
+
+
+# ------------------------------------------- compression wired into training
+
+def test_compression_applies_in_train_step(devices8):
+    """round-2 VERDICT item 4: the engine drives the compression schedule
+    every step (reference engine.py:2044) — pruning masks are enforced in
+    the compiled step's compute params, gated by the traced step."""
+    import deepspeed_tpu
+    from deepspeed_tpu.compression import compress_params_traced
+    cfg = base_config(
+        compression_training={
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": ["mlp_out_w"]}}}})
+    engine, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=cfg)
+    assert engine._compression_plans is not None
+    for i in range(4):
+        b = random_batches(1, batch_size=8, seed=i)[0]
+        loss = engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+        assert np.isfinite(float(loss))
+        eff = compress_params_traced(engine.state["params"],
+                                     engine.state["step"],
+                                     engine._compression_plans)
+        frac0 = float((np.asarray(eff["blocks"]["mlp_out_w"]) == 0).mean())
+        if int(engine.state["step"]) >= 2:
+            assert 0.4 < frac0 < 0.6, (i, frac0)   # mask enforced
+        else:
+            assert frac0 < 0.1, (i, frac0)         # gate not yet elapsed
+
+
+def test_compression_before_offset_matches_uncompressed(devices8):
+    """With every schedule offset in the future the compressed step is the
+    identity — losses equal an uncompressed run exactly."""
+    import deepspeed_tpu
+    from tests.test_zeropp import _train
+    ref, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(),
+                                       config=base_config())
+    cmp_cfg = base_config(
+        compression_training={
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 1000},
+                "different_groups": {
+                    "wq": {"params": {"target_bits": 8},
+                           "modules": ["qkv_w"]}}}})
+    cmp, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=cmp_cfg)
+    np.testing.assert_allclose(_train(cmp, steps=3, seed=21),
+                               _train(ref, steps=3, seed=21), rtol=1e-6)
+
+
+def test_structured_pruning_row_head_channel():
+    """Row/head/channel structured tiers (reference basic_layer.py row,
+    head, channel pruning): whole output columns / head groups / input rows
+    zero out per layer slice."""
+    from deepspeed_tpu.compression import redundancy_clean
+    m = tiny_gpt2()
+    params = jax.jit(m.init)(jax.random.PRNGKey(0))
+    cfg = {
+        "row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "r": {"params": {"dense_ratio": 0.75},
+                      "modules": ["mlp_in_w"]}}},
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "c": {"params": {"dense_ratio": 0.75},
+                      "modules": ["mlp_out_w"]}}},
+        "head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "h": {"params": {"dense_ratio": 0.5, "num_heads": 4},
+                      "modules": ["proj_w"]}}},
+    }
+    out = redundancy_clean(params, cfg)
+    # row pruning: whole OUTPUT columns zero, identical per layer slice
+    w = np.asarray(out["blocks"]["mlp_in_w"])        # [L, D, 4D]
+    col_zero = (w == 0).all(axis=1)                  # [L, 4D]
+    assert np.isclose(col_zero.mean(), 0.25, atol=0.05)
+    # channel pruning: whole INPUT rows zero
+    w = np.asarray(out["blocks"]["mlp_out_w"])       # [L, 4D, D]
+    row_zero = (w == 0).all(axis=2)                  # [L, 4D]
+    assert np.isclose(row_zero.mean(), 0.25, atol=0.05)
+    # head pruning: the proj INPUT is the head-concatenated stream —
+    # contiguous head_dim groups of the IN dim zero together
+    w = np.asarray(out["blocks"]["proj_w"])          # [L, D, D] (H=4)
+    L, D, _ = w.shape
+    hd = D // 4
+    head_zero = (w.reshape(L, 4, hd, D) == 0).all(axis=(2, 3))   # [L, 4]
+    assert np.isclose(head_zero.mean(), 0.5, atol=0.01)
+
+
+def test_activation_quantization_training(devices8):
+    """activation_quantization: block outputs quantize through an STE once
+    the schedule offset elapses; training stays finite and the compiled
+    step actually changes (loss differs from the unquantized run)."""
+    import deepspeed_tpu
+    from tests.test_zeropp import _train
+    aq_cfg = base_config(
+        compression_training={
+            "activation_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                "different_groups": {
+                    "aq": {"params": {"bits": 4}, "modules": ["*"]}}}})
+    ref, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(),
+                                       config=base_config())
+    aq, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=aq_cfg)
+    l_ref = _train(ref, steps=5, seed=33)
+    l_aq = _train(aq, steps=5, seed=33)
+    assert all(np.isfinite(l_aq))
+    np.testing.assert_allclose(l_aq[:2], l_ref[:2], rtol=1e-6)  # pre-offset
+    assert abs(l_aq[3] - l_ref[3]) > 1e-6   # 4-bit activations bite
+
+
+def test_layer_reduction_transform():
+    """layer_reduction (reference compress.py student init): keep the
+    configured teacher layers of the stacked blocks."""
+    from deepspeed_tpu.compression import apply_layer_reduction
+    m = tiny_gpt2(num_layers=4)
+    params = jax.jit(m.init)(jax.random.PRNGKey(0))
+    cfg = {"layer_reduction": {"enabled": True, "teacher_layer": [0, 3]}}
+    small, n = apply_layer_reduction(params, cfg)
+    assert n == 2
+    np.testing.assert_allclose(
+        np.asarray(small["blocks"]["qkv_w"][1]),
+        np.asarray(params["blocks"]["qkv_w"][3]))
+    # reduced model trains end-to-end
+    import deepspeed_tpu
+    m2 = tiny_gpt2(num_layers=2)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=m2, model_parameters=small, config=base_config())
+    b = random_batches(1, batch_size=8, seed=0)[0]
+    assert np.isfinite(float(engine.train_batch(
+        batch={"input_ids": b["input_ids"][None]})))

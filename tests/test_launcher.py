@@ -208,8 +208,15 @@ def test_launch_module_mode():
 TRAIN_SCRIPT = textwrap.dedent("""
     import os
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               " --xla_force_host_platform_device_count=8")
+    # drop any inherited virtual-device flags (the outer pytest process
+    # forces an 8-device mesh): one CPU device — this test exercises the
+    # LAUNCHER, not the mesh
+    os.environ["XLA_FLAGS"] = ""
+    # a sitecustomize may have pre-imported jax pinned to a remote TPU
+    # platform; the env var alone is not honoured then — pin the live
+    # config too so the smoke test never touches (or hangs on) a tunnel
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     assert os.environ["COORDINATOR_ADDRESS"].startswith("127.0.0.1")
     assert os.environ["NPROC"] == "1" and os.environ["PROCESS_ID"] == "0"
     import numpy as np
