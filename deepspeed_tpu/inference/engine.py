@@ -247,11 +247,36 @@ class InferenceEngine:
 
         cache_dtype = self._config.kv_cache_dtype or dtype
 
+        def _cache_constraint(B):
+            """Stable KV-cache layout for the whole generate program: the
+            batch dim shards over as much of the dp group as divides it,
+            every other dim left unconstrained (TP still shards the KV-head
+            dim).  Without the pin, XLA picks per-while-loop layouts and
+            falls back to replicate-then-repartition between them (SPMD
+            'Involuntary full rematerialization')."""
+            shape = dict(self.mesh.shape)
+            axes = []
+            rem = B
+            for a in self.topology.data_parallel_axes:
+                if shape[a] > 1 and rem % shape[a] == 0:
+                    axes.append(a)
+                    rem //= shape[a]
+            if not axes:
+                return lambda cache: cache
+            def pin_leaf(c):
+                spec = P(*([P.UNCONSTRAINED, tuple(axes)]
+                           + [P.UNCONSTRAINED] * (c.ndim - 2)))
+                return jax.lax.with_sharding_constraint(
+                    c, NamedSharding(self.mesh, spec))
+            return lambda cache: jax.tree.map(pin_leaf, cache)
+
         def gen(params, tokens_padded, lengths, rng, temperature):
             B = tokens_padded.shape[0]
-            cache = model.init_cache_fn(B, cache_size, cache_dtype)
+            pin = _cache_constraint(B)
+            cache = pin(model.init_cache_fn(B, cache_size, cache_dtype))
             logits, cache = model.prefill_fn(
                 params, {"input_ids": tokens_padded}, cache)
+            cache = pin(cache)
             last = logits[jnp.arange(B), lengths - 1]       # [B, V]
             rng, sub = jax.random.split(rng)
             nxt = sample(last, sub, do_sample=do_sample,
@@ -262,6 +287,7 @@ class InferenceEngine:
             def body(carry, _):
                 cache, tok, lens, rng, done = carry
                 logits, cache = model.decode_fn(params, tok, cache, lens)
+                cache = pin(cache)
                 rng, sub = jax.random.split(rng)
                 new = sample(logits, sub, do_sample=do_sample,
                              temperature=temperature, top_k=top_k, top_p=top_p)

@@ -85,23 +85,34 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     """
     B, S, D = x.shape
     T = B * S
-    xt = x.reshape(T, D)
-    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    mesh = get_topology().mesh
+    wsc = jax.lax.with_sharding_constraint
+    # token dim = flattened (batch-sharded, seq-sharded) dims: pin every
+    # token-major tensor to the same layout so the SPMD partitioner never
+    # falls back to replicate-then-repartition on the backward transposes
+    tok = P(tuple(get_topology().zero_shard_axes))
+    tok_sh = jax.sharding.NamedSharding(mesh, tok)
+    xt = wsc(x.reshape(T, D), tok_sh)
+    logits = wsc(
+        xt.astype(jnp.float32) @ params["router"].astype(jnp.float32),
+        tok_sh)
     cf = config.capacity_factor if train else config.eval_capacity_factor
     noise = rng if (train and config.noisy_gate_policy) else None
     gate: GateOutput = topkgating(logits, config.top_k, cf,
                                   config.min_capacity, noise,
                                   config.z_loss_coef)
+    combine_w = wsc(gate.combine_weights, tok_sh)
+    dispatch_m = wsc(gate.dispatch_mask, tok_sh)
     # dispatch: [T,E,C] x [T,D] -> [E,C,D]  (token->expert all-to-all)
     dispatched = jnp.einsum("tec,td->ecd",
-                            gate.dispatch_mask.astype(x.dtype), xt)
-    mesh = get_topology().mesh
-    dispatched = jax.lax.with_sharding_constraint(
-        dispatched, jax.sharding.NamedSharding(mesh, P(EXPERT_AXIS, None, None)))
+                            dispatch_m.astype(x.dtype), xt)
+    dispatched = wsc(dispatched,
+                     jax.sharding.NamedSharding(mesh, P(EXPERT_AXIS)))
     out = _expert_ffn(params, dispatched, config)          # [E, C, D]
+    out = wsc(out, jax.sharding.NamedSharding(mesh, P(EXPERT_AXIS)))
     # combine: [T,E,C] x [E,C,D] -> [T,D]  (expert->token all-to-all)
-    combined = jnp.einsum("tec,ecd->td",
-                          gate.combine_weights.astype(x.dtype), out)
+    combined = wsc(jnp.einsum("tec,ecd->td",
+                              combine_w.astype(x.dtype), out), tok_sh)
     aux = gate.l_aux * config.aux_loss_coef + gate.router_z_loss
     return combined.reshape(B, S, D), aux
 

@@ -190,3 +190,38 @@ def test_mixtral_ep_sharded_generate(devices8):
     got = np.asarray(ep_eng.generate(prompts, max_new_tokens=10,
                                      do_sample=False))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_moe_train_step_no_involuntary_remat(devices8, capfd):
+    """round-2 VERDICT item 5: the EPxSPxZeRO-2 MoE train step compiles
+    without XLA SPMD 'Involuntary full rematerialization' fallbacks (the
+    replicate-then-repartition path the partitioner warns about) — the
+    only multi-chip performance signal available off-hardware."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.mixtral import mixtral_model
+    moe = mixtral_model("tiny", attention_impl="xla", dtype="float32",
+                        capacity_factor=4.0)
+    engine, *_ = deepspeed_tpu.initialize(model=moe, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"sequence_parallel_size": 2, "expert_parallel_size": 2,
+                 "data_parallel_size": 4},
+        "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    batch = engine._shard_batch(
+        {"input_ids": rng.integers(0, 256, size=(1, 8, 16),
+                                   dtype=np.int32)}, stacked=True)
+    fn = engine._get_compiled("train_step")
+    lowered = fn.lower(engine.state, batch, engine._next_rng())
+    # the cache would skip the partitioner (and its warning) entirely
+    cache_was = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        capfd.readouterr()
+        lowered.compile()
+        err = capfd.readouterr().err
+    finally:
+        jax.config.update("jax_enable_compilation_cache", cache_was)
+    assert "Involuntary full rematerialization" not in err, err[-3000:]
