@@ -1295,11 +1295,18 @@ class DeepSpeedEngine:
         schedule (num_pipe_buffers unset/M keeps the old behaviour)."""
         fp16 = self._config.fp16.enabled
         gas = self.gradient_accumulation_steps()
-        n_buffers = int(
-            (self._config._param_dict.get("pipeline", {}) or {})
-            .get("num_pipe_buffers", 0) or 0)
+        pipe_cfg = self._config._param_dict.get("pipeline", {}) or {}
+        n_buffers = int(pipe_cfg.get("num_pipe_buffers", 0) or 0)
         policy, grad_specs = self.zero_policy, self.grad_specs
         n_stages = int(self.model.meta.get("num_stages", 1))
+        if str(pipe_cfg.get("schedule", "")).lower() == "1f1b" \
+                and n_stages > 1:
+            if pipe_cfg.get("num_pipe_buffers"):
+                logger.warning(
+                    "pipeline.num_pipe_buffers is ignored under "
+                    "schedule='1f1b' (the interleaved schedule's ring "
+                    "buffers are sized by the stage count)")
+            return self._build_1f1b_train_step(n_stages)
         chunked = 0 < n_buffers < gas and gas % n_buffers == 0
         if chunked and n_buffers < n_stages:
             logger.warning(
@@ -1354,6 +1361,46 @@ class DeepSpeedEngine:
             grads = policy.constrain_grads(grads, grad_specs)
             new_state, metrics = self._apply_grads(state, grads)
             metrics["loss"] = loss / scale
+            return new_state, metrics
+
+        return train_step
+
+    def _build_1f1b_train_step(self, n_stages: int):
+        """True one-pass 1F1B pipeline schedule (config ``pipeline.schedule
+        = "1f1b"``; reference runtime/pipe/schedule.py:189 TrainSchedule):
+        one fill/drain for the whole batch at O(n_stages) live activations
+        — see runtime/pipe/pipeline.pipeline_1f1b_loss_and_grad."""
+        from deepspeed_tpu.runtime.pipe.pipeline import \
+            pipeline_1f1b_loss_and_grad
+        fp16 = self._config.fp16.enabled
+        gas = self.gradient_accumulation_steps()
+        policy, grad_specs = self.zero_policy, self.grad_specs
+        model = self.model
+        if self._compression_plans is not None:
+            logger.warning(
+                "compression_training is not applied under the 1f1b "
+                "pipeline schedule (the manual fwd/bwd interleave bypasses "
+                "the compression transform); training uncompressed")
+
+        def train_step(state, stacked_batch, rng):
+            params = state["params"]
+            scale = state["scaler"].cur_scale if fp16 else jnp.float32(1.0)
+            cparams = _tree_cast(params, self.compute_dtype)
+
+            def head_loss(p, y, b):
+                # the pipelined model's single loss definition (shared
+                # with the GPipe schedule), scaled per microbatch
+                return (model.head_loss_fn(p, y, b).astype(jnp.float32)
+                        * (scale / gas))
+
+            loss_sum, grads = pipeline_1f1b_loss_and_grad(
+                lambda h, lp: model.block_fn(lp, h), model.embed_fn,
+                head_loss, cparams, model.blocks_key, stacked_batch,
+                n_stages)
+            grads = _tree_cast(grads, jnp.float32)
+            grads = policy.constrain_grads(grads, grad_specs)
+            new_state, metrics = self._apply_grads(state, grads)
+            metrics["loss"] = loss_sum / scale
             return new_state, metrics
 
         return train_step

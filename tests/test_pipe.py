@@ -243,3 +243,62 @@ def test_pipeline_bad_buffer_count_warns_and_runs(devices8):
     batch = {"input_ids": rng.integers(0, 128, size=(gas, 4, 16),
                                        dtype=np.int32)}
     assert np.isfinite(float(eng.train_batch(batch=batch)))
+
+
+# ------------------------------------------------------------------- 1F1B
+
+def test_pipeline_1f1b_parity(devices8):
+    """pipeline.schedule='1f1b' (round-2 VERDICT item 7): the one-pass
+    interleaved schedule matches the all-live GPipe losses."""
+    gas = 8
+    mesh = {"pipe_parallel_size": 4, "data_parallel_size": 2}
+    base = dict(train_micro_batch_size_per_gpu=1,
+                gradient_accumulation_steps=gas, mesh=mesh)
+    e_all, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(num_layers=4), num_stages=4),
+        config=base_config(**base))
+    e_1f1b, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(num_layers=4), num_stages=4),
+        config=base_config(**base, pipeline={"schedule": "1f1b"}))
+    rng = np.random.default_rng(29)
+    for step in range(2):
+        batch = {"input_ids": rng.integers(0, 128, size=(gas, 8, 16),
+                                           dtype=np.int32)}
+        l_a = float(e_all.train_batch(batch=batch))
+        l_b = float(e_1f1b.train_batch(batch=batch))
+        assert abs(l_a - l_b) < 2e-4, f"step {step}: {l_a} vs {l_b}"
+
+
+def test_pipeline_1f1b_memory_independent_of_microbatches(devices8):
+    """1F1B's live activations are O(n_stages) ring-buffer slots: temp
+    memory must beat the all-live schedule at large M and grow only
+    marginally when M doubles (the all-live schedule's residuals double)."""
+    import jax
+    mesh = {"pipe_parallel_size": 4, "data_parallel_size": 2}
+    rng = np.random.default_rng(3)
+
+    def temp_bytes(gas, schedule):
+        from deepspeed_tpu.comm import reset_topology
+        reset_topology()
+        extra = {"pipeline": {"schedule": schedule}} if schedule else {}
+        eng, *_ = deepspeed_tpu.initialize(
+            model=pipeline_model(
+                tiny_gpt2(num_layers=4, max_seq_len=64), num_stages=4),
+            config=base_config(
+                train_micro_batch_size_per_gpu=2,
+                gradient_accumulation_steps=gas, mesh=mesh, **extra))
+        batch = {"input_ids": rng.integers(0, 128, size=(gas, 16, 64),
+                                           dtype=np.int32)}
+        sharded = eng._shard_batch(batch, stacked=True)
+        fn = eng._get_compiled("train_step")
+        compiled = fn.lower(eng.state, sharded, eng._next_rng()).compile()
+        mem = compiled.memory_analysis()
+        return int(getattr(mem, "temp_size_in_bytes", 0))
+
+    all_live_16 = temp_bytes(16, None)
+    f1b_16 = temp_bytes(16, "1f1b")
+    assert f1b_16 < all_live_16, (f1b_16, all_live_16)
+    # doubling M doubles the all-live residuals; 1F1B stays ~flat (ring
+    # buffers sized by n_stages, not M)
+    f1b_32 = temp_bytes(32, "1f1b")
+    assert f1b_32 < 1.5 * f1b_16, (f1b_16, f1b_32)
