@@ -58,7 +58,9 @@ class Autotuner:
                  stages=DEFAULT_STAGES, micro_batches=DEFAULT_MICRO_BATCHES,
                  remat_policies=DEFAULT_REMAT, steps: int = 3,
                  warmup_steps: int = 1, seq_len: Optional[int] = None,
-                 results_dir: str = "autotuning_results"):
+                 results_dir: str = "autotuning_results",
+                 tuner_type: str = "gridsearch",
+                 tuner_early_stopping: int = 0):
         self.base_config = dict(base_config)
         self.model_factory = model_factory
         self.stages = tuple(stages)
@@ -68,6 +70,8 @@ class Autotuner:
         self.warmup_steps = warmup_steps
         self.seq_len = seq_len
         self.results_dir = results_dir
+        self.tuner_type = tuner_type
+        self.tuner_early_stopping = int(tuner_early_stopping)
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------ trial
@@ -128,20 +132,90 @@ class Autotuner:
             gc.collect()
 
     # ------------------------------------------------------------------ tune
+    def _build_cost_model(self):
+        from deepspeed_tpu.autotuning.tuner import CostModel
+        try:
+            probe = self.model_factory(remat=False, remat_policy="nothing")
+        except TypeError:
+            probe = self.model_factory()
+        cfg = getattr(probe, "config", None)
+        hbm = None
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            hbm = stats.get("bytes_limit")
+        except Exception:
+            pass
+        n_dev = 1
+        try:
+            import jax
+            n_dev = len(jax.devices())
+        except Exception:
+            pass
+        return CostModel(
+            n_params=(probe.meta or {}).get("n_params", 0),
+            d_model=getattr(cfg, "d_model", 0),
+            num_layers=getattr(cfg, "num_layers", 1),
+            seq_len=self.seq_len or getattr(cfg, "max_seq_len", 128),
+            dp_world=n_dev, hbm_bytes=hbm)
+
     def tune(self) -> Optional[TrialResult]:
-        """Run the grid; returns the best feasible trial (highest
-        samples/sec) and writes ranked results + best config JSON."""
-        for stage, remat in itertools.product(self.stages,
-                                              self.remat_policies):
-            for mb in self.micro_batches:
-                r = self._run_trial(stage, mb, remat)
-                self.results.append(r)
-                log_dist(
-                    f"autotune: stage={stage} micro={mb} remat={remat} -> "
-                    + (f"{r.samples_per_sec:.1f} samples/s" if r.ok
-                       else f"FAIL ({r.error[:80]})"), ranks=[0])
-                if not r.ok:
-                    # larger micro batches only cost more memory: stop probing
+        """Run the candidate space in the configured tuner's order
+        (gridsearch | random | model_based); returns the best feasible
+        trial (highest samples/sec) and writes ranked results + best
+        config JSON.  ``tuner_early_stopping`` > 0 stops after that many
+        consecutive non-improving measurements (reference
+        model_based_tuner early stopping)."""
+        from deepspeed_tpu.autotuning.tuner import (Candidate,
+                                                    order_candidates)
+        cands = [Candidate(stage, mb, remat)
+                 for stage, remat in itertools.product(self.stages,
+                                                       self.remat_policies)
+                 for mb in self.micro_batches]
+        cost_model = (self._build_cost_model()
+                      if self.tuner_type == "model_based" else None)
+        to_run, pruned = order_candidates(cands, self.tuner_type, cost_model)
+        for c in pruned:
+            self.results.append(TrialResult(
+                self._candidate_config(c.stage, c.micro_batch),
+                c.micro_batch, c.stage, c.remat, False,
+                error="pruned: cost model predicts out-of-memory"))
+        if pruned:
+            log_dist(f"autotune: cost model pruned {len(pruned)} "
+                     f"sure-OOM candidates", ranks=[0])
+        failed_mb = {}       # (stage, remat) -> smallest failing micro batch
+        best_sps = 0.0
+        since_best = 0
+        for c in to_run:
+            key = (c.stage, c.remat)
+            if key in failed_mb and c.micro_batch >= failed_mb[key]:
+                # larger micro batches only cost more memory: skip
+                continue
+            r = self._run_trial(c.stage, c.micro_batch, c.remat)
+            self.results.append(r)
+            log_dist(
+                f"autotune: stage={c.stage} micro={c.micro_batch} "
+                f"remat={c.remat} -> "
+                + (f"{r.samples_per_sec:.1f} samples/s" if r.ok
+                   else f"FAIL ({r.error[:80]})"), ranks=[0])
+            if not r.ok:
+                failed_mb[key] = min(c.micro_batch,
+                                     failed_mb.get(key, 1 << 30))
+                continue
+            if r.samples_per_sec > best_sps:
+                best_sps = r.samples_per_sec
+                since_best = 0
+            else:
+                since_best += 1
+                # a non-improving streak only means "past the peak" under
+                # the cost model's best-first ordering (reference couples
+                # early stopping with the model-based tuner)
+                if (self.tuner_early_stopping
+                        and self.tuner_type == "model_based"
+                        and since_best >= self.tuner_early_stopping):
+                    log_dist(
+                        f"autotune: early stop after {since_best} "
+                        f"non-improving trials", ranks=[0])
                     break
         best = self.best()
         self._write_results(best)
@@ -170,6 +244,63 @@ class Autotuner:
                 f"{self.results_dir}/best_config.json", ranks=[0])
 
 
+def resolve_model_factory(spec: str, model_kwargs: Optional[dict] = None):
+    """Model spec -> factory(remat=..., remat_policy=...) -> Model.
+
+    Accepted specs (reference autotuning tunes the USER's model; here the
+    functional equivalent is a factory the config names):
+
+    - ``"<arch>:<size>"`` — in-tree registry: ``gpt2:125m``, ``llama:7b``,
+      ``mixtral:tiny``, ``bert:large`` (+ per-arch **model_kwargs**).
+    - ``"pkg.module:fn"`` — an importable entry point returning a Model
+      (called with remat/remat_policy plus **model_kwargs**).
+    - ``"<size>"`` — bare GPT-2 size (backwards compatible).
+    """
+    model_kwargs = dict(model_kwargs or {})
+    if ":" in spec:
+        arch, _, rest = spec.partition(":")
+        from deepspeed_tpu import models as _m
+        registry = {"gpt2": _m.gpt2_model, "llama": _m.llama_model,
+                    "mixtral": _m.mixtral_model, "bert": _m.bert_model}
+        if arch in registry:
+            fn, size = registry[arch], rest
+            return lambda **kw: fn(size, **{**model_kwargs, **kw})
+        # entry point "pkg.module:fn"
+        import importlib
+        mod = importlib.import_module(arch)
+        entry = getattr(mod, rest)
+        return lambda **kw: entry(**{**model_kwargs, **kw})
+    from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.models.gpt2 import GPT2_SIZES
+    if spec not in GPT2_SIZES:
+        raise ValueError(
+            f"autotuning model spec {spec!r} is neither a known gpt2 size "
+            f"({sorted(GPT2_SIZES)}) nor an 'arch:size'/'pkg.module:fn' "
+            "spec")
+    return lambda **kw: gpt2_model(spec, **{**model_kwargs, **kw})
+
+
+def tune_from_config(base: dict) -> Optional[TrialResult]:
+    """Tune per the config's ``autotuning`` section (the single path both
+    the ``deepspeed --autotuning`` launcher entry and ``ds_autotune``
+    use)."""
+    base = dict(base)
+    tuning = base.pop("autotuning", {})
+    factory = resolve_model_factory(tuning.get("model", "125m"),
+                                    tuning.get("model_kwargs"))
+    tuner = Autotuner(
+        base, factory,
+        stages=tuning.get("stages", DEFAULT_STAGES),
+        micro_batches=tuning.get("micro_batches", DEFAULT_MICRO_BATCHES),
+        remat_policies=tuning.get("remat_policies", DEFAULT_REMAT),
+        steps=int(tuning.get("steps", 3)),
+        seq_len=tuning.get("seq_len"),
+        results_dir=tuning.get("results_dir", "autotuning_results"),
+        tuner_type=tuning.get("tuner_type", "gridsearch"),
+        tuner_early_stopping=int(tuning.get("tuner_early_stopping", 0)))
+    return tuner.tune()
+
+
 def run_autotuning(args):
     """Launcher entry (reference runner.py:358): tune for the user script's
     config, then print the best config path.  The user script is expected to
@@ -183,15 +314,5 @@ def run_autotuning(args):
             "autotuning needs --deepspeed_config <file> among the user args")
     with open(config_path) as f:
         base = json.load(f)
-    tuning = base.pop("autotuning", {})
-    from deepspeed_tpu.models import gpt2_model
-    size = tuning.get("model", "125m")
-    tuner = Autotuner(
-        base, lambda **kw: gpt2_model(size, **kw),
-        stages=tuning.get("stages", DEFAULT_STAGES),
-        micro_batches=tuning.get("micro_batches", DEFAULT_MICRO_BATCHES),
-        remat_policies=tuning.get("remat_policies", DEFAULT_REMAT),
-        steps=int(tuning.get("steps", 3)),
-        results_dir=tuning.get("results_dir", "autotuning_results"))
-    best = tuner.tune()
+    best = tune_from_config(base)
     return 0 if best is not None else 1

@@ -1,0 +1,97 @@
+"""Tuner strategies (reference: deepspeed/autotuning/tuner/
+{index_based_tuner,model_based_tuner,cost_model}.py).
+
+The reference offers three exploration orders over the candidate space:
+``gridsearch`` (exhaustive, in order), ``random`` (shuffled), and
+``model_based`` (a cost model predicts each candidate's performance;
+candidates run best-first and the search stops early once measurements
+stop improving).  The TPU-native cost model is analytical rather than the
+reference's learned XGBoost regressor: per-candidate memory is estimated
+from the ZeRO stage's bytes/param and the activation footprint (pruning
+sure-OOM candidates without paying their compile), and throughput is
+ranked by a simple prior (bigger micro-batches amortise better; higher
+stages and heavier remat pay overhead).
+"""
+import random as _random
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+#: remat policy -> rough live-activation multiplier relative to "dots"
+_REMAT_ACT = {"nothing": 3.0, "save_attn": 1.6, "dots": 1.0}
+#: remat policy -> recompute-overhead prior
+_REMAT_COST = {"nothing": 1.0, "save_attn": 1.05, "dots": 1.12}
+
+
+@dataclass(frozen=True)
+class Candidate:
+    stage: int
+    micro_batch: int
+    remat: str
+
+
+class CostModel:
+    """Analytical feasibility + throughput prior for one candidate."""
+
+    def __init__(self, n_params: float, d_model: int, num_layers: int,
+                 seq_len: int, dp_world: int, hbm_bytes: Optional[int]):
+        self.n_params = float(n_params or 0)
+        self.d_model = max(int(d_model or 0), 1)
+        self.num_layers = max(int(num_layers or 1), 1)
+        self.seq_len = max(int(seq_len or 128), 1)
+        self.dp = max(int(dp_world), 1)
+        self.hbm = hbm_bytes
+
+    def state_bytes(self, stage: int) -> float:
+        """fp32 params + grads + Adam moments, per device (reference ZeRO
+        memory model: stage 1 shards optimizer state, 2 adds grads, 3 adds
+        params)."""
+        p = self.n_params
+        dp = self.dp
+        if stage >= 3:
+            return 16.0 * p / dp
+        if stage == 2:
+            return 4.0 * p + 12.0 * p / dp
+        if stage == 1:
+            return 8.0 * p + 8.0 * p / dp
+        return 16.0 * p
+
+    def activation_bytes(self, micro_batch: int, remat: str) -> float:
+        # ~ tokens x d_model x layers x multiplier, fp32
+        mult = _REMAT_ACT.get(remat, 2.0)
+        return (4.0 * micro_batch * self.seq_len * self.d_model
+                * self.num_layers * mult)
+
+    def feasible(self, c: Candidate, safety: float = 0.9) -> bool:
+        if self.hbm is None or self.n_params <= 0:
+            return True          # no budget known: measure instead of guess
+        need = self.state_bytes(c.stage) + self.activation_bytes(
+            c.micro_batch, c.remat)
+        return need <= safety * self.hbm
+
+    def score(self, c: Candidate) -> float:
+        """Higher = predicted faster.  Prior only — measurements decide."""
+        comm = {0: 1.0, 1: 1.0, 2: 1.02, 3: 1.12}.get(c.stage, 1.15)
+        amort = c.micro_batch / (c.micro_batch + 0.5)
+        return amort / (comm * _REMAT_COST.get(c.remat, 1.1))
+
+
+def order_candidates(cands: List[Candidate], tuner_type: str,
+                     cost_model: Optional[CostModel],
+                     seed: int = 0) -> Tuple[List[Candidate], List[Candidate]]:
+    """-> (to_run, pruned) per the reference's tuner types."""
+    tuner_type = (tuner_type or "gridsearch").lower()
+    if tuner_type in ("gridsearch", "grid"):
+        return list(cands), []
+    if tuner_type == "random":
+        out = list(cands)
+        _random.Random(seed).shuffle(out)
+        return out, []
+    if tuner_type != "model_based":
+        raise ValueError(f"unknown autotuning tuner_type {tuner_type!r} "
+                         "(gridsearch | random | model_based)")
+    if cost_model is None:
+        return list(cands), []
+    keep = [c for c in cands if cost_model.feasible(c)]
+    pruned = [c for c in cands if not cost_model.feasible(c)]
+    keep.sort(key=cost_model.score, reverse=True)
+    return keep, pruned

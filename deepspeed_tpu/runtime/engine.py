@@ -1299,14 +1299,24 @@ class DeepSpeedEngine:
         n_buffers = int(pipe_cfg.get("num_pipe_buffers", 0) or 0)
         policy, grad_specs = self.zero_policy, self.grad_specs
         n_stages = int(self.model.meta.get("num_stages", 1))
-        if str(pipe_cfg.get("schedule", "")).lower() == "1f1b" \
-                and n_stages > 1:
-            if pipe_cfg.get("num_pipe_buffers"):
+        sched = str(pipe_cfg.get("schedule", "") or "").lower()
+        if sched not in ("", "1f1b", "gpipe"):
+            raise ValueError(
+                f"pipeline.schedule={sched!r}: expected '1f1b' or 'gpipe' "
+                "(default: all-live/chunked GPipe)")
+        if sched == "1f1b" and n_stages > 1:
+            if gas < n_stages:
                 logger.warning(
-                    "pipeline.num_pipe_buffers is ignored under "
-                    "schedule='1f1b' (the interleaved schedule's ring "
-                    "buffers are sized by the stage count)")
-            return self._build_1f1b_train_step(n_stages)
+                    f"pipeline.schedule='1f1b' needs gradient_accumulation_"
+                    f"steps >= pipeline stages ({n_stages}), got {gas}; "
+                    "running the all-live schedule")
+            else:
+                if pipe_cfg.get("num_pipe_buffers"):
+                    logger.warning(
+                        "pipeline.num_pipe_buffers is ignored under "
+                        "schedule='1f1b' (the interleaved schedule's ring "
+                        "buffers are sized by the stage count)")
+                return self._build_1f1b_train_step(n_stages)
         chunked = 0 < n_buffers < gas and gas % n_buffers == 0
         if chunked and n_buffers < n_stages:
             logger.warning(

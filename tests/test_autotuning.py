@@ -67,3 +67,63 @@ def test_best_ranks_by_throughput():
         TrialResult({}, 4, 3, "nothing", False),
     ]
     assert t.best().samples_per_sec == 30
+
+
+# ------------------------------------------------- generality + cost model
+
+def test_resolve_model_factory_registry_and_entry_point():
+    from deepspeed_tpu.autotuning.autotuner import resolve_model_factory
+    f = resolve_model_factory("llama:tiny",
+                              {"attention_impl": "xla", "dtype": "float32"})
+    m = f(remat=False, remat_policy="nothing")
+    assert m.meta["name"] == "llama-tiny"
+    # entry point form: any importable pkg.module:fn works
+    f2 = resolve_model_factory(
+        "deepspeed_tpu.models.llama:llama_model",
+        {"size": "tiny", "attention_impl": "xla"})
+    m2 = f2(remat=False, remat_policy="nothing")
+    assert m2.meta["name"] == "llama-tiny"
+
+
+def test_cost_model_prunes_and_orders():
+    from deepspeed_tpu.autotuning.tuner import (Candidate, CostModel,
+                                                order_candidates)
+    cm = CostModel(n_params=1e9, d_model=2048, num_layers=24, seq_len=1024,
+                   dp_world=1, hbm_bytes=16 << 30)
+    cands = [Candidate(s, mb, "dots") for s in (0, 3) for mb in (1, 256)]
+    to_run, pruned = order_candidates(cands, "model_based", cm)
+    # stage-0 fp32 state alone is 16 GB at 1B params: pruned without compile
+    assert any(c.stage == 0 for c in pruned)
+    assert all(c.stage == 3 or c.micro_batch <= 1 for c in to_run)
+    # gridsearch never prunes
+    all_run, none = order_candidates(cands, "gridsearch", cm)
+    assert len(all_run) == 4 and not none
+
+
+def test_autotune_llama_end_to_end_cli(devices8, tmp_path):
+    """round-2 VERDICT item 9 done-criterion: tune a llama config from the
+    CLI entry (run_autotuning), model-based tuner with early stopping."""
+    import types
+    from deepspeed_tpu.autotuning.autotuner import run_autotuning
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "autotuning": {
+            "model": "llama:tiny",
+            "model_kwargs": {"attention_impl": "xla", "dtype": "float32"},
+            "stages": [0, 2], "micro_batches": [1, 2],
+            "remat_policies": ["nothing"], "steps": 1, "seq_len": 16,
+            "tuner_type": "model_based", "tuner_early_stopping": 3,
+            "results_dir": str(tmp_path / "at")},
+    }
+    cfg_path = tmp_path / "ds_config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    args = types.SimpleNamespace(
+        user_args=["train.py", "--deepspeed_config", str(cfg_path)])
+    assert run_autotuning(args) == 0
+    best = json.load(open(tmp_path / "at" / "best_config.json"))
+    assert best["zero_optimization"]["stage"] in (0, 2)
+    rows = json.load(open(tmp_path / "at" / "results.json"))
+    assert any(r["ok"] for r in rows)
