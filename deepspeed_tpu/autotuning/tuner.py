@@ -91,7 +91,8 @@ def order_candidates(cands: List[Candidate], tuner_type: str,
                          "(gridsearch | random | model_based)")
     if cost_model is None:
         return list(cands), []
-    keep = [c for c in cands if cost_model.feasible(c)]
-    pruned = [c for c in cands if not cost_model.feasible(c)]
+    keep, pruned = [], []
+    for c in cands:
+        (keep if cost_model.feasible(c) else pruned).append(c)
     keep.sort(key=cost_model.score, reverse=True)
     return keep, pruned

@@ -1,0 +1,181 @@
+"""BLOOM-style decoder: ALiBi positional attention (no position
+embeddings), embedding LayerNorm, biased GELU MLP, tied head.
+
+Reference capability: the bloom kernel-injection container
+(deepspeed/module_inject/containers/bloom.py); converted checkpoints run
+every engine feature natively.
+"""
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.neox import _ln
+
+
+@dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    max_seq_len: int = 2048
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 64
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+    remat: bool = False
+    remat_policy: str = "nothing"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def d_mlp(self) -> int:
+        return 4 * self.d_model
+
+
+BLOOM_SIZES = {
+    "tiny": dict(vocab_size=256, max_seq_len=64, num_layers=2, num_heads=4,
+                 d_model=32),
+    "560m": dict(vocab_size=250880, max_seq_len=2048, num_layers=24,
+                 num_heads=16, d_model=1024),
+}
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """ALiBi per-head slopes (Press et al.; matches HF's
+    build_alibi_tensor)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    if np.log2(num_heads).is_integer():
+        return pow2_slopes(num_heads)
+    closest = 2 ** int(np.floor(np.log2(num_heads)))
+    base = pow2_slopes(closest)
+    extra = pow2_slopes(2 * closest)[0::2][: num_heads - closest]
+    return np.concatenate([base, extra])
+
+
+def init_params(config: BloomConfig, rng) -> dict:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    k = iter(jax.random.split(rng, 8))
+    std = 0.02
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "wte": norm(next(k), (V, D)) * std,
+        "emb_ln_scale": jnp.ones((D,)), "emb_ln_bias": jnp.zeros((D,)),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D)), "ln1_bias": jnp.zeros((L, D)),
+            "ln2_scale": jnp.ones((L, D)), "ln2_bias": jnp.zeros((L, D)),
+            "qkv_w": norm(next(k), (L, D, 3 * D)) * std,
+            "qkv_b": jnp.zeros((L, 3 * D)),
+            "dense_w": norm(next(k), (L, D, D)) * std / (2 * L) ** 0.5,
+            "dense_b": jnp.zeros((L, D)),
+            "mlp_in_w": norm(next(k), (L, D, M)) * std,
+            "mlp_in_b": jnp.zeros((L, M)),
+            "mlp_out_w": norm(next(k), (L, M, D)) * std / (2 * L) ** 0.5,
+            "mlp_out_b": jnp.zeros((L, D)),
+        },
+        "lnf_scale": jnp.ones((D,)), "lnf_bias": jnp.zeros((D,)),
+    }
+
+
+def logical_specs(config: BloomConfig) -> dict:
+    return {
+        "wte": P("model", None),
+        "emb_ln_scale": P(), "emb_ln_bias": P(),
+        "blocks": {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
+            "dense_w": P(None, "model", None), "dense_b": P(),
+            "mlp_in_w": P(None, None, "model"), "mlp_in_b": P(None, "model"),
+            "mlp_out_w": P(None, "model", None), "mlp_out_b": P(),
+        },
+        "lnf_scale": P(), "lnf_bias": P(),
+    }
+
+
+def _alibi_attention(q, k, v, slopes):
+    """Causal attention with the ALiBi additive bias
+    ``slopes[h] * key_position`` (row-shift-invariant form HF uses)."""
+    B, S, H, hd = q.shape
+    scale = hd ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    bias = slopes[None, :, None, None] * jnp.arange(S)[None, None, None, :]
+    scores = scores + bias
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, layer, config: BloomConfig, slopes, rng=None):
+    B, S, D = x.shape
+    H, hd = config.num_heads, config.head_dim
+    dt = x.dtype
+    h = _ln(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
+    qkv = h @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
+    q, kk, v = jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
+    attn = _alibi_attention(q, kk, v, slopes)
+    x = x + (attn.reshape(B, S, D) @ layer["dense_w"].astype(dt)
+             + layer["dense_b"].astype(dt))
+    h = _ln(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
+    m = jax.nn.gelu(h @ layer["mlp_in_w"].astype(dt)
+                    + layer["mlp_in_b"].astype(dt), approximate=True)
+    return x + m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
+
+
+def forward(params, batch, config: BloomConfig, rng=None):
+    tokens = batch["input_ids"]
+    dtype = jnp.dtype(config.dtype)
+    slopes = jnp.asarray(alibi_slopes(config.num_heads), jnp.float32)
+    x = params["wte"].astype(dtype)[tokens]
+    x = _ln(x, params["emb_ln_scale"], params["emb_ln_bias"],
+            config.layer_norm_eps)
+
+    def block_fn(x, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        return _block(x, maybe_stream(layer), config, slopes, rng)
+    if config.remat:
+        from deepspeed_tpu.models.gpt2 import remat_policy
+        block_fn = jax.checkpoint(
+            block_fn, policy=remat_policy(config.remat_policy))
+    from deepspeed_tpu.models.model import scan_blocks
+    x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
+                    config.num_layers)
+    x = _ln(x, params["lnf_scale"], params["lnf_bias"],
+            config.layer_norm_eps)
+    # tied head (BLOOM always ties lm_head to the word embeddings)
+    return x @ params["wte"].astype(dtype).T
+
+
+def count_params(config: BloomConfig) -> int:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    per_layer = 4 * D + 3 * D * D + 3 * D + D * D + D + D * M + M + M * D + D
+    return V * D + 2 * D + L * per_layer + 2 * D
+
+
+def bloom_model(size: str = "tiny", **overrides) -> Model:
+    cfg_kwargs = dict(BLOOM_SIZES[size]) if size in BLOOM_SIZES else {}
+    cfg_kwargs.update(overrides)
+    config = BloomConfig(**cfg_kwargs)
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        logical_specs=logical_specs(config),
+        flops_per_token=6.0 * n_params,
+        meta={"name": f"bloom-{size}", "n_params": n_params,
+              "supports_random_ltd": True, "supports_pld": True},
+    )

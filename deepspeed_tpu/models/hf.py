@@ -329,3 +329,210 @@ def mixtral_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         if "lm_head.weight" in sd else g("embed_tokens.weight").T,
     }
     return model, params
+
+
+def opt_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF OPTForCausalLM (or its state_dict) -> (Model, params).
+
+    OPT is a pre-LN GPT-2-family decoder with ReLU MLPs and learned
+    positions stored at a +2 offset (OPTLearnedPositionalEmbedding); the
+    offset rows are sliced away so native arange positions line up."""
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"model.decoder.{k}"])
+    n_layers = 1 + max(int(k.split(".")[3]) for k in sd
+                       if k.startswith("model.decoder.layers."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    D = g("embed_tokens.weight").shape[1]
+    if hf_cfg is not None:
+        if not getattr(hf_cfg, "do_layer_norm_before", True):
+            raise NotImplementedError(
+                "opt_from_hf: do_layer_norm_before=False (the 350m post-LN "
+                "variant) is not representable by the pre-LN native block")
+        if int(getattr(hf_cfg, "word_embed_proj_dim", D)) != D:
+            raise NotImplementedError(
+                "opt_from_hf: word_embed_proj_dim != hidden_size "
+                "(projection in/out layers) is not representable")
+    if hf_cfg is None and "num_heads" not in overrides:
+        # head_dim varies across the OPT family (80 at 2.7b): never guess
+        raise ValueError(
+            "opt_from_hf: bare state_dict carries no config — pass the "
+            "transformers model or a num_heads= override")
+    act = (str(getattr(hf_cfg, "activation_function", "relu"))
+           if hf_cfg is not None else overrides.get("activation", "relu"))
+    act_map = {"relu": "relu", "gelu": "gelu", "gelu_new": "gelu"}
+    if act not in act_map:
+        raise NotImplementedError(
+            f"opt_from_hf: activation_function={act!r} is not representable "
+            "(relu/gelu only)")
+    wpe = g("embed_positions.weight")
+    ffn = _to_np(sd["model.decoder.layers.0.fc1.weight"]).shape[0]
+    cfg = dict(vocab_size=g("embed_tokens.weight").shape[0],
+               max_seq_len=wpe.shape[0] - 2,       # drop the +2 offset rows
+               num_layers=n_layers, d_model=D,
+               num_heads=(int(hf_cfg.num_attention_heads)
+                          if hf_cfg is not None else overrides["num_heads"]),
+               activation=act_map[act], mlp_dim=ffn)
+    cfg.update(overrides)
+    model = gpt2_model("custom", **cfg)
+    if "lm_head.weight" in sd and not np.allclose(
+            _to_np(sd["lm_head.weight"]), g("embed_tokens.weight")):
+        raise ValueError(
+            "opt_from_hf: checkpoint has an UNTIED lm_head; the native "
+            "gpt2-family block ties the head to the embedding")
+
+    def lay(i, k):
+        return _to_np(sd[f"model.decoder.layers.{i}.{k}"])
+
+    def stack(k, transpose=False):
+        return np.stack([lay(i, k).T if transpose else lay(i, k)
+                         for i in range(n_layers)])
+
+    qkv_w = np.concatenate([stack("self_attn.q_proj.weight", True),
+                            stack("self_attn.k_proj.weight", True),
+                            stack("self_attn.v_proj.weight", True)], axis=-1)
+    qkv_b = np.concatenate([stack("self_attn.q_proj.bias"),
+                            stack("self_attn.k_proj.bias"),
+                            stack("self_attn.v_proj.bias")], axis=-1)
+    params = {
+        "wte": g("embed_tokens.weight"),
+        "wpe": wpe[2:],
+        "blocks": {
+            "ln1_scale": stack("self_attn_layer_norm.weight"),
+            "ln1_bias": stack("self_attn_layer_norm.bias"),
+            "qkv_w": qkv_w, "qkv_b": qkv_b,
+            "proj_w": stack("self_attn.out_proj.weight", True),
+            "proj_b": stack("self_attn.out_proj.bias"),
+            "ln2_scale": stack("final_layer_norm.weight"),
+            "ln2_bias": stack("final_layer_norm.bias"),
+            "mlp_in_w": stack("fc1.weight", True),
+            "mlp_in_b": stack("fc1.bias"),
+            "mlp_out_w": stack("fc2.weight", True),
+            "mlp_out_b": stack("fc2.bias"),
+        },
+        "lnf_scale": g("final_layer_norm.weight"),
+        "lnf_bias": g("final_layer_norm.bias"),
+    }
+    return model, params
+
+
+def neox_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF GPTNeoXForCausalLM (or its state_dict) -> (Model, params).
+
+    The fused query_key_value weight is head-major [H, 3, hd, D]; its
+    transpose [D, H*(3*hd)] already matches the native per-head
+    [q|k|v] packing, so no de-interleave is needed."""
+    from deepspeed_tpu.models.neox import neox_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"gpt_neox.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("gpt_neox.layers."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None and "num_heads" not in overrides:
+        raise ValueError(
+            "neox_from_hf: bare state_dict carries no config — pass the "
+            "transformers model or a num_heads= override (rotary_pct= and "
+            "rope_theta= too if not 0.25/10000)")
+    D = g("embed_in.weight").shape[1]
+    cfg = dict(vocab_size=g("embed_in.weight").shape[0],
+               num_layers=n_layers, d_model=D)
+    if hf_cfg is not None:
+        cfg["num_heads"] = int(hf_cfg.num_attention_heads)
+        cfg["rotary_pct"] = float(getattr(hf_cfg, "rotary_pct", 0.25))
+        cfg["rope_theta"] = float(getattr(hf_cfg, "rotary_emb_base", 10000))
+        cfg["layer_norm_eps"] = float(getattr(hf_cfg, "layer_norm_eps",
+                                              1e-5))
+        cfg["max_seq_len"] = int(getattr(hf_cfg, "max_position_embeddings",
+                                         2048))
+        cfg["use_parallel_residual"] = bool(
+            getattr(hf_cfg, "use_parallel_residual", True))
+    cfg.update(overrides)
+    model = neox_model("custom", **cfg)
+
+    def stack(fmt, transpose=False):
+        return np.stack([_to_np(sd[f"gpt_neox.layers.{i}.{fmt}"]).T
+                         if transpose else
+                         _to_np(sd[f"gpt_neox.layers.{i}.{fmt}"])
+                         for i in range(n_layers)])
+
+    params = {
+        "wte": g("embed_in.weight"),
+        "blocks": {
+            "ln1_scale": stack("input_layernorm.weight"),
+            "ln1_bias": stack("input_layernorm.bias"),
+            "ln2_scale": stack("post_attention_layernorm.weight"),
+            "ln2_bias": stack("post_attention_layernorm.bias"),
+            "qkv_w": stack("attention.query_key_value.weight", True),
+            "qkv_b": stack("attention.query_key_value.bias"),
+            "dense_w": stack("attention.dense.weight", True),
+            "dense_b": stack("attention.dense.bias"),
+            "mlp_in_w": stack("mlp.dense_h_to_4h.weight", True),
+            "mlp_in_b": stack("mlp.dense_h_to_4h.bias"),
+            "mlp_out_w": stack("mlp.dense_4h_to_h.weight", True),
+            "mlp_out_b": stack("mlp.dense_4h_to_h.bias"),
+        },
+        "lnf_scale": g("final_layer_norm.weight"),
+        "lnf_bias": g("final_layer_norm.bias"),
+        "embed_out": _to_np(sd["embed_out.weight"]).T,
+    }
+    return model, params
+
+
+def bloom_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF BloomForCausalLM (or its state_dict) -> (Model, params).
+
+    Same head-major fused-QKV layout as NeoX (transpose = native
+    packing); ALiBi slopes are recomputed from the head count."""
+    from deepspeed_tpu.models.bloom import bloom_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"transformer.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("transformer.h."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None and "num_heads" not in overrides:
+        # head_dim varies across the BLOOM family (128 at 7b1): never guess
+        raise ValueError(
+            "bloom_from_hf: bare state_dict carries no config — pass the "
+            "transformers model or a num_heads= override")
+    D = g("word_embeddings.weight").shape[1]
+    cfg = dict(vocab_size=g("word_embeddings.weight").shape[0],
+               num_layers=n_layers, d_model=D,
+               num_heads=(int(hf_cfg.n_head) if hf_cfg is not None
+                          else overrides["num_heads"]))
+    if hf_cfg is not None:
+        cfg["layer_norm_eps"] = float(getattr(hf_cfg, "layer_norm_epsilon",
+                                              1e-5))
+    cfg.update(overrides)
+    model = bloom_model("custom", **cfg)
+
+    def stack(fmt, transpose=False):
+        return np.stack([_to_np(sd[f"transformer.h.{i}.{fmt}"]).T
+                         if transpose else
+                         _to_np(sd[f"transformer.h.{i}.{fmt}"])
+                         for i in range(n_layers)])
+
+    params = {
+        "wte": g("word_embeddings.weight"),
+        "emb_ln_scale": g("word_embeddings_layernorm.weight"),
+        "emb_ln_bias": g("word_embeddings_layernorm.bias"),
+        "blocks": {
+            "ln1_scale": stack("input_layernorm.weight"),
+            "ln1_bias": stack("input_layernorm.bias"),
+            "ln2_scale": stack("post_attention_layernorm.weight"),
+            "ln2_bias": stack("post_attention_layernorm.bias"),
+            "qkv_w": stack("self_attention.query_key_value.weight", True),
+            "qkv_b": stack("self_attention.query_key_value.bias"),
+            "dense_w": stack("self_attention.dense.weight", True),
+            "dense_b": stack("self_attention.dense.bias"),
+            "mlp_in_w": stack("mlp.dense_h_to_4h.weight", True),
+            "mlp_in_b": stack("mlp.dense_h_to_4h.bias"),
+            "mlp_out_w": stack("mlp.dense_4h_to_h.weight", True),
+            "mlp_out_b": stack("mlp.dense_4h_to_h.bias"),
+        },
+        "lnf_scale": g("ln_f.weight"),
+        "lnf_bias": g("ln_f.bias"),
+    }
+    return model, params

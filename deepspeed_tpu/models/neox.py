@@ -1,0 +1,186 @@
+"""GPT-NeoX-style decoder (Pythia / NeoX-20B family): LayerNorm with
+biases, fused-QKV attention with PARTIAL rotary embeddings
+(``rotary_pct`` of each head rotates, the rest passes through), biased
+GELU MLP, and the parallel attention+MLP residual
+(``use_parallel_residual``).
+
+Reference capability: the gptneox kernel-injection container
+(deepspeed/module_inject/containers/gptneox.py); here the architecture is
+a native model so every engine feature (ZeRO, TP specs, offload,
+compression) applies unchanged after ``neox_from_hf`` conversion.
+"""
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.llama import rope
+from deepspeed_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class NeoXConfig:
+    vocab_size: int = 50432
+    max_seq_len: int = 2048
+    num_layers: int = 6
+    num_heads: int = 8
+    d_model: int = 512
+    rotary_pct: float = 0.25
+    rope_theta: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    dtype: str = "float32"
+    remat: bool = False
+    remat_policy: str = "nothing"
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def d_mlp(self) -> int:
+        return 4 * self.d_model
+
+    @property
+    def rotary_ndims(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+
+NEOX_SIZES = {
+    "tiny": dict(vocab_size=256, max_seq_len=64, num_layers=2, num_heads=4,
+                 d_model=32),
+    "pythia-160m": dict(vocab_size=50304, max_seq_len=2048, num_layers=12,
+                        num_heads=12, d_model=768),
+    "20b": dict(vocab_size=50432, max_seq_len=2048, num_layers=44,
+                num_heads=64, d_model=6144, rotary_pct=0.25),
+}
+
+
+def init_params(config: NeoXConfig, rng) -> dict:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    k = iter(jax.random.split(rng, 10))
+    std = 0.02
+    norm = partial(jax.random.normal, dtype=jnp.float32)
+    return {
+        "wte": norm(next(k), (V, D)) * std,
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D)), "ln1_bias": jnp.zeros((L, D)),
+            "ln2_scale": jnp.ones((L, D)), "ln2_bias": jnp.zeros((L, D)),
+            "qkv_w": norm(next(k), (L, D, 3 * D)) * std,
+            "qkv_b": jnp.zeros((L, 3 * D)),
+            "dense_w": norm(next(k), (L, D, D)) * std / (2 * L) ** 0.5,
+            "dense_b": jnp.zeros((L, D)),
+            "mlp_in_w": norm(next(k), (L, D, M)) * std,
+            "mlp_in_b": jnp.zeros((L, M)),
+            "mlp_out_w": norm(next(k), (L, M, D)) * std / (2 * L) ** 0.5,
+            "mlp_out_b": jnp.zeros((L, D)),
+        },
+        "lnf_scale": jnp.ones((D,)), "lnf_bias": jnp.zeros((D,)),
+        "embed_out": norm(next(k), (D, V)) * std,
+    }
+
+
+def logical_specs(config: NeoXConfig) -> dict:
+    return {
+        "wte": P("model", None),
+        "blocks": {
+            "ln1_scale": P(), "ln1_bias": P(),
+            "ln2_scale": P(), "ln2_bias": P(),
+            "qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
+            "dense_w": P(None, "model", None), "dense_b": P(),
+            "mlp_in_w": P(None, None, "model"), "mlp_in_b": P(None, "model"),
+            "mlp_out_w": P(None, "model", None), "mlp_out_b": P(),
+        },
+        "lnf_scale": P(), "lnf_bias": P(),
+        "embed_out": P(None, "model"),
+    }
+
+
+def _ln(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _partial_rope(x, config: NeoXConfig, positions=None):
+    """Rotate the first ``rotary_ndims`` of each head, pass the rest."""
+    rot = config.rotary_ndims
+    if rot >= x.shape[-1]:
+        return rope(x, config.rope_theta, positions)
+    xr = rope(x[..., :rot], config.rope_theta, positions)
+    return jnp.concatenate([xr, x[..., rot:]], axis=-1)
+
+
+def _block(x, layer, config: NeoXConfig, rng=None):
+    B, S, D = x.shape
+    H, hd = config.num_heads, config.head_dim
+    dt = x.dtype
+    h1 = _ln(x, layer["ln1_scale"], layer["ln1_bias"],
+             config.layer_norm_eps)
+    qkv = h1 @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
+    q, kk, v = jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
+    q = _partial_rope(q, config)
+    kk = _partial_rope(kk, config)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn_out = (attn.reshape(B, S, D) @ layer["dense_w"].astype(dt)
+                + layer["dense_b"].astype(dt))
+    h2_in = x if config.use_parallel_residual else x + attn_out
+    h2 = _ln(h2_in, layer["ln2_scale"], layer["ln2_bias"],
+             config.layer_norm_eps)
+    m = jax.nn.gelu(h2 @ layer["mlp_in_w"].astype(dt)
+                    + layer["mlp_in_b"].astype(dt), approximate=True)
+    mlp_out = m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
+    if config.use_parallel_residual:
+        return x + attn_out + mlp_out       # gpt-j style parallel residual
+    return h2_in + mlp_out
+
+
+def forward(params, batch, config: NeoXConfig, rng=None):
+    tokens = batch["input_ids"]
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens]
+
+    def block_fn(x, layer):
+        from deepspeed_tpu.models.model import maybe_stream
+        return _block(x, maybe_stream(layer), config, rng)
+    if config.remat:
+        from deepspeed_tpu.models.gpt2 import remat_policy
+        block_fn = jax.checkpoint(
+            block_fn, policy=remat_policy(config.remat_policy))
+    from deepspeed_tpu.models.model import scan_blocks
+    x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
+                    config.num_layers)
+    x = _ln(x, params["lnf_scale"], params["lnf_bias"],
+            config.layer_norm_eps)
+    return x @ params["embed_out"].astype(dtype)
+
+
+def count_params(config: NeoXConfig) -> int:
+    D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
+                  config.d_mlp)
+    per_layer = 4 * D + 3 * D * D + 3 * D + D * D + D + D * M + M + M * D + D
+    return V * D + L * per_layer + 2 * D + D * V
+
+
+def neox_model(size: str = "tiny", **overrides) -> Model:
+    cfg_kwargs = dict(NEOX_SIZES[size]) if size in NEOX_SIZES else {}
+    cfg_kwargs.update(overrides)
+    config = NeoXConfig(**cfg_kwargs)
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        logical_specs=logical_specs(config),
+        flops_per_token=6.0 * n_params,
+        meta={"name": f"neox-{size}", "n_params": n_params,
+              "supports_random_ltd": True, "supports_pld": True,
+              "sparse_grad_params": {"wte": "input_ids"}},
+    )

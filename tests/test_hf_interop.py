@@ -128,3 +128,72 @@ def test_mixtral_from_hf_logits_match():
         ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
     got = np.asarray(model.apply(params, {"input_ids": ids}))
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_opt_from_hf_logits_match():
+    from transformers import OPTConfig, OPTForCausalLM
+    from deepspeed_tpu.models.hf import opt_from_hf
+    torch.manual_seed(4)
+    hf = OPTForCausalLM(OPTConfig(
+        vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        do_layer_norm_before=True, dropout=0.0,
+        activation_function="relu")).eval()
+    model, params = opt_from_hf(hf, dtype="float32", attention_impl="xla")
+    ids = np.random.default_rng(4).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_neox_from_hf_logits_match():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    from deepspeed_tpu.models.hf import neox_from_hf
+    torch.manual_seed(5)
+    hf = GPTNeoXForCausalLM(GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, rotary_pct=0.25,
+        use_parallel_residual=True, hidden_act="gelu",
+        hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    model, params = neox_from_hf(hf, dtype="float32", attention_impl="xla")
+    ids = np.random.default_rng(5).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_neox_from_hf_serial_residual():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    from deepspeed_tpu.models.hf import neox_from_hf
+    torch.manual_seed(6)
+    hf = GPTNeoXForCausalLM(GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, rotary_pct=1.0,
+        use_parallel_residual=False, hidden_act="gelu",
+        hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    model, params = neox_from_hf(hf, dtype="float32", attention_impl="xla")
+    ids = np.random.default_rng(6).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_bloom_from_hf_logits_match():
+    from transformers import BloomConfig as HFBloomConfig
+    from transformers import BloomForCausalLM
+    from deepspeed_tpu.models.hf import bloom_from_hf
+    torch.manual_seed(7)
+    hf = BloomForCausalLM(HFBloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0)).eval()
+    model, params = bloom_from_hf(hf, dtype="float32")
+    ids = np.random.default_rng(7).integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)

@@ -84,3 +84,25 @@ def test_numpy_init_matches_jax_init_distributions():
         assert a.shape == b.shape, path
         sa, sb = float(np.std(np.asarray(a))), float(np.std(b))
         assert abs(sa - sb) <= 0.1 * max(sa, sb, 1e-3), (path, sa, sb)
+
+
+def test_neox_and_bloom_native_models_train(devices8):
+    """The new native architectures (neox partial-rotary parallel-residual,
+    bloom ALiBi) train through the engine like every other model."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import neox_model, bloom_model
+    from tests.util import base_config
+    rng = np.random.default_rng(0)
+    for factory in (lambda: neox_model("tiny", attention_impl="xla"),
+                    lambda: bloom_model("tiny")):
+        from deepspeed_tpu.comm import reset_topology
+        reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(
+            model=factory(), config=base_config(
+                zero_optimization={"stage": 2}))
+        losses = []
+        for i in range(3):
+            batch = {"input_ids": rng.integers(
+                0, 256, size=(1, 8, 16), dtype=np.int32)}
+            losses.append(float(engine.train_batch(batch=batch)))
+        assert all(np.isfinite(losses))
