@@ -37,7 +37,7 @@ class GPT2Config:
     remat: bool = False             # activation checkpointing per layer
     remat_policy: str = "nothing"   # nothing | save_attn | dots | offload_attn
     attention_impl: str = "auto"    # auto | xla | flash (pallas)
-    activation: str = "gelu"        # gelu (tanh approx) | relu (OPT family)
+    activation: str = "gelu"        # gelu (tanh approx) | gelu_exact (erf) | relu
     mlp_dim: int = 0                # 0 = the GPT-2 default 4*d_model
 
     @property
@@ -237,8 +237,10 @@ def _block_finish(x, attn, layer, config: GPT2Config):
     x = x + attn @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
     h = h @ layer["mlp_in_w"].astype(h.dtype) + layer["mlp_in_b"].astype(h.dtype)
-    h = (jax.nn.relu(h) if config.activation == "relu"
-         else jax.nn.gelu(h, approximate=True))
+    if config.activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=config.activation != "gelu_exact")
     x = x + h @ layer["mlp_out_w"].astype(x.dtype) + layer["mlp_out_b"].astype(x.dtype)
     return x
 

@@ -361,7 +361,8 @@ def opt_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
             "transformers model or a num_heads= override")
     act = (str(getattr(hf_cfg, "activation_function", "relu"))
            if hf_cfg is not None else overrides.get("activation", "relu"))
-    act_map = {"relu": "relu", "gelu": "gelu", "gelu_new": "gelu"}
+    # HF "gelu" is the exact erf form; gelu_new is the tanh approximation
+    act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
     if act not in act_map:
         raise NotImplementedError(
             f"opt_from_hf: activation_function={act!r} is not representable "
@@ -448,6 +449,13 @@ def neox_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
                                          2048))
         cfg["use_parallel_residual"] = bool(
             getattr(hf_cfg, "use_parallel_residual", True))
+        act = str(getattr(hf_cfg, "hidden_act", "gelu"))
+        approx = {"gelu": False, "gelu_new": True, "gelu_fast": True,
+                  "gelu_pytorch_tanh": True}
+        if act not in approx:
+            raise NotImplementedError(
+                f"neox_from_hf: hidden_act={act!r} is not representable")
+        cfg["gelu_approximate"] = approx[act]
     cfg.update(overrides)
     model = neox_model("custom", **cfg)
 

@@ -33,6 +33,9 @@ class NeoXConfig:
     rope_theta: float = 10000.0
     layer_norm_eps: float = 1e-5
     use_parallel_residual: bool = True
+    #: HF GPT-NeoX default hidden_act="gelu" is the EXACT erf GELU;
+    #: gelu_new/gelu_fast variants map to the tanh approximation
+    gelu_approximate: bool = False
     dtype: str = "float32"
     remat: bool = False
     remat_policy: str = "nothing"
@@ -135,7 +138,8 @@ def _block(x, layer, config: NeoXConfig, rng=None):
     h2 = _ln(h2_in, layer["ln2_scale"], layer["ln2_bias"],
              config.layer_norm_eps)
     m = jax.nn.gelu(h2 @ layer["mlp_in_w"].astype(dt)
-                    + layer["mlp_in_b"].astype(dt), approximate=True)
+                    + layer["mlp_in_b"].astype(dt),
+                    approximate=config.gelu_approximate)
     mlp_out = m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
     if config.use_parallel_residual:
         return x + attn_out + mlp_out       # gpt-j style parallel residual

@@ -33,6 +33,10 @@ class MoEConfig:
     activation: str = "silu_glu"               # silu_glu (Mixtral) | gelu
     aux_loss_coef: float = 0.01
     z_loss_coef: float = 0.0
+    #: Residual MoE (reference moe/layer.py:28 ``use_residual``, the PR-MoE
+    #: building block, arXiv:2201.05596): a dense FFN runs beside the
+    #: routed experts and a learned 2-way softmax coefficient mixes them
+    use_residual: bool = False
 
 
 def init_moe_params(config: MoEConfig, rng) -> dict:
@@ -47,6 +51,16 @@ def init_moe_params(config: MoEConfig, rng) -> dict:
     }
     if config.activation == "silu_glu":
         params["w_gate"] = norm(next(k), (E, D, F)) * std
+    if config.use_residual:
+        # dense residual FFN + the 2-way mixing coefficient head; keys
+        # fold off a branch so plain-MoE seeded init stays byte-identical
+        rk = iter(jax.random.split(jax.random.fold_in(rng, 17), 4))
+        params["res_in"] = norm(next(rk), (D, F)) * std
+        params["res_out"] = norm(next(rk), (F, D)) * std
+        params["coef_w"] = norm(next(rk), (D, 2)) * std
+        params["coef_b"] = jnp.zeros((2,))
+        if config.activation == "silu_glu":
+            params["res_gate"] = norm(next(rk), (D, F)) * std
     return params
 
 
@@ -58,6 +72,13 @@ def moe_logical_specs(config: MoEConfig) -> dict:
     }
     if config.activation == "silu_glu":
         specs["w_gate"] = P(EXPERT_AXIS, None, "model")
+    if config.use_residual:
+        specs["res_in"] = P(None, "model")
+        specs["res_out"] = P("model", None)
+        specs["coef_w"] = P()
+        specs["coef_b"] = P()
+        if config.activation == "silu_glu":
+            specs["res_gate"] = P(None, "model")
     return specs
 
 
@@ -114,7 +135,24 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     combined = wsc(jnp.einsum("tec,ecd->td",
                               combine_w.astype(x.dtype), out), tok_sh)
     aux = gate.l_aux * config.aux_loss_coef + gate.router_z_loss
-    return combined.reshape(B, S, D), aux
+    moe_out = combined.reshape(B, S, D)
+    if config.use_residual:
+        # Residual MoE (reference moe/layer.py:116-123): dense FFN beside
+        # the experts, mixed by a learned per-token softmax coefficient
+        dt = x.dtype
+        if config.activation == "silu_glu":
+            h = (jax.nn.silu(x @ params["res_gate"].astype(dt))
+                 * (x @ params["res_in"].astype(dt)))
+        else:
+            h = jax.nn.gelu(x @ params["res_in"].astype(dt),
+                            approximate=True)
+        res = h @ params["res_out"].astype(dt)
+        coef = jax.nn.softmax(
+            (x @ params["coef_w"].astype(dt)
+             + params["coef_b"].astype(dt)).astype(jnp.float32), axis=-1)
+        coef = coef.astype(dt)
+        moe_out = moe_out * coef[..., 0:1] + res * coef[..., 1:]
+    return moe_out, aux
 
 
 @dataclass

@@ -11,6 +11,7 @@ breakdown for our Model protocol.
 import time
 from typing import Any, Callable, Dict, Optional
 
+import numpy as np
 import jax
 
 from deepspeed_tpu.utils.logging import log_dist
@@ -104,6 +105,14 @@ class FlopsProfiler:
             f"{flops_to_string(self.total_flops / dur)}",
             "-" * 60,
         ]
+        if detailed and self.model is not None:
+            try:
+                lines += module_tree_lines(self.model,
+                                           max_depth=module_depth,
+                                           total_latency=dur,
+                                           total_flops=self.total_flops)
+            except Exception as e:     # never let reporting kill training
+                lines.append(f"(per-module breakdown unavailable: {e})")
         text = "\n".join(lines)
         if output_file:
             with open(output_file, "w") as f:
@@ -133,3 +142,81 @@ def get_model_profile(model, batch, backward: bool = True):
         "params": n_params,
         "arithmetic_intensity": cost["flops"] / max(cost["bytes_accessed"], 1),
     }
+
+
+# ---------------------------------------------------------- per-module tree
+# (reference profiler.py:28 prints a module tree of params/MACs/latency; the
+# functional equivalent walks the params pytree: exact param counts per
+# subtree, matmul MACs estimated per weight leaf, latency/FLOPs apportioned
+# by each subtree's MAC share)
+
+_NON_MATMUL = ("bias", "_b", "scale", "norm", "ln", "wpe", "wtype")
+
+
+def _leaf_macs_per_token(name: str, shape) -> float:
+    """MACs one token pays against a weight leaf: matmul weights
+    contribute in x out (stacked layer dims multiply through); vectors,
+    scalars, and per-element bias/scale/norm leaves 0."""
+    if len(shape) < 2:
+        return 0.0
+    lname = name.lower()
+    if any(t in lname for t in _NON_MATMUL):
+        return 0.0           # stacked [L, D] scales are not matmuls
+    macs = 1.0
+    for s in shape:
+        macs *= s
+    return float(macs)       # prod = L * in * out for stacked leaves
+
+
+def module_tree_profile(model) -> dict:
+    """Nested {name: {params, macs_per_token, children}} from the model's
+    param shapes (cached eval_shape — no device work)."""
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    # untied-head models pay no matmul against the embedding table — it is
+    # a pure gather; only tied heads reuse wte as the output projection
+    untied = isinstance(shapes, dict) and any(
+        k in shapes for k in ("lm_head", "embed_out"))
+
+    def walk(tree, name=""):
+        if isinstance(tree, dict):
+            children = {k: walk(v, k) for k, v in tree.items()}
+            return {
+                "params": sum(c["params"] for c in children.values()),
+                "macs_per_token": sum(c["macs_per_token"]
+                                      for c in children.values()),
+                "children": children,
+            }
+        macs = _leaf_macs_per_token(name, tree.shape)
+        if untied and name == "wte":
+            macs = 0.0               # embedding lookup, not a matmul
+        return {"params": int(1 if not tree.shape else
+                              np.prod(tree.shape)),
+                "macs_per_token": macs,
+                "children": {}}
+
+    return walk(shapes)
+
+
+def module_tree_lines(model, max_depth: int = -1, total_latency: float = 0.0,
+                      total_flops: float = 0.0):
+    """Render the tree the way the reference prints its module profile:
+    params, MAC share, and the latency/FLOPs apportioned by that share."""
+    tree = module_tree_profile(model)
+    total_macs = max(tree["macs_per_token"], 1.0)
+    lines = ["per-module breakdown (params | MAC share | est. latency):"]
+
+    def emit(name, node, depth):
+        if max_depth >= 0 and depth > max_depth:
+            return
+        share = node["macs_per_token"] / total_macs
+        lat = total_latency * share
+        lines.append(
+            "  " * depth + f"{name}: {params_to_string(node['params'])} "
+            f"params | {share * 100:5.1f}% MACs | {lat * 1e3:8.2f} ms | "
+            f"{num_to_string(total_flops * share)}FLOPs")
+        for k, child in sorted(node["children"].items(),
+                               key=lambda kv: -kv[1]["macs_per_token"]):
+            emit(k, child, depth + 1)
+
+    emit("model", tree, 0)
+    return lines

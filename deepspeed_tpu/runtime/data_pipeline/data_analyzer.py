@@ -1,0 +1,97 @@
+"""Offline corpus analyzer (reference:
+deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py
+``DataAnalyzer`` — the map-reduce job that scores every sample of a corpus
+per metric and writes the index files the curriculum data sampler
+consumes).
+
+Map phase: worker ``i`` of ``num_workers`` scores its contiguous shard of
+the dataset with each metric function and writes a per-worker
+``<metric>_<i>`` indexed file.  Reduce phase: worker files merge into
+
+- ``<metric>_sample_to_metric`` — metric value per sample index, and
+- ``<metric>_metric_to_sample`` — sample indices grouped by metric value
+  (the difficulty buckets),
+
+both in the memory-mapped indexed format.  ``load_difficulties`` adapts
+the result straight into ``DeepSpeedDataSampler``'s input.
+"""
+import os
+from typing import Callable, Dict, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, write_dataset)
+
+
+class DataAnalyzer:
+    def __init__(self, dataset, metric_fns: Dict[str, Callable],
+                 save_path: str, num_workers: int = 1,
+                 batch_size: int = 1024):
+        """``metric_fns``: name -> fn(sample) -> int/float difficulty.
+        ``dataset``: anything with __len__/__getitem__."""
+        self.dataset = dataset
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.num_workers = max(1, int(num_workers))
+        self.batch_size = int(batch_size)
+
+    # ------------------------------------------------------------------ map
+    def _shard(self, worker_id: int):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return range(worker_id * per, min((worker_id + 1) * per, n))
+
+    def run_map(self, worker_id: int = 0):
+        """Score this worker's shard; one indexed file per metric."""
+        os.makedirs(self.save_path, exist_ok=True)
+        idx = self._shard(worker_id)
+        for name, fn in self.metric_fns.items():
+            vals = [fn(self.dataset[i]) for i in idx]
+            write_dataset(
+                os.path.join(self.save_path, f"{name}_{worker_id}"),
+                [np.asarray([v]) for v in vals], dtype=np.int64)
+
+    # --------------------------------------------------------------- reduce
+    def run_reduce(self):
+        """Merge worker files into sample_to_metric + metric_to_sample."""
+        for name in self.metric_fns:
+            vals = []
+            for w in range(self.num_workers):
+                part = MMapIndexedDataset(
+                    os.path.join(self.save_path, f"{name}_{w}"))
+                vals.extend(int(part[i][0]) for i in range(len(part)))
+                part.close()
+            vals = np.asarray(vals, np.int64)
+            write_dataset(
+                os.path.join(self.save_path, f"{name}_sample_to_metric"),
+                [vals], dtype=np.int64)
+            # difficulty buckets: sample ids per metric value
+            b = MMapIndexedDatasetBuilder(
+                os.path.join(self.save_path, f"{name}_metric_to_sample"),
+                dtype=np.int64)
+            uniq = np.unique(vals)
+            for v in uniq:
+                b.add_item(np.nonzero(vals == v)[0])
+            b.finalize()
+            np.save(os.path.join(self.save_path, f"{name}_values.npy"),
+                    uniq)
+
+    def run(self):
+        """Single-process convenience: map all shards, then reduce."""
+        for w in range(self.num_workers):
+            self.run_map(w)
+        self.run_reduce()
+        return self.save_path
+
+
+def load_difficulties(save_path: str,
+                      metrics: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Analyzer output -> the ``DeepSpeedDataSampler`` difficulties dict."""
+    out = {}
+    for name in metrics:
+        ds = MMapIndexedDataset(
+            os.path.join(save_path, f"{name}_sample_to_metric"))
+        out[name] = np.asarray(ds[0])
+        ds.close()
+    return out

@@ -1,0 +1,96 @@
+"""Memory-mapped indexed dataset (reference:
+deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py
+``MMapIndexedDataset`` — the Megatron binary corpus format the offline
+DataAnalyzer reads and writes).
+
+Format: ``<path>.bin`` holds the concatenated sample payloads;
+``<path>.idx`` holds a small header (magic, dtype code, sample count)
+followed by per-sample element counts and byte offsets.  Reads go through
+``np.memmap`` so a multi-hundred-GB corpus costs no resident RAM.
+"""
+import os
+import struct
+from typing import Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX\x01"
+#: dtype codes (subset of the reference's _code_to_dtype)
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` per sample, then ``finalize``."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._prefix = prefix
+        self._bin = open(data_file_path(prefix), "wb")
+        self._sizes = []
+        self._offsets = [0]
+
+    def add_item(self, array):
+        arr = np.ascontiguousarray(np.asarray(array), dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._sizes.append(arr.size)
+        self._offsets.append(self._offsets[-1] + arr.nbytes)
+        return len(self._sizes) - 1
+
+    def finalize(self):
+        self._bin.close()
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<BQ", _CODES[self.dtype],
+                                len(self._sizes)))
+            f.write(np.asarray(self._sizes, np.int64).tobytes())
+            f.write(np.asarray(self._offsets[:-1], np.int64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Random-access reader over the ``.bin``/``.idx`` pair."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{prefix}.idx: bad magic {magic!r}")
+            code, n = struct.unpack("<BQ", f.read(9))
+            self.dtype = np.dtype(_DTYPES[code])
+            self.sizes = np.frombuffer(f.read(8 * n), np.int64)
+            self.offsets = np.frombuffer(f.read(8 * n), np.int64)
+        self._data = np.memmap(data_file_path(prefix), mode="r",
+                               dtype=np.uint8)
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        off, size = int(self.offsets[i]), int(self.sizes[i])
+        raw = self._data[off:off + size * self.dtype.itemsize]
+        return np.frombuffer(raw, self.dtype)
+
+    def close(self):
+        self._data = None
+
+
+def write_dataset(prefix: str, samples: Sequence, dtype=np.int32):
+    """Convenience one-shot writer."""
+    b = MMapIndexedDatasetBuilder(prefix, dtype)
+    for s in samples:
+        b.add_item(s)
+    b.finalize()
+    return prefix

@@ -262,3 +262,62 @@ def test_ds_report_runs(capsys):
     out = capsys.readouterr().out
     assert "deepspeed_tpu version" in out
     assert "jax version" in out
+
+
+# ------------------------------------------------------------ new bin tools
+
+import json
+import pathlib
+
+REPO_BIN = pathlib.Path(REPO) / "bin"
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    import runpy
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4],
+                          "min_gpus": 1, "max_gpus": 8}}
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    import sys as _sys
+    argv = _sys.argv
+    _sys.argv = ["ds_elastic", "-c", str(p), "-w", "4"]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(str(REPO_BIN / "ds_elastic"), run_name="__main__")
+        assert e.value.code == 0
+    finally:
+        _sys.argv = argv
+    out = capsys.readouterr().out
+    assert "final batch size" in out and "micro batch @ world=4" in out
+
+
+def test_ds_ssh_local_fallback(tmp_path, capsys):
+    import runpy
+    import sys as _sys
+    argv = _sys.argv
+    _sys.argv = ["ds_ssh", "-f", str(tmp_path / "nope"), "echo", "DS_SSH_OK"]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(str(REPO_BIN / "ds_ssh"), run_name="__main__")
+        assert e.value.code == 0
+    finally:
+        _sys.argv = argv
+
+
+def test_ds_bench_runs_on_virtual_mesh():
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import os, runpy, sys\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        f"sys.argv = ['ds_bench', '--max-bytes', str(1 << 20),"
+        " '--trials', '1', '--warmup', '1', '--ops', 'all_reduce']\n"
+        f"runpy.run_path({str(REPO + '/bin/ds_bench')!r},"
+        " run_name='__main__')\n")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert "all_reduce" in r.stdout, r.stderr[-1500:]

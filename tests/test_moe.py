@@ -225,3 +225,69 @@ def test_moe_train_step_no_involuntary_remat(devices8, capfd):
     finally:
         jax.config.update("jax_enable_compilation_cache", cache_was)
     assert "Involuntary full rematerialization" not in err, err[-3000:]
+
+
+def test_residual_moe_layer(devices8):
+    """use_residual (reference moe/layer.py:28, the PR-MoE block): a dense
+    FFN runs beside the experts mixed by a learned softmax coefficient —
+    output differs from the plain routed layer and gradients reach both
+    branches."""
+    from deepspeed_tpu.moe.layer import (MoEConfig, init_moe_params,
+                                         moe_layer)
+    cfg_plain = MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                          capacity_factor=4.0)
+    cfg_res = MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                        capacity_factor=4.0, use_residual=True)
+    rng = jax.random.PRNGKey(0)
+    p_res = init_moe_params(cfg_res, rng)
+    assert {"res_in", "res_out", "res_gate", "coef_w",
+            "coef_b"} <= set(p_res)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    out_res, aux = moe_layer(p_res, x, cfg_res, train=True)
+    p_plain = {k: v for k, v in p_res.items()
+               if k in ("router", "w_in", "w_out", "w_gate")}
+    out_plain, _ = moe_layer(p_plain, x, cfg_plain, train=True)
+    assert out_res.shape == x.shape
+    assert not np.allclose(np.asarray(out_res), np.asarray(out_plain))
+
+    def loss(p):
+        return jnp.sum(moe_layer(p, x, cfg_res, train=True)[0] ** 2)
+
+    g = jax.grad(loss)(p_res)
+    assert float(np.abs(np.asarray(g["res_in"])).max()) > 0
+    assert float(np.abs(np.asarray(g["coef_w"])).max()) > 0
+    assert float(np.abs(np.asarray(g["w_out"])).max()) > 0
+
+
+def test_pr_moe_pyramid(devices8):
+    """PR-MoE pyramid: residual MoE layers with DIFFERENT expert counts
+    per depth (the reference's SimplePRMoEModel shape) train end-to-end."""
+    from deepspeed_tpu.moe.layer import (MoEConfig, init_moe_params,
+                                         moe_layer)
+    import optax
+    cfgs = [MoEConfig(d_model=16, d_ff=32, num_experts=2, top_k=1,
+                      capacity_factor=4.0, use_residual=True),
+            MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                      capacity_factor=4.0, use_residual=True)]
+    rng = jax.random.PRNGKey(2)
+    params = [init_moe_params(c, jax.random.fold_in(rng, i))
+              for i, c in enumerate(cfgs)]
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 16))
+
+    def loss(ps):
+        h, aux = x, 0.0
+        for p, c in zip(ps, cfgs):
+            out, a = moe_layer(p, h, c, train=True)
+            h = h + out
+            aux = aux + a
+        return jnp.mean(h ** 2) + aux
+
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+    l0 = None
+    for _ in range(5):
+        l, g = jax.value_and_grad(loss)(params)
+        upd, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, upd)
+        l0 = l0 if l0 is not None else float(l)
+    assert float(l) < l0
