@@ -20,22 +20,42 @@ def _on_tpu() -> bool:
         return False
 
 
-def xla_causal_attention(q, k, v):
+def xla_causal_attention(q, k, v, segment_ids=None):
     """Reference einsum attention with causal mask; [B, S, H, hd] layout.
-    fp32 softmax accumulation for bf16 inputs."""
+    fp32 softmax accumulation for bf16 inputs.  ``segment_ids`` [B, S]
+    restricts attention within packed segments."""
     B, S, H, hd = q.shape
     scale = hd ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None, None]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, None, :, None]
+                       == segment_ids[:, None, None, :])
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def flash_causal_attention(q, k, v):
+def flash_causal_attention(q, k, v, segment_ids=None):
     """Pallas TPU flash attention (blockwise, never materialises the [S,S]
-    scores in HBM)."""
+    scores in HBM).
+
+    Kernel selection: the tuned stock-op wrapper by default; the in-tree
+    from-scratch FlashAttention-2 kernel (ops/pallas/ds_flash_attention)
+    when ``segment_ids`` is given (sequence packing — only it supports
+    segments) or when ``DS_FLASH_KERNEL=ds`` is set."""
+    import os
+    if segment_ids is not None or os.environ.get(
+            "DS_FLASH_KERNEL", "").lower() == "ds":
+        from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+            ds_flash_attention
+        try:
+            return ds_flash_attention(q, k, v, segment_ids=segment_ids,
+                                      causal=True)
+        except ValueError:
+            # sequence length does not block-decompose: exact XLA path
+            return xla_causal_attention(q, k, v, segment_ids)
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     return flash_attention(q, k, v, causal=True)
 

@@ -1,0 +1,289 @@
+"""From-scratch Pallas flash attention, forward AND backward, with
+segment-id (sequence-packing) support.
+
+Reference capability: the fused training transformer kernel
+(csrc/transformer/softmax_kernels.cu + ds_transformer_cuda.cpp) — rebuilt
+as a TPU kernel rather than translated.  Algorithm: FlashAttention-2
+(online softmax forward saving per-row logsumexp; recompute-based
+backward in two passes — dK/dV blocks looping over query tiles, dQ blocks
+looping over key tiles).
+
+Layouts: q/k/v [B, S, H, hd] (the models' layout), transposed internally
+to [B, H, S, hd].  ``segment_ids`` [B, S] int32 restricts attention to
+same-segment pairs — packed-sequence training the stock wrapper lacked
+(pass None for a single segment).  The [S, S] score matrix never
+materialises in HBM; VMEM holds one [block_q, block_k] tile.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _causal_kblocks(iq, block_q, block_k, seq_len):
+    """#key-blocks a causal q-block row needs (whole blocks; block_q is a
+    multiple of block_k by construction)."""
+    return jnp.minimum((iq + 1) * block_q // block_k, seq_len // block_k)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_q, block_k, seq_len):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale       # [Bq, hd]
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    segq = segq_ref[0]                                   # [Bq]
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    n_kblocks = (_causal_kblocks(iq, block_q, block_k, seq_len)
+                 if causal else seq_len // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        segk = segk_ref[0, pl.dslice(j * block_k, block_k)]
+        mask = segq[:, None] == segk[None, :]
+        if causal:
+            mask &= q_pos >= (j * block_k + k_base)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                segq_ref, segk_ref, dk_ref, dv_ref, *,
+                sm_scale, causal, block_q, block_k, seq_len):
+    ik = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)                  # [Bk, hd]
+    v = v_ref[0, 0].astype(jnp.float32)
+    k_pos = ik * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    q_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    segk = segk_ref[0, pl.dslice(ik * block_k, block_k)]
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    start = (ik * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        segq = segq_ref[0, pl.dslice(j * block_q, block_q)]
+        mask = segq[:, None] == segk[None, :]
+        if causal:
+            mask &= (j * block_q + q_base) >= k_pos
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv_new = dv + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_new = dk + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = lax.fori_loop(start, seq_len // block_q, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               segq_ref, segk_ref, dq_ref, *,
+               sm_scale, causal, block_q, block_k, seq_len):
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    q_pos = iq * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    segq = segq_ref[0]
+
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    n_kblocks = (_causal_kblocks(iq, block_q, block_k, seq_len)
+                 if causal else seq_len // block_k)
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        segk = segk_ref[0, pl.dslice(j * block_k, block_k)]
+        mask = segq[:, None] == segk[None, :]
+        if causal:
+            mask &= q_pos >= (j * block_k + k_base)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, n_kblocks, body, dq0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _to_bhsd(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def _choose_blocks(seq_len, block_q, block_k):
+    bq = min(block_q, seq_len)
+    bk = min(block_k, seq_len)
+    while bq > 1 and seq_len % bq:
+        bq //= 2
+    while bk > 1 and seq_len % bk:
+        bk //= 2
+    # the causal loop bounds assume block_q is a multiple of block_k
+    while bq % bk and bk > 1:
+        bk //= 2
+    if seq_len % bq or seq_len % bk or bq % bk or bq < 8 or bk < 8:
+        raise ValueError(
+            f"ds_flash_attention: seq_len {seq_len} does not decompose "
+            f"into >=8-sized blocks (got block_q={bq}, block_k={bk}); pad "
+            "the sequence to a multiple of 8")
+    return bq, bk
+
+
+def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
+                       sm_scale=None, block_q=512, block_k=512):
+    """q/k/v: [B, S, H, hd] -> [B, S, H, hd].  ``segment_ids``: None or a
+    [B, S] int array; packed sequences attend only within their own
+    segment (non-differentiable — it rides the VJP closure)."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = _fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
+                    block_k)
+        return o
+
+    def fwd(q, k, v):
+        return _fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
+                    block_k)
+
+    def bwd(res, do):
+        return _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k,
+                         res, do)
+
+    f.defvjp(fwd, bwd)
+    return f(q, k, v)
+
+
+def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
+    B, S, H, hd = q.shape
+    sm = sm_scale if sm_scale is not None else hd ** -0.5
+    bq, bk = _choose_blocks(S, block_q, block_k)
+    qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
+           else jnp.zeros((B, S), jnp.int32))
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
+        seq_len=S)
+    oT, lse = pl.pallas_call(
+        kernel, grid=(B, H, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, bq), lambda b, h, i: (b, i)),
+            pl.BlockSpec((1, S), lambda b, h, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ])(qT, kT, vT, seg, seg)
+    o = jnp.transpose(oT, (0, 2, 1, 3))
+    return o, (q, k, v, o, lse)
+
+
+def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    B, S, H, hd = q.shape
+    sm = sm_scale if sm_scale is not None else hd ** -0.5
+    bq, bk = _choose_blocks(S, block_q, block_k)
+    qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    doT, oT = _to_bhsd(do), _to_bhsd(o)
+    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
+           else jnp.zeros((B, S), jnp.int32))
+    delta = jnp.sum(doT.astype(jnp.float32) * oT.astype(jnp.float32),
+                    axis=-1)                              # [B, H, S]
+
+    full = pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0))
+    full_s = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
+    seg_full = pl.BlockSpec((1, S), lambda b, h, i: (b, 0))
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
+        seq_len=S)
+    dkT, dvT = pl.pallas_call(
+        dkv_kernel, grid=(B, H, S // bk),
+        in_specs=[full,
+                  pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
+                  pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
+                  full, full_s, full_s, seg_full, seg_full],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, S, hd), q.dtype)],
+    )(qT, kT, vT, doT, lse, delta, seg, seg)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
+        seq_len=S)
+    dqT = pl.pallas_call(
+        dq_kernel, grid=(B, H, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            full, full,
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, bq), lambda b, h, i: (b, i)),
+            seg_full,
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+    )(qT, kT, vT, doT, lse, delta, seg, seg)
+
+    dq = jnp.transpose(dqT, (0, 2, 1, 3))
+    dk = jnp.transpose(dkT, (0, 2, 1, 3))
+    dv = jnp.transpose(dvT, (0, 2, 1, 3))
+    return dq, dk, dv
+

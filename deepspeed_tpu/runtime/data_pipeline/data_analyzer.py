@@ -48,24 +48,30 @@ class DataAnalyzer:
         idx = self._shard(worker_id)
         for name, fn in self.metric_fns.items():
             vals = [fn(self.dataset[i]) for i in idx]
+            # float metrics keep their dtype (int64 would truncate, e.g.
+            # perplexity difficulties in [0, 1))
+            dtype = (np.int64 if all(
+                float(v) == int(v) for v in vals) else np.float64)
             write_dataset(
                 os.path.join(self.save_path, f"{name}_{worker_id}"),
-                [np.asarray([v]) for v in vals], dtype=np.int64)
+                [np.asarray([v]) for v in vals], dtype=dtype)
 
     # --------------------------------------------------------------- reduce
     def run_reduce(self):
         """Merge worker files into sample_to_metric + metric_to_sample."""
         for name in self.metric_fns:
             vals = []
+            float_any = False
             for w in range(self.num_workers):
                 part = MMapIndexedDataset(
                     os.path.join(self.save_path, f"{name}_{w}"))
-                vals.extend(int(part[i][0]) for i in range(len(part)))
+                float_any |= np.issubdtype(part.dtype, np.floating)
+                vals.extend(part[i][0] for i in range(len(part)))
                 part.close()
-            vals = np.asarray(vals, np.int64)
+            vals = np.asarray(vals, np.float64 if float_any else np.int64)
             write_dataset(
                 os.path.join(self.save_path, f"{name}_sample_to_metric"),
-                [vals], dtype=np.int64)
+                [vals], dtype=vals.dtype)
             # difficulty buckets: sample ids per metric value
             b = MMapIndexedDatasetBuilder(
                 os.path.join(self.save_path, f"{name}_metric_to_sample"),

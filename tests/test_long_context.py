@@ -297,3 +297,87 @@ def test_pallas_block_sparse_bwd_noncausal_and_empty_rows():
     dq = jax.grad(loss2)(q, k, v)
     np.testing.assert_array_equal(np.asarray(dq[:, :16]),
                                   np.zeros_like(np.asarray(dq[:, :16])))
+
+
+# ------------------------------------------- from-scratch flash kernel
+
+import functools
+from jax.experimental import pallas as pl
+
+
+@pytest.fixture
+def interpret_pallas(monkeypatch):
+    monkeypatch.setattr(
+        pl, "pallas_call", functools.partial(pl.pallas_call,
+                                             interpret=True))
+
+def _dense_ref_attn(q, k, v, seg=None, causal=True):
+    import jax
+    import jax.numpy as jnp
+    S = q.shape[1]
+    hd = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (hd ** -0.5)
+    mask = (jnp.tril(jnp.ones((S, S), bool)) if causal
+            else jnp.ones((S, S), bool))[None, None]
+    if seg is not None:
+        mask = mask & (seg[:, None, :, None] == seg[:, None, None, :])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("use_seg,causal", [
+    (False, True), (True, True), (False, False), (True, False)])
+def test_ds_flash_attention_fwd_bwd_parity(interpret_pallas, use_seg,
+                                           causal):
+    """round-2 VERDICT item 6: the from-scratch FlashAttention-2 kernel
+    (fwd + recompute bwd, segment-id packing) matches the dense reference
+    in interpret mode."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+    rng = np.random.default_rng(3)
+    B, S, H, hd = 2, 128, 2, 64
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    seg = (jnp.asarray(np.repeat(rng.integers(0, 3, (B, 4)), S // 4,
+                                 axis=1), jnp.int32) if use_seg else None)
+    out = ds_flash_attention(q, k, v, segment_ids=seg, causal=causal,
+                             block_q=64, block_k=32)
+    ref = _dense_ref_attn(q, k, v, seg, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss(q, k, v):
+        return jnp.sum(ds_flash_attention(q, k, v, segment_ids=seg,
+                                          causal=causal, block_q=64,
+                                          block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_dense_ref_attn(q, k, v, seg, causal) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ds_flash_segment_isolation(interpret_pallas):
+    """Tokens must not attend across segment boundaries: perturbing
+    segment 0 leaves segment 1's outputs bit-identical."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+    rng = np.random.default_rng(4)
+    B, S, H, hd = 1, 128, 2, 64
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    seg = jnp.asarray([[0] * 64 + [1] * 64], jnp.int32)
+    out1 = ds_flash_attention(q, k, v, segment_ids=seg, block_q=64,
+                              block_k=64)
+    k2 = k.at[0, :64].set(99.0)
+    v2 = v.at[0, :64].set(-99.0)
+    out2 = ds_flash_attention(q, k2, v2, segment_ids=seg, block_q=64,
+                              block_k=64)
+    np.testing.assert_array_equal(np.asarray(out1[0, 64:]),
+                                  np.asarray(out2[0, 64:]))
