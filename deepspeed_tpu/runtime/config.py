@@ -197,6 +197,13 @@ class CurriculumLearningConfig(DeepSpeedConfigModel):
     max_difficulty: int = 1024
     schedule_type: str = "fixed_linear"
     schedule_config: Dict[str, Any] = Field(default_factory=dict)
+    #: TPU-specific, opt-in: every distinct truncated sequence length
+    #: compiles a fresh step; a bucket > 1 rounds the effective seqlen UP
+    #: to a multiple, bounding compiles at max_difficulty/bucket while the
+    #: schedule moves in fine steps.  0 (default) keeps the reference's
+    #: exact truncation semantics — the engine warns when a fine schedule
+    #: would compile per difficulty value.
+    seqlen_bucket: int = 0
 
 
 class EigenvalueConfig(DeepSpeedConfigModel):

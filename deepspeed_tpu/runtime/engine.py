@@ -587,6 +587,14 @@ class DeepSpeedEngine:
                 import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_params_legacy)
+            step = int(cl.schedule_config.get("difficulty_step", 8) or 8)
+            if (cl.curriculum_type == "seqlen"
+                    and not getattr(cl, "seqlen_bucket", 0) and step < 8):
+                logger.warning(
+                    f"curriculum_learning: difficulty_step={step} compiles "
+                    "a fresh train step per distinct sequence length on "
+                    "TPU; set curriculum_learning.seqlen_bucket (e.g. 64) "
+                    "to bound recompiles")
         # progressive layer drop (reference engine.py:1755 PLD theta kwarg)
         self.progressive_layer_drop = None
         pld = self._config.pld_config
@@ -1686,9 +1694,10 @@ class DeepSpeedEngine:
 
     def _apply_curriculum(self, batch):
         """Legacy seqlen curriculum (reference engine.py:1761): truncate the
-        batch's sequence dim to the scheduled difficulty.  Each new
-        difficulty value compiles a fresh step — schedules should move in
-        coarse increments on TPU."""
+        batch's sequence dim to the scheduled difficulty.  Each distinct
+        truncated length compiles a fresh step, so the difficulty rounds UP
+        to a multiple of ``curriculum_learning.seqlen_bucket`` — fine
+        schedules cost at most max_difficulty/bucket compiles."""
         if self.curriculum_scheduler is None:
             return batch
         difficulty = self.curriculum_scheduler.update_difficulty(
@@ -1696,6 +1705,9 @@ class DeepSpeedEngine:
         cl = self._config.curriculum_learning
         if cl.curriculum_type != "seqlen" or not isinstance(batch, dict):
             return batch
+        bucket = int(getattr(cl, "seqlen_bucket", 0) or 0)
+        if bucket > 1:
+            difficulty = -(-difficulty // bucket) * bucket
         seq = max((np.shape(v)[-1] for k, v in batch.items()
                    if k in self._SEQ_KEYS), default=0)
         if seq <= difficulty:
