@@ -113,23 +113,34 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
     """q/k/v: [B, S, H, hd] -> [B, S, H, hd], no causal mask.
 
     Unpadded batches (``pad_mask=None``) ride the Pallas flash kernel on
-    TPU at S>=256; a padding mask forces the XLA path (the flash wrapper
-    carries no segment ids yet) — omit the mask when nothing is padded, an
-    all-ones mask still pays the masked path.
+    TPU at S>=256.  A padding mask maps onto the from-scratch kernel's
+    segment ids (real tokens segment 1, pads segment 0 — pads only see
+    pads, whose outputs are discarded), so padded encoder batches get the
+    flash path too; sequence lengths that do not block-decompose fall back
+    to the exact XLA path.
     """
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     noncausal = partial(flash_attention, causal=False)
+
+    def flash_padded():
+        from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+            ds_flash_attention
+        seg = pad_mask.astype(jnp.int32)
+        return ds_flash_attention(q, k, v, segment_ids=seg, causal=False)
+
     if impl == "flash":
         if pad_mask is not None:
-            raise NotImplementedError(
-                "impl='flash' cannot honour a padding mask (no segment-id "
-                "support in the flash wrapper yet); drop the mask or use "
-                "impl='auto'/'xla'")
+            return flash_padded()
         # explicit request: no fallback — surface the real error
         return noncausal(q, k, v)
-    if (pad_mask is None and impl == "auto" and _on_tpu()
-            and q.shape[1] >= 256 and _flash_usable(q, fn=noncausal)):
-        return noncausal(q, k, v)
+    if impl == "auto" and _on_tpu() and q.shape[1] >= 256:
+        if pad_mask is None and _flash_usable(q, fn=noncausal):
+            return noncausal(q, k, v)
+        if pad_mask is not None:
+            try:
+                return flash_padded()
+            except ValueError:   # seq does not block-decompose
+                pass
     return xla_bidirectional_attention(q, k, v, pad_mask)
 
 

@@ -381,3 +381,28 @@ def test_ds_flash_segment_isolation(interpret_pallas):
                               block_k=64)
     np.testing.assert_array_equal(np.asarray(out1[0, 64:]),
                                   np.asarray(out2[0, 64:]))
+
+
+def test_ds_flash_pad_mask_as_segments(interpret_pallas):
+    """Padded encoder batches map onto the kernel's segment ids (real=1,
+    pad=0): real-token outputs match the XLA masked path exactly; pad
+    positions (whose outputs downstream losses discard) are isolated."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+    from deepspeed_tpu.ops.attention import xla_bidirectional_attention
+    rng = np.random.default_rng(8)
+    B, S, H, hd = 2, 128, 2, 64
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, hd)), jnp.float32)
+    lens = [96, 64]
+    pad = np.zeros((B, S), np.int32)
+    for b, L in enumerate(lens):
+        pad[b, :L] = 1
+    pad = jnp.asarray(pad)
+    out = ds_flash_attention(q, k, v, segment_ids=pad, causal=False,
+                             block_q=64, block_k=64)
+    ref = xla_bidirectional_attention(q, k, v, pad_mask=pad)
+    for b, L in enumerate(lens):
+        np.testing.assert_allclose(np.asarray(out[b, :L]),
+                                   np.asarray(ref[b, :L]), atol=2e-5)
