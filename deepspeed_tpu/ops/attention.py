@@ -122,25 +122,24 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     noncausal = partial(flash_attention, causal=False)
 
-    def flash_padded():
+    def flash_padded(a, b, c):
         from deepspeed_tpu.ops.pallas.ds_flash_attention import \
             ds_flash_attention
-        seg = pad_mask.astype(jnp.int32)
-        return ds_flash_attention(q, k, v, segment_ids=seg, causal=False)
+        return ds_flash_attention(a, b, c, segment_ids=pad_mask,
+                                  causal=False)
 
     if impl == "flash":
         if pad_mask is not None:
-            return flash_padded()
+            return flash_padded(q, k, v)
         # explicit request: no fallback — surface the real error
         return noncausal(q, k, v)
     if impl == "auto" and _on_tpu() and q.shape[1] >= 256:
         if pad_mask is None and _flash_usable(q, fn=noncausal):
             return noncausal(q, k, v)
-        if pad_mask is not None:
-            try:
-                return flash_padded()
-            except ValueError:   # seq does not block-decompose
-                pass
+        # padded: probe the segment-capable kernel the same (loudly
+        # logged) way the unpadded path probes the stock wrapper
+        if pad_mask is not None and _flash_usable(q, fn=flash_padded):
+            return flash_padded(q, k, v)
     return xla_bidirectional_attention(q, k, v, pad_mask)
 
 
