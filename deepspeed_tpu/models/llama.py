@@ -183,10 +183,8 @@ def _block(x, layer, config: LlamaConfig, rng=None):
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     q, kk, v = _block_qkv(x, layer, config)
-    if KV != H:   # grouped-query: repeat kv heads
-        rep = H // KV
-        kk = jnp.repeat(kk, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # kv heads stay compact: the attention dispatch attends GQA natively
+    # (from-scratch flash kernel) or repeats in the fallback paths
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     return _block_finish(x, attn.reshape(B, S, H * hd), layer, config)

@@ -149,10 +149,6 @@ def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     q, kk, v = _qkv(x, layer, config)
-    if KV != H:
-        rep = H // KV
-        kk = jnp.repeat(kk, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     attn = causal_attention(q, kk, v, impl=config.attention_impl)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     return _moe_finish(x, attn.reshape(B, S, H * hd), layer, config,

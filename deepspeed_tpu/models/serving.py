@@ -51,12 +51,7 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = qkv_fn(carry, layer, None)
         hd = q.shape[-1]
-        ka, va = kk, v
-        if KV != H:
-            rep = H // KV
-            ka = jnp.repeat(kk, rep, axis=2)
-            va = jnp.repeat(v, rep, axis=2)
-        attn = causal_attention(q, ka, va, impl=attention_impl)
+        attn = causal_attention(q, kk, v, impl=attention_impl)
         out = finish_fn(carry, attn.reshape(B, S, H * hd), layer)
         return out, (kk, v)
 

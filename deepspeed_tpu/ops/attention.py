@@ -63,17 +63,19 @@ def flash_causal_attention(q, k, v, segment_ids=None):
 _FLASH_STATUS = {}  # probe result per (S, hd): True usable / exception string
 
 
-def _flash_usable(q, fn=None) -> bool:
+def _flash_usable(q, fn=None, k=None) -> bool:
     """Probe the Pallas flash path once per shape class and remember the
     outcome.  A failure is logged loudly (never silently degraded — VERDICT
     round 1 flagged the silent except here) so a bench run on a slow fallback
     is visible in the logs."""
     from deepspeed_tpu.utils.logging import logger
     fn = fn or flash_causal_attention
-    key = (q.shape[1], q.shape[3], getattr(fn, "__name__", "bidirectional"))
+    kv = q if k is None else k
+    key = (q.shape[1], q.shape[3], kv.shape[2],
+           getattr(fn, "__name__", "bidirectional"))
     if key not in _FLASH_STATUS:
         try:
-            jax.eval_shape(fn, q, q, q)
+            jax.eval_shape(fn, q, kv, kv)
             _FLASH_STATUS[key] = True
             logger.info(f"attention: Pallas flash selected for S={key[0]} "
                         f"head_dim={key[1]}")
@@ -86,12 +88,37 @@ def _flash_usable(q, fn=None) -> bool:
     return _FLASH_STATUS[key] is True
 
 
+def _ds_gqa_causal(q, k, v):
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+    return ds_flash_attention(q, k, v, causal=True)
+
+
 def _local_causal_attention(q, k, v, impl: str = "auto"):
+    gqa = k.shape[2] != q.shape[2]
     if impl == "flash":
         # explicit request: no fallback — surface the real error
+        if gqa:
+            return _ds_gqa_causal(q, k, v)
         return flash_causal_attention(q, k, v)
-    if impl == "auto" and _on_tpu() and q.shape[1] >= 256 and _flash_usable(q):
-        return flash_causal_attention(q, k, v)
+    if impl == "auto" and _on_tpu() and q.shape[1] >= 256:
+        if gqa and _flash_usable(q, fn=_ds_gqa_causal, k=k):
+            # grouped-query: the from-scratch kernel reads each KV head
+            # once per group instead of attending repeated copies
+            return _ds_gqa_causal(q, k, v)
+        if gqa:
+            # kernel unusable for this shape: repeat and try the tuned
+            # stock wrapper before surrendering to the [S,S] einsum
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            gqa = False
+        if _flash_usable(q):
+            return flash_causal_attention(q, k, v)
+    if gqa:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     return xla_causal_attention(q, k, v)
 
 
@@ -144,7 +171,9 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
 
 
 def causal_attention(q, k, v, impl: str = "auto"):
-    """q/k/v: [B, S, H, hd] -> [B, S, H, hd].
+    """q [B, S, H, hd], k/v [B, S, KV, hd] -> [B, S, H, hd]; KV may divide
+    H (GQA — the from-scratch flash kernel attends compact KV natively,
+    other paths repeat).
 
     When the mesh has an active ``seq`` axis, attention runs under Ulysses
     sequence parallelism (head-scatter all-to-all; see sequence/layer.py) —
@@ -156,6 +185,17 @@ def causal_attention(q, k, v, impl: str = "auto"):
     except Exception:
         sp = 1
     if sp > 1:
+        # Ulysses scatters heads over the seq axis: compact KV rides the
+        # all-to-all whenever each (model-sharded) KV head shard divides
+        # sp (1/group the wire bytes); otherwise repeat first
+        try:
+            tp = get_topology().mesh.shape["model"]
+        except Exception:
+            tp = 1
+        if k.shape[2] != q.shape[2] and k.shape[2] % (sp * tp):
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         from deepspeed_tpu.sequence.layer import distributed_attention
         return distributed_attention(
             q, k, v, lambda a, b, c: _local_causal_attention(a, b, c, impl))

@@ -8,11 +8,13 @@ as a TPU kernel rather than translated.  Algorithm: FlashAttention-2
 backward in two passes — dK/dV blocks looping over query tiles, dQ blocks
 looping over key tiles).
 
-Layouts: q/k/v [B, S, H, hd] (the models' layout), transposed internally
-to [B, H, S, hd].  ``segment_ids`` [B, S] int32 restricts attention to
-same-segment pairs — packed-sequence training the stock wrapper lacked
-(pass None for a single segment).  The [S, S] score matrix never
-materialises in HBM; VMEM holds one [block_q, block_k] tile.
+Layouts: q [B, S, H, hd], k/v [B, S, KV, hd] (grouped-query attention:
+KV may divide H — each group of H/KV query heads reads one KV head, so
+GQA models stream KV at 1/group the HBM traffic instead of repeating
+heads).  ``segment_ids`` [B, S] int32 restricts attention to same-segment
+pairs — packed-sequence training the stock wrapper lacked (pass None for
+a single segment).  The [S, S] score matrix never materialises in HBM;
+VMEM holds one [block_q, block_k] tile.
 """
 import functools
 
@@ -74,8 +76,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 segq_ref, segk_ref, dk_ref, dv_ref, *,
-                sm_scale, causal, block_q, block_k, seq_len):
-    ik = pl.program_id(2)
+                sm_scale, causal, block_q, block_k, seq_len, rep):
+    """Grid (B, S//block_k, H) with the Q-head dim INNERMOST: consecutive
+    grid steps within one rep-group revisit the same dk/dv output block
+    (index h//rep), which persists in VMEM — the kernel accumulates into
+    it, so VMEM holds one head's tiles regardless of the GQA group size.
+    dk/dv outputs are fp32 (exact accumulation across the group)."""
+    ik = pl.program_id(1)
+    ih = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)                  # [Bk, hd]
     v = v_ref[0, 0].astype(jnp.float32)
     k_pos = ik * block_k + lax.broadcasted_iota(
@@ -113,8 +121,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk_new, dv_new
 
     dk, dv = lax.fori_loop(start, seq_len // block_q, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(ih % rep == 0)
+    def _init():
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
+
+    @pl.when(ih % rep != 0)
+    def _accum():
+        dk_ref[0, 0] = dk_ref[0, 0] + dk
+        dv_ref[0, 0] = dv_ref[0, 0] + dv
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -179,9 +195,11 @@ def _choose_blocks(seq_len, block_q, block_k):
 
 def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
                        sm_scale=None, block_q=512, block_k=512):
-    """q/k/v: [B, S, H, hd] -> [B, S, H, hd].  ``segment_ids``: None or a
-    [B, S] int array; packed sequences attend only within their own
-    segment (non-differentiable — it rides the VJP closure)."""
+    """q [B, S, H, hd], k/v [B, S, KV, hd] -> [B, S, H, hd].  KV may
+    divide H (grouped-query attention — KV streams once per group).
+    ``segment_ids``: None or a [B, S] int array; packed sequences attend
+    only within their own segment (non-differentiable — it rides the VJP
+    closure)."""
 
     @jax.custom_vjp
     def f(q, k, v):
@@ -203,6 +221,11 @@ def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
 
 def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
     B, S, H, hd = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"ds_flash_attention: q heads {H} not a multiple "
+                         f"of kv heads {KV}")
+    rep = H // KV
     sm = sm_scale if sm_scale is not None else hd ** -0.5
     bq, bk = _choose_blocks(S, block_q, block_k)
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
@@ -215,8 +238,10 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
         kernel, grid=(B, H, S // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, bq), lambda b, h, i: (b, i)),
             pl.BlockSpec((1, S), lambda b, h, i: (b, 0)),
         ],
@@ -235,6 +260,8 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
 def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
     B, S, H, hd = q.shape
+    KV = k.shape[2]
+    rep = H // KV
     sm = sm_scale if sm_scale is not None else hd ** -0.5
     bq, bk = _choose_blocks(S, block_q, block_k)
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
@@ -248,20 +275,31 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
     full_s = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
     seg_full = pl.BlockSpec((1, S), lambda b, h, i: (b, 0))
 
+    # dK/dV: Q-head-innermost grid; rep-group steps accumulate into the
+    # shared (b, h//rep, i) fp32 output block
     dkv_kernel = functools.partial(
         _dkv_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
-        seq_len=S)
+        seq_len=S, rep=rep)
     dkT, dvT = pl.pallas_call(
-        dkv_kernel, grid=(B, H, S // bk),
-        in_specs=[full,
-                  pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
-                  pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
-                  full, full_s, full_s, seg_full, seg_full],
+        dkv_kernel, grid=(B, S // bk, H),
+        in_specs=[
+            pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, h, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, h, 0)),
+            pl.BlockSpec((1, S), lambda b, i, h: (b, 0)),
+            pl.BlockSpec((1, S), lambda b, i, h: (b, 0))],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, i: (b, h, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
-                   jax.ShapeDtypeStruct((B, H, S, hd), q.dtype)],
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, i, h: (b, h // rep, i, 0)),
+            pl.BlockSpec((1, 1, bk, hd),
+                         lambda b, i, h: (b, h // rep, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32),
+                   jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32)],
     )(qT, kT, vT, doT, lse, delta, seg, seg)
 
     dq_kernel = functools.partial(
@@ -271,7 +309,10 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
         dq_kernel, grid=(B, H, S // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            full, full,
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
             pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
@@ -283,7 +324,7 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
     )(qT, kT, vT, doT, lse, delta, seg, seg)
 
     dq = jnp.transpose(dqT, (0, 2, 1, 3))
-    dk = jnp.transpose(dkT, (0, 2, 1, 3))
-    dv = jnp.transpose(dvT, (0, 2, 1, 3))
+    dk = jnp.transpose(dkT, (0, 2, 1, 3)).astype(k.dtype)
+    dv = jnp.transpose(dvT, (0, 2, 1, 3)).astype(v.dtype)
     return dq, dk, dv
 
