@@ -121,7 +121,8 @@ def _partial_rope(x, config: NeoXConfig, positions=None):
     return jnp.concatenate([xr, x[..., rot:]], axis=-1)
 
 
-def _block(x, layer, config: NeoXConfig, rng=None):
+def _block_qkv(x, layer, config: NeoXConfig, positions=None):
+    """LN1 + fused QKV (head-major [q|k|v] packing) + partial rotary."""
     B, S, D = x.shape
     H, hd = config.num_heads, config.head_dim
     dt = x.dtype
@@ -129,10 +130,15 @@ def _block(x, layer, config: NeoXConfig, rng=None):
              config.layer_norm_eps)
     qkv = h1 @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
     q, kk, v = jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
-    q = _partial_rope(q, config)
-    kk = _partial_rope(kk, config)
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
-    attn_out = (attn.reshape(B, S, D) @ layer["dense_w"].astype(dt)
+    q = _partial_rope(q, config, positions)
+    kk = _partial_rope(kk, config, positions)
+    return q, kk, v
+
+
+def _block_finish(x, attn_flat, layer, config: NeoXConfig):
+    """Output projection + MLP with the parallel/serial residual form."""
+    dt = x.dtype
+    attn_out = (attn_flat @ layer["dense_w"].astype(dt)
                 + layer["dense_b"].astype(dt))
     h2_in = x if config.use_parallel_residual else x + attn_out
     h2 = _ln(h2_in, layer["ln2_scale"], layer["ln2_bias"],
@@ -144,6 +150,13 @@ def _block(x, layer, config: NeoXConfig, rng=None):
     if config.use_parallel_residual:
         return x + attn_out + mlp_out       # gpt-j style parallel residual
     return h2_in + mlp_out
+
+
+def _block(x, layer, config: NeoXConfig, rng=None):
+    B, S, D = x.shape
+    q, kk, v = _block_qkv(x, layer, config)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    return _block_finish(x, attn.reshape(B, S, D), layer, config)
 
 
 def forward(params, batch, config: NeoXConfig, rng=None):
@@ -173,6 +186,47 @@ def count_params(config: NeoXConfig) -> int:
     return V * D + L * per_layer + 2 * D + D * V
 
 
+def _serving_fns(config: NeoXConfig):
+    """KV-cache serving via the shared rotary scaffold (models/serving.py):
+    NeoX contributes its fused-QKV partial-rotary projection and the
+    parallel-residual finish."""
+    from deepspeed_tpu.models import serving
+
+    def embed_fn(params, tokens):
+        return params["wte"].astype(jnp.dtype(config.dtype))[tokens]
+
+    def qkv_fn(x, layer, positions):
+        return _block_qkv(x, layer, config, positions)
+
+    def finish_fn(x, attn_flat, layer):
+        return _block_finish(x, attn_flat, layer, config)
+
+    def head_fn(params, x):
+        x = _ln(x, params["lnf_scale"], params["lnf_bias"],
+                config.layer_norm_eps)
+        return x @ params["embed_out"].astype(jnp.dtype(config.dtype))
+
+    def init_cache_fn(bs, max_len, dtype=None):
+        return serving.init_cache(config.num_layers, config.num_heads,
+                                  config.head_dim, bs, max_len, dtype,
+                                  config.dtype)
+
+    def prefill_fn(p, b, c):
+        return serving.prefill(
+            p, b, c, embed_fn=embed_fn, qkv_fn=qkv_fn, finish_fn=finish_fn,
+            head_fn=head_fn, num_heads=config.num_heads,
+            num_kv_heads=config.num_heads,
+            attention_impl=config.attention_impl)
+
+    def decode_fn(p, t, c, l):
+        return serving.decode_step(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
+
+    return init_cache_fn, prefill_fn, decode_fn
+
+
 def neox_model(size: str = "tiny", **overrides) -> Model:
     cfg_kwargs = dict(NEOX_SIZES[size]) if size in NEOX_SIZES else {}
     cfg_kwargs.update(overrides)
@@ -187,4 +241,6 @@ def neox_model(size: str = "tiny", **overrides) -> Model:
         meta={"name": f"neox-{size}", "n_params": n_params,
               "supports_random_ltd": True, "supports_pld": True,
               "sparse_grad_params": {"wte": "input_ids"}},
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+                   _serving_fns(config))),
     )
