@@ -359,8 +359,12 @@ def opt_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         raise ValueError(
             "opt_from_hf: bare state_dict carries no config — pass the "
             "transformers model or a num_heads= override")
-    act = (str(getattr(hf_cfg, "activation_function", "relu"))
-           if hf_cfg is not None else overrides.get("activation", "relu"))
+    # an activation override names the HF form; consume it here (through
+    # the same map) so cfg.update below cannot clobber the translation
+    act = (str(overrides.pop("activation"))
+           if "activation" in overrides
+           else str(getattr(hf_cfg, "activation_function", "relu"))
+           if hf_cfg is not None else "relu")
     # HF "gelu" is the exact erf form; gelu_new is the tanh approximation
     act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
     if act not in act_map:

@@ -37,40 +37,69 @@ def xla_causal_attention(q, k, v, segment_ids=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def flash_causal_attention(q, k, v, segment_ids=None):
+def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
     """Pallas TPU flash attention (blockwise, never materialises the [S,S]
     scores in HBM).
 
     Kernel selection: the tuned stock-op wrapper by default; the in-tree
     from-scratch FlashAttention-2 kernel (ops/pallas/ds_flash_attention)
     when ``segment_ids`` is given (sequence packing — only it supports
-    segments) or when ``DS_FLASH_KERNEL=ds`` is set."""
+    segments) or when ``DS_FLASH_KERNEL=ds`` is set.  With
+    ``fallback=False`` (the explicit ``impl="flash"`` contract) unsupported
+    shapes raise instead of degrading to the XLA einsum path."""
     import os
     if segment_ids is not None or os.environ.get(
             "DS_FLASH_KERNEL", "").lower() == "ds":
         from deepspeed_tpu.ops.pallas.ds_flash_attention import \
             ds_flash_attention
+        if fallback and not _ds_vmem_ok(q):
+            return xla_causal_attention(q, k, v, segment_ids)
         try:
             return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                       causal=True)
         except ValueError:
+            if not fallback:
+                raise
             # sequence length does not block-decompose: exact XLA path
             return xla_causal_attention(q, k, v, segment_ids)
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     return flash_attention(q, k, v, causal=True)
 
 
-_FLASH_STATUS = {}  # probe result per (S, hd): True usable / exception string
+def _ds_vmem_ok(q) -> bool:
+    """VMEM-budget check for the from-scratch kernel's whole-S staging; the
+    eval_shape probe cannot see Mosaic VMEM exhaustion, so oversized shapes
+    are routed to the XLA path here (loudly, once per shape class)."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import vmem_fits
+    key = ("vmem", q.shape[1], q.shape[3], q.dtype.itemsize)
+    if key not in _FLASH_STATUS:
+        _FLASH_STATUS[key] = vmem_fits(q.shape[1], q.shape[3],
+                                       q.dtype.itemsize)
+        if _FLASH_STATUS[key] is not True:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                f"attention: ds flash kernel working set for S={q.shape[1]} "
+                f"head_dim={q.shape[3]} {q.dtype} exceeds the VMEM budget — "
+                "falling back to XLA einsum attention (raise "
+                "DS_FLASH_VMEM_MB only if the target core has more VMEM)")
+    return _FLASH_STATUS[key] is True
 
 
-def _flash_usable(q, fn=None, k=None) -> bool:
+_FLASH_STATUS = {}  # probe/guard result per shape-class key: True / message
+
+
+def _flash_usable(q, fn=None, k=None, ds=False) -> bool:
     """Probe the Pallas flash path once per shape class and remember the
     outcome.  A failure is logged loudly (never silently degraded — VERDICT
     round 1 flagged the silent except here) so a bench run on a slow fallback
-    is visible in the logs."""
+    is visible in the logs.  ``ds=True`` marks fns that route to the
+    from-scratch kernel, whose whole-S VMEM staging the eval_shape probe
+    cannot vet — those get the budget check first."""
     from deepspeed_tpu.utils.logging import logger
     fn = fn or flash_causal_attention
     kv = q if k is None else k
+    if ds and not _ds_vmem_ok(q):
+        return False
     key = (q.shape[1], q.shape[3], kv.shape[2],
            getattr(fn, "__name__", "bidirectional"))
     if key not in _FLASH_STATUS:
@@ -100,9 +129,9 @@ def _local_causal_attention(q, k, v, impl: str = "auto"):
         # explicit request: no fallback — surface the real error
         if gqa:
             return _ds_gqa_causal(q, k, v)
-        return flash_causal_attention(q, k, v)
+        return flash_causal_attention(q, k, v, fallback=False)
     if impl == "auto" and _on_tpu() and q.shape[1] >= 256:
-        if gqa and _flash_usable(q, fn=_ds_gqa_causal, k=k):
+        if gqa and _flash_usable(q, fn=_ds_gqa_causal, k=k, ds=True):
             # grouped-query: the from-scratch kernel reads each KV head
             # once per group instead of attending repeated copies
             return _ds_gqa_causal(q, k, v)
@@ -165,7 +194,8 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
             return noncausal(q, k, v)
         # padded: probe the segment-capable kernel the same (loudly
         # logged) way the unpadded path probes the stock wrapper
-        if pad_mask is not None and _flash_usable(q, fn=flash_padded):
+        if pad_mask is not None and _flash_usable(q, fn=flash_padded,
+                                                  ds=True):
             return flash_padded(q, k, v)
     return xla_bidirectional_attention(q, k, v, pad_mask)
 

@@ -193,6 +193,32 @@ def _choose_blocks(seq_len, block_q, block_k):
     return bq, bk
 
 
+def vmem_fits(seq_len, head_dim, itemsize, block_q=512, block_k=512,
+              budget_bytes=None):
+    """Whether one (batch, head) grid step's VMEM working set fits on-core.
+
+    The kernels stage the full-sequence K/V (forward/dq) or Q/dO (dk/dv
+    pass) per grid step via whole-S BlockSpecs, so the dominant term is
+    2*S*hd*itemsize; Pallas double-buffers the pipelined blocks, hence the
+    factor 2 on top, plus per-row fp32 lse/delta/segments and the
+    [block_q, hd] tiles.  The dispatch layer calls this before selecting
+    the kernel — ``jax.eval_shape`` probes only shapes and would pass a
+    16k-fp32 sequence that Mosaic then rejects at compile time (advisor
+    round 3).  Budget defaults to 12 MiB of the ~16 MiB/core VMEM;
+    override with DS_FLASH_VMEM_MB."""
+    import os
+    if budget_bytes is None:
+        budget_bytes = int(os.environ.get("DS_FLASH_VMEM_MB", "12")) << 20
+    try:
+        bq, bk = _choose_blocks(seq_len, block_q, block_k)
+    except ValueError:
+        return False
+    full_kv = 2 * seq_len * head_dim * itemsize      # K+V (or Q+dO) whole-S
+    rows = 16 * seq_len                              # lse/delta/2×segments
+    tiles = (bq + bk) * head_dim * (itemsize + 2 * 4)  # in tiles + fp32 acc
+    return 2 * (full_kv + rows) + tiles <= budget_bytes
+
+
 def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
                        sm_scale=None, block_q=512, block_k=512):
     """q [B, S, H, hd], k/v [B, S, KV, hd] -> [B, S, H, hd].  KV may

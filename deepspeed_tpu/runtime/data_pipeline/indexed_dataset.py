@@ -3,10 +3,15 @@ deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py
 ``MMapIndexedDataset`` — the Megatron binary corpus format the offline
 DataAnalyzer reads and writes).
 
-Format: ``<path>.bin`` holds the concatenated sample payloads;
-``<path>.idx`` holds a small header (magic, dtype code, sample count)
-followed by per-sample element counts and byte offsets.  Reads go through
-``np.memmap`` so a multi-hundred-GB corpus costs no resident RAM.
+Native format: ``<path>.bin`` holds the concatenated sample payloads;
+``<path>.idx`` holds a small header (magic ``DSTPUIDX``, dtype code,
+sample count) followed by per-sample element counts and byte offsets.
+The reader ALSO accepts the reference's ``MMIDIDX`` .idx layout
+(9-byte magic, version, dtype code, length, doc count, int32 sizes,
+int64 pointers, int64 doc_idx — indexed_dataset.py:372-451), so existing
+Megatron/DeepSpeed corpora load unchanged; the builder writes only the
+native layout.  Reads go through ``np.memmap`` so a multi-hundred-GB
+corpus costs no resident RAM.
 """
 import os
 import struct
@@ -15,10 +20,16 @@ from typing import Sequence
 import numpy as np
 
 _MAGIC = b"DSTPUIDX\x01"
-#: dtype codes (subset of the reference's _code_to_dtype)
+_MMIDIDX_MAGIC = b"MMIDIDX\x00\x00"  # reference Megatron wire format
+#: native dtype codes (DSTPUIDX files only)
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
            5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
 _CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+#: the reference's code table (indexed_dataset.py:101-112) — NOT the same
+#: assignment as the native one (6 is float64 there, float32 here)
+_MMIDIDX_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+                   5: np.int64, 6: np.float64, 7: np.double, 8: np.uint16,
+                   9: np.uint32, 10: np.uint64}
 
 
 def data_file_path(prefix: str) -> str:
@@ -64,12 +75,30 @@ class MMapIndexedDataset:
     def __init__(self, prefix: str):
         with open(index_file_path(prefix), "rb") as f:
             magic = f.read(len(_MAGIC))
-            if magic != _MAGIC:
+            if magic == _MAGIC:
+                code, n = struct.unpack("<BQ", f.read(9))
+                self.dtype = np.dtype(_DTYPES[code])
+                self.sizes = np.frombuffer(f.read(8 * n), np.int64)
+                self.offsets = np.frombuffer(f.read(8 * n), np.int64)
+                self.doc_idx = np.arange(n + 1, dtype=np.int64)
+            elif magic == _MMIDIDX_MAGIC:
+                (version,) = struct.unpack("<Q", f.read(8))
+                if version != 1:
+                    raise ValueError(
+                        f"{prefix}.idx: MMIDIDX version {version} != 1")
+                (code,) = struct.unpack("<B", f.read(1))
+                if code not in _MMIDIDX_DTYPES:
+                    raise ValueError(
+                        f"{prefix}.idx: unknown MMIDIDX dtype code {code}")
+                self.dtype = np.dtype(_MMIDIDX_DTYPES[code])
+                (n,) = struct.unpack("<Q", f.read(8))
+                (doc_count,) = struct.unpack("<Q", f.read(8))
+                self.sizes = np.frombuffer(f.read(4 * n),
+                                           np.int32).astype(np.int64)
+                self.offsets = np.frombuffer(f.read(8 * n), np.int64)
+                self.doc_idx = np.frombuffer(f.read(8 * doc_count), np.int64)
+            else:
                 raise ValueError(f"{prefix}.idx: bad magic {magic!r}")
-            code, n = struct.unpack("<BQ", f.read(9))
-            self.dtype = np.dtype(_DTYPES[code])
-            self.sizes = np.frombuffer(f.read(8 * n), np.int64)
-            self.offsets = np.frombuffer(f.read(8 * n), np.int64)
         self._data = np.memmap(data_file_path(prefix), mode="r",
                                dtype=np.uint8)
 

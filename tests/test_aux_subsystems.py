@@ -393,6 +393,54 @@ def test_indexed_dataset_roundtrip(tmp_path):
     assert [len(x) for x in ds[2:5]] == [len(s) for s in samples[2:5]]
 
 
+def test_indexed_dataset_reads_megatron_mmididx(tmp_path):
+    """Wire compat: a reference-format MMIDIDX .idx/.bin pair (Megatron
+    corpus, reference indexed_dataset.py:372-451) loads through the same
+    reader as the native format."""
+    import struct
+    from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+        MMapIndexedDataset)
+    rng = np.random.default_rng(2)
+    samples = [rng.integers(0, 5000, size=rng.integers(2, 30)).astype(
+        np.uint16) for _ in range(9)]
+    prefix = str(tmp_path / "meg")
+    with open(prefix + ".bin", "wb") as f:
+        for s in samples:
+            f.write(s.tobytes())
+    sizes = np.array([s.size for s in samples], np.int32)
+    pointers = np.concatenate(
+        [[0], np.cumsum([s.nbytes for s in samples[:-1]])]).astype(np.int64)
+    doc_idx = np.arange(len(samples) + 1, dtype=np.int64)
+    with open(prefix + ".idx", "wb") as f:
+        f.write(b"MMIDIDX\x00\x00")
+        f.write(struct.pack("<Q", 1))
+        f.write(struct.pack("<B", 8))                    # code 8 = uint16
+        f.write(struct.pack("<Q", len(samples)))
+        f.write(struct.pack("<Q", len(doc_idx)))
+        f.write(sizes.tobytes())
+        f.write(pointers.tobytes())
+        f.write(doc_idx.tobytes())
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 9 and ds.dtype == np.uint16
+    for i in (0, 4, 8):
+        np.testing.assert_array_equal(ds[i], samples[i])
+    np.testing.assert_array_equal(ds.doc_idx, doc_idx)
+    # code 6 is float64 on the MMIDIDX wire (float32 in the native table)
+    fsample = rng.normal(size=5)
+    fprefix = str(tmp_path / "megf")
+    with open(fprefix + ".bin", "wb") as f:
+        f.write(fsample.tobytes())
+    with open(fprefix + ".idx", "wb") as f:
+        f.write(b"MMIDIDX\x00\x00")
+        f.write(struct.pack("<QBQQ", 1, 6, 1, 2))
+        f.write(np.array([5], np.int32).tobytes())
+        f.write(np.array([0], np.int64).tobytes())
+        f.write(np.array([0, 1], np.int64).tobytes())
+    fds = MMapIndexedDataset(fprefix)
+    assert fds.dtype == np.float64
+    np.testing.assert_array_equal(fds[0], fsample)
+
+
 def test_data_analyzer_map_reduce_feeds_sampler(tmp_path):
     """DataAnalyzer (reference data_analyzer.py): multi-worker map +
     reduce produce sample_to_metric / metric_to_sample index files that

@@ -147,6 +147,30 @@ def test_opt_from_hf_logits_match():
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
 
 
+def test_opt_from_hf_bare_sd_activation_override_logits_match():
+    """Advisor round 3: with a bare state_dict, an activation='gelu'
+    override must select the exact erf gelu (HF semantics) — previously
+    cfg.update clobbered the act_map translation with the raw override,
+    silently swapping in the tanh approximation."""
+    from transformers import OPTConfig, OPTForCausalLM
+    from deepspeed_tpu.models.hf import opt_from_hf
+    torch.manual_seed(14)
+    hf = OPTForCausalLM(OPTConfig(
+        vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        do_layer_norm_before=True, dropout=0.0,
+        activation_function="gelu")).eval()
+    model, params = opt_from_hf(
+        hf.state_dict(), num_heads=4, activation="gelu",
+        dtype="float32", attention_impl="xla")
+    ids = np.random.default_rng(14).integers(0, 128, (2, 16)).astype(
+        np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
 def test_neox_from_hf_logits_match():
     from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
     from deepspeed_tpu.models.hf import neox_from_hf

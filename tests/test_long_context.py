@@ -408,6 +408,33 @@ def test_ds_flash_pad_mask_as_segments(interpret_pallas):
                                    np.asarray(ref[b, :L]), atol=2e-5)
 
 
+def test_ds_flash_vmem_guard_routes_oversized_to_xla():
+    """Advisor round 3: the kernels stage full-sequence K/V in VMEM per
+    grid step, so shapes whose working set exceeds the ~16 MiB/core budget
+    must never reach the Mosaic compiler — the dispatch layer's budget
+    check routes them to the XLA path (eval_shape alone cannot see this)."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import vmem_fits
+    from deepspeed_tpu.ops import attention as att
+    # 1k bf16 fits comfortably; 16k fp32 exceeds 12 MiB (advisor's case)
+    assert vmem_fits(1024, 64, 2)
+    assert not vmem_fits(16384, 64, 4)
+    # dispatch: a packed (segment-id) call on the oversized shape traces
+    # through the XLA fallback instead of the kernel — eval_shape of the
+    # kernel path would "pass" and then die in Mosaic on real hardware
+    B, S, H, hd = 1, 16384, 2, 64
+    q = jax.ShapeDtypeStruct((B, S, H, hd), jnp.float32)
+    seg = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    att._FLASH_STATUS.clear()
+    out = jax.eval_shape(
+        lambda q, k, v, s: att.flash_causal_attention(q, k, v,
+                                                      segment_ids=s),
+        q, q, q, seg)
+    assert out.shape == (B, S, H, hd)
+    key = ("vmem", S, hd, 4)
+    assert att._FLASH_STATUS.get(key) is not True  # guard fired
+    att._FLASH_STATUS.clear()
+
+
 def test_ds_flash_gqa_parity(interpret_pallas):
     """Grouped-query attention: the kernel attends compact KV heads
     natively; parity vs the repeated-head dense reference for fwd and all
