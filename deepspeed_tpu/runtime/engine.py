@@ -130,6 +130,11 @@ class DeepSpeedEngine:
         self.training_dataloader = None
         self.collate_fn = collate_fn
         self.mpu = mpu
+        # pluggable checkpoint backend (reference engine.py:897
+        # _configure_checkpointing: torch vs async nebula engine) — the
+        # async Orbax engine overlaps saves with subsequent train steps
+        self.checkpoint_engine = None
+        self._pending_ckpt = None
 
         # ---- precision -------------------------------------------------------
         if self._config.fp16.enabled:
@@ -2042,9 +2047,44 @@ class DeepSpeedEngine:
             log_dist(msg, ranks=[0])
 
     # ------------------------------------------------------------------ checkpoint
+    def _get_checkpoint_engine(self):
+        """Resolve the pluggable backend (reference engine.py:897): a
+        client-set ``engine.checkpoint_engine`` wins; else config
+        ``checkpoint.async_save`` selects the async Orbax engine (the
+        Nebula-equivalent), else the synchronous Orbax default."""
+        if self.checkpoint_engine is None:
+            from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+                AsyncOrbaxCheckpointEngine, OrbaxCheckpointEngine)
+            if self._config.checkpoint_config.async_save:
+                self.checkpoint_engine = AsyncOrbaxCheckpointEngine()
+            else:
+                self.checkpoint_engine = OrbaxCheckpointEngine()
+        return self.checkpoint_engine
+
+    def wait_pending_checkpoint(self):
+        """Block until an in-flight async save is durable, then publish its
+        ``latest`` pointer.  No-op for sync engines / no pending save.
+        Called automatically before the next save/load, so at most one
+        save overlaps training."""
+        if self._pending_ckpt is None:
+            return
+        save_dir, tag, save_latest, aux_thread = self._pending_ckpt
+        self._pending_ckpt = None
+        if aux_thread is not None:
+            aux_thread.join()
+        self._get_checkpoint_engine().commit(tag)
+        if save_latest and jax.process_index() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"committed checkpoint {os.path.join(save_dir, str(tag))}",
+                 ranks=[0])
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
-        from deepspeed_tpu.runtime.checkpoint_engine.engine import save_state
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            METADATA_FILE, STATE_DIR)
+        self.wait_pending_checkpoint()
+        ckpt_engine = self._get_checkpoint_engine()
         tag = tag or f"global_step{self.global_steps}"
         ckpt_dir = os.path.join(save_dir, str(tag))
         extra = {
@@ -2055,21 +2095,61 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
             "config": self._config._param_dict,
         }
-        save_state(ckpt_dir, self.state, extra)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt_engine.create(tag)
+        ckpt_engine.save(self.state, os.path.join(ckpt_dir, STATE_DIR))
+        if jax.process_index() == 0:
+            import json as _json
+            with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
+                _json.dump(extra, f, indent=2, default=str)
+        is_async = getattr(ckpt_engine, "is_async", False)
+        # host-side optimizer tiers: snapshot synchronously (their pinned /
+        # in-place buffers mutate every step), serialize alongside the
+        # Orbax write — in the background when async
+        import numpy as np_
+        aux_flats = {}
         if self.streamed_optimizer is not None:
-            self.streamed_optimizer.save_npz(
-                os.path.join(ckpt_dir, "streamed_optimizer.npz"))
+            aux_flats["streamed_optimizer.npz"] = \
+                self.streamed_optimizer.npz_state()
         if self.host_optimizer is not None:
-            import numpy as np_
             sd = self.host_optimizer.state_dict()
             flat = {"step_count": np_.int64(sd["step_count"])}
             for p, arr in sd["master"].items():
-                flat[f"master::{p}"] = arr
+                flat[f"master::{p}"] = np_.array(arr, copy=is_async)
             for p, moments in sd["moments"].items():
                 for j, mbuf in enumerate(moments):
-                    flat[f"moment{j}::{p}"] = mbuf
-            np_.savez(os.path.join(ckpt_dir, "host_optimizer.npz"), **flat)
-        if save_latest:
+                    flat[f"moment{j}::{p}"] = np_.array(mbuf, copy=is_async)
+            aux_flats["host_optimizer.npz"] = flat
+
+        def _write_aux():
+            for name, payload in aux_flats.items():
+                np_.savez(os.path.join(ckpt_dir, name), **payload)
+
+        if is_async:
+            # commit + `latest` publish are deferred until the background
+            # serialization finishes (wait_pending_checkpoint); training
+            # continues immediately against the already-snapshotted state
+            import atexit
+            import threading
+            import weakref
+            aux_thread = None
+            if aux_flats:
+                aux_thread = threading.Thread(target=_write_aux,
+                                              daemon=False)
+                aux_thread.start()
+            self._pending_ckpt = (save_dir, tag, save_latest, aux_thread)
+            if not getattr(self, "_ckpt_atexit", False):
+                # the last save of a run must still publish `latest` even
+                # if the script exits without another checkpoint call
+                ref = weakref.ref(self)
+                atexit.register(
+                    lambda: ref() and ref().wait_pending_checkpoint())
+                self._ckpt_atexit = True
+            log_dist(f"async checkpoint {ckpt_dir} in flight", ranks=[0])
+            return True
+        _write_aux()
+        ckpt_engine.commit(tag)
+        if save_latest and jax.process_index() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
         log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
@@ -2079,7 +2159,10 @@ class DeepSpeedEngine:
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True,
                         load_module_only=False):
-        from deepspeed_tpu.runtime.checkpoint_engine.engine import load_state
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            METADATA_FILE, STATE_DIR)
+        self.wait_pending_checkpoint()
+        ckpt_engine = self._get_checkpoint_engine()
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -2088,9 +2171,17 @@ class DeepSpeedEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         ckpt_dir = os.path.join(load_dir, str(tag))
-        state, extra = load_state(
-            ckpt_dir, self.state, self.state_shardings,
-            load_optimizer_states=load_optimizer_states and not load_module_only)
+        state = ckpt_engine.load(os.path.join(ckpt_dir, STATE_DIR),
+                                 template=self.state,
+                                 shardings=self.state_shardings)
+        if not (load_optimizer_states and not load_module_only):
+            state = {**state, "opt_state": self.state["opt_state"]}
+        extra = {}
+        meta_path = os.path.join(ckpt_dir, METADATA_FILE)
+        if os.path.exists(meta_path):
+            import json as _json
+            with open(meta_path) as f:
+                extra = _json.load(f)
         self.state = state
         streamed_path = os.path.join(ckpt_dir, "streamed_optimizer.npz")
         if (self.streamed_optimizer is not None

@@ -345,7 +345,10 @@ class StreamedOptimizer:
         self.step_count = int(sd.get("step_count", 0))
 
     # npz persistence for the engine's checkpoint format
-    def save_npz(self, path: str):
+    def npz_state(self) -> dict:
+        """Flat host-numpy snapshot (np.asarray copies out of the pinned
+        buffers, which later donated updates reuse in place — the copy is
+        what makes a deferred/async write safe)."""
         flat = {"step_count": np.int64(self.step_count)}
         for tag, tree in (("master", self.master), ("m", self.m),
                           ("v", self.v)):
@@ -354,7 +357,10 @@ class StreamedOptimizer:
                 key = tag + "::" + "/".join(
                     str(getattr(k, "key", k)) for k in kp)
                 flat[key] = np.asarray(leaf)
-        np.savez(path, **flat)
+        return flat
+
+    def save_npz(self, path: str):
+        np.savez(path, **self.npz_state())
 
     def load_npz(self, path: str):
         flat = np.load(path)

@@ -139,6 +139,45 @@ def test_checkpoint_roundtrip(devices8, tmp_path, stage):
     assert abs(loss_before - loss_after) < 1e-5
 
 
+def test_async_checkpoint_overlaps_training(devices8, tmp_path):
+    """Async engine (reference nebula_checkpoint_engine.py capability):
+    save_checkpoint returns with the save in flight, training continues
+    and mutates the live state, the commit barrier publishes `latest`,
+    and the restored state is the SAVE-TIME snapshot — not the
+    post-save-mutated one."""
+    import os
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+        AsyncOrbaxCheckpointEngine)
+    engine = _make_engine({"zero_optimization": {"stage": 2},
+                           "checkpoint": {"async_save": True}})
+    _train(engine, steps=2, seed=1)
+    at_save = np.asarray(
+        engine.state["params"]["blocks"]["qkv_w"]).copy()
+    engine.save_checkpoint(str(tmp_path), client_state={"bar": 2})
+    assert isinstance(engine.checkpoint_engine, AsyncOrbaxCheckpointEngine)
+    # commit deferred: `latest` is not published while the save is in
+    # flight, and training keeps going meanwhile
+    assert not os.path.exists(os.path.join(str(tmp_path), "latest"))
+    _train(engine, steps=2, seed=21)
+    mutated = np.asarray(engine.state["params"]["blocks"]["qkv_w"])
+    assert np.abs(mutated - at_save).max() > 0
+    engine.wait_pending_checkpoint()
+    assert os.path.exists(os.path.join(str(tmp_path), "latest"))
+
+    engine2 = _make_engine({"zero_optimization": {"stage": 2},
+                            "checkpoint": {"async_save": True}})
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None and client == {"bar": 2}
+    assert engine2.global_steps == 2
+    restored = np.asarray(engine2.state["params"]["blocks"]["qkv_w"])
+    np.testing.assert_array_equal(restored, at_save)
+    # a second async save auto-commits any pending one at entry
+    engine.save_checkpoint(str(tmp_path), tag="second")
+    engine.save_checkpoint(str(tmp_path), tag="third")
+    engine.wait_pending_checkpoint()
+    assert open(os.path.join(str(tmp_path), "latest")).read() == "third"
+
+
 def test_checkpoint_reshape_across_stages(devices8, tmp_path):
     """Universal-checkpoint property: save under stage 0, load under stage 3
     (reference: checkpoint/universal_checkpoint.py capability)."""

@@ -88,6 +88,27 @@ def test_offload_checkpoint_roundtrip(devices8, tmp_path):
     assert abs(l_next - l_resume) < 1e-5
 
 
+def test_offload_async_checkpoint_roundtrip(devices8, tmp_path):
+    """Async save with the host-optimizer tier: the aux npz snapshot is
+    taken at save time and serialized on the background thread; training
+    continues and the restore sees the save-time optimizer state."""
+    cfg = base_config(zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu"}},
+        checkpoint={"async_save": True})
+    e1, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=cfg)
+    _train(e1, steps=2, seed=1)
+    e1.save_checkpoint(str(tmp_path / "ck"))
+    l_next = _train(e1, steps=1, seed=33)[0]      # mutates host buffers
+    e1.wait_pending_checkpoint()
+
+    e2, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=cfg)
+    e2.load_checkpoint(str(tmp_path / "ck"))
+    assert (e2.host_optimizer.opt.step_count
+            == e1.host_optimizer.opt.step_count - 1)
+    l_resume = _train(e2, steps=1, seed=33)[0]
+    assert abs(l_next - l_resume) < 1e-5
+
+
 def test_offload_gradient_clipping(devices8):
     engine, *_ = deepspeed_tpu.initialize(
         model=tiny_gpt2(), config=base_config(
