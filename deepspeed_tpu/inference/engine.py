@@ -39,7 +39,11 @@ def _tree_cast(tree, dtype):
 
 class InferenceEngine:
     def __init__(self, model, config: DeepSpeedInferenceConfig,
-                 model_parameters=None, mesh=None):
+                 model_parameters=None, mesh=None, defer_params=False):
+        """``defer_params=True`` skips parameter materialisation entirely —
+        the caller binds ``self.params`` itself (the hybrid engine does:
+        its fused view is already cast+sharded, and the default path would
+        build a second full-size placed copy only to discard it)."""
         self.model = model
         self._config = config
         tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
@@ -56,6 +60,13 @@ class InferenceEngine:
         self.dtype = jnp.dtype(config.dtype)
 
         logical = getattr(model, "logical_specs", None)
+        if defer_params:
+            self.params = None
+            self._generate_fns = {}
+            self._forward = jax.jit(lambda p, batch: model.apply(p, batch))
+            log_dist(f"InferenceEngine: tp={tp}, dtype={self.dtype} "
+                     "(params deferred)", ranks=[0])
+            return
         if model_parameters is None:
             params = model.init(jax.random.PRNGKey(0))
         else:

@@ -260,6 +260,16 @@ class Model:
     block_fn: Optional[Callable] = None
     head_fn: Optional[Callable] = None
     blocks_key: str = "blocks"
+    #: optional pytree of bool matching params: False leaves are FROZEN —
+    #: the engine excludes them from the optimizer (no updates, no moment
+    #: memory; reference capability: requires_grad=False params /
+    #: SimpleFrozenModel coverage).  LoRA sets base=False, adapters=True.
+    trainable_mask: Any = None
+    #: optional params -> params transform that materialises merged
+    #: inference weights (LoRA fuse-for-generate; reference
+    #: hybrid_engine.py:138-158 _fuse_lora).  The hybrid/inference view
+    #: applies it; training always runs unfused.
+    fuse_fn: Optional[Callable] = None
     #: KV-cache serving path (engines use these when present):
     #: init_cache_fn(batch_size, max_len, dtype) -> cache pytree;
     #: prefill_fn(params, batch, cache) -> (logits [B,S,V], cache);

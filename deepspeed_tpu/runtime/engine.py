@@ -369,6 +369,11 @@ class DeepSpeedEngine:
             # TPU-native ZeRO-Infinity tier: optimizer state in pinned host
             # DRAM, update streamed on device — no Python/host round trips
             # (the C++ host-Adam path remains for NVMe and non-Adam configs)
+            if getattr(model, "trainable_mask", None) is not None:
+                raise NotImplementedError(
+                    "trainable_mask (frozen params / LoRA) is not supported "
+                    "with the offload optimizer tiers — adapter states are "
+                    "small; drop offload_optimizer for LoRA runs")
             from deepspeed_tpu.runtime.zero.device_offload import \
                 StreamedOptimizer
             self.streamed_optimizer = StreamedOptimizer(
@@ -382,6 +387,11 @@ class DeepSpeedEngine:
             self.opt_specs = ()
             self.opt_shardings = ()
         elif self._offload:
+            if getattr(model, "trainable_mask", None) is not None:
+                raise NotImplementedError(
+                    "trainable_mask (frozen params / LoRA) is not supported "
+                    "with the offload optimizer tiers — adapter states are "
+                    "small; drop offload_optimizer for LoRA runs")
             from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
             nvme_swapper = None
             if self._offload_device == "nvme":
@@ -411,6 +421,20 @@ class DeepSpeedEngine:
                 inner = build_optimizer(self._config.optimizer_name,
                                         self._config.optimizer_params,
                                         lr_schedule=self.lr_schedule)
+            mask = getattr(model, "trainable_mask", None)
+            if mask is not None:
+                # frozen leaves (reference: requires_grad=False params —
+                # LoRA bases, frozen embeddings): the inner transform never
+                # sees them (optax.masked stores MaskedNode, so no moment
+                # memory) and their updates are forced to zero
+                inv = jax.tree.map(lambda m: not m, mask)
+                inner = optax.chain(
+                    optax.masked(inner, mask),
+                    optax.masked(optax.set_to_zero(), inv))
+                opt_param_specs = jax.tree.map(
+                    lambda m, spec: spec if m else optax.MaskedNode(),
+                    mask, opt_param_specs,
+                    is_leaf=lambda x: isinstance(x, bool))
             chain = []
             if self._config.gradient_clipping > 0:
                 chain.append(

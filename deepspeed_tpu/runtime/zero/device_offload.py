@@ -356,7 +356,10 @@ class StreamedOptimizer:
             for kp, leaf in pairs:
                 key = tag + "::" + "/".join(
                     str(getattr(k, "key", k)) for k in kp)
-                flat[key] = np.asarray(leaf)
+                # np.array copy=True: np.asarray of a CPU-backed jax array
+                # is a zero-copy VIEW of the buffer that donated updates
+                # rewrite in place — a deferred write needs the snapshot
+                flat[key] = np.array(leaf, copy=True)
         return flat
 
     def save_npz(self, path: str):

@@ -1,0 +1,137 @@
+"""LoRA adapters + RLHF hybrid-engine depth (reference:
+deepspeed/runtime/hybrid_engine.py:138-174 — _fuse_lora/_unfuse_lora around
+generate; VERDICT round 3 item 3)."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.lora import (attach_lora_params, merge_lora,
+                                        wrap_lora)
+from tests.util import base_config, random_batches, tiny_gpt2
+
+
+def _train(engine, steps=3, seed=0, lr_batches=1):
+    losses = []
+    gas = engine.gradient_accumulation_steps()
+    for i in range(steps):
+        batches = iter(random_batches(gas, batch_size=8,
+                                      seed=seed + i * gas))
+        losses.append(float(engine.train_batch(batches)))
+    return losses
+
+
+def test_lora_identity_at_init(devices8):
+    """B starts at zero, so the wrapped model's logits equal the base
+    model's for the same base weights (the LoRA-paper init contract)."""
+    base = tiny_gpt2()
+    wrapped = wrap_lora(base, rank=4)
+    params = wrapped.init(jax.random.PRNGKey(0))
+    batch = random_batches(1, batch_size=2, seed=0)[0]
+    got = wrapped.apply(params, batch)
+    ref = base.apply(params["base"], batch)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_lora_train_updates_adapters_only(devices8):
+    """The engine's trainable_mask path: base weights are bit-frozen
+    (no update, no weight decay — AdamW would decay unfrozen bases even
+    at zero grad), adapters move, loss decreases."""
+    wrapped = wrap_lora(tiny_gpt2(), rank=4, alpha=8.0)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=wrapped, config=base_config(
+            optimizer={"type": "AdamW",
+                       "params": {"lr": 1e-2, "weight_decay": 0.1}}))
+    base_before = jax.tree.map(np.asarray, engine.state["params"]["base"])
+    fixed = random_batches(1, batch_size=8, seed=3)[0]
+    losses = [float(engine.train_batch(iter([fixed]))) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    base_after = engine.state["params"]["base"]
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a,
+                                                            np.asarray(b)),
+                 base_before, base_after)
+    b_leaf = np.asarray(
+        engine.state["params"]["lora"]["blocks/qkv_w"]["b"])
+    assert np.abs(b_leaf).max() > 0
+    # frozen leaves carry no optimizer moments (optax MaskedNode)
+    moment_leaves = len(jax.tree.leaves(engine.state["opt_state"]))
+    full_leaves = len(jax.tree.leaves(engine.state["params"]))
+    assert moment_leaves < 2 * full_leaves
+
+
+def test_lora_tp_zero3_matches_dp(devices8):
+    """Adapters ride the logical specs: TP×ZeRO-3 LoRA training matches
+    the pure-DP run."""
+    ref_engine, *_ = deepspeed_tpu.initialize(
+        model=wrap_lora(tiny_gpt2(), rank=4), config=base_config())
+    tp_engine, *_ = deepspeed_tpu.initialize(
+        model=wrap_lora(tiny_gpt2(), rank=4),
+        config={**base_config(),
+                "zero_optimization": {"stage": 3},
+                "mesh": {"model_parallel_size": 2}})
+    ref_losses = _train(ref_engine, steps=3, seed=5)
+    tp_losses = _train(tp_engine, steps=3, seed=5)
+    np.testing.assert_allclose(tp_losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_lora_hybrid_fuse_generate(devices8):
+    """RLHF shape: train the policy with LoRA, generate with fused
+    weights, assert the inference view equals the explicit merge
+    (reference _fuse_lora) and regenerate after updates differs."""
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+    wrapped = wrap_lora(tiny_gpt2(), rank=4, alpha=16.0)
+    engine = DeepSpeedHybridEngine(
+        config=base_config(optimizer={"type": "Adam",
+                                      "params": {"lr": 5e-2}}),
+        model=wrapped)
+    ids = np.arange(1, 9, dtype=np.int32)[None]
+    gen0 = engine.generate(ids, max_new_tokens=6)
+    assert gen0.shape == (1, 14)
+    for i in range(3):
+        b = random_batches(1, batch_size=8, seed=70 + i)[0]
+        engine.train_batch(batch={"input_ids": b["input_ids"][None]})
+    gen1 = engine.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(gen0[:, :8], gen1[:, :8])
+    assert not np.array_equal(gen0, gen1)
+    # the bound inference params ARE the explicit merge in compute dtype
+    view = engine._inference_view().params
+    scale = wrapped.meta["lora"]["scale"]
+    expect = merge_lora(engine.state["params"]["base"],
+                        engine.state["params"]["lora"], scale,
+                        freeze_base=False)
+    jax.tree.map(
+        lambda v, e: np.testing.assert_allclose(
+            np.asarray(v), np.asarray(e), rtol=1e-6, atol=1e-7),
+        view, expect)
+
+
+def test_attach_lora_to_pretrained_base(devices8):
+    """The RLHF entry: adapters around an existing (pretrained) base."""
+    base = tiny_gpt2()
+    base_params = base.init(jax.random.PRNGKey(7))
+    wrapped = wrap_lora(base, rank=2)
+    params = attach_lora_params(wrapped, base_params,
+                                rng=jax.random.PRNGKey(8))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=wrapped, config=base_config(), model_parameters=params)
+    got = np.asarray(engine.state["params"]["base"]["wte"])
+    np.testing.assert_allclose(got, np.asarray(base_params["wte"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_lora_wraps_specless_model(devices8):
+    """A base Model with no logical_specs (pure DP) must still wrap and
+    train — adapter specs fall back to replicated P()."""
+    from dataclasses import replace
+    base = replace(tiny_gpt2(), logical_specs=None)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=wrap_lora(base, rank=2), config=base_config())
+    assert np.isfinite(_train(engine, steps=1, seed=0)[0])
+
+
+def test_lora_rejects_offload(devices8):
+    with pytest.raises(NotImplementedError, match="trainable_mask"):
+        deepspeed_tpu.initialize(
+            model=wrap_lora(tiny_gpt2(), rank=2),
+            config=base_config(zero_optimization={
+                "offload_optimizer": {"device": "cpu"}}))
