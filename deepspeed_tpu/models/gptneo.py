@@ -1,0 +1,153 @@
+"""GPT-Neo decoder (reference container:
+module_inject/containers/gptneo.py:1): GPT-2 family layout (learned
+positions, pre-LN blocks, tied head) with two Neo-specific twists —
+alternating GLOBAL / LOCAL (sliding-window, 256) attention layers, and
+UNSCALED attention scores (no 1/sqrt(hd); the HF implementation
+compensates in init, not in the kernel).
+
+TPU design: blocks run under one ``lax.scan`` carrying the layer index;
+each layer's window rides a closed-over [L] constant indexed by the
+traced counter, so global and local layers share ONE compiled block —
+the banded mask degenerates to plain causal when window==0.  The
+windowed path uses the exact einsum attention (a Pallas block-skipping
+path exists in ops/sparse_attention for long-S serving).
+"""
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models import gpt2 as _g
+
+
+@dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 2048
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    layer_norm_eps: float = 1e-5
+    #: per-layer attention kind, "global" or "local" (HF attention_types
+    #: expanded); defaults to the GPT-Neo alternating pattern
+    attention_layers: Tuple[str, ...] = ()
+    window_size: int = 256
+    activation: str = "gelu"        # tanh approx (HF gelu_new)
+    mlp_dim: int = 0
+    dtype: str = "float32"
+    remat: bool = False
+    remat_policy: str = "nothing"
+    attention_impl: str = "auto"
+
+    @property
+    def d_mlp(self) -> int:
+        return self.mlp_dim or 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @property
+    def layer_kinds(self) -> Tuple[str, ...]:
+        if self.attention_layers:
+            assert len(self.attention_layers) == self.num_layers
+            return self.attention_layers
+        return tuple("global" if i % 2 == 0 else "local"
+                     for i in range(self.num_layers))
+
+
+def _gpt2_cfg(config: GPTNeoConfig) -> _g.GPT2Config:
+    """Internal view for the shared GPT-2-family helpers (same param
+    layout, LN and MLP maths)."""
+    return _g.GPT2Config(
+        vocab_size=config.vocab_size, max_seq_len=config.max_seq_len,
+        num_layers=config.num_layers, num_heads=config.num_heads,
+        d_model=config.d_model, layer_norm_eps=config.layer_norm_eps,
+        activation=config.activation, mlp_dim=config.mlp_dim,
+        dtype=config.dtype, attention_impl=config.attention_impl)
+
+
+def _banded_attention(q, k, v, window):
+    """Causal attention with UNSCALED scores and an optional sliding
+    window (``window`` is a traced scalar; 0 = full causal)."""
+    B, S, H, hd = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    i = lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    j = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    mask = j <= i
+    mask &= (window == 0) | (i - j < window)
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def forward(params: dict, batch: dict, config: GPTNeoConfig, rng=None):
+    tokens = batch["input_ids"]
+    B, S = tokens.shape
+    g2 = _gpt2_cfg(config)
+    dtype = jnp.dtype(config.dtype)
+    x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
+    windows = jnp.asarray(
+        [0 if kind == "global" else config.window_size
+         for kind in config.layer_kinds], jnp.int32)
+
+    def block(x, layer, idx):
+        from deepspeed_tpu.models.model import maybe_stream
+        layer = maybe_stream(layer)
+        q, kk, v = _g._block_qkv(x, layer, g2)
+        attn = _banded_attention(q, kk, v, windows[idx])
+        attn = attn.reshape(B, S, config.d_model)
+        attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
+        return _g._block_finish(x, attn, layer, g2)
+
+    if config.remat:
+        block = jax.checkpoint(block,
+                               policy=_g.remat_policy(config.remat_policy))
+
+    def body(carry, layer):
+        h, idx = carry
+        return (block(h, layer, idx), idx + 1), None
+
+    (x, _), _ = lax.scan(body, (x, jnp.int32(0)), params["blocks"])
+    x = _g._layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                       config.layer_norm_eps)
+    return x @ params["wte"].astype(dtype).T       # tied head
+
+
+def count_params(config: GPTNeoConfig) -> int:
+    D, V, L, M, S = (config.d_model, config.vocab_size, config.num_layers,
+                     config.d_mlp, config.max_seq_len)
+    per_layer = 4 * D + 3 * D * D + 3 * D + D * D + D + D * M + M + M * D + D
+    return V * D + S * D + L * per_layer + 2 * D
+
+
+def gptneo_model(size: str = "tiny", **overrides) -> Model:
+    sizes = {
+        "tiny": dict(vocab_size=256, max_seq_len=64, num_layers=2,
+                     num_heads=4, d_model=32, window_size=16),
+        "125m": dict(vocab_size=50257, max_seq_len=2048, num_layers=12,
+                     num_heads=12, d_model=768),
+        "1.3b": dict(vocab_size=50257, max_seq_len=2048, num_layers=24,
+                     num_heads=16, d_model=2048),
+        "2.7b": dict(vocab_size=50257, max_seq_len=2048, num_layers=32,
+                     num_heads=20, d_model=2560),
+    }
+    cfg_kwargs = dict(sizes[size]) if size in sizes else {}
+    cfg_kwargs.update(overrides)
+    config = GPTNeoConfig(**cfg_kwargs)
+    g2 = _gpt2_cfg(config)
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(_g.init_params, g2),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        logical_specs=_g.logical_specs(g2),
+        flops_per_token=6.0 * n_params,
+        meta={"name": f"gptneo-{size}", "n_params": n_params,
+              "sparse_grad_params": {"wte": "input_ids"}},
+    )

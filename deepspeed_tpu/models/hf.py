@@ -492,6 +492,175 @@ def neox_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
     return model, params
 
 
+def gptj_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF GPTJForCausalLM (or its state_dict) -> (Model, params)
+    (reference container: module_inject/containers/gptj.py:1).
+
+    GPT-J maps onto the native NeoX block: parallel residual, partial
+    rotary (``rotary_dim`` of each head) with the rotate-every-two
+    pairing (``rotary_interleaved``), a SINGLE shared block LayerNorm
+    (converted as ln2 := ln1), bias-free attention projections (zeros),
+    and a biased untied lm_head (``head_bias``)."""
+    from deepspeed_tpu.models.neox import neox_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"transformer.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("transformer.h."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None and ("num_heads" not in overrides
+                           or "rotary_pct" not in overrides):
+        raise ValueError(
+            "gptj_from_hf: bare state_dict carries no config — pass the "
+            "transformers model or num_heads= and rotary_pct= "
+            "(rotary_dim/head_dim) overrides")
+    D = g("wte.weight").shape[1]
+    cfg = dict(vocab_size=g("wte.weight").shape[0],
+               num_layers=n_layers, d_model=D,
+               use_parallel_residual=True, rotary_interleaved=True,
+               head_bias=True)
+    if hf_cfg is not None:
+        H = int(hf_cfg.n_head)
+        hd = D // H
+        cfg["num_heads"] = H
+        cfg["rotary_pct"] = float(getattr(hf_cfg, "rotary_dim", hd) or hd) / hd
+        cfg["max_seq_len"] = int(getattr(hf_cfg, "n_positions", 2048))
+        cfg["layer_norm_eps"] = float(getattr(hf_cfg, "layer_norm_epsilon",
+                                              1e-5))
+        act = str(getattr(hf_cfg, "activation_function", "gelu_new"))
+        approx = {"gelu": False, "gelu_new": True, "gelu_fast": True,
+                  "gelu_pytorch_tanh": True}
+        if act not in approx:
+            raise NotImplementedError(
+                f"gptj_from_hf: activation_function={act!r} is not "
+                "representable")
+        cfg["gelu_approximate"] = approx[act]
+    cfg.update(overrides)
+    model = neox_model("custom", **cfg)
+    H = cfg["num_heads"]
+    hd = D // H
+
+    def lay(i, k):
+        return _to_np(sd[f"transformer.h.{i}.{k}"])
+
+    def stack(fmt, transpose=False):
+        return np.stack([lay(i, fmt).T if transpose else lay(i, fmt)
+                         for i in range(n_layers)])
+
+    # head-major [q|k|v] packing per head (the NeoX fused-QKV layout):
+    # [L, D, H, hd] per projection, concatenated on the last axis
+    def hm(fmt):
+        return stack(fmt, True).reshape(n_layers, D, H, hd)
+
+    qkv_w = np.concatenate([hm("attn.q_proj.weight"),
+                            hm("attn.k_proj.weight"),
+                            hm("attn.v_proj.weight")],
+                           axis=-1).reshape(n_layers, D, 3 * D)
+    ln_w = stack("ln_1.weight")
+    ln_b = stack("ln_1.bias")
+    params = {
+        "wte": g("wte.weight"),
+        "blocks": {
+            # GPT-J's one shared LayerNorm feeds both branches
+            "ln1_scale": ln_w, "ln1_bias": ln_b,
+            "ln2_scale": ln_w.copy(), "ln2_bias": ln_b.copy(),
+            "qkv_w": qkv_w,
+            "qkv_b": np.zeros((n_layers, 3 * D), np.float32),
+            "dense_w": stack("attn.out_proj.weight", True),
+            "dense_b": np.zeros((n_layers, D), np.float32),
+            "mlp_in_w": stack("mlp.fc_in.weight", True),
+            "mlp_in_b": stack("mlp.fc_in.bias"),
+            "mlp_out_w": stack("mlp.fc_out.weight", True),
+            "mlp_out_b": stack("mlp.fc_out.bias"),
+        },
+        "lnf_scale": g("ln_f.weight"), "lnf_bias": g("ln_f.bias"),
+        "embed_out": _to_np(sd["lm_head.weight"]).T,
+        "embed_out_b": _to_np(sd["lm_head.bias"]),
+    }
+    return model, params
+
+
+def gptneo_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF GPTNeoForCausalLM (or its state_dict) -> (Model, params)
+    (reference container: module_inject/containers/gptneo.py:1).
+
+    GPT-2 layout with bias-free separate q/k/v projections (zero-filled
+    into the fused qkv bias), alternating global/local attention expanded
+    from ``attention_types``, and unscaled scores — all carried by the
+    native gptneo model."""
+    from deepspeed_tpu.models.gptneo import gptneo_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"transformer.{k}"])
+    n_layers = 1 + max(int(k.split(".")[2]) for k in sd
+                       if k.startswith("transformer.h."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is None and "num_heads" not in overrides:
+        raise ValueError(
+            "gptneo_from_hf: bare state_dict carries no config — pass the "
+            "transformers model or a num_heads= override (and "
+            "attention_layers= if not the alternating default)")
+    D = g("wte.weight").shape[1]
+    wpe = g("wpe.weight")
+    cfg = dict(vocab_size=g("wte.weight").shape[0],
+               max_seq_len=wpe.shape[0], num_layers=n_layers, d_model=D)
+    if hf_cfg is not None:
+        cfg["num_heads"] = int(hf_cfg.num_heads)
+        cfg["window_size"] = int(getattr(hf_cfg, "window_size", 256))
+        cfg["attention_layers"] = tuple(hf_cfg.attention_layers)
+        cfg["layer_norm_eps"] = float(getattr(hf_cfg, "layer_norm_epsilon",
+                                              1e-5))
+        act = str(getattr(hf_cfg, "activation_function", "gelu_new"))
+        act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu",
+                   "gelu_pytorch_tanh": "gelu"}
+        if act not in act_map:
+            raise NotImplementedError(
+                f"gptneo_from_hf: activation_function={act!r} is not "
+                "representable")
+        cfg["activation"] = act_map[act]
+        inter = getattr(hf_cfg, "intermediate_size", None)
+        if inter:
+            cfg["mlp_dim"] = int(inter)
+    cfg.update(overrides)
+    model = gptneo_model("custom", **cfg)
+    if "lm_head.weight" in sd and not np.allclose(
+            _to_np(sd["lm_head.weight"]), g("wte.weight")):
+        raise ValueError(
+            "gptneo_from_hf: checkpoint has an UNTIED lm_head; the native "
+            "gpt2-family block ties the head to the embedding")
+
+    def stack(fmt, transpose=False):
+        return np.stack([_to_np(sd[f"transformer.h.{i}.{fmt}"]).T
+                         if transpose else
+                         _to_np(sd[f"transformer.h.{i}.{fmt}"])
+                         for i in range(n_layers)])
+
+    qkv_w = np.concatenate([stack("attn.attention.q_proj.weight", True),
+                            stack("attn.attention.k_proj.weight", True),
+                            stack("attn.attention.v_proj.weight", True)],
+                           axis=-1)
+    params = {
+        "wte": g("wte.weight"),
+        "wpe": wpe,
+        "blocks": {
+            "ln1_scale": stack("ln_1.weight"),
+            "ln1_bias": stack("ln_1.bias"),
+            "qkv_w": qkv_w,
+            "qkv_b": np.zeros((n_layers, 3 * D), np.float32),
+            "proj_w": stack("attn.attention.out_proj.weight", True),
+            "proj_b": stack("attn.attention.out_proj.bias"),
+            "ln2_scale": stack("ln_2.weight"),
+            "ln2_bias": stack("ln_2.bias"),
+            "mlp_in_w": stack("mlp.c_fc.weight", True),
+            "mlp_in_b": stack("mlp.c_fc.bias"),
+            "mlp_out_w": stack("mlp.c_proj.weight", True),
+            "mlp_out_b": stack("mlp.c_proj.bias"),
+        },
+        "lnf_scale": g("ln_f.weight"), "lnf_bias": g("ln_f.bias"),
+    }
+    return model, params
+
+
 def bloom_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
     """HF BloomForCausalLM (or its state_dict) -> (Model, params).
 

@@ -135,9 +135,13 @@ def _rms_norm(x, scale, eps):
     return (x32 * lax.rsqrt(var + eps) * scale).astype(x.dtype)
 
 
-def rope(x, theta: float, positions=None):
-    """Rotary embeddings on [B, S, H, hd] (split-half convention).
-    ``positions``: [S] (shared across batch) or [B, S] (per-row, decode)."""
+def rope(x, theta: float, positions=None, interleaved: bool = False):
+    """Rotary embeddings on [B, S, H, hd].  ``interleaved=False`` pairs
+    dim i with i+hd/2 (llama/NeoX split-half convention);
+    ``interleaved=True`` pairs dims (2i, 2i+1) (the GPT-J rotate_every_two
+    convention — same frequencies, different lane pairing, so converted
+    checkpoints must match their family's layout).  ``positions``: [S]
+    (shared across batch) or [B, S] (per-row, decode)."""
     B, S, H, hd = x.shape
     if positions is None:
         positions = jnp.arange(S)
@@ -150,8 +154,15 @@ def rope(x, theta: float, positions=None):
         angles = positions[:, :, None] * freqs[None, None, :]   # [B, S, hd/2]
         cos = jnp.cos(angles)[:, :, None, :]
         sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    xf = x.astype(jnp.float32)
+    if interleaved:
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        r1, r2 = x1 * cos - x2 * sin, x1 * sin + x2 * cos
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    else:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                              axis=-1)
     return out.astype(x.dtype)
 
 

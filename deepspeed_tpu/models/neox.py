@@ -36,6 +36,11 @@ class NeoXConfig:
     #: HF GPT-NeoX default hidden_act="gelu" is the EXACT erf GELU;
     #: gelu_new/gelu_fast variants map to the tanh approximation
     gelu_approximate: bool = False
+    #: GPT-J variants (module_inject/containers/gptj.py capability): the
+    #: rotate-every-two rotary pairing and the biased untied lm_head.
+    #: GPT-J's single shared block LayerNorm converts as ln2 := ln1.
+    rotary_interleaved: bool = False
+    head_bias: bool = False
     dtype: str = "float32"
     remat: bool = False
     remat_policy: str = "nothing"
@@ -86,10 +91,14 @@ def init_params(config: NeoXConfig, rng) -> dict:
         },
         "lnf_scale": jnp.ones((D,)), "lnf_bias": jnp.zeros((D,)),
         "embed_out": norm(next(k), (D, V)) * std,
+        **({"embed_out_b": jnp.zeros((V,))} if config.head_bias else {}),
     }
 
 
 def logical_specs(config: NeoXConfig) -> dict:
+    head = {"embed_out": P(None, "model")}
+    if config.head_bias:
+        head["embed_out_b"] = P("model")
     return {
         "wte": P("model", None),
         "blocks": {
@@ -101,7 +110,7 @@ def logical_specs(config: NeoXConfig) -> dict:
             "mlp_out_w": P(None, "model", None), "mlp_out_b": P(),
         },
         "lnf_scale": P(), "lnf_bias": P(),
-        "embed_out": P(None, "model"),
+        **head,
     }
 
 
@@ -115,9 +124,10 @@ def _ln(x, scale, bias, eps):
 def _partial_rope(x, config: NeoXConfig, positions=None):
     """Rotate the first ``rotary_ndims`` of each head, pass the rest."""
     rot = config.rotary_ndims
+    il = config.rotary_interleaved
     if rot >= x.shape[-1]:
-        return rope(x, config.rope_theta, positions)
-    xr = rope(x[..., :rot], config.rope_theta, positions)
+        return rope(x, config.rope_theta, positions, interleaved=il)
+    xr = rope(x[..., :rot], config.rope_theta, positions, interleaved=il)
     return jnp.concatenate([xr, x[..., rot:]], axis=-1)
 
 
@@ -176,14 +186,18 @@ def forward(params, batch, config: NeoXConfig, rng=None):
                     config.num_layers)
     x = _ln(x, params["lnf_scale"], params["lnf_bias"],
             config.layer_norm_eps)
-    return x @ params["embed_out"].astype(dtype)
+    logits = x @ params["embed_out"].astype(dtype)
+    if config.head_bias:
+        logits = logits + params["embed_out_b"].astype(dtype)
+    return logits
 
 
 def count_params(config: NeoXConfig) -> int:
     D, V, L, M = (config.d_model, config.vocab_size, config.num_layers,
                   config.d_mlp)
     per_layer = 4 * D + 3 * D * D + 3 * D + D * D + D + D * M + M + M * D + D
-    return V * D + L * per_layer + 2 * D + D * V
+    return (V * D + L * per_layer + 2 * D + D * V
+            + (V if config.head_bias else 0))
 
 
 def _serving_fns(config: NeoXConfig):
@@ -204,7 +218,11 @@ def _serving_fns(config: NeoXConfig):
     def head_fn(params, x):
         x = _ln(x, params["lnf_scale"], params["lnf_bias"],
                 config.layer_norm_eps)
-        return x @ params["embed_out"].astype(jnp.dtype(config.dtype))
+        logits = x @ params["embed_out"].astype(jnp.dtype(config.dtype))
+        if config.head_bias:
+            logits = logits + params["embed_out_b"].astype(
+                jnp.dtype(config.dtype))
+        return logits
 
     def init_cache_fn(bs, max_len, dtype=None):
         return serving.init_cache(config.num_layers, config.num_heads,

@@ -171,6 +171,48 @@ def test_opt_from_hf_bare_sd_activation_override_logits_match():
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
 
 
+def test_gptj_from_hf_logits_match():
+    """GPT-J (reference containers/gptj.py): rotate-every-two partial
+    rotary, shared block LN, bias-free attention, biased untied head."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+    from deepspeed_tpu.models.hf import gptj_from_hf
+    torch.manual_seed(15)
+    hf = GPTJForCausalLM(GPTJConfig(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4, activation_function="gelu_new", resid_pdrop=0.0,
+        embd_pdrop=0.0, attn_pdrop=0.0)).eval()
+    model, params = gptj_from_hf(hf, dtype="float32", attention_impl="xla")
+    ids = np.random.default_rng(15).integers(0, 128, (2, 16)).astype(
+        np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_gptneo_from_hf_logits_match():
+    """GPT-Neo (reference containers/gptneo.py): alternating global/local
+    attention with unscaled scores; seq > window so the sliding mask is
+    load-bearing in the comparison."""
+    from transformers import GPTNeoConfig as HFNeoConfig
+    from transformers import GPTNeoForCausalLM
+    from deepspeed_tpu.models.hf import gptneo_from_hf
+    torch.manual_seed(16)
+    hf = GPTNeoForCausalLM(HFNeoConfig(
+        vocab_size=128, max_position_embeddings=32, hidden_size=32,
+        num_layers=4, attention_types=[[["global", "local"], 2]],
+        num_heads=4, window_size=8, activation_function="gelu_new",
+        resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0,
+        classifier_dropout=0.0)).eval()
+    model, params = gptneo_from_hf(hf, dtype="float32")
+    ids = np.random.default_rng(16).integers(0, 128, (2, 24)).astype(
+        np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
 def test_neox_from_hf_logits_match():
     from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
     from deepspeed_tpu.models.hf import neox_from_hf

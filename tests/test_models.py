@@ -93,8 +93,13 @@ def test_neox_and_bloom_native_models_train(devices8):
     from deepspeed_tpu.models import neox_model, bloom_model
     from tests.util import base_config
     rng = np.random.default_rng(0)
+    from deepspeed_tpu.models.gptneo import gptneo_model
     for factory in (lambda: neox_model("tiny", attention_impl="xla"),
-                    lambda: bloom_model("tiny")):
+                    lambda: bloom_model("tiny"),
+                    lambda: gptneo_model("tiny"),
+                    lambda: neox_model("tiny", attention_impl="xla",
+                                       rotary_interleaved=True,
+                                       head_bias=True)):   # gpt-j form
         from deepspeed_tpu.comm import reset_topology
         reset_topology()
         engine, *_ = deepspeed_tpu.initialize(
