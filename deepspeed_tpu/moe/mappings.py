@@ -1,0 +1,55 @@
+"""MoE × tensor-parallel token mappings (reference:
+deepspeed/moe/mappings.py:28-101 — ``gather_tokens``/``drop_tokens``
+all-gather activations across the TP group before expert routing and
+re-slice after, so MoE composes with Megatron-style tensor parallelism).
+
+TPU-native formulation: under SPMD the pair collapses to sharding
+annotations.  ``gather_tokens`` constrains the dimension to be UNSHARDED
+over the ``model`` axis (XLA inserts the all-gather) and ``drop_tokens``
+constrains it to be sharded over ``model`` (XLA inserts the slice); the
+autodiff transposes reproduce the reference's custom autograd pair
+(_GatherTokens.backward = drop, _DropTokens.backward = gather) for free.
+The in-tree MoE layer itself needs neither — its token dim is laid out
+over the data/seq axes (moe/layer.py ``tok``), replicated across TP, so
+routing, capacity, and the aux loss are TP-consistent by construction;
+these entry points serve clients whose upstream activations arrive
+TP-sharded (Megatron sequence-parallel blocks).
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import get_topology, MODEL_AXIS
+
+
+def _tp_size() -> int:
+    try:
+        return get_topology().mesh.shape[MODEL_AXIS]
+    except Exception:
+        return 1
+
+
+def gather_tokens(x, dim: int = 0):
+    """All-gather ``dim`` across the tensor-model axis (reference
+    mappings.py:95 early-outs the same way when tp==1)."""
+    if _tp_size() == 1:
+        return x
+    mesh = get_topology().mesh
+    spec = [None] * x.ndim
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+
+
+def drop_tokens(x, dim: int = 0):
+    """Shard ``dim`` across the tensor-model axis — each TP rank keeps its
+    1/tp slice (reference mappings.py:47 ``_drop_tokens``)."""
+    if _tp_size() == 1:
+        return x
+    mesh = get_topology().mesh
+    if x.shape[dim] % mesh.shape[MODEL_AXIS]:
+        raise ValueError(
+            f"drop_tokens: dim {dim} ({x.shape[dim]}) is not divisible by "
+            f"tensor parallel world size ({mesh.shape[MODEL_AXIS]})")
+    spec = [None] * x.ndim
+    spec[dim] = MODEL_AXIS
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))

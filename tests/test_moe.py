@@ -123,6 +123,64 @@ def test_mixtral_ep_matches_no_ep(devices8):
     np.testing.assert_allclose(losses[0], losses[1], rtol=2e-4, atol=2e-5)
 
 
+def test_mixtral_ep_tp_matches_dp(devices8):
+    """EP × TP composition (reference moe/mappings.py:28-101 +
+    tests/unit/moe/test_moe_tp.py): experts over the expert axis AND
+    weights column/row-split over the model axis must reproduce the
+    pure-DP math."""
+    cfgs = [{}, {"expert_parallel_size": 2, "model_parallel_size": 2,
+                 "data_parallel_size": 4}]
+    losses = []
+    for mesh in cfgs:
+        m = mixtral_model("tiny", attention_impl="xla", dtype="float32",
+                          capacity_factor=4.0)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=m, config=base_config(mesh=mesh) if mesh
+            else base_config())
+        shape = dict(engine.mesh.shape)
+        if mesh:
+            assert shape["expert"] == 2 and shape["model"] == 2
+        rng = np.random.default_rng(7)
+        ls = []
+        for i in range(2):
+            batch = {"input_ids": rng.integers(0, 256, size=(1, 8, 16),
+                                               dtype=np.int32)}
+            ls.append(float(engine.train_batch(batch=batch)))
+        losses.append(ls)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=2e-4, atol=2e-5)
+
+
+def test_token_mappings_gather_drop(devices8):
+    """gather_tokens/drop_tokens (reference moe/mappings.py): the SPMD
+    sharding-annotation pair round-trips values and produces the
+    model-axis layouts the reference's collectives produce."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.comm import reset_topology
+    from deepspeed_tpu.comm.mesh import MeshTopology, set_topology
+    from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+    reset_topology()
+    topo = MeshTopology(model_parallel_size=2, data_parallel_size=4)
+    set_topology(topo)
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+
+    @jax.jit
+    def f(x):
+        dropped = drop_tokens(x, dim=0)
+        return gather_tokens(dropped, dim=0), dropped
+
+    with topo.mesh:
+        full, dropped = f(x)
+    np.testing.assert_array_equal(np.asarray(full), x)
+    # dropped really lives model-sharded on dim 0
+    spec = dropped.sharding.spec
+    assert spec[0] == "model", spec
+    with pytest.raises(ValueError, match="not divisible"):
+        with topo.mesh:
+            jax.jit(lambda t: drop_tokens(t, 0))(x[:3])
+    reset_topology()
+
+
 # ------------------------------------------------------------- MoE serving
 
 def _serving_mixtral(**over):
