@@ -245,7 +245,11 @@ def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
     return f(q, k, v)
 
 
-def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
+def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
+         interpret=None):
+    # interpret=None leaves the pallas default (and any test monkeypatch)
+    # in force; True forces interpret mode (ring path off-TPU)
+    _ikw = {} if interpret is None else {"interpret": interpret}
     B, S, H, hd = q.shape
     KV = k.shape[2]
     if H % KV:
@@ -261,7 +265,7 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
         _fwd_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
         seq_len=S)
     oT, lse = pl.pallas_call(
-        kernel, grid=(B, H, S // bq),
+        kernel, grid=(B, H, S // bq), **_ikw,
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, hd),
@@ -285,17 +289,30 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
 
 def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
+    doT, oT = _to_bhsd(do), _to_bhsd(o)
+    delta = jnp.sum(doT.astype(jnp.float32) * oT.astype(jnp.float32),
+                    axis=-1)                              # [B, H, S]
+    return _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal,
+                      sm_scale, block_q, block_k)
+
+
+def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
+               block_q, block_k, interpret=None, keep_fp32=False):
+    """The two backward pallas calls, driven by EXPLICIT lse/delta — the
+    ring-attention composition feeds the GLOBAL logsumexp and delta here
+    so each K/V chunk's contribution is the exact global-softmax term.
+    ``keep_fp32`` returns dq/dk/dv unrounded (fp32) so a caller that sums
+    chunk contributions (the ring) accumulates exactly and casts once."""
+    _ikw = {} if interpret is None else {"interpret": interpret}
     B, S, H, hd = q.shape
     KV = k.shape[2]
     rep = H // KV
     sm = sm_scale if sm_scale is not None else hd ** -0.5
     bq, bk = _choose_blocks(S, block_q, block_k)
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    doT, oT = _to_bhsd(do), _to_bhsd(o)
+    doT = _to_bhsd(do)
     seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
            else jnp.zeros((B, S), jnp.int32))
-    delta = jnp.sum(doT.astype(jnp.float32) * oT.astype(jnp.float32),
-                    axis=-1)                              # [B, H, S]
 
     full = pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0))
     full_s = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
@@ -307,7 +324,7 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
         _dkv_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
         seq_len=S, rep=rep)
     dkT, dvT = pl.pallas_call(
-        dkv_kernel, grid=(B, S // bk, H),
+        dkv_kernel, grid=(B, S // bk, H), **_ikw,
         in_specs=[
             pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, bk, hd),
@@ -332,7 +349,7 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
         _dq_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
         seq_len=S)
     dqT = pl.pallas_call(
-        dq_kernel, grid=(B, H, S // bq),
+        dq_kernel, grid=(B, H, S // bq), **_ikw,
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, hd),
@@ -346,11 +363,39 @@ def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
             seg_full,
         ],
         out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (B, H, S, hd), jnp.float32 if keep_fp32 else q.dtype),
     )(qT, kT, vT, doT, lse, delta, seg, seg)
 
     dq = jnp.transpose(dqT, (0, 2, 1, 3))
-    dk = jnp.transpose(dkT, (0, 2, 1, 3)).astype(k.dtype)
-    dv = jnp.transpose(dvT, (0, 2, 1, 3)).astype(v.dtype)
+    dk = jnp.transpose(dkT, (0, 2, 1, 3))
+    dv = jnp.transpose(dvT, (0, 2, 1, 3))
+    if not keep_fp32:
+        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
     return dq, dk, dv
+
+
+# -------------------------------------------------------- ring composition
+# Chunk-level entry points for blockwise context parallelism
+# (sequence/ring_attention.py): the ring merges per-chunk (o, lse) pairs
+# online in the forward and replays each chunk's backward against the
+# GLOBAL lse/delta — exactly the flash decomposition, spread over the
+# seq-axis ring instead of the in-kernel key loop.
+
+def chunk_fwd(q, k, v, causal, sm_scale=None, block_q=512, block_k=512,
+              interpret=None):
+    """One K/V chunk's attention: -> (o [B,S,H,hd], lse [B,H,S]).
+    Not differentiable on its own — the ring owns the VJP."""
+    o, (_, _, _, _, lse) = _fwd(q, k, v, None, causal, sm_scale, block_q,
+                                block_k, interpret=interpret)
+    return o, lse
+
+
+def chunk_bwd(q, k, v, do, lse, delta, causal, sm_scale=None, block_q=512,
+              block_k=512, interpret=None):
+    """One K/V chunk's gradient contributions given the GLOBAL softmax
+    stats: -> (dq, dk, dv), all fp32 — the ring sums sp of these, so
+    per-chunk rounding would defeat its fp32 travel accumulators."""
+    return _bwd_calls(q, k, v, do, lse, delta, None, causal, sm_scale,
+                      block_q, block_k, interpret=interpret, keep_fp32=True)
 

@@ -46,10 +46,138 @@ def _block_attn_update(q, k, v, q_pos, k_pos, m, l, o, scale, causal):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, causal: bool = True, sm_scale=None):
+def _ring_flash(sp, scale, causal, interpret):
+    """Per-shard ring attention whose chunk products run the from-scratch
+    flash kernel (ops/pallas/ds_flash_attention chunk_fwd/chunk_bwd) —
+    long-context CP with kernel economics (round-3 VERDICT item 8;
+    reference analogue: the Ulysses+FlashAttention pairing,
+    blogs/deepspeed-ulysses/README.md:70-72).
+
+    Forward: each ring step classifies the resident K/V block at BLOCK
+    granularity — past (full attention), diagonal (causal kernel), future
+    (skip) — and merges the chunk's (o, lse) into the running online
+    softmax.  Backward: a second ring pass feeds the GLOBAL lse/delta to
+    the chunk backward kernels; dK/dV accumulators travel the ring with
+    their blocks and arrive home after the full cycle."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import (chunk_bwd,
+                                                             chunk_fwd)
+    kw = dict(sm_scale=scale, interpret=interpret)
+
+    def merge(o_acc, lse_acc, o_i, lse_i):
+        lse_new = jnp.logaddexp(lse_acc, lse_i)           # [b,h,sq]
+        safe = jnp.where(lse_new <= NEG_INF / 2, 0.0, lse_new)
+        w_old = jnp.where(lse_acc <= NEG_INF / 2, 0.0,
+                          jnp.exp(lse_acc - safe))
+        w_new = jnp.where(lse_i <= NEG_INF / 2, 0.0,
+                          jnp.exp(lse_i - safe))
+        o_acc = (o_acc * w_old.transpose(0, 2, 1)[..., None]
+                 + o_i.astype(jnp.float32)
+                 * w_new.transpose(0, 2, 1)[..., None])
+        return o_acc, lse_new
+
+    def branch_idx(src, my):
+        if not causal:
+            return jnp.int32(0)
+        return jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+
+    @jax.custom_vjp
+    def rf(ql, kl, vl):
+        o, _ = rf_fwd(ql, kl, vl)
+        return o
+
+    def rf_fwd(ql, kl, vl):
+        my = lax.axis_index(SEQ_AXIS)
+        b, sq, h, hd = ql.shape
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        branches = [
+            lambda kb, vb: chunk_fwd(ql, kb, vb, causal=False, **kw),
+            lambda kb, vb: chunk_fwd(ql, kb, vb, causal=True, **kw),
+            lambda kb, vb: (jnp.zeros_like(ql),
+                            jnp.full((b, h, sq), NEG_INF, jnp.float32)),
+        ]
+
+        def step(carry, i):
+            k_blk, v_blk, o_acc, lse_acc = carry
+            src = (my - i) % sp
+            o_i, lse_i = lax.switch(branch_idx(src, my), branches,
+                                    k_blk, v_blk)
+            o_acc, lse_acc = merge(o_acc, lse_acc, o_i, lse_i)
+            k_blk = lax.ppermute(k_blk, SEQ_AXIS, perm)
+            v_blk = lax.ppermute(v_blk, SEQ_AXIS, perm)
+            return (k_blk, v_blk, o_acc, lse_acc), None
+
+        o0 = jnp.zeros((b, sq, h, hd), jnp.float32)
+        lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+        (_, _, o, lse), _ = lax.scan(step, (kl, vl, o0, lse0),
+                                     jnp.arange(sp))
+        out = o.astype(ql.dtype)
+        return out, (ql, kl, vl, out, lse)
+
+    def rf_bwd(res, do):
+        ql, kl, vl, o, lse = res
+        my = lax.axis_index(SEQ_AXIS)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1).transpose(0, 2, 1)       # [b,h,sq]
+        zeros3 = lambda kb, vb: (jnp.zeros_like(ql, jnp.float32),
+                                 jnp.zeros_like(kb, jnp.float32),
+                                 jnp.zeros_like(vb, jnp.float32))
+        branches = [
+            lambda kb, vb: chunk_bwd(ql, kb, vb, do, lse, delta,
+                                     causal=False, **kw),
+            lambda kb, vb: chunk_bwd(ql, kb, vb, do, lse, delta,
+                                     causal=True, **kw),
+            zeros3,
+        ]
+
+        def step(carry, i):
+            k_blk, v_blk, dk_blk, dv_blk, dq = carry
+            src = (my - i) % sp
+            dq_i, dk_i, dv_i = lax.switch(branch_idx(src, my), branches,
+                                          k_blk, v_blk)
+            dq = dq + dq_i.astype(jnp.float32)
+            dk_blk = dk_blk + dk_i.astype(jnp.float32)
+            dv_blk = dv_blk + dv_i.astype(jnp.float32)
+            k_blk = lax.ppermute(k_blk, SEQ_AXIS, perm)
+            v_blk = lax.ppermute(v_blk, SEQ_AXIS, perm)
+            dk_blk = lax.ppermute(dk_blk, SEQ_AXIS, perm)
+            dv_blk = lax.ppermute(dv_blk, SEQ_AXIS, perm)
+            return (k_blk, v_blk, dk_blk, dv_blk, dq), None
+
+        dq0 = jnp.zeros_like(ql, jnp.float32)
+        (_, _, dk, dv, dq), _ = lax.scan(
+            step, (kl, vl, jnp.zeros_like(kl, jnp.float32),
+                   jnp.zeros_like(vl, jnp.float32), dq0),
+            jnp.arange(sp))
+        return (dq.astype(ql.dtype), dk.astype(kl.dtype),
+                dv.astype(vl.dtype))
+
+    rf.defvjp(rf_fwd, rf_bwd)
+    return rf
+
+
+def _flash_chunks_ok(s_local, hd, itemsize, heads_match) -> bool:
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import (_choose_blocks,
+                                                             vmem_fits)
+    if not heads_match:
+        return False
+    try:
+        _choose_blocks(s_local, 512, 512)
+    except ValueError:
+        return False
+    return vmem_fits(s_local, hd, itemsize)
+
+
+def ring_attention(q, k, v, causal: bool = True, sm_scale=None,
+                   impl: str = "auto"):
     """q/k/v: [B, S, H, hd] with S sharded over the ``seq`` mesh axis.
     Returns [B, S, H, hd] with the same sharding.  Falls back to a single
-    dense block when the seq axis has size 1."""
+    dense block when the seq axis has size 1.
+
+    ``impl``: "auto" routes each per-chunk product through the
+    from-scratch flash kernel when the local chunk decomposes into kernel
+    blocks and fits the VMEM budget (interpret mode off-TPU); "dense"
+    keeps the einsum online-softmax path; "flash" forces the kernel."""
     topo = get_topology()
     mesh = topo.mesh
     sp = mesh.shape[SEQ_AXIS]
@@ -58,6 +186,26 @@ def ring_attention(q, k, v, causal: bool = True, sm_scale=None):
     dp = tuple(topo.data_parallel_axes)
     spec = P(dp, SEQ_AXIS, MODEL_AXIS, None)
     s_local = S // sp
+
+    use_flash = impl == "flash" or (
+        impl == "auto" and _flash_chunks_ok(
+            s_local, hd, jnp.dtype(q.dtype).itemsize,
+            k.shape[2] == q.shape[2]))
+    if use_flash:
+        if sp == 1:
+            # degenerate ring: one block — the kernel IS the computation
+            from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+                ds_flash_attention
+            if impl == "flash":
+                return ds_flash_attention(q, k, v, causal=causal,
+                                          sm_scale=sm_scale)
+        else:
+            interpret = jax.devices()[0].platform != "tpu"
+            rf = _ring_flash(sp, scale, causal, interpret)
+            inner_flash = shard_map(rf, mesh=mesh,
+                                    in_specs=(spec, spec, spec),
+                                    out_specs=spec, check_vma=False)
+            return inner_flash(q, k, v)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
