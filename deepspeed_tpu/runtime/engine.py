@@ -882,10 +882,31 @@ class DeepSpeedEngine:
         if self._offload or self._offload_param:
             return None                      # warned at init (both tiers)
         if self.model.meta.get("pipeline"):
-            logger.warning(
-                "zero_quantized_gradients/sparse_gradients do not apply to "
-                "the pipeline train step; reducing dense in full precision")
-            return None
+            # scanned/chunked GPipe is plain auto-SPMD over the pipe axis,
+            # which stays AUTO inside the tier's partially-manual shard_map
+            # (manual = data/hpz only) — the compositions coexist.  The
+            # 1F1B interleave's custom VJP does not re-enter the tier's
+            # value_and_grad structure; that restriction is load-bearing
+            # (asserted in tests/test_zeropp.py).
+            pipe_cfg = self._config._param_dict.get("pipeline", {}) or {}
+            sched = str(pipe_cfg.get("schedule", "") or "").lower()
+            n_stages = int(self.model.meta.get("num_stages", 1))
+            gas = self.gradient_accumulation_steps()
+            if sched == "1f1b" and n_stages > 1 and gas >= n_stages:
+                logger.warning(
+                    "zero_quantized_gradients/sparse/1-bit exchanges do not "
+                    "compose with the 1f1b pipeline schedule (its manual "
+                    "fwd/bwd interleave bypasses the exchange tier); "
+                    "reducing dense in full precision — use the chunked "
+                    "GPipe schedule for a quantized wire under PP")
+                return None
+            if onebit_kind:
+                logger.warning(
+                    "1-bit optimizers do not engage their compressed "
+                    "exchange under pipeline schedules; exchanging dense")
+                onebit_kind = None
+                if not qgz and not sparse_leaves:
+                    return None
         mesh = self.mesh
         manual = tuple(a for a in (DATA_AXIS, HPZ_AXIS)
                        if mesh.shape[a] > 1)
@@ -1066,6 +1087,19 @@ class DeepSpeedEngine:
         onebit = plan["onebit"]
         mesh_shape = dict(mesh.shape)
         treedef = plan["treedef"]
+        # pipeline composition (GPipe / chunked GPipe only — the plan
+        # builder rejects 1f1b): the pipelined loss consumes the WHOLE
+        # microbatch stack at once (microbatches fill the pipeline), so
+        # the per-micro accumulation scan collapses to one call per chunk
+        pipeline = bool(self.model.meta.get("pipeline"))
+        pipe_chunks = 1
+        if pipeline:
+            pipe_cfg = self._config._param_dict.get("pipeline", {}) or {}
+            n_buffers = int(pipe_cfg.get("num_pipe_buffers", 0) or 0)
+            n_stages = int(self.model.meta.get("num_stages", 1))
+            if (0 < n_buffers < gas and gas % n_buffers == 0
+                    and n_buffers >= n_stages):
+                pipe_chunks = gas // n_buffers
         dp_axes = tuple(self.topology.data_parallel_axes)
         batch_dp = tuple(a for a in dp_axes if a in manual)
         batch_entries = (None, batch_dp if len(batch_dp) > 1
@@ -1116,8 +1150,30 @@ class DeepSpeedEngine:
 
                 zeros = jax.tree.map(
                     lambda x: jnp.zeros(x.shape, jnp.float32), p)
-                (local_g, local_l), _ = jax.lax.scan(
-                    micro, (zeros, jnp.float32(0.0)), b)
+                if pipeline and pipe_chunks == 1:
+                    # whole stack through the pipeline in one pass (the
+                    # pipelined loss averages microbatches internally)
+                    local_l, local_g = jax.value_and_grad(loss_fn)(
+                        p, b, r, s / n_manual)
+                    local_g = _tree_cast(local_g, jnp.float32)
+                elif pipeline:
+                    chunks = jax.tree.map(
+                        lambda x: x.reshape(pipe_chunks, gas // pipe_chunks,
+                                            *x.shape[1:]), b)
+
+                    def chunk_body(carry, cb):
+                        g_acc, l_acc = carry
+                        l, g = jax.value_and_grad(loss_fn)(
+                            p, cb, r, s / (pipe_chunks * n_manual))
+                        g = _tree_cast(g, jnp.float32)
+                        return (jax.tree.map(jnp.add, g_acc, g),
+                                l_acc + l), None
+
+                    (local_g, local_l), _ = jax.lax.scan(
+                        chunk_body, (zeros, jnp.float32(0.0)), chunks)
+                else:
+                    (local_g, local_l), _ = jax.lax.scan(
+                        micro, (zeros, jnp.float32(0.0)), b)
 
                 g_leaves = jax.tree.leaves(local_g)
                 err_leaves = (jax.tree.leaves(err) if err is not None
@@ -1365,6 +1421,32 @@ class DeepSpeedEngine:
             logger.warning(
                 f"pipeline.num_pipe_buffers={n_buffers} does not divide "
                 f"gradient_accumulation_steps={gas}; running all-live")
+
+        # quantized/sparse exchange tier under GPipe (round-3 VERDICT
+        # item 4): the tier's shard_map keeps the pipe axis auto, so the
+        # scanned pipeline composes with the int8 gradient wire
+        qgz_fn = self._qgz_grad_fn()
+        if qgz_fn is not None:
+            plan = self._get_qgz_plan()
+            wrapped_any = (plan["block_scope"] is not None
+                           or any(w is not None
+                                  for w in plan["nonblock_wrap"]))
+            use_compress = (self._compression_plans is not None
+                            and not wrapped_any)
+
+            def qgz_train_step(state, stacked_batch, rng):
+                params = state["params"]
+                scale = (state["scaler"].cur_scale if fp16
+                         else jnp.float32(1.0))
+                cs = state["step"] if use_compress else None
+                loss_sum, grads = qgz_fn(params, stacked_batch, rng, scale,
+                                         cs)
+                grads = policy.constrain_grads(grads, grad_specs)
+                new_state, metrics = self._apply_grads(state, grads)
+                metrics["loss"] = loss_sum / scale
+                return new_state, metrics
+
+            return qgz_train_step
 
         def loss_of_chunk(params, chunk_batch, rng, scale, cs=None):
             cparams = _tree_cast(params, self.compute_dtype)

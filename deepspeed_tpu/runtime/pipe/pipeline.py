@@ -30,18 +30,29 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.comm.mesh import get_topology, PIPE_AXIS
 
 
+def _pipe_sharding():
+    """Pipe-axis sharding against the CURRENT trace context's mesh — when
+    the pipeline runs inside the quantized-exchange tier's partially-
+    manual shard_map (engine._qgz_grad_fn), the constraint must carry
+    that context's axis types (data/hpz Manual, pipe Auto), not the
+    all-auto concrete mesh."""
+    cur = jax.sharding.get_abstract_mesh()
+    if cur is not None and not cur.empty:
+        return NamedSharding(cur, P(PIPE_AXIS))
+    return NamedSharding(get_topology().mesh, P(PIPE_AXIS))
+
+
 def stage_params_view(blocks_params, n_stages: int):
     """[L, ...] stacked layer params -> [n_stages, L/S, ...], stage dim
     constrained to the pipe axis."""
-    mesh = get_topology().mesh
+    spec = _pipe_sharding()
 
     def reshape(p):
         L = p.shape[0]
         assert L % n_stages == 0, (
             f"num_layers {L} must divide evenly into {n_stages} stages")
         v = p.reshape(n_stages, L // n_stages, *p.shape[1:])
-        return lax.with_sharding_constraint(
-            v, NamedSharding(mesh, P(PIPE_AXIS)))
+        return lax.with_sharding_constraint(v, spec)
 
     return jax.tree.map(reshape, blocks_params)
 
@@ -79,8 +90,7 @@ def pipeline_blocks(block_fn: Callable, blocks_params, x_micro, n_stages: int):
         f"need >= {n_stages} microbatches to fill the pipeline, got {n_micro} "
         f"(set gradient_accumulation_steps >= pipe_parallel_size)")
     staged = stage_params_view(blocks_params, n_stages)
-    mesh = get_topology().mesh
-    state_spec = NamedSharding(mesh, P(PIPE_AXIS))
+    state_spec = _pipe_sharding()
     vstages = jax.vmap(make_stage_apply(block_fn))
 
     state = jnp.zeros((n_stages,) + x_micro.shape[1:], x_micro.dtype)
@@ -145,8 +155,7 @@ def pipeline_1f1b_loss_and_grad(block_fn, embed_fn, head_loss_fn, params,
     full params tree (blocks grads summed over microbatches, non-block
     grads = embed + head contributions).
     """
-    mesh = get_topology().mesh
-    state_spec = NamedSharding(mesh, P(PIPE_AXIS))
+    state_spec = _pipe_sharding()
     bk = blocks_key
     M = jax.tree.leaves(stacked_batch)[0].shape[0]
     S = n_stages

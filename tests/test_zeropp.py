@@ -366,3 +366,84 @@ def test_qgz_with_qwz_combined(devices8):
     l_ref = _train(ref, steps=4, seed=71)
     l_zpp = _train(zpp, steps=4, seed=71)
     np.testing.assert_allclose(l_zpp, l_ref, rtol=0.08, atol=0.08)
+
+
+# ------------------------------------------------- qgZ × pipeline (r3 item 4)
+
+def _pipe_cfg(gas, qgz, **extra_pipe):
+    cfg = base_config(
+        train_micro_batch_size_per_gpu=1, gradient_accumulation_steps=gas,
+        zero_optimization={"stage": 1,
+                           **({"zero_quantized_gradients": True}
+                              if qgz else {})},
+        mesh={"pipe_parallel_size": 2, "data_parallel_size": 4})
+    if extra_pipe:
+        cfg["pipeline"] = extra_pipe
+    return cfg
+
+
+def _pipe_train(engine, gas, steps, seed):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        batch = {"input_ids": rng.integers(0, 128, size=(gas, 4, 16),
+                                           dtype=np.int32)}
+        out.append(float(engine.train_batch(batch=batch)))
+    return out
+
+
+def test_qgz_under_pipeline_gpipe(devices8):
+    """round-3 VERDICT item 4: the quantized gradient exchange composes
+    with the scanned-GPipe pipeline (the tier's shard_map keeps the pipe
+    axis auto); parity with the dense pipeline run + int8 on the wire."""
+    from deepspeed_tpu.runtime.pipe.pipeline import pipeline_model
+    gas = 4
+    ref, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2),
+        config=_pipe_cfg(gas, qgz=False))
+    qgz, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2),
+        config=_pipe_cfg(gas, qgz=True))
+    assert qgz._get_qgz_plan() is not None, "qgZ did not engage under PP"
+    l_ref = _pipe_train(ref, gas, steps=3, seed=81)
+    l_qgz = _pipe_train(qgz, gas, steps=3, seed=81)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+    batch = qgz._shard_batch(
+        {"input_ids": np.zeros((gas, 4, 16), np.int32)}, stacked=True)
+    fn = qgz._get_compiled("train_step")
+    with qgz._train_scope():
+        hlo = fn.lower(qgz.state, batch,
+                       qgz._next_rng()).compile().as_text()
+    comm = [l for l in hlo.splitlines()
+            if "all-to-all" in l or "all-gather" in l]
+    assert any("s8[" in l for l in comm), comm[:5]
+
+
+def test_qgz_under_pipeline_chunked(devices8):
+    """Chunked GPipe (num_pipe_buffers) + qgZ: the tier scans pipeline
+    chunks and still tracks the dense run."""
+    from deepspeed_tpu.runtime.pipe.pipeline import pipeline_model
+    gas = 4
+    ref, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2),
+        config=_pipe_cfg(gas, qgz=False, num_pipe_buffers=2))
+    qgz, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2),
+        config=_pipe_cfg(gas, qgz=True, num_pipe_buffers=2))
+    assert qgz._get_qgz_plan() is not None
+    l_ref = _pipe_train(ref, gas, steps=3, seed=83)
+    l_qgz = _pipe_train(qgz, gas, steps=3, seed=83)
+    np.testing.assert_allclose(l_qgz, l_ref, rtol=0.05, atol=0.05)
+
+
+def test_qgz_1f1b_restriction_is_loadbearing(devices8):
+    """1F1B's manual interleave bypasses the exchange tier: the plan must
+    refuse (warn-and-degrade) and training must still run dense — the
+    documented restriction, asserted (round-3 VERDICT item 4)."""
+    from deepspeed_tpu.runtime.pipe.pipeline import pipeline_model
+    gas = 4
+    engine, *_ = deepspeed_tpu.initialize(
+        model=pipeline_model(tiny_gpt2(), num_stages=2),
+        config=_pipe_cfg(gas, qgz=True, schedule="1f1b"))
+    assert engine._get_qgz_plan() is None
+    assert np.isfinite(_pipe_train(engine, gas, steps=1, seed=85)[0])
