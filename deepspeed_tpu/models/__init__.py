@@ -9,4 +9,5 @@ from deepspeed_tpu.models.hf import (gpt2_from_hf, llama_from_hf,
                                      bert_from_hf, mixtral_from_hf,
                                      opt_from_hf, neox_from_hf,
                                      bloom_from_hf, gptj_from_hf,
-                                     gptneo_from_hf)
+                                     gptneo_from_hf, distilbert_from_hf,
+                                     internlm_from_hf, megatron_gpt_from_sd)

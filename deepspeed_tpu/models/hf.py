@@ -209,6 +209,10 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         cfg["rms_norm_eps"] = float(getattr(hf_cfg, "rms_norm_eps", 1e-5))
         cfg["max_seq_len"] = int(getattr(hf_cfg, "max_position_embeddings",
                                          4096))
+    # biased attention projections (InternLM / attention_bias=True
+    # checkpoints — reference container module_inject/containers/internlm.py)
+    attn_bias = "model.layers.0.self_attn.q_proj.bias" in sd
+    cfg["attn_bias"] = attn_bias
     cfg.update(overrides)
     model = llama_model("custom", **cfg)
 
@@ -218,19 +222,28 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
     def stack(fmt):
         return np.stack([g(fmt.format(i)) for i in range(n_layers)])
 
+    blocks = {
+        "attn_norm": stack("layers.{}.input_layernorm.weight"),
+        "wq": stack_t("layers.{}.self_attn.q_proj.weight"),
+        "wk": stack_t("layers.{}.self_attn.k_proj.weight"),
+        "wv": stack_t("layers.{}.self_attn.v_proj.weight"),
+        "wo": stack_t("layers.{}.self_attn.o_proj.weight"),
+        "mlp_norm": stack("layers.{}.post_attention_layernorm.weight"),
+        "w_gate": stack_t("layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack_t("layers.{}.mlp.up_proj.weight"),
+        "w_down": stack_t("layers.{}.mlp.down_proj.weight"),
+    }
+    if attn_bias:
+        blocks["wq_b"] = stack("layers.{}.self_attn.q_proj.bias")
+        blocks["wk_b"] = stack("layers.{}.self_attn.k_proj.bias")
+        blocks["wv_b"] = stack("layers.{}.self_attn.v_proj.bias")
+        blocks["wo_b"] = (
+            stack("layers.{}.self_attn.o_proj.bias")
+            if "model.layers.0.self_attn.o_proj.bias" in sd
+            else np.zeros((n_layers, D), np.float32))
     params = {
         "wte": g("embed_tokens.weight"),
-        "blocks": {
-            "attn_norm": stack("layers.{}.input_layernorm.weight"),
-            "wq": stack_t("layers.{}.self_attn.q_proj.weight"),
-            "wk": stack_t("layers.{}.self_attn.k_proj.weight"),
-            "wv": stack_t("layers.{}.self_attn.v_proj.weight"),
-            "wo": stack_t("layers.{}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("layers.{}.post_attention_layernorm.weight"),
-            "w_gate": stack_t("layers.{}.mlp.gate_proj.weight"),
-            "w_up": stack_t("layers.{}.mlp.up_proj.weight"),
-            "w_down": stack_t("layers.{}.mlp.down_proj.weight"),
-        },
+        "blocks": blocks,
         "final_norm": g("norm.weight"),
         # tied-embedding checkpoints (safetensors drops the shared tensor)
         # reuse the embedding matrix as the head
@@ -238,6 +251,14 @@ def llama_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         if "lm_head.weight" in sd else g("embed_tokens.weight").T,
     }
     return model, params
+
+
+def internlm_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """InternLM (reference container: module_inject/containers/internlm.py:1)
+    is the llama block with biased q/k/v/o projections and the same
+    ``model.layers.*`` checkpoint naming — ``llama_from_hf`` detects and
+    loads the biases, so this entry point is the documented alias."""
+    return llama_from_hf(model_or_sd, **overrides)
 
 
 def mixtral_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
@@ -488,6 +509,175 @@ def neox_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
         "lnf_scale": g("final_layer_norm.weight"),
         "lnf_bias": g("final_layer_norm.bias"),
         "embed_out": _to_np(sd["embed_out.weight"]).T,
+    }
+    return model, params
+
+
+def megatron_gpt_from_sd(state_dict, num_heads: int,
+                         **overrides) -> Tuple[Any, dict]:
+    """Megatron-LM GPT state dict -> (Model, params) (reference container:
+    module_inject/containers/megatron_gpt.py:1 + policy megatron_v2).
+
+    Classic Megatron GPT is the pre-LN GPT-2 block with learned positions
+    and a tied head; the one wire difference from HF GPT-2 is the fused
+    ``attention.query_key_value`` packing: torch-Linear rows ordered
+    HEAD-MAJOR ``[H, 3, hd]`` (each head's q,k,v contiguous) where the
+    native gpt2 layout is thirds ``[q_all | k_all | v_all]`` — the
+    converter de-interleaves.  Keys are accepted with or without the
+    ``model./language_model.`` prefixes and with ``transformer.`` or
+    ``encoder.`` as the layer container (old/new Megatron-LM)."""
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+
+    sd = {}
+    for key, val in _state_dict(state_dict).items():
+        for pre in ("model.", "language_model."):
+            if key.startswith(pre):
+                key = key[len(pre):]
+        key = key.replace("encoder.", "transformer.", 1) \
+            if key.startswith("encoder.") else key
+        sd[key] = val
+    g = lambda k: _to_np(sd[k])
+    n_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd
+        if k.startswith("transformer.layers."))
+    wte = g("embedding.word_embeddings.weight")
+    wpe = g("embedding.position_embeddings.weight")
+    V, D = wte.shape
+    H = int(num_heads)
+    hd = D // H
+    ffn = _to_np(
+        sd["transformer.layers.0.mlp.dense_h_to_4h.weight"]).shape[0]
+    cfg = dict(vocab_size=V, max_seq_len=wpe.shape[0], num_layers=n_layers,
+               d_model=D, num_heads=H, activation="gelu", mlp_dim=ffn)
+    cfg.update(overrides)
+    model = gpt2_model("custom", **cfg)
+
+    def lay(i, k):
+        return _to_np(sd[f"transformer.layers.{i}.{k}"])
+
+    def stack(fmt, transpose=False):
+        return np.stack([lay(i, fmt).T if transpose else lay(i, fmt)
+                         for i in range(n_layers)])
+
+    # head-major [H, 3, hd] rows -> native thirds [q|k|v] columns
+    def deinterleave_w(fmt):
+        return np.stack([
+            lay(i, fmt).reshape(H, 3, hd, D)
+            .transpose(3, 1, 0, 2).reshape(D, 3 * D)
+            for i in range(n_layers)])
+
+    def deinterleave_b(fmt):
+        return np.stack([
+            lay(i, fmt).reshape(H, 3, hd)
+            .transpose(1, 0, 2).reshape(3 * D)
+            for i in range(n_layers)])
+
+    params = {
+        "wte": wte,
+        "wpe": wpe,
+        "blocks": {
+            "ln1_scale": stack("input_layernorm.weight"),
+            "ln1_bias": stack("input_layernorm.bias"),
+            "qkv_w": deinterleave_w("attention.query_key_value.weight"),
+            "qkv_b": deinterleave_b("attention.query_key_value.bias"),
+            "proj_w": stack("attention.dense.weight", True),
+            "proj_b": stack("attention.dense.bias"),
+            "ln2_scale": stack("post_attention_layernorm.weight"),
+            "ln2_bias": stack("post_attention_layernorm.bias"),
+            "mlp_in_w": stack("mlp.dense_h_to_4h.weight", True),
+            "mlp_in_b": stack("mlp.dense_h_to_4h.bias"),
+            "mlp_out_w": stack("mlp.dense_4h_to_h.weight", True),
+            "mlp_out_b": stack("mlp.dense_4h_to_h.bias"),
+        },
+        "lnf_scale": g("transformer.final_layernorm.weight"),
+        "lnf_bias": g("transformer.final_layernorm.bias"),
+    }
+    return model, params
+
+
+def distilbert_from_hf(model_or_sd, **overrides) -> Tuple[Any, dict]:
+    """HF DistilBertForMaskedLM (or its state_dict) -> (Model, params)
+    (reference container: module_inject/containers/distil_bert.py:1).
+
+    DistilBERT is the BERT post-LN block without token-type embeddings:
+    the native bert model carries it with ``type_vocab_size=1`` and a
+    zero type row (the no-token_type_ids path adds row 0).  The MLM head
+    (vocab_transform -> gelu -> vocab_layer_norm -> tied projector +
+    bias) matches the native head shape exactly."""
+    from deepspeed_tpu.models.bert import bert_model
+
+    sd = _state_dict(model_or_sd)
+    g = lambda k: _to_np(sd[f"distilbert.{k}"])
+    n_layers = 1 + max(int(k.split(".")[3]) for k in sd
+                       if k.startswith("distilbert.transformer.layer."))
+    hf_cfg = getattr(model_or_sd, "config", None)
+    if hf_cfg is not None and getattr(hf_cfg, "sinusoidal_pos_embds",
+                                      False):
+        raise NotImplementedError(
+            "distilbert_from_hf: sinusoidal_pos_embds is not representable "
+            "(native model stores learned positions)")
+    D = g("embeddings.word_embeddings.weight").shape[1]
+    V = g("embeddings.word_embeddings.weight").shape[0]
+    M = _to_np(sd["distilbert.transformer.layer.0.ffn.lin1.weight"]).shape[0]
+    if M != 4 * D:
+        raise NotImplementedError(
+            f"distilbert_from_hf: hidden_dim {M} != 4*dim {4 * D} is not "
+            "representable (native bert block fixes d_mlp = 4*d_model)")
+    cfg = dict(
+        vocab_size=V,
+        max_seq_len=g("embeddings.position_embeddings.weight").shape[0],
+        type_vocab_size=1, num_layers=n_layers, d_model=D,
+        num_heads=(int(hf_cfg.n_heads) if hf_cfg is not None
+                   else max(1, D // 64)),
+        gelu_approximate=(
+            str(getattr(hf_cfg, "activation", "gelu"))
+            in ("gelu_new", "gelu_pytorch_tanh", "gelu_fast")
+            if hf_cfg is not None else False))
+    cfg.update(overrides)
+    model = bert_model("custom", **cfg)
+    if not np.allclose(_to_np(sd["vocab_projector.weight"]),
+                       g("embeddings.word_embeddings.weight")):
+        raise ValueError(
+            "distilbert_from_hf: checkpoint has an UNTIED vocab_projector; "
+            "the native MLM head ties the decoder to the embedding")
+
+    def lay(i, k):
+        return _to_np(sd[f"distilbert.transformer.layer.{i}.{k}"])
+
+    def stack(fmt, transpose=False):
+        return np.stack([lay(i, fmt).T if transpose else lay(i, fmt)
+                         for i in range(n_layers)])
+
+    qkv_w = np.concatenate([stack("attention.q_lin.weight", True),
+                            stack("attention.k_lin.weight", True),
+                            stack("attention.v_lin.weight", True)], axis=-1)
+    qkv_b = np.concatenate([stack("attention.q_lin.bias"),
+                            stack("attention.k_lin.bias"),
+                            stack("attention.v_lin.bias")], axis=-1)
+    params = {
+        "wte": g("embeddings.word_embeddings.weight"),
+        "wpe": g("embeddings.position_embeddings.weight"),
+        "wtype": np.zeros((1, D), np.float32),
+        "emb_ln_scale": g("embeddings.LayerNorm.weight"),
+        "emb_ln_bias": g("embeddings.LayerNorm.bias"),
+        "blocks": {
+            "qkv_w": qkv_w, "qkv_b": qkv_b,
+            "proj_w": stack("attention.out_lin.weight", True),
+            "proj_b": stack("attention.out_lin.bias"),
+            "ln1_scale": stack("sa_layer_norm.weight"),
+            "ln1_bias": stack("sa_layer_norm.bias"),
+            "mlp_in_w": stack("ffn.lin1.weight", True),
+            "mlp_in_b": stack("ffn.lin1.bias"),
+            "mlp_out_w": stack("ffn.lin2.weight", True),
+            "mlp_out_b": stack("ffn.lin2.bias"),
+            "ln2_scale": stack("output_layer_norm.weight"),
+            "ln2_bias": stack("output_layer_norm.bias"),
+        },
+        "mlm_dense_w": _to_np(sd["vocab_transform.weight"]).T,
+        "mlm_dense_b": _to_np(sd["vocab_transform.bias"]),
+        "mlm_ln_scale": _to_np(sd["vocab_layer_norm.weight"]),
+        "mlm_ln_bias": _to_np(sd["vocab_layer_norm.bias"]),
+        "mlm_bias": _to_np(sd["vocab_projector.bias"]),
     }
     return model, params
 

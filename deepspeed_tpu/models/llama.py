@@ -30,6 +30,9 @@ class LlamaConfig:
     d_mlp: int = 11008
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
+    #: InternLM variant (module_inject/containers/internlm.py capability):
+    #: biased q/k/v/o projections on the otherwise-llama block
+    attn_bias: bool = False
     dtype: str = "bfloat16"
     remat: bool = False
     remat_policy: str = "nothing"
@@ -60,19 +63,25 @@ def init_params(config: LlamaConfig, rng) -> dict:
     std = 0.02
     res_std = std / (2 * L) ** 0.5
     norm = partial(jax.random.normal, dtype=jnp.float32)
+    blocks = {
+        "attn_norm": jnp.ones((L, D)),
+        "wq": norm(next(k), (L, D, H * hd)) * std,
+        "wk": norm(next(k), (L, D, KV * hd)) * std,
+        "wv": norm(next(k), (L, D, KV * hd)) * std,
+        "wo": norm(next(k), (L, H * hd, D)) * res_std,
+        "mlp_norm": jnp.ones((L, D)),
+        "w_gate": norm(next(k), (L, D, M)) * std,
+        "w_up": norm(next(k), (L, D, M)) * std,
+        "w_down": norm(next(k), (L, M, D)) * res_std,
+    }
+    if config.attn_bias:
+        blocks.update({"wq_b": jnp.zeros((L, H * hd)),
+                       "wk_b": jnp.zeros((L, KV * hd)),
+                       "wv_b": jnp.zeros((L, KV * hd)),
+                       "wo_b": jnp.zeros((L, D))})
     return {
         "wte": norm(next(k), (V, D)) * std,
-        "blocks": {
-            "attn_norm": jnp.ones((L, D)),
-            "wq": norm(next(k), (L, D, H * hd)) * std,
-            "wk": norm(next(k), (L, D, KV * hd)) * std,
-            "wv": norm(next(k), (L, D, KV * hd)) * std,
-            "wo": norm(next(k), (L, H * hd, D)) * res_std,
-            "mlp_norm": jnp.ones((L, D)),
-            "w_gate": norm(next(k), (L, D, M)) * std,
-            "w_up": norm(next(k), (L, D, M)) * std,
-            "w_down": norm(next(k), (L, M, D)) * res_std,
-        },
+        "blocks": blocks,
         "final_norm": jnp.ones((D,)),
         "lm_head": norm(next(k), (D, V)) * std,
     }
@@ -92,38 +101,48 @@ def numpy_init_params(config: LlamaConfig, seed: int = 0) -> dict:
     def norm(shape, scale):
         return rng.standard_normal(shape, dtype=np.float32) * scale
 
+    blocks = {
+        "attn_norm": np.ones((L, D), np.float32),
+        "wq": norm((L, D, H * hd), std),
+        "wk": norm((L, D, KV * hd), std),
+        "wv": norm((L, D, KV * hd), std),
+        "wo": norm((L, H * hd, D), res_std),
+        "mlp_norm": np.ones((L, D), np.float32),
+        "w_gate": norm((L, D, M), std),
+        "w_up": norm((L, D, M), std),
+        "w_down": norm((L, M, D), res_std),
+    }
+    if config.attn_bias:
+        blocks.update({"wq_b": np.zeros((L, H * hd), np.float32),
+                       "wk_b": np.zeros((L, KV * hd), np.float32),
+                       "wv_b": np.zeros((L, KV * hd), np.float32),
+                       "wo_b": np.zeros((L, D), np.float32)})
     return {
         "wte": norm((V, D), std),
-        "blocks": {
-            "attn_norm": np.ones((L, D), np.float32),
-            "wq": norm((L, D, H * hd), std),
-            "wk": norm((L, D, KV * hd), std),
-            "wv": norm((L, D, KV * hd), std),
-            "wo": norm((L, H * hd, D), res_std),
-            "mlp_norm": np.ones((L, D), np.float32),
-            "w_gate": norm((L, D, M), std),
-            "w_up": norm((L, D, M), std),
-            "w_down": norm((L, M, D), res_std),
-        },
+        "blocks": blocks,
         "final_norm": np.ones((D,), np.float32),
         "lm_head": norm((D, V), std),
     }
 
 
 def logical_specs(config: LlamaConfig) -> dict:
+    blocks = {
+        "attn_norm": P(),
+        "wq": P(None, None, "model"),
+        "wk": P(None, None, "model"),
+        "wv": P(None, None, "model"),
+        "wo": P(None, "model", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, None, "model"),
+        "w_up": P(None, None, "model"),
+        "w_down": P(None, "model", None),
+    }
+    if config.attn_bias:
+        blocks.update({"wq_b": P(None, "model"), "wk_b": P(None, "model"),
+                       "wv_b": P(None, "model"), "wo_b": P()})
     return {
         "wte": P("model", None),
-        "blocks": {
-            "attn_norm": P(),
-            "wq": P(None, None, "model"),
-            "wk": P(None, None, "model"),
-            "wv": P(None, None, "model"),
-            "wo": P(None, "model", None),
-            "mlp_norm": P(),
-            "w_gate": P(None, None, "model"),
-            "w_up": P(None, None, "model"),
-            "w_down": P(None, "model", None),
-        },
+        "blocks": blocks,
         "final_norm": P(),
         "lm_head": P(None, "model"),
     }
@@ -173,9 +192,16 @@ def _block_qkv(x, layer, config: LlamaConfig, positions=None):
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     dt = h.dtype
-    q = (h @ layer["wq"].astype(dt)).reshape(B, S, H, hd)
-    kk = (h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
+    q = h @ layer["wq"].astype(dt)
+    kk = h @ layer["wk"].astype(dt)
+    v = h @ layer["wv"].astype(dt)
+    if config.attn_bias:
+        q = q + layer["wq_b"].astype(dt)
+        kk = kk + layer["wk_b"].astype(dt)
+        v = v + layer["wv_b"].astype(dt)
+    q = q.reshape(B, S, H, hd)
+    kk = kk.reshape(B, S, KV, hd)
+    v = v.reshape(B, S, KV, hd)
     q = rope(q, config.rope_theta, positions)
     kk = rope(kk, config.rope_theta, positions)
     return q, kk, v
@@ -183,7 +209,10 @@ def _block_qkv(x, layer, config: LlamaConfig, positions=None):
 
 def _block_finish(x, attn, layer, config: LlamaConfig):
     dt = x.dtype
-    x = x + attn @ layer["wo"].astype(dt)
+    attn_out = attn @ layer["wo"].astype(dt)
+    if config.attn_bias:
+        attn_out = attn_out + layer["wo_b"].astype(dt)
+    x = x + attn_out
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
     gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
     x = x + gated @ layer["w_down"].astype(dt)
