@@ -118,20 +118,32 @@ def _alibi_attention(q, k, v, slopes):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, layer, config: BloomConfig, slopes, rng=None):
+def _block_qkv(x, layer, config: BloomConfig, positions=None):
+    """LN1 + fused QKV (head-major [q|k|v] packing); no positional
+    transform — ALiBi biases scores, not projections."""
     B, S, D = x.shape
     H, hd = config.num_heads, config.head_dim
     dt = x.dtype
     h = _ln(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
     qkv = h @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
-    q, kk, v = jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
-    attn = _alibi_attention(q, kk, v, slopes)
-    x = x + (attn.reshape(B, S, D) @ layer["dense_w"].astype(dt)
+    return jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
+
+
+def _block_finish(x, attn_flat, layer, config: BloomConfig):
+    dt = x.dtype
+    x = x + (attn_flat @ layer["dense_w"].astype(dt)
              + layer["dense_b"].astype(dt))
     h = _ln(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
     m = jax.nn.gelu(h @ layer["mlp_in_w"].astype(dt)
                     + layer["mlp_in_b"].astype(dt), approximate=True)
     return x + m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
+
+
+def _block(x, layer, config: BloomConfig, slopes, rng=None):
+    B, S, D = x.shape
+    q, kk, v = _block_qkv(x, layer, config)
+    attn = _alibi_attention(q, kk, v, slopes)
+    return _block_finish(x, attn.reshape(B, S, D), layer, config)
 
 
 def forward(params, batch, config: BloomConfig, rng=None):
@@ -165,6 +177,54 @@ def count_params(config: BloomConfig) -> int:
     return V * D + 2 * D + L * per_layer + 2 * D
 
 
+def _serving_fns(config: BloomConfig):
+    """KV-cache serving through the shared scaffold (models/serving.py):
+    BLOOM contributes its fused-QKV projection, the post-LN finish, and
+    the ALiBi bias — biased causal attention at prefill, the decode
+    kernel's ``alibi_slopes`` form per token (reference capability:
+    containers/bloom.py + the ds_softmax_context ALiBi path)."""
+    from deepspeed_tpu.models import serving
+
+    slopes = jnp.asarray(alibi_slopes(config.num_heads), jnp.float32)
+    dt = jnp.dtype(config.dtype)
+
+    def embed_fn(params, tokens):
+        x = params["wte"].astype(dt)[tokens]
+        return _ln(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                   config.layer_norm_eps)
+
+    def qkv_fn(x, layer, positions):
+        return _block_qkv(x, layer, config, positions)
+
+    def finish_fn(x, attn_flat, layer):
+        return _block_finish(x, attn_flat, layer, config)
+
+    def head_fn(params, x):
+        x = _ln(x, params["lnf_scale"], params["lnf_bias"],
+                config.layer_norm_eps)
+        return x @ params["wte"].astype(dt).T
+
+    def init_cache_fn(bs, max_len, dtype=None):
+        return serving.init_cache(config.num_layers, config.num_heads,
+                                  config.head_dim, bs, max_len, dtype,
+                                  config.dtype)
+
+    def prefill_fn(p, b, c):
+        return serving.prefill(
+            p, b, c, embed_fn=embed_fn, qkv_fn=qkv_fn, finish_fn=finish_fn,
+            head_fn=head_fn, num_heads=config.num_heads,
+            num_kv_heads=config.num_heads, attention_impl="xla",
+            attn_fn=lambda q, k, v: _alibi_attention(q, k, v, slopes))
+
+    def decode_fn(p, t, c, l):
+        return serving.decode_step(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads, alibi_slopes=slopes)
+
+    return init_cache_fn, prefill_fn, decode_fn
+
+
 def bloom_model(size: str = "tiny", **overrides) -> Model:
     cfg_kwargs = dict(BLOOM_SIZES[size]) if size in BLOOM_SIZES else {}
     cfg_kwargs.update(overrides)
@@ -178,4 +238,6 @@ def bloom_model(size: str = "tiny", **overrides) -> Model:
         flops_per_token=6.0 * n_params,
         meta={"name": f"bloom-{size}", "n_params": n_params,
               "supports_random_ltd": True, "supports_pld": True},
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+                   _serving_fns(config))),
     )

@@ -37,21 +37,25 @@ def init_cache(num_layers, num_kv_heads, head_dim, batch_size, max_len,
 
 
 def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
-            num_heads, num_kv_heads, attention_impl):
+            num_heads, num_kv_heads, attention_impl, attn_fn=None):
     """Causal forward over right-padded prompts filling the compact cache.
-    Returns (logits [B, S, V], cache)."""
+    Returns (logits [B, S, V], cache).  ``attn_fn(q, k, v)`` overrides the
+    causal-attention dispatch (ALiBi models pass their biased form)."""
     from deepspeed_tpu.ops.attention import causal_attention
     tokens = batch["input_ids"]
     B, S = tokens.shape
     x = embed_fn(params, tokens)
     H, KV = num_heads, num_kv_heads
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: causal_attention(q, k, v,
+                                                   impl=attention_impl)
 
     def body(carry, layer):
         from deepspeed_tpu.models.model import maybe_stream
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = qkv_fn(carry, layer, None)
         hd = q.shape[-1]
-        attn = causal_attention(q, kk, v, impl=attention_impl)
+        attn = attn_fn(q, kk, v)
         out = finish_fn(carry, attn.reshape(B, S, H * hd), layer)
         return out, (kk, v)
 
@@ -71,10 +75,11 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
 
 
 def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
-                finish_fn, head_fn, num_heads):
+                finish_fn, head_fn, num_heads, alibi_slopes=None):
     """One decode step: tokens [B], lengths [B] current fill counts.
     Rotary positions are per-row; the GQA cache stays compact (KV heads) —
-    the decode kernel handles the query-group mapping."""
+    the decode kernel handles the query-group mapping.  ``alibi_slopes``
+    [H] selects the BLOOM additive-bias form in the decode kernel."""
     from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
     B = tokens.shape[0]
     H = num_heads
@@ -101,7 +106,8 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
             kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
             vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
         attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
-                                k_scale=ksc, v_scale=vsc)
+                                k_scale=ksc, v_scale=vsc,
+                                alibi_slopes=alibi_slopes)
         out = finish_fn(carry[:, None, :],
                         attn.reshape(B, 1, H * hd).astype(carry.dtype),
                         layer)[:, 0, :]

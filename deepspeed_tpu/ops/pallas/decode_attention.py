@@ -38,17 +38,24 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
-                   head_dim, rep, sm_scale, precision, quantized):
+                   head_dim, rep, sm_scale, precision, quantized, alibi):
     """Grid: (B, num_s_blocks); S is the minor (sequential) dimension so the
     online-softmax state in scratch carries across S-blocks of one row.
 
     ``quantized``: k/v blocks are int8 with per-(position, kv-head) fp32
     scales (two extra inputs) — the cache stream halves its HBM bytes and
-    dequantizes on the VPU in VMEM."""
+    dequantizes on the VPU in VMEM.  ``alibi``: one extra [rep, KV] fp32
+    input of group-major per-head slopes; scores get the BLOOM additive
+    bias ``slope * key_position`` before the online softmax."""
+    rest = list(rest)
+    ks_ref = vs_ref = sl_ref = None
     if quantized:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
-    else:
-        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    if alibi:
+        sl_ref = rest[0]
+        rest = rest[1:]
+    o_ref, m_ref, l_ref, acc_ref = rest
     s_idx = pl.program_id(1)
     n_s = pl.num_programs(1)
     cache_len = len_ref[pl.program_id(0)]
@@ -99,6 +106,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
             scores = jax.lax.dot(
                 k, w, preferred_element_type=jnp.float32,
                 precision=precision) * sm_scale
+            if alibi:
+                scores = scores + (sl_ref[r, :][None, :]
+                                   * pos.astype(jnp.float32))
             scores = jnp.where(valid, scores, NEG_INF)      # [bs, KV]
 
             m_prev = m_ref[r, :]                            # [KV]
@@ -176,10 +186,11 @@ def quantize_token_into_cache(kc, vc, ksc, vsc, rows, lengths, k_new, v_new):
 
 def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                             sm_scale=None, block_s: int = 512,
-                            k_scale=None, v_scale=None):
+                            k_scale=None, v_scale=None, alibi_slopes=None):
     """q: [B, H, hd]; k/v_cache: [B, S_max, KV, hd]; cache_len: [B] int32.
     int8 caches pass their per-vector fp32 ``k_scale``/``v_scale``
-    [B, S_max, KV].  Returns [B, H, hd]."""
+    [B, S_max, KV].  ``alibi_slopes`` [H] adds the BLOOM positional bias.
+    Returns [B, H, hd]."""
     B, H, hd = q.shape
     _, S_max, KV, _ = k_cache.shape
     rep = H // KV
@@ -218,7 +229,8 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                  else None)
     kernel = partial(_decode_kernel, block_s=block_s, kv_heads=KV,
                      head_dim=hd, rep=rep, sm_scale=sm_scale,
-                     precision=precision, quantized=quantized)
+                     precision=precision, quantized=quantized,
+                     alibi=alibi_slopes is not None)
     cache_spec = pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
                               memory_space=pltpu.VMEM)
     in_specs = [
@@ -237,6 +249,13 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                                   memory_space=pltpu.VMEM)
         in_specs += [scale_spec, scale_spec]
         args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+    if alibi_slopes is not None:
+        # group-major slope table matching the packed query layout
+        sl_rk = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            KV, rep).transpose(1, 0)
+        in_specs += [pl.BlockSpec((rep, KV), lambda b, s: (0, 0),
+                                  memory_space=pltpu.VMEM)]
+        args += [sl_rk]
     out = pl.pallas_call(
         kernel,
         grid=(B, S_max // block_s),
@@ -255,7 +274,7 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
 
 
 def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
-                         k_scale=None, v_scale=None):
+                         k_scale=None, v_scale=None, alibi_slopes=None):
     """Reference/fallback implementation (CPU meshes, numeric tests).
     Same signature as the Pallas kernel."""
     if k_scale is not None:
@@ -273,6 +292,9 @@ def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
     scores = jnp.einsum("bhd,bshd->bhs", q, k_cache,
                         precision=prec).astype(jnp.float32)
     scores = scores * sm_scale
+    if alibi_slopes is not None:
+        scores = scores + (jnp.asarray(alibi_slopes, jnp.float32)[None, :, None]
+                           * jnp.arange(S_max)[None, None, :])
     valid = jnp.arange(S_max)[None, None, :] < cache_len[:, None, None]
     scores = jnp.where(valid, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
@@ -280,14 +302,16 @@ def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, sm_scale=None,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None, alibi_slopes=None):
     """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.  int8
-    caches pass per-vector fp32 scales (see ``quantize_kv``)."""
+    caches pass per-vector fp32 scales (see ``quantize_kv``);
+    ``alibi_slopes`` [H] selects the BLOOM positional-bias form."""
     from deepspeed_tpu.ops.attention import _on_tpu
     if _on_tpu():
         return decode_attention_pallas(q, k_cache, v_cache, cache_len,
                                        sm_scale=sm_scale, k_scale=k_scale,
-                                       v_scale=v_scale)
+                                       v_scale=v_scale,
+                                       alibi_slopes=alibi_slopes)
     return decode_attention_xla(q, k_cache, v_cache, cache_len,
                                 sm_scale=sm_scale, k_scale=k_scale,
-                                v_scale=v_scale)
+                                v_scale=v_scale, alibi_slopes=alibi_slopes)

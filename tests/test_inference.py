@@ -138,6 +138,48 @@ def test_quantized_inference_composes_with_tp(devices8):
     assert agree >= 0.75, agree
 
 
+def test_gptj_form_cached_generate_matches_nocache(devices8):
+    """GPT-J form (NeoX scaffold with rotate-every-two rotary + biased
+    untied head): cached generation token-identical to the no-cache
+    oracle — the serving qkv/head paths carry both new flags."""
+    from deepspeed_tpu.models.neox import neox_model
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    import jax as _jax
+    m = neox_model("tiny", attention_impl="xla", dtype="float32",
+                   max_seq_len=128, rotary_interleaved=True,
+                   head_bias=True)
+    params = m.init(_jax.random.PRNGKey(3))
+    params["embed_out_b"] = params["embed_out_b"] + 0.3  # bias load-bearing
+    eng = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="float32"),
+                          model_parameters=params)
+    rng = np.random.default_rng(4)
+    prompts = rng.integers(1, 200, (2, 7)).astype(np.int32)
+    a = eng.generate(prompts, max_new_tokens=10, do_sample=False,
+                     use_cache=False)
+    b = eng.generate(prompts, max_new_tokens=10, do_sample=False,
+                     use_cache=True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_bloom_cached_generate_matches_nocache(devices8):
+    """BLOOM serving (ALiBi — no rotary; biased prefill attention + the
+    decode kernel's alibi_slopes form): cached generation token-identical
+    to the no-cache oracle."""
+    from deepspeed_tpu.models.bloom import bloom_model
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    m = bloom_model("tiny", dtype="float32", max_seq_len=128)
+    eng = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="float32"))
+    rng = np.random.default_rng(5)
+    prompts = rng.integers(1, 200, (3, 9)).astype(np.int32)
+    a = eng.generate(prompts, max_new_tokens=12, do_sample=False,
+                     use_cache=False)
+    b = eng.generate(prompts, max_new_tokens=12, do_sample=False,
+                     use_cache=True)
+    np.testing.assert_array_equal(a, b)
+
+
 def test_neox_cached_generate_matches_nocache(devices8):
     """GPT-NeoX serving via the shared scaffold (fused QKV + partial
     rotary with per-row decode positions + parallel residual): cached
