@@ -38,7 +38,8 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
-                   head_dim, rep, sm_scale, precision, quantized, alibi):
+                   head_dim, rep, sm_scale, precision, quantized, alibi,
+                   windowed):
     """Grid: (B, num_s_blocks); S is the minor (sequential) dimension so the
     online-softmax state in scratch carries across S-blocks of one row.
 
@@ -46,19 +47,26 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
     scales (two extra inputs) — the cache stream halves its HBM bytes and
     dequantizes on the VPU in VMEM.  ``alibi``: one extra [rep, KV] fp32
     input of group-major per-head slopes; scores get the BLOOM additive
-    bias ``slope * key_position`` before the online softmax."""
+    bias ``slope * key_position`` before the online softmax.
+    ``windowed``: one extra [B] int32 SMEM input of per-row window floors
+    — positions below it are masked (sliding-window / GPT-Neo local
+    attention)."""
     rest = list(rest)
-    ks_ref = vs_ref = sl_ref = None
+    ks_ref = vs_ref = sl_ref = min_ref = None
     if quantized:
         ks_ref, vs_ref = rest[0], rest[1]
         rest = rest[2:]
     if alibi:
         sl_ref = rest[0]
         rest = rest[1:]
+    if windowed:
+        min_ref = rest[0]
+        rest = rest[1:]
     o_ref, m_ref, l_ref, acc_ref = rest
     s_idx = pl.program_id(1)
     n_s = pl.num_programs(1)
     cache_len = len_ref[pl.program_id(0)]
+    min_pos = min_ref[pl.program_id(0)] if windowed else None
     Dk = kv_heads * head_dim
 
     @pl.when(s_idx == 0)
@@ -97,6 +105,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_s, kv_heads,
         pos = s_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_s, kv_heads), 0)     # [bs, KV]
         valid = pos < cache_len
+        if windowed:
+            valid &= pos >= min_pos
 
         for r in range(rep):
             # minor-dim insertion on bf16 vectors is unsupported by Mosaic;
@@ -186,7 +196,8 @@ def quantize_token_into_cache(kc, vc, ksc, vsc, rows, lengths, k_new, v_new):
 
 def decode_attention_pallas(q, k_cache, v_cache, cache_len,
                             sm_scale=None, block_s: int = 512,
-                            k_scale=None, v_scale=None, alibi_slopes=None):
+                            k_scale=None, v_scale=None, alibi_slopes=None,
+                            min_pos=None):
     """q: [B, H, hd]; k/v_cache: [B, S_max, KV, hd]; cache_len: [B] int32.
     int8 caches pass their per-vector fp32 ``k_scale``/``v_scale``
     [B, S_max, KV].  ``alibi_slopes`` [H] adds the BLOOM positional bias.
@@ -230,7 +241,8 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
     kernel = partial(_decode_kernel, block_s=block_s, kv_heads=KV,
                      head_dim=hd, rep=rep, sm_scale=sm_scale,
                      precision=precision, quantized=quantized,
-                     alibi=alibi_slopes is not None)
+                     alibi=alibi_slopes is not None,
+                     windowed=min_pos is not None)
     cache_spec = pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
                               memory_space=pltpu.VMEM)
     in_specs = [
@@ -256,6 +268,10 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
         in_specs += [pl.BlockSpec((rep, KV), lambda b, s: (0, 0),
                                   memory_space=pltpu.VMEM)]
         args += [sl_rk]
+    if min_pos is not None:
+        in_specs += [pl.BlockSpec((B,), lambda b, s: (0,),
+                                  memory_space=pltpu.SMEM)]
+        args += [min_pos.astype(jnp.int32)]
     out = pl.pallas_call(
         kernel,
         grid=(B, S_max // block_s),
@@ -274,7 +290,8 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
 
 
 def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
-                         k_scale=None, v_scale=None, alibi_slopes=None):
+                         k_scale=None, v_scale=None, alibi_slopes=None,
+                         min_pos=None):
     """Reference/fallback implementation (CPU meshes, numeric tests).
     Same signature as the Pallas kernel."""
     if k_scale is not None:
@@ -296,22 +313,29 @@ def decode_attention_xla(q, k_cache, v_cache, cache_len, sm_scale=None,
         scores = scores + (jnp.asarray(alibi_slopes, jnp.float32)[None, :, None]
                            * jnp.arange(S_max)[None, None, :])
     valid = jnp.arange(S_max)[None, None, :] < cache_len[:, None, None]
+    if min_pos is not None:
+        valid &= jnp.arange(S_max)[None, None, :] >= min_pos[:, None, None]
     scores = jnp.where(valid, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bshd->bhd", probs, v_cache, precision=prec)
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, sm_scale=None,
-                     k_scale=None, v_scale=None, alibi_slopes=None):
+                     k_scale=None, v_scale=None, alibi_slopes=None,
+                     min_pos=None):
     """Dispatch: Pallas kernel on TPU, XLA reference elsewhere.  int8
     caches pass per-vector fp32 scales (see ``quantize_kv``);
-    ``alibi_slopes`` [H] selects the BLOOM positional-bias form."""
+    ``alibi_slopes`` [H] selects the BLOOM positional-bias form;
+    ``min_pos`` [B] masks positions below a per-row floor
+    (sliding-window attention)."""
     from deepspeed_tpu.ops.attention import _on_tpu
     if _on_tpu():
         return decode_attention_pallas(q, k_cache, v_cache, cache_len,
                                        sm_scale=sm_scale, k_scale=k_scale,
                                        v_scale=v_scale,
-                                       alibi_slopes=alibi_slopes)
+                                       alibi_slopes=alibi_slopes,
+                                       min_pos=min_pos)
     return decode_attention_xla(q, k_cache, v_cache, cache_len,
                                 sm_scale=sm_scale, k_scale=k_scale,
-                                v_scale=v_scale, alibi_slopes=alibi_slopes)
+                                v_scale=v_scale, alibi_slopes=alibi_slopes,
+                                min_pos=min_pos)

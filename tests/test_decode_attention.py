@@ -64,6 +64,29 @@ def test_decode_kernel_alibi_matches_reference(interpret_pallas, H, KV):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_decode_kernel_min_pos_matches_reference(interpret_pallas):
+    """Sliding-window floor (GPT-Neo local attention): kernel vs XLA
+    reference with per-row min_pos, and poisoned below-floor positions
+    must not leak."""
+    rng = np.random.default_rng(44)
+    B, H, hd, Smax = 2, 4, 64, 256
+    q = jnp.array(rng.standard_normal((B, H, hd)), jnp.float32)
+    k = jnp.array(rng.standard_normal((B, Smax, H, hd)), jnp.float32)
+    v = jnp.array(rng.standard_normal((B, Smax, H, hd)), jnp.float32)
+    lens = jnp.array([120, 250], jnp.int32)
+    floor = jnp.array([100, 0], jnp.int32)
+    ref = da.decode_attention_xla(q, k, v, lens, min_pos=floor)
+    out = da.decode_attention_pallas(q, k, v, lens, block_s=128,
+                                     min_pos=floor)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    k2 = k.at[0, :100].set(1e4)
+    v2 = v.at[0, :100].set(-1e4)
+    out2 = da.decode_attention_pallas(q, k2, v2, lens, block_s=128,
+                                      min_pos=floor)
+    np.testing.assert_allclose(np.asarray(out2[0]), np.asarray(out[0]),
+                               atol=2e-5)
+
+
 def test_decode_kernel_ignores_positions_past_len(interpret_pallas):
     """Garbage beyond cache_len must not leak into the output."""
     rng = np.random.default_rng(0)

@@ -191,6 +191,33 @@ def test_checkpoint_reshape_across_stages(devices8, tmp_path):
     assert abs(l0 - l3) < 2e-4
 
 
+def test_frozen_params_not_updated(devices8):
+    """Frozen-parameter coverage (reference SimpleFrozenModel,
+    tests/unit/runtime/zero/test_zero.py): a trainable_mask freezing the
+    embedding leaves it bit-identical under ZeRO-2 + AdamW weight decay
+    while the rest of the model trains."""
+    import dataclasses
+    import jax
+    base = tiny_gpt2()
+    shapes = jax.eval_shape(base.init, jax.random.PRNGKey(0))
+    mask = jax.tree.map(lambda _: True, shapes)
+    mask["wte"] = False
+    model = dataclasses.replace(base, trainable_mask=mask)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=base_config(
+            zero_optimization={"stage": 2},
+            optimizer={"type": "AdamW",
+                       "params": {"lr": 1e-2, "weight_decay": 0.1}}))
+    wte_before = np.asarray(engine.state["params"]["wte"]).copy()
+    qkv_before = np.asarray(
+        engine.state["params"]["blocks"]["qkv_w"]).copy()
+    _train(engine, steps=3, seed=2)
+    np.testing.assert_array_equal(
+        np.asarray(engine.state["params"]["wte"]), wte_before)
+    assert np.abs(np.asarray(engine.state["params"]["blocks"]["qkv_w"])
+                  - qkv_before).max() > 0
+
+
 def test_lr_scheduler_wired(devices8):
     engine = _make_engine({
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},

@@ -180,6 +180,26 @@ def test_bloom_cached_generate_matches_nocache(devices8):
     np.testing.assert_array_equal(a, b)
 
 
+def test_gptneo_cached_generate_matches_nocache(devices8):
+    """GPT-Neo serving (alternating global/local layers): the decode
+    kernel's min_pos floor reproduces the sliding window — cached
+    generation token-identical to the no-cache oracle, with enough new
+    tokens to cross the window boundary."""
+    from deepspeed_tpu.models.gptneo import gptneo_model
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    m = gptneo_model("tiny", dtype="float32", max_seq_len=128,
+                     window_size=8)
+    eng = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="float32"))
+    rng = np.random.default_rng(6)
+    prompts = rng.integers(1, 200, (2, 6)).astype(np.int32)
+    a = eng.generate(prompts, max_new_tokens=14, do_sample=False,
+                     use_cache=False)
+    b = eng.generate(prompts, max_new_tokens=14, do_sample=False,
+                     use_cache=True)
+    np.testing.assert_array_equal(a, b)
+
+
 def test_neox_cached_generate_matches_nocache(devices8):
     """GPT-NeoX serving via the shared scaffold (fused QKV + partial
     rotary with per-row decode positions + parallel residual): cached
