@@ -54,14 +54,23 @@ def main():
         model = bert_model(MODEL_SIZE.split("-", 1)[1], max_seq_len=SEQ,
                            dtype="bfloat16", remat=remat,
                            remat_policy=REMAT_POLICY)
+    elif MODEL_SIZE.startswith("mixtral"):
+        # BASELINE config 5's measurable half: BENCH_MODEL=mixtral-1b-moe
+        # BENCH_SEQ=1024 BENCH_MICRO=8 (ep=1 single chip)
+        from deepspeed_tpu.models.mixtral import mixtral_model
+        model = mixtral_model(MODEL_SIZE.split("-", 1)[1], max_seq_len=SEQ,
+                              dtype="bfloat16", remat=remat,
+                              remat_policy=REMAT_POLICY)
     else:
         model = gpt2_model(MODEL_SIZE, max_seq_len=SEQ, dtype="bfloat16",
                            remat=remat, remat_policy=REMAT_POLICY)
     n_params = model.meta["n_params"]
     cfg = model.config
-    # MFU accounting: 6N matmul flops/token + causal attention
-    # (12*L*S*D fwd+bwd, halved for causal masking)
-    flops_per_token = 6.0 * n_params + 6.0 * cfg.num_layers * SEQ * cfg.d_model
+    # MFU accounting: 6N matmul flops/token (N = ACTIVE params for MoE —
+    # model.flops_per_token) + causal attention (12*L*S*D fwd+bwd, halved
+    # for causal masking)
+    flops_per_token = ((model.flops_per_token or 6.0 * n_params)
+                       + 6.0 * cfg.num_layers * SEQ * cfg.d_model)
 
     config = {
         "train_micro_batch_size_per_gpu": MICRO,
@@ -107,7 +116,7 @@ def main():
     mfu = tokens_per_sec_chip * flops_per_token / (chip_peak_tflops() * 1e12)
 
     print(json.dumps({
-        "metric": ((MODEL_SIZE if MODEL_SIZE.startswith("bert")
+        "metric": ((MODEL_SIZE if MODEL_SIZE.startswith(("bert", "mixtral"))
                     else f"gpt2_{MODEL_SIZE}")
                    + f"_bf16_zero{ZERO_STAGE}"
                    + ("_offload" if OFFLOAD else "") + "_mfu"),

@@ -64,6 +64,11 @@ class MixtralConfig:
 MIXTRAL_SIZES = {
     "tiny": dict(vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
                  num_kv_heads=2, d_model=32, d_ff=64, num_experts=4, top_k=2),
+    # single-chip bench config (~0.8B total / ~0.3B active): full MoE
+    # state (bf16 params + fp32 masters/moments) fits one 16 GB chip
+    "1b-moe": dict(vocab_size=32000, max_seq_len=2048, num_layers=8,
+                   num_heads=16, num_kv_heads=8, d_model=1024, d_ff=3584,
+                   num_experts=8, top_k=2),
     "8x7b": dict(),
 }
 

@@ -1,0 +1,91 @@
+"""Flash-kernel A/B: the from-scratch ds_flash_attention vs the tuned
+stock wrapper, forward+backward at training shapes.
+
+The dense-path dispatch default (ops/attention.py) is decided by this
+measurement (PERF.md deferred list; round-3/4 VERDICT item 1): run on
+the real chip at the 760M bench shape and flip the default if `ds` wins.
+
+    python scripts/flash_ab.py                  # 760M shape (B12 S1024 H16 hd96)
+    FLASH_AB_B=4 FLASH_AB_S=2048 python scripts/flash_ab.py
+
+Prints one JSON line per kernel plus a "winner" line.  Off-TPU it runs a
+tiny interpret-mode smoke (numbers meaningless, plumbing verified).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu" or \
+        "tpu" in str(jax.devices()[0]).lower()
+    if on_tpu:
+        B = int(os.environ.get("FLASH_AB_B", 12))
+        S = int(os.environ.get("FLASH_AB_S", 1024))
+        H = int(os.environ.get("FLASH_AB_H", 16))
+        hd = int(os.environ.get("FLASH_AB_HD", 96))
+        steps, warmup = 20, 5
+        interpret = None
+    else:
+        B, S, H, hd = 1, 128, 2, 64       # interpret-mode smoke
+        steps, warmup = 1, 1
+        interpret = True
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, hd)),
+                           jnp.bfloat16) for _ in range(3))
+
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+
+    def stock(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    def ds(q, k, v):
+        return ds_flash_attention(q, k, v, causal=True)
+
+    impls = {"stock": stock, "ds": ds}
+    if interpret:
+        from jax.experimental import pallas as pl
+        import functools
+        pl.pallas_call = functools.partial(pl.pallas_call, interpret=True)
+
+    results = {}
+    for name, fn in impls.items():
+        loss = jax.jit(jax.value_and_grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        out = loss(q, k, v)
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            out = loss(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(steps):
+            out = loss(q, k, v)
+        jax.block_until_ready(out)
+        ms = (time.time() - t0) / steps * 1e3
+        results[name] = ms
+        print(json.dumps({"kernel": name, "fwd_bwd_ms": round(ms, 3),
+                          "shape": [B, S, H, hd]}))
+    winner = min(results, key=results.get)
+    print(json.dumps({
+        "winner": winner,
+        "speedup": round(max(results.values()) / min(results.values()), 3),
+        "action": ("flip ops/attention.py dense default to the ds kernel"
+                   if winner == "ds" and on_tpu else
+                   "keep the stock wrapper as the dense default"
+                   if on_tpu else "smoke only (not on TPU)"),
+    }))
+
+
+if __name__ == "__main__":
+    main()
