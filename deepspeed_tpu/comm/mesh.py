@@ -59,6 +59,10 @@ class MeshTopology:
     sequence_parallel_size: int = 1
     expert_parallel_size: int = 1
     hpz_partition_size: int = 1                   # ZeRO++ hpZ group size
+    #: how attention runs over the seq axis: "ulysses" (head-scatter
+    #: all-to-all) or "ring" (blockwise K/V ring — the long-context CP
+    #: path; chunk products ride the flash kernel when shapes allow)
+    sequence_parallel_impl: str = "ulysses"
     devices: Optional[Sequence] = None
     mesh: Mesh = field(init=False, default=None)
 

@@ -214,6 +214,18 @@ def causal_attention(q, k, v, impl: str = "auto"):
         sp = get_topology().mesh.shape[SEQ_AXIS]
     except Exception:
         sp = 1
+    if sp > 1 and getattr(get_topology(), "sequence_parallel_impl",
+                          "ulysses") == "ring":
+        # ring CP (config mesh.sequence_parallel_impl="ring"): K/V blocks
+        # rotate around the seq axis; the ring repeats compact KV itself
+        # only in its dense fallback, but its shard_map spec expects
+        # matching head counts — repeat here for GQA models
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        from deepspeed_tpu.sequence.ring_attention import ring_attention
+        return ring_attention(q, k, v, causal=True)
     if sp > 1:
         # Ulysses scatters heads over the seq axis: compact KV rides the
         # all-to-all whenever each (model-sharded) KV head shard divides

@@ -116,6 +116,7 @@ class MeshConfig(DeepSpeedConfigModel):
     model_parallel_size: int = 1
     pipe_parallel_size: int = 1
     sequence_parallel_size: int = 1
+    sequence_parallel_impl: str = "ulysses"    # "ulysses" | "ring"
     expert_parallel_size: int = 1
     data_parallel_size: Optional[int] = None   # inferred from device count
 

@@ -116,6 +116,7 @@ class DeepSpeedEngine:
             model_parallel_size=mesh_cfg.model_parallel_size,
             pipe_parallel_size=mesh_cfg.pipe_parallel_size,
             sequence_parallel_size=mesh_cfg.sequence_parallel_size,
+            sequence_parallel_impl=mesh_cfg.sequence_parallel_impl,
             expert_parallel_size=mesh_cfg.expert_parallel_size,
             hpz_partition_size=hpz_size)
         if mesh is not None:
