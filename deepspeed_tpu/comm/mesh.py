@@ -67,6 +67,10 @@ class MeshTopology:
     mesh: Mesh = field(init=False, default=None)
 
     def __post_init__(self):
+        if self.sequence_parallel_impl not in ("ulysses", "ring"):
+            raise ValueError(
+                f"sequence_parallel_impl={self.sequence_parallel_impl!r}: "
+                "expected 'ulysses' or 'ring'")
         devices = list(self.devices) if self.devices is not None else jax.devices()
         n = len(devices)
         tp, pp, sp, ep = (self.model_parallel_size, self.pipe_parallel_size,

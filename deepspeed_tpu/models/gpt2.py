@@ -311,22 +311,29 @@ def init_cache(config: GPT2Config, batch_size: int, max_len: int, dtype=None):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def prefill(params, batch, cache, config: GPT2Config):
+def prefill(params, batch, cache, config: GPT2Config, attn_fn=None):
     """Run the causal forward over (right-padded) prompts, filling the cache.
-    Returns (logits [B, S, V], cache)."""
+    Returns (logits [B, S, V], cache).  ``attn_fn(q, k, v, layer_idx)``
+    overrides the attention product (GPT-Neo's banded/unscaled form rides
+    this hook)."""
     tokens = batch["input_ids"]
     B, S = tokens.shape
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens] + params["wpe"].astype(dtype)[:S]
+    if attn_fn is None:
+        attn_fn = lambda q, k, v, idx: causal_attention(
+            q, k, v, impl=config.attention_impl)
 
-    def body(carry, layer):
+    def body(carry, layer_idx):
+        layer, idx = layer_idx
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry, layer, config)
-        attn = causal_attention(q, kk, v, impl=config.attention_impl)
+        attn = attn_fn(q, kk, v, idx)
         out = _block_finish(carry, attn.reshape(B, S, -1), layer, config)
         return out, (kk, v)
 
-    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    idxs = jnp.arange(config.num_layers)
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], idxs))
     if "k_s" in cache:      # int8 cache: quantize the prefill block
         from deepspeed_tpu.ops.pallas.decode_attention import (
             quantize_prefill_into_cache)
@@ -342,9 +349,15 @@ def prefill(params, batch, cache, config: GPT2Config):
     return logits, cache
 
 
-def decode_step(params, tokens, cache, lengths, config: GPT2Config):
+def decode_step(params, tokens, cache, lengths, config: GPT2Config,
+                sm_scale=None, min_pos_fn=None):
     """One decode step.  tokens [B] int32, lengths [B] = current cache fill
-    per row (the new token's position).  Returns (logits [B, V], cache)."""
+    per row (the new token's position).  Returns (logits [B, V], cache).
+
+    Hooks for gpt2-family variants: ``sm_scale`` overrides the score
+    scale (GPT-Neo's unscaled form passes 1.0); ``min_pos_fn(idx,
+    lengths) -> [B]`` supplies a per-layer sliding-window floor for the
+    decode kernel."""
     from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
     B = tokens.shape[0]
     dtype = jnp.dtype(config.dtype)
@@ -357,9 +370,9 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config):
 
     def body(carry, layer_kv):
         if quantized:
-            layer, kc, vc, ksc, vsc = layer_kv
+            layer, idx, kc, vc, ksc, vsc = layer_kv
         else:
-            layer, kc, vc = layer_kv
+            layer, idx, kc, vc = layer_kv
             ksc = vsc = None
         layer = maybe_stream(layer)      # dequant / host-stream per layer
         q, kk, v = _block_qkv(carry[:, None, :], layer, config)
@@ -371,13 +384,17 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config):
         else:
             kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
             vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
-                                k_scale=ksc, v_scale=vsc)
+        attn = decode_attention(
+            q[:, 0], kc, vc, lengths + 1, sm_scale=sm_scale,
+            k_scale=ksc, v_scale=vsc,
+            min_pos=(min_pos_fn(idx, lengths) if min_pos_fn is not None
+                     else None))
         out = _block_finish(carry, attn.reshape(B, D).astype(carry.dtype),
                             layer, config)
         return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
 
-    xs = (params["blocks"], cache["k"], cache["v"])
+    idxs = jnp.arange(config.num_layers)
+    xs = (params["blocks"], idxs, cache["k"], cache["v"])
     if quantized:
         xs += (cache["k_s"], cache["v_s"])
     x, ys = lax.scan(body, x, xs)

@@ -127,97 +127,28 @@ def count_params(config: GPTNeoConfig) -> int:
 
 
 def _serving_fns(config: GPTNeoConfig):
-    """KV-cache serving: GPT-2-family cache with per-layer sliding
-    windows — local layers mask cache positions below
-    ``length+1-window`` (the decode kernel's ``min_pos`` floor) and keep
-    the unscaled-score form via ``sm_scale=1`` with pre-scaled queries
-    undone (scores are plain q·k)."""
-    from deepspeed_tpu.ops.pallas.decode_attention import (
-        decode_attention, quantize_prefill_into_cache,
-        quantize_token_into_cache)
+    """KV-cache serving: the gpt2 serving path with two hooks — the
+    banded/unscaled attention per layer at prefill, and a per-layer
+    sliding-window floor (``length+1-window``, the decode kernel's
+    ``min_pos``) with ``sm_scale=1`` at decode."""
     g2 = _gpt2_cfg(config)
-    dt = jnp.dtype(config.dtype)
-    D = config.d_model
     windows = jnp.asarray(
         [0 if kind == "global" else config.window_size
          for kind in config.layer_kinds], jnp.int32)
 
-    def init_cache_fn(bs, max_len, dtype=None):
-        return _g.init_cache(g2, bs, max_len, dtype)
+    def attn_fn(q, k, v, idx):
+        return _banded_attention(q, k, v, windows[idx])
 
-    def prefill_fn(params, batch, cache):
-        tokens = batch["input_ids"]
-        B, S = tokens.shape
-        x = (params["wte"].astype(dt)[tokens]
-             + params["wpe"].astype(dt)[:S])
+    def min_pos_fn(idx, lengths):
+        win = windows[idx]
+        return jnp.where(win > 0, jnp.maximum(lengths + 1 - win, 0), 0)
 
-        def body(carry, layer_idx):
-            layer, idx = layer_idx[0], layer_idx[1]
-            from deepspeed_tpu.models.model import maybe_stream
-            layer = maybe_stream(layer)
-            q, kk, v = _g._block_qkv(carry, layer, g2)
-            attn = _banded_attention(q, kk, v, windows[idx])
-            out = _g._block_finish(carry, attn.reshape(B, S, D), layer, g2)
-            return out, (kk, v)
-
-        idxs = jnp.arange(config.num_layers)
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], idxs))
-        logits = _g.head(params, x, g2)
-        if "k_s" in cache:
-            return logits, quantize_prefill_into_cache(cache, ks, vs)
-        cache = {
-            "k": lax.dynamic_update_slice(
-                cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(
-                cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
-        }
-        return logits, cache
-
-    def decode_fn(params, tokens, cache, lengths):
-        B = tokens.shape[0]
-        x = (params["wte"].astype(dt)[tokens]
-             + params["wpe"].astype(dt)[lengths])
-        rows = jnp.arange(B)
-        quantized = "k_s" in cache
-
-        def body(carry, layer_kv):
-            if quantized:
-                layer, idx, kc, vc, ksc, vsc = layer_kv
-            else:
-                layer, idx, kc, vc = layer_kv
-                ksc = vsc = None
-            from deepspeed_tpu.models.model import maybe_stream
-            layer = maybe_stream(layer)
-            q, kk, v = _g._block_qkv(carry[:, None, :], layer, g2)
-            if quantized:
-                kc, vc, ksc, vsc = quantize_token_into_cache(
-                    kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
-            else:
-                kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
-                vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-            win = windows[idx]
-            floor = jnp.where(win > 0,
-                              jnp.maximum(lengths + 1 - win, 0), 0)
-            attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
-                                    sm_scale=1.0, k_scale=ksc,
-                                    v_scale=vsc, min_pos=floor)
-            out = _g._block_finish(
-                carry, attn.reshape(B, D).astype(carry.dtype), layer, g2)
-            return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
-
-        idxs = jnp.arange(config.num_layers)
-        xs = (params["blocks"], idxs, cache["k"], cache["v"])
-        if quantized:
-            xs += (cache["k_s"], cache["v_s"])
-        x, ys = lax.scan(body, x, xs)
-        logits = _g.head(params, x[:, None, :], g2)[:, 0]
-        if quantized:
-            ks, vs, kss, vss = ys
-            return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
-        ks, vs = ys
-        return logits, {"k": ks, "v": vs}
-
-    return init_cache_fn, prefill_fn, decode_fn
+    return (
+        lambda bs, ml, dtype=None: _g.init_cache(g2, bs, ml, dtype),
+        lambda p, b, c: _g.prefill(p, b, c, g2, attn_fn=attn_fn),
+        lambda p, t, c, l: _g.decode_step(p, t, c, l, g2, sm_scale=1.0,
+                                          min_pos_fn=min_pos_fn),
+    )
 
 
 def gptneo_model(size: str = "tiny", **overrides) -> Model:

@@ -533,8 +533,10 @@ def megatron_gpt_from_sd(state_dict, num_heads: int,
         for pre in ("model.", "language_model."):
             if key.startswith(pre):
                 key = key[len(pre):]
-        key = key.replace("encoder.", "transformer.", 1) \
-            if key.startswith("encoder.") else key
+        if key.startswith("encoder."):
+            key = key.replace("encoder.", "transformer.", 1)
+        # new Megatron-LM names the attention module self_attention
+        key = key.replace(".self_attention.", ".attention.")
         sd[key] = val
     g = lambda k: _to_np(sd[k])
     n_layers = 1 + max(

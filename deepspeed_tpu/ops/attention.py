@@ -225,7 +225,10 @@ def causal_attention(q, k, v, impl: str = "auto"):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         from deepspeed_tpu.sequence.ring_attention import ring_attention
-        return ring_attention(q, k, v, causal=True)
+        # honor the caller's impl choice: "xla" means the exact einsum
+        # path, which is the ring's "dense" chunk product
+        return ring_attention(q, k, v, causal=True,
+                              impl={"xla": "dense"}.get(impl, impl))
     if sp > 1:
         # Ulysses scatters heads over the seq axis: compact KV rides the
         # all-to-all whenever each (model-sharded) KV head shard divides

@@ -267,11 +267,17 @@ def test_internlm_from_hf_logits_match():
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
 
 
-def test_megatron_gpt_from_sd_logits_match():
+@pytest.mark.parametrize("attn_mod,container", [
+    ("attention", "transformer"),        # old Megatron-LM naming
+    ("self_attention", "encoder"),       # new Megatron-LM naming
+])
+def test_megatron_gpt_from_sd_logits_match(attn_mod, container):
     """Megatron-GPT (reference containers/megatron_gpt.py): the converter
-    de-interleaves the head-major fused QKV.  Verified by synthesizing a
-    Megatron-named state dict from an HF GPT-2 (known thirds packing,
-    permuted to [H,3,hd] rows) and matching the HF logits."""
+    de-interleaves the head-major fused QKV and accepts both the old
+    (transformer.*.attention) and new (encoder.*.self_attention) key
+    layouts.  Verified by synthesizing a Megatron-named state dict from
+    an HF GPT-2 (known thirds packing, permuted to [H,3,hd] rows) and
+    matching the HF logits."""
     from transformers import GPT2Config, GPT2LMHeadModel
     from deepspeed_tpu.models.hf import megatron_gpt_from_sd
     torch.manual_seed(19)
@@ -287,23 +293,23 @@ def test_megatron_gpt_from_sd_logits_match():
             hsd["transformer.wte.weight"],
         "language_model.embedding.position_embeddings.weight":
             hsd["transformer.wpe.weight"],
-        "language_model.transformer.final_layernorm.weight":
+        f"language_model.{container}.final_layernorm.weight":
             hsd["transformer.ln_f.weight"],
-        "language_model.transformer.final_layernorm.bias":
+        f"language_model.{container}.final_layernorm.bias":
             hsd["transformer.ln_f.bias"],
     }
     for i in range(2):
         hk = lambda k: hsd[f"transformer.h.{i}.{k}"]
-        base = f"language_model.transformer.layers.{i}."
+        base = f"language_model.{container}.layers.{i}."
         # HF Conv1D c_attn [D, 3D] thirds -> megatron Linear rows [H,3,hd]
         w = hk("attn.c_attn.weight").reshape(D, 3, H, hd)
-        meg[base + "attention.query_key_value.weight"] = (
+        meg[base + f"{attn_mod}.query_key_value.weight"] = (
             w.transpose(2, 1, 3, 0).reshape(3 * D, D))
         b = hk("attn.c_attn.bias").reshape(3, H, hd)
-        meg[base + "attention.query_key_value.bias"] = (
+        meg[base + f"{attn_mod}.query_key_value.bias"] = (
             b.transpose(1, 0, 2).reshape(3 * D))
-        meg[base + "attention.dense.weight"] = hk("attn.c_proj.weight").T
-        meg[base + "attention.dense.bias"] = hk("attn.c_proj.bias")
+        meg[base + f"{attn_mod}.dense.weight"] = hk("attn.c_proj.weight").T
+        meg[base + f"{attn_mod}.dense.bias"] = hk("attn.c_proj.bias")
         meg[base + "input_layernorm.weight"] = hk("ln_1.weight")
         meg[base + "input_layernorm.bias"] = hk("ln_1.bias")
         meg[base + "post_attention_layernorm.weight"] = hk("ln_2.weight")
