@@ -245,11 +245,12 @@ def _block_finish(x, attn, layer, config: GPT2Config):
     return x
 
 
-def _block(x, layer, config: GPT2Config, rng=None):
+def _block(x, layer, config: GPT2Config, rng=None, segment_ids=None):
     """One transformer block; shapes [B, S, D]."""
     B, S, D = x.shape
     q, kk, v = _block_qkv(x, layer, config)
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl,
+                            segment_ids=segment_ids)
     attn = attn.reshape(B, S, D)
     # named residual: the save_attn remat policy keeps attention outputs and
     # recomputes the (cheap, MXU-bound) linear parts in the backward pass —
@@ -272,17 +273,20 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
     # stream-inside-remat: with ZeRO-Infinity param offload the layer slice is
     # transferred host→device *inside* the remat boundary, so backward
     # re-streams it instead of keeping every layer's device copy alive
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
+
     def block_fn(x, layer):
-        return _block(x, maybe_stream(layer), config, rng)
+        return _block(x, maybe_stream(layer), config, rng, seg)
     if config.remat:
         block_fn = jax.checkpoint(block_fn,
                                   policy=remat_policy(config.remat_policy))
 
     # layer scan with random-LTD + progressive-layer-drop hooks (see
-    # models/model.py scan_blocks)
+    # models/model.py scan_blocks); packed batches skip LTD (a token
+    # subset would misalign the closed-over segment ids)
     from deepspeed_tpu.models.model import scan_blocks
     x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
-                    config.num_layers)
+                    config.num_layers, allow_ltd=seg is None)
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                     config.layer_norm_eps)
     logits = x @ params["wte"].astype(dtype).T   # tied embedding

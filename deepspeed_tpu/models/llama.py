@@ -219,13 +219,14 @@ def _block_finish(x, attn, layer, config: LlamaConfig):
     return x
 
 
-def _block(x, layer, config: LlamaConfig, rng=None):
+def _block(x, layer, config: LlamaConfig, rng=None, segment_ids=None):
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     q, kk, v = _block_qkv(x, layer, config)
     # kv heads stay compact: the attention dispatch attends GQA natively
     # (from-scratch flash kernel) or repeats in the fallback paths
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl,
+                            segment_ids=segment_ids)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     return _block_finish(x, attn.reshape(B, S, H * hd), layer, config)
 
@@ -236,19 +237,22 @@ def forward(params, batch, config: LlamaConfig, rng=None):
     x = params["wte"].astype(dtype)[tokens]
     # stream-inside-remat (see models/model.py maybe_stream): param-offload
     # transfers happen inside the remat boundary
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
+
     def block_fn(x, layer):
         from deepspeed_tpu.models.model import maybe_stream
-        return _block(x, maybe_stream(layer), config, rng)
+        return _block(x, maybe_stream(layer), config, rng, seg)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(
             block_fn, policy=remat_policy(config.remat_policy))
 
     # layer scan with random-LTD + progressive-layer-drop hooks (see
-    # models/model.py scan_blocks)
+    # models/model.py scan_blocks); packed batches skip LTD (a token
+    # subset would misalign the closed-over segment ids)
     from deepspeed_tpu.models.model import scan_blocks
     x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
-                    config.num_layers)
+                    config.num_layers, allow_ltd=seg is None)
     x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
     return x @ params["lm_head"].astype(dtype)
 

@@ -304,8 +304,16 @@ def _default_lm_loss(apply_fn):
         mask = batch.get("attention_mask")
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), targets)
+        m = None
         if mask is not None:
             m = mask[:, 1:].astype(jnp.float32)
+        seg = batch.get("segment_ids")
+        if seg is not None:
+            # packed sequences: the last token of one segment must not be
+            # scored against the first token of the next
+            same = (seg[:, 1:] == seg[:, :-1]).astype(jnp.float32)
+            m = same if m is None else m * same
+        if m is not None:
             return (losses * m).sum() / jnp.maximum(m.sum(), 1.0)
         return losses.mean()
 

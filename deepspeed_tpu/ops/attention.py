@@ -123,8 +123,32 @@ def _ds_gqa_causal(q, k, v):
     return ds_flash_attention(q, k, v, causal=True)
 
 
-def _local_causal_attention(q, k, v, impl: str = "auto"):
+def _local_causal_attention(q, k, v, impl: str = "auto", segment_ids=None):
     gqa = k.shape[2] != q.shape[2]
+    if segment_ids is not None:
+        # packed sequences: only the from-scratch kernel (GQA-native,
+        # segment-masked) or the exact einsum can honor the mask
+        if impl != "xla" and _on_tpu() and q.shape[1] >= 256 \
+                and _ds_vmem_ok(q):
+            from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+                ds_flash_attention
+            try:
+                return ds_flash_attention(q, k, v,
+                                          segment_ids=segment_ids,
+                                          causal=True)
+            except ValueError:
+                if impl == "flash":
+                    raise
+        elif impl == "flash":
+            from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+                ds_flash_attention
+            return ds_flash_attention(q, k, v, segment_ids=segment_ids,
+                                      causal=True)
+        if gqa:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return xla_causal_attention(q, k, v, segment_ids)
     if impl == "flash":
         # explicit request: no fallback — surface the real error
         if gqa:
@@ -200,14 +224,18 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
     return xla_bidirectional_attention(q, k, v, pad_mask)
 
 
-def causal_attention(q, k, v, impl: str = "auto"):
+def causal_attention(q, k, v, impl: str = "auto", segment_ids=None):
     """q [B, S, H, hd], k/v [B, S, KV, hd] -> [B, S, H, hd]; KV may divide
     H (GQA — the from-scratch flash kernel attends compact KV natively,
-    other paths repeat).
+    other paths repeat).  ``segment_ids`` [B, S] restricts attention
+    within packed segments (models thread ``batch["segment_ids"]`` here;
+    the from-scratch kernel masks natively, the einsum path exactly).
 
     When the mesh has an active ``seq`` axis, attention runs under Ulysses
     sequence parallelism (head-scatter all-to-all; see sequence/layer.py) —
-    models get SP transparently.
+    models get SP transparently.  Packed segments compose with Ulysses
+    (the head-scattered local product sees the full sequence) but not
+    with ring CP (block-granular masks only — rejected loudly).
     """
     from deepspeed_tpu.comm.mesh import get_topology, SEQ_AXIS
     try:
@@ -216,6 +244,11 @@ def causal_attention(q, k, v, impl: str = "auto"):
         sp = 1
     if sp > 1 and getattr(get_topology(), "sequence_parallel_impl",
                           "ulysses") == "ring":
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed sequences (segment_ids) do not compose with ring "
+                "context parallelism — use sequence_parallel_impl="
+                "'ulysses' for packed batches")
         # ring CP (config mesh.sequence_parallel_impl="ring"): K/V blocks
         # rotate around the seq axis; the ring repeats compact KV itself
         # only in its dense fallback, but its shard_map spec expects
@@ -243,5 +276,6 @@ def causal_attention(q, k, v, impl: str = "auto"):
             v = jnp.repeat(v, rep, axis=2)
         from deepspeed_tpu.sequence.layer import distributed_attention
         return distributed_attention(
-            q, k, v, lambda a, b, c: _local_causal_attention(a, b, c, impl))
-    return _local_causal_attention(q, k, v, impl)
+            q, k, v, lambda a, b, c: _local_causal_attention(
+                a, b, c, impl, segment_ids))
+    return _local_causal_attention(q, k, v, impl, segment_ids)

@@ -218,6 +218,37 @@ def test_frozen_params_not_updated(devices8):
                   - qkv_before).max() > 0
 
 
+def test_unused_parameters_train(devices8):
+    """UnusedParametersModel coverage (reference simple_model.py:
+    a param no forward path touches must not break the step — the
+    reference's hook-driven ZeRO needed special handling; here zero
+    grads flow naturally and Adam leaves the leaf untouched)."""
+    import dataclasses
+    import jax
+    base = tiny_gpt2()
+    orig_init, orig_loss = base.init_fn, base.loss_fn
+
+    def init_fn(rng):
+        p = orig_init(rng)
+        p["unused_w"] = jax.numpy.ones((8, 8))
+        return p
+
+    def loss_fn(params, batch, rng=None):
+        rest = {k: v for k, v in params.items() if k != "unused_w"}
+        return orig_loss(rest, batch, rng)
+
+    from jax.sharding import PartitionSpec as P
+    model = dataclasses.replace(
+        base, init_fn=init_fn, loss_fn=loss_fn,
+        logical_specs={**base.logical_specs, "unused_w": P()})
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=base_config(zero_optimization={"stage": 2}))
+    losses = _train(engine, steps=2, seed=6)
+    assert np.isfinite(losses).all()
+    np.testing.assert_array_equal(
+        np.asarray(engine.state["params"]["unused_w"]), np.ones((8, 8)))
+
+
 def test_lr_scheduler_wired(devices8):
     engine = _make_engine({
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
