@@ -262,7 +262,8 @@ def resolve_model_factory(spec: str, model_kwargs: Optional[dict] = None):
         from deepspeed_tpu import models as _m
         registry = {"gpt2": _m.gpt2_model, "llama": _m.llama_model,
                     "mixtral": _m.mixtral_model, "bert": _m.bert_model,
-                    "neox": _m.neox_model, "bloom": _m.bloom_model}
+                    "neox": _m.neox_model, "bloom": _m.bloom_model,
+                    "gptneo": _m.gptneo_model}
         if arch in registry:
             fn, size = registry[arch], rest
             return lambda **kw: fn(size, **{**model_kwargs, **kw})
