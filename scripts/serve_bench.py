@@ -1,0 +1,81 @@
+"""Serving benchmark: steady-state decode tokens/s through the
+InferenceEngine (KV cache + Pallas decode kernel).
+
+On-chip queue item (PERF.md): MoE int8-KV serving rate, plus rates for
+the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
+
+    python scripts/serve_bench.py                          # gpt2 125m
+    SERVE_MODEL=mixtral:1b-moe SERVE_KV=int8 python scripts/serve_bench.py
+    SERVE_MODEL=bloom:560m SERVE_B=8 python scripts/serve_bench.py
+
+Prints one JSON line: prefill ms + steady decode tokens/s.
+Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+
+def main():
+    on_tpu = "tpu" in str(jax.devices()[0]).lower()
+    spec = os.environ.get("SERVE_MODEL",
+                          "gpt2:125m" if on_tpu else "gpt2:custom")
+    B = int(os.environ.get("SERVE_B", 4))
+    prompt_len = int(os.environ.get("SERVE_PROMPT", 128 if on_tpu else 8))
+    new_tokens = int(os.environ.get("SERVE_TOKENS", 256 if on_tpu else 8))
+    kv_dtype = os.environ.get("SERVE_KV") or None
+    quant = bool(int(os.environ.get("SERVE_INT8_WEIGHTS", "0")))
+
+    from deepspeed_tpu import models as M
+    arch, _, size = spec.partition(":")
+    registry = {"gpt2": M.gpt2_model, "llama": M.llama_model,
+                "mixtral": M.mixtral_model, "neox": M.neox_model,
+                "bloom": M.bloom_model, "gptneo": M.gptneo_model}
+    kwargs = {} if on_tpu else dict(
+        vocab_size=256, max_seq_len=64, num_layers=2, num_heads=4,
+        d_model=32)
+    model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
+                           else "float32",
+                           max_seq_len=max(2048 if on_tpu else 64,
+                                           prompt_len + new_tokens),
+                           **{k: v for k, v in kwargs.items()
+                              if k != "max_seq_len"})
+
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    cfg = DeepSpeedInferenceConfig(
+        dtype="bfloat16" if on_tpu else "float32",
+        quant={"enabled": quant},
+        kv_cache_dtype=kv_dtype)
+    eng = InferenceEngine(model, cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, model.config.vocab_size,
+                           (B, prompt_len)).astype(np.int32)
+    # warmup (compile)
+    out = eng.generate(prompts, max_new_tokens=new_tokens, do_sample=False)
+    t0 = time.time()
+    out = eng.generate(prompts, max_new_tokens=new_tokens, do_sample=False)
+    np.asarray(out)
+    dt = time.time() - t0
+    toks = B * new_tokens
+    print(json.dumps({
+        "metric": f"{spec}_serve"
+                  + ("_int8kv" if kv_dtype == "int8" else "")
+                  + ("_int8w" if quant else ""),
+        "value": round(toks / dt, 1),
+        "unit": "decode_tokens_per_sec",
+        "detail": {"batch": B, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens,
+                   "total_s": round(dt, 3)},
+    }))
+
+
+if __name__ == "__main__":
+    main()
