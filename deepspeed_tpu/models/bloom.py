@@ -103,16 +103,20 @@ def logical_specs(config: BloomConfig) -> dict:
     }
 
 
-def _alibi_attention(q, k, v, slopes):
+def _alibi_attention(q, k, v, slopes, segment_ids=None):
     """Causal attention with the ALiBi additive bias
-    ``slopes[h] * key_position`` (row-shift-invariant form HF uses)."""
+    ``slopes[h] * key_position`` (row-shift-invariant form HF uses);
+    ``segment_ids`` restricts attention within packed segments."""
     B, S, H, hd = q.shape
     scale = hd ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     bias = slopes[None, :, None, None] * jnp.arange(S)[None, None, None, :]
     scores = scores + bias
-    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))[None, None]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, None, :, None]
+                       == segment_ids[:, None, None, :])
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -139,10 +143,11 @@ def _block_finish(x, attn_flat, layer, config: BloomConfig):
     return x + m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
 
 
-def _block(x, layer, config: BloomConfig, slopes, rng=None):
+def _block(x, layer, config: BloomConfig, slopes, rng=None,
+           segment_ids=None):
     B, S, D = x.shape
     q, kk, v = _block_qkv(x, layer, config)
-    attn = _alibi_attention(q, kk, v, slopes)
+    attn = _alibi_attention(q, kk, v, slopes, segment_ids)
     return _block_finish(x, attn.reshape(B, S, D), layer, config)
 
 
@@ -154,16 +159,18 @@ def forward(params, batch, config: BloomConfig, rng=None):
     x = _ln(x, params["emb_ln_scale"], params["emb_ln_bias"],
             config.layer_norm_eps)
 
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
+
     def block_fn(x, layer):
         from deepspeed_tpu.models.model import maybe_stream
-        return _block(x, maybe_stream(layer), config, slopes, rng)
+        return _block(x, maybe_stream(layer), config, slopes, rng, seg)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(
             block_fn, policy=remat_policy(config.remat_policy))
     from deepspeed_tpu.models.model import scan_blocks
     x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
-                    config.num_layers)
+                    config.num_layers, allow_ltd=seg is None)
     x = _ln(x, params["lnf_scale"], params["lnf_bias"],
             config.layer_norm_eps)
     # tied head (BLOOM always ties lm_head to the word embeddings)

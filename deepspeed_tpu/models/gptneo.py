@@ -71,9 +71,10 @@ def _gpt2_cfg(config: GPTNeoConfig) -> _g.GPT2Config:
         dtype=config.dtype, attention_impl=config.attention_impl)
 
 
-def _banded_attention(q, k, v, window):
+def _banded_attention(q, k, v, window, segment_ids=None):
     """Causal attention with UNSCALED scores and an optional sliding
-    window (``window`` is a traced scalar; 0 = full causal)."""
+    window (``window`` is a traced scalar; 0 = full causal);
+    ``segment_ids`` restricts attention within packed segments."""
     B, S, H, hd = q.shape
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
@@ -81,7 +82,11 @@ def _banded_attention(q, k, v, window):
     j = lax.broadcasted_iota(jnp.int32, (S, S), 1)
     mask = j <= i
     mask &= (window == 0) | (i - j < window)
-    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    mask = mask[None, None]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, None, :, None]
+                       == segment_ids[:, None, None, :])
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -96,11 +101,13 @@ def forward(params: dict, batch: dict, config: GPTNeoConfig, rng=None):
         [0 if kind == "global" else config.window_size
          for kind in config.layer_kinds], jnp.int32)
 
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
+
     def block(x, layer, idx):
         from deepspeed_tpu.models.model import maybe_stream
         layer = maybe_stream(layer)
         q, kk, v = _g._block_qkv(x, layer, g2)
-        attn = _banded_attention(q, kk, v, windows[idx])
+        attn = _banded_attention(q, kk, v, windows[idx], seg)
         attn = attn.reshape(B, S, config.d_model)
         attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
         return _g._block_finish(x, attn, layer, g2)

@@ -149,12 +149,14 @@ def _moe_finish(x, attn_flat, layer, config: MixtralConfig, train: bool,
     return x + moe_out, aux
 
 
-def _block(carry, layer, config: MixtralConfig, train: bool, rng=None):
+def _block(carry, layer, config: MixtralConfig, train: bool, rng=None,
+           segment_ids=None):
     x = carry
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     q, kk, v = _qkv(x, layer, config)
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl,
+                            segment_ids=segment_ids)
     attn = jax.ad_checkpoint.checkpoint_name(attn, "attn_out")
     return _moe_finish(x, attn.reshape(B, S, H * hd), layer, config,
                        train, rng)
@@ -165,10 +167,12 @@ def forward_with_aux(params, batch, config: MixtralConfig, train: bool = True,
     tokens = batch["input_ids"]
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens]
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
     # stream-inside-remat (see models/model.py maybe_stream)
     def block_fn(x, layer):
         from deepspeed_tpu.models.model import maybe_stream
-        return _block(x, maybe_stream(layer), config, train=train, rng=rng)
+        return _block(x, maybe_stream(layer), config, train=train, rng=rng,
+                      segment_ids=seg)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(

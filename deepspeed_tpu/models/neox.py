@@ -162,10 +162,11 @@ def _block_finish(x, attn_flat, layer, config: NeoXConfig):
     return h2_in + mlp_out
 
 
-def _block(x, layer, config: NeoXConfig, rng=None):
+def _block(x, layer, config: NeoXConfig, rng=None, segment_ids=None):
     B, S, D = x.shape
     q, kk, v = _block_qkv(x, layer, config)
-    attn = causal_attention(q, kk, v, impl=config.attention_impl)
+    attn = causal_attention(q, kk, v, impl=config.attention_impl,
+                            segment_ids=segment_ids)
     return _block_finish(x, attn.reshape(B, S, D), layer, config)
 
 
@@ -173,17 +174,18 @@ def forward(params, batch, config: NeoXConfig, rng=None):
     tokens = batch["input_ids"]
     dtype = jnp.dtype(config.dtype)
     x = params["wte"].astype(dtype)[tokens]
+    seg = batch.get("segment_ids") if isinstance(batch, dict) else None
 
     def block_fn(x, layer):
         from deepspeed_tpu.models.model import maybe_stream
-        return _block(x, maybe_stream(layer), config, rng)
+        return _block(x, maybe_stream(layer), config, rng, seg)
     if config.remat:
         from deepspeed_tpu.models.gpt2 import remat_policy
         block_fn = jax.checkpoint(
             block_fn, policy=remat_policy(config.remat_policy))
     from deepspeed_tpu.models.model import scan_blocks
     x = scan_blocks(block_fn, x, params["blocks"], rng, batch,
-                    config.num_layers)
+                    config.num_layers, allow_ltd=seg is None)
     x = _ln(x, params["lnf_scale"], params["lnf_bias"],
             config.layer_norm_eps)
     logits = x @ params["embed_out"].astype(dtype)

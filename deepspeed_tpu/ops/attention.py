@@ -128,22 +128,24 @@ def _local_causal_attention(q, k, v, impl: str = "auto", segment_ids=None):
     if segment_ids is not None:
         # packed sequences: only the from-scratch kernel (GQA-native,
         # segment-masked) or the exact einsum can honor the mask
-        if impl != "xla" and _on_tpu() and q.shape[1] >= 256 \
+        from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+            ds_flash_attention
+        if impl == "flash":
+            # explicit request: no fallback — surface the real error
+            return ds_flash_attention(q, k, v, segment_ids=segment_ids,
+                                      causal=True)
+        if impl == "auto" and _on_tpu() and q.shape[1] >= 256 \
                 and _ds_vmem_ok(q):
-            from deepspeed_tpu.ops.pallas.ds_flash_attention import \
-                ds_flash_attention
             try:
                 return ds_flash_attention(q, k, v,
                                           segment_ids=segment_ids,
                                           causal=True)
             except ValueError:
-                if impl == "flash":
-                    raise
-        elif impl == "flash":
-            from deepspeed_tpu.ops.pallas.ds_flash_attention import \
-                ds_flash_attention
-            return ds_flash_attention(q, k, v, segment_ids=segment_ids,
-                                      causal=True)
+                from deepspeed_tpu.utils.logging import warning_once
+                warning_once(
+                    f"packed attention: S={q.shape[1]} does not "
+                    "block-decompose for the flash kernel — exact einsum "
+                    "fallback (materialises [S,S] scores)")
         if gqa:
             rep = q.shape[2] // k.shape[2]
             k = jnp.repeat(k, rep, axis=2)
@@ -275,7 +277,12 @@ def causal_attention(q, k, v, impl: str = "auto", segment_ids=None):
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         from deepspeed_tpu.sequence.layer import distributed_attention
+        if segment_ids is not None:
+            return distributed_attention(
+                q, k, v,
+                lambda a, b, c, seg: _local_causal_attention(
+                    a, b, c, impl, seg),
+                segment_ids=segment_ids)
         return distributed_attention(
-            q, k, v, lambda a, b, c: _local_causal_attention(
-                a, b, c, impl, segment_ids))
+            q, k, v, lambda a, b, c: _local_causal_attention(a, b, c, impl))
     return _local_causal_attention(q, k, v, impl, segment_ids)

@@ -24,35 +24,54 @@ def seq_all_to_all(x, scatter_axis: int, gather_axis: int):
                           concat_axis=gather_axis, tiled=True)
 
 
-def distributed_attention(q, k, v, local_attn):
+def distributed_attention(q, k, v, local_attn, segment_ids=None):
     """q/k/v: [B, S, H, hd] (globally); runs ``local_attn`` over full sequence
     with heads scattered across the ``seq`` axis.
 
-    ``local_attn(q, k, v) -> out`` must be shape-preserving.
+    ``local_attn(q, k, v[, segment_ids]) -> out`` must be shape-preserving.
+    ``segment_ids`` [B, S] (packed sequences) enters the shard_map as a
+    sharded operand — batch over the dp axes, sequence over seq — and is
+    seq-all-gathered so the head-scattered local product sees the full
+    sequence's mask.
     """
     topo = get_topology()
     mesh = topo.mesh
     sp = mesh.shape[SEQ_AXIS]
     if sp == 1:
-        return local_attn(q, k, v)
+        return (local_attn(q, k, v) if segment_ids is None
+                else local_attn(q, k, v, segment_ids))
     # fully-manual specs: batch over the dp axes, sequence over seq, heads over
     # model (partial-manual `axis_names` mode currently trips an XLA abort when
     # nested under grad+scan on the CPU backend)
     dp = tuple(topo.data_parallel_axes)
     spec = P(dp, SEQ_AXIS, MODEL_AXIS, None)
+    seg_spec = P(dp, SEQ_AXIS)
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+    if segment_ids is None:
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def inner(ql, kl, vl):
+            # [b, S/sp, h, hd] -> scatter heads(2), gather seq(1) -> [b, S, h/sp, hd]
+            qg = seq_all_to_all(ql, 2, 1)
+            kg = seq_all_to_all(kl, 2, 1)
+            vg = seq_all_to_all(vl, 2, 1)
+            out = local_attn(qg, kg, vg)
+            # reverse: scatter seq(1), gather heads(2)
+            return seq_all_to_all(out, 1, 2)
+
+        return inner(q, k, v)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
              out_specs=spec, check_vma=False)
-    def inner(ql, kl, vl):
-        # [b, S/sp, h, hd] -> scatter heads(2), gather seq(1) -> [b, S, h/sp, hd]
+    def inner_seg(ql, kl, vl, segl):
         qg = seq_all_to_all(ql, 2, 1)
         kg = seq_all_to_all(kl, 2, 1)
         vg = seq_all_to_all(vl, 2, 1)
-        out = local_attn(qg, kg, vg)
-        # reverse: scatter seq(1), gather heads(2)
+        seg = lax.all_gather(segl, SEQ_AXIS, axis=1, tiled=True)
+        out = local_attn(qg, kg, vg, seg)
         return seq_all_to_all(out, 1, 2)
 
-    return inner(q, k, v)
+    return inner_seg(q, k, v, segment_ids)
 
 
 class DistributedAttention:

@@ -572,6 +572,30 @@ def test_packed_loss_masks_segment_boundary(devices8):
     assert keep.sum() == 10
 
 
+def test_packed_with_ulysses_and_dp(devices8):
+    """Packed batches under sequence parallelism WITH data parallelism
+    (review round 4: segment_ids must enter the Ulysses shard_map as a
+    sharded operand, not a closure capture): sp=2 x dp=4 packed training
+    matches the pure-DP packed run."""
+    import deepspeed_tpu
+    from tests.util import tiny_gpt2, base_config
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 2}))
+    sp, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config(
+            zero_optimization={"stage": 2},
+            mesh={"sequence_parallel_size": 2}))
+    rng = np.random.default_rng(10)
+    for i in range(2):
+        ids = rng.integers(1, 128, (1, 8, 16)).astype(np.int32)
+        seg = np.tile(np.array([0] * 8 + [1] * 8, np.int32), (1, 8, 1))
+        batch = {"input_ids": ids, "segment_ids": seg}
+        l_ref = float(ref.train_batch(batch=batch))
+        l_sp = float(sp.train_batch(batch=batch))
+        assert abs(l_ref - l_sp) < 2e-4, f"step {i}: {l_ref} vs {l_sp}"
+
+
 def test_packed_training_through_engine(devices8):
     """segment_ids ride the engine batch like any other leaf (sharded
     with the batch dims); a packed ZeRO-2 step trains finite, and llama's
@@ -579,9 +603,13 @@ def test_packed_training_through_engine(devices8):
     import deepspeed_tpu
     from tests.util import tiny_gpt2, base_config
     from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.models.bloom import bloom_model
+    from deepspeed_tpu.models.gptneo import gptneo_model
     for model in (tiny_gpt2(),
                   llama_model("tiny", dtype="float32",
-                              attention_impl="xla", max_seq_len=64)):
+                              attention_impl="xla", max_seq_len=64),
+                  bloom_model("tiny"),
+                  gptneo_model("tiny")):
         from deepspeed_tpu.comm import reset_topology
         reset_topology()
         engine, *_ = deepspeed_tpu.initialize(
