@@ -18,6 +18,7 @@ from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu import comm  # noqa: F401  (deepspeed.comm facade)
+from deepspeed_tpu import zero  # noqa: F401  (deepspeed.zero API surface)
 
 
 def initialize(args=None,

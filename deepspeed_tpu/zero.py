@@ -1,0 +1,70 @@
+"""deepspeed.zero-compatible public API surface (reference:
+deepspeed/runtime/zero/partition_parameters.py ``Init`` :707 and
+``GatheredParameters`` :1936 — the two context managers user code
+imports as ``deepspeed.zero.*``).
+
+TPU-native semantics:
+
+- ``Init`` — the reference patches module construction so every param is
+  born partitioned.  Here params are ALWAYS born sharded (the engine
+  jits model init with ZeRO out_shardings), so ``Init`` is an alias of
+  ``utils.init_on_device.OnDevice``: inside it, ``abstract_init`` builds
+  shapes only (meta construction), and ``materialize`` lands real params
+  directly in sharded storage.
+- ``GatheredParameters`` — the reference gathers partitioned params so
+  rank ``modifier_rank`` can read/modify them, re-partitioning on exit.
+  Here the context yields MUTABLE host (numpy) copies of the engine's
+  param tree; on exit the (possibly edited) tree is device_put back with
+  the engine's original shardings and dtypes.  Passing a bare pytree
+  yields read-only host copies (nothing to write back to).
+"""
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.init_on_device import (  # noqa: F401
+    OnDevice, abstract_init, materialize)
+
+
+class Init(OnDevice):
+    """reference partition_parameters.py:707 — accepts (and ignores) the
+    torch-specific ctor arguments so reference call sites port verbatim."""
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear=True, remote_device=None,
+                 pin_memory=False, config_dict_or_path=None, config=None,
+                 enabled=True, dtype=None, mpu=None, sequence_data_parallel_group=None):
+        super().__init__(dtype=dtype, device="meta", enabled=enabled)
+
+
+class GatheredParameters:
+    """reference partition_parameters.py:1936.
+
+    with zero.GatheredParameters(engine) as host_params:
+        host_params["wte"][0] = 0.0        # surgical weight edit
+    # exit: written back sharded, original dtypes
+    """
+
+    def __init__(self, params, modifier_rank=0, fwd_module=None,
+                 enabled=True):
+        self._engine = params if hasattr(params, "state") else None
+        self._tree = None if self._engine is not None else params
+        self.enabled = enabled
+        self._host = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self._tree
+        src = (self._engine.state["params"] if self._engine is not None
+               else self._tree)
+        self._host = jax.tree.map(lambda x: np.array(x), src)
+        return self._host
+
+    def __exit__(self, *exc):
+        if self.enabled and self._engine is not None and exc[0] is None:
+            src = self._engine.state["params"]
+            shardings = self._engine.state_shardings["params"]
+            new = jax.tree.map(
+                lambda h, old: jax.numpy.asarray(h, old.dtype),
+                self._host, src)
+            self._engine.state["params"] = jax.device_put(new, shardings)
+        return False
