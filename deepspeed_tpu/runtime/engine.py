@@ -727,6 +727,26 @@ class DeepSpeedEngine:
             return [float(self.lr_schedule(jnp.int32(step)))]
         return [self.base_lr]
 
+    def get_type(self):
+        """(reference engine.py get_type)"""
+        return [self._config.optimizer_name or "adam"]
+
+    def get_mom(self):
+        """(reference engine.py:2249) momentum for SGD-family optimizers,
+        betas otherwise."""
+        params = self._config.optimizer_params or {}
+        name = (self._config.optimizer_name or "adam").lower()
+        if name in ("sgd", "rmsprop"):
+            return [float(params.get("momentum", 0.0))]
+        betas = params.get("betas", (0.9, 0.999))
+        return [tuple(float(b) for b in betas)]
+
+    def get_pld_theta(self):
+        """(reference engine.py get_pld_theta)"""
+        if self.progressive_layer_drop is not None:
+            return float(self.progressive_layer_drop.get_theta())
+        return None
+
     @property
     def lr_scheduler(self):
         return self.lr_schedule

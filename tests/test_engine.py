@@ -265,3 +265,18 @@ def test_eval_batch(devices8):
     engine = _make_engine()
     loss = float(engine.eval_batch(random_batch(batch_size=8)))
     assert np.isfinite(loss)
+
+
+def test_engine_introspection_api(devices8):
+    """Reference engine accessors (engine.py:2243-2259): get_lr/get_type/
+    get_mom/get_pld_theta."""
+    engine = _make_engine({"optimizer": {
+        "type": "AdamW", "params": {"lr": 2e-3, "betas": (0.8, 0.95)}}})
+    assert engine.get_lr() == [2e-3]
+    assert engine.get_type() == ["adamw"]
+    assert engine.get_mom() == [(0.8, 0.95)]
+    assert engine.get_pld_theta() is None
+    sgd = _make_engine({"optimizer": {"type": "SGD",
+                                      "params": {"lr": 0.1,
+                                                 "momentum": 0.9}}})
+    assert sgd.get_mom() == [0.9]
