@@ -52,10 +52,13 @@ class GatheredParameters:
         self._host = None
 
     def __enter__(self):
-        if not self.enabled:
-            return self._tree
         src = (self._engine.state["params"] if self._engine is not None
                else self._tree)
+        if not self.enabled:
+            # reference semantics: no gather, no write-back — but the
+            # conditional-gather idiom still reads inside the block, so
+            # yield (read-only) host copies rather than None
+            return jax.tree.map(lambda x: np.array(x), src)
         self._host = jax.tree.map(lambda x: np.array(x), src)
         return self._host
 

@@ -103,3 +103,6 @@ def test_zero_public_api_surface(devices8):
     # read-only form: a bare pytree round-trips without error
     with GatheredParameters(engine.state["params"]) as host:
         assert float(np.asarray(host["wte"]).max()) == 0.25
+    # conditional-gather idiom: enabled=False still yields readable params
+    with GatheredParameters(engine, enabled=False) as host:
+        assert float(host["wte"].max()) == 0.25
