@@ -135,3 +135,22 @@ def test_lora_rejects_offload(devices8):
             model=wrap_lora(tiny_gpt2(), rank=2),
             config=base_config(zero_optimization={
                 "offload_optimizer": {"device": "cpu"}}))
+
+
+@pytest.mark.parametrize("prec", [{"bf16": {"enabled": True}},
+                                  {"fp16": {"enabled": True,
+                                            "initial_scale_power": 8}}])
+def test_lora_mixed_precision(devices8, prec):
+    """LoRA composes with the mixed-precision paths: masked optimizer +
+    loss scaling keep the base bit-frozen while adapters train."""
+    wrapped = wrap_lora(tiny_gpt2(), rank=4)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=wrapped, config={**base_config(), **prec,
+                               "zero_optimization": {"stage": 2}})
+    base_before = jax.tree.map(np.asarray, engine.state["params"]["base"])
+    losses = _train(engine, steps=3, seed=4)
+    assert np.isfinite(losses).all()
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        a, np.asarray(b)), base_before, engine.state["params"]["base"])
+    assert np.abs(np.asarray(
+        engine.state["params"]["lora"]["blocks/qkv_w"]["b"])).max() > 0
