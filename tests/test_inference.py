@@ -217,3 +217,32 @@ def test_neox_cached_generate_matches_nocache(devices8):
     b = eng.generate(prompts, max_new_tokens=12, do_sample=False,
                      use_cache=True)
     np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("family", ["neox", "bloom", "gptneo"])
+def test_generate_tp_new_serving_families(devices8, family):
+    """TP serving parity for the round-4 serving families: tp=2 cached
+    generation token-identical to tp=1 (same init seed)."""
+    from deepspeed_tpu.models.neox import neox_model
+    from deepspeed_tpu.models.bloom import bloom_model
+    from deepspeed_tpu.models.gptneo import gptneo_model
+    from deepspeed_tpu.comm import reset_topology
+    factories = {
+        "neox": lambda: neox_model("tiny", attention_impl="xla",
+                                   dtype="float32", max_seq_len=128),
+        "bloom": lambda: bloom_model("tiny", dtype="float32",
+                                     max_seq_len=128),
+        "gptneo": lambda: gptneo_model("tiny", dtype="float32",
+                                       max_seq_len=128, window_size=8),
+    }
+    reset_topology()
+    ref = deepspeed_tpu.init_inference(model=factories[family](),
+                                       config={"dtype": "float32"})
+    prompt = np.arange(1, 7, dtype=np.int32)[None]
+    a = ref.generate(prompt, max_new_tokens=8)
+    reset_topology()
+    tp = deepspeed_tpu.init_inference(
+        model=factories[family](),
+        config={"dtype": "float32", "tensor_parallel": {"tp_size": 2}})
+    b = tp.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(a, b)
