@@ -246,3 +246,28 @@ def test_generate_tp_new_serving_families(devices8, family):
         config={"dtype": "float32", "tensor_parallel": {"tp_size": 2}})
     b = tp.generate(prompt, max_new_tokens=8)
     np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("family", ["bloom", "gptneo"])
+def test_int8_kv_cache_new_serving_families(devices8, family):
+    """int8 KV cache composes with the ALiBi (bloom) and windowed
+    (gptneo) decode paths — greedy tokens track the fp cache closely."""
+    import jax as _jax
+    from deepspeed_tpu.models.bloom import bloom_model
+    from deepspeed_tpu.models.gptneo import gptneo_model
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    m = (bloom_model("tiny", dtype="float32", max_seq_len=128)
+         if family == "bloom" else
+         gptneo_model("tiny", dtype="float32", max_seq_len=128,
+                      window_size=8))
+    params = m.init(_jax.random.PRNGKey(0))
+    fp = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="float32"),
+                         model_parameters=params)
+    q8 = InferenceEngine(m, DeepSpeedInferenceConfig(
+        dtype="float32", kv_cache_dtype="int8"), model_parameters=params)
+    rng = np.random.default_rng(2)
+    prompts = rng.integers(1, 200, (2, 8)).astype(np.int32)
+    a = fp.generate(prompts, max_new_tokens=8, do_sample=False)
+    b = q8.generate(prompts, max_new_tokens=8, do_sample=False)
+    assert (np.asarray(a) == np.asarray(b)).mean() > 0.85
