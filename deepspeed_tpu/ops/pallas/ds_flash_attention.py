@@ -39,10 +39,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
     q_pos = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    segq = segq_ref[0]                                   # [Bq]
+    segq = segq_ref[0]                                   # [Bq, 1]
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     n_kblocks = (_causal_kblocks(iq, block_q, block_k, seq_len)
                  if causal else seq_len // block_k)
@@ -53,24 +53,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
         v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        segk = segk_ref[0, pl.dslice(j * block_k, block_k)]
-        mask = segq[:, None] == segk[None, :]
+        segk = segk_ref[0, :, pl.dslice(j * block_k, block_k)]   # [1, Bk]
+        mask = segq == segk
         if causal:
             mask &= q_pos >= (j * block_k + k_base)
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, 0.0)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + lax.dot_general(
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
     l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0, 0] = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
 
 
@@ -89,7 +89,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_pos = ik * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     q_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    segk = segk_ref[0, pl.dslice(ik * block_k, block_k)]
+    segk = segk_ref[0, :, pl.dslice(ik * block_k, block_k)]  # [1, Bk]
 
     dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dv0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
@@ -100,21 +100,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(jnp.float32)
         do = do_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]     # [Bq, 1]
         delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)]
         s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        segq = segq_ref[0, pl.dslice(j * block_q, block_q)]
-        mask = segq[:, None] == segk[None, :]
+        segq = segq_ref[0, pl.dslice(j * block_q, block_q)]      # [Bq, 1]
+        mask = segq == segk
         if causal:
             mask &= (j * block_q + q_base) >= k_pos
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_new = dv + lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk_new = dk + lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -139,12 +139,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
+    lse = lse_ref[0, 0]                                  # [Bq, 1]
     delta = delta_ref[0, 0]
     q_pos = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    segq = segq_ref[0]
+    segq = segq_ref[0]                                   # [Bq, 1]
 
     dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     n_kblocks = (_causal_kblocks(iq, block_q, block_k, seq_len)
@@ -155,14 +155,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
         s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        segk = segk_ref[0, pl.dslice(j * block_k, block_k)]
-        mask = segq[:, None] == segk[None, :]
+        segk = segk_ref[0, :, pl.dslice(j * block_k, block_k)]   # [1, Bk]
+        mask = segq == segk
         if causal:
             mask &= q_pos >= (j * block_k + k_base)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         return dq + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -261,6 +261,12 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
            else jnp.zeros((B, S), jnp.int32))
+    # TPU-legal layouts for per-row operands: segment ids travel twice —
+    # as a [B, S, 1] column (q side) and a [B, 1, S] row (k side) — so the
+    # in-kernel mask is a plain (Bq,1)==(1,Bk) broadcast; lse rides a
+    # trailing singleton dim (Mosaic requires the last two block dims to
+    # divide (8, 128) or equal the array dims — a bare [B, S] block fails)
+    seg_col, seg_row = seg[:, :, None], seg[:, None, :]
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
         seq_len=S)
@@ -272,19 +278,19 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
                          lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, 1, S, hd),
                          lambda b, h, i: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, bq), lambda b, h, i: (b, i)),
-            pl.BlockSpec((1, S), lambda b, h, i: (b, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
-        ])(qT, kT, vT, seg, seg)
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ])(qT, kT, vT, seg_col, seg_row)
     o = jnp.transpose(oT, (0, 2, 1, 3))
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k, res, do):
@@ -313,10 +319,9 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
     doT = _to_bhsd(do)
     seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
            else jnp.zeros((B, S), jnp.int32))
-
-    full = pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0))
-    full_s = pl.BlockSpec((1, 1, S), lambda b, h, i: (b, h, 0))
-    seg_full = pl.BlockSpec((1, S), lambda b, h, i: (b, 0))
+    # same TPU-legal layout scheme as the forward (see _fwd)
+    seg_col, seg_row = seg[:, :, None], seg[:, None, :]
+    lse4, delta4 = lse[..., None], delta[..., None]      # [B, H, S, 1]
 
     # dK/dV: Q-head-innermost grid; rep-group steps accumulate into the
     # shared (b, h//rep, i) fp32 output block
@@ -332,10 +337,10 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
             pl.BlockSpec((1, 1, bk, hd),
                          lambda b, i, h: (b, h // rep, i, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, h, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, h, 0)),
-            pl.BlockSpec((1, S), lambda b, i, h: (b, 0)),
-            pl.BlockSpec((1, S), lambda b, i, h: (b, 0))],
+            pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i, h: (b, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, 0, 0))],
         out_specs=[
             pl.BlockSpec((1, 1, bk, hd),
                          lambda b, i, h: (b, h // rep, i, 0)),
@@ -343,7 +348,7 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
                          lambda b, i, h: (b, h // rep, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32),
                    jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32)],
-    )(qT, kT, vT, doT, lse, delta, seg, seg)
+    )(qT, kT, vT, doT, lse4, delta4, seg_col, seg_row)
 
     dq_kernel = functools.partial(
         _dq_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
@@ -357,15 +362,15 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
             pl.BlockSpec((1, 1, S, hd),
                          lambda b, h, i: (b, h // rep, 0, 0)),
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, bq), lambda b, h, i: (b, i)),
-            seg_full,
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(
             (B, H, S, hd), jnp.float32 if keep_fp32 else q.dtype),
-    )(qT, kT, vT, doT, lse, delta, seg, seg)
+    )(qT, kT, vT, doT, lse4, delta4, seg_col, seg_row)
 
     dq = jnp.transpose(dqT, (0, 2, 1, 3))
     dk = jnp.transpose(dkT, (0, 2, 1, 3))
