@@ -57,7 +57,7 @@ def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
     if segment_ids is not None or not prefer_stock:
         from deepspeed_tpu.ops.pallas.ds_flash_attention import \
             ds_flash_attention
-        if not fallback or _ds_vmem_ok(q):
+        if not fallback or _ds_vmem_ok(q, segment_ids is not None):
             try:
                 return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                           causal=True)
@@ -79,15 +79,15 @@ def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
         return xla_causal_attention(q, k, v)
 
 
-def _ds_vmem_ok(q) -> bool:
+def _ds_vmem_ok(q, packed=False) -> bool:
     """VMEM-budget check for the from-scratch kernel's whole-S staging; the
     eval_shape probe cannot see Mosaic VMEM exhaustion, so oversized shapes
     are routed to the XLA path here (loudly, once per shape class)."""
     from deepspeed_tpu.ops.pallas.ds_flash_attention import vmem_fits
-    key = ("vmem", q.shape[1], q.shape[3], q.dtype.itemsize)
+    key = ("vmem", q.shape[1], q.shape[3], q.dtype.itemsize, packed)
     if key not in _FLASH_STATUS:
         _FLASH_STATUS[key] = vmem_fits(q.shape[1], q.shape[3],
-                                       q.dtype.itemsize)
+                                       q.dtype.itemsize, packed=packed)
         if _FLASH_STATUS[key] is not True:
             from deepspeed_tpu.utils.logging import logger
             logger.warning(
@@ -103,7 +103,7 @@ def _ds_vmem_ok(q) -> bool:
 _FLASH_STATUS = {}  # probe/guard result per shape-class key: True / message
 
 
-def _flash_usable(q, fn=None, k=None, ds=False) -> bool:
+def _flash_usable(q, fn=None, k=None, ds=False, packed=False) -> bool:
     """Probe the Pallas flash path once per shape class and remember the
     outcome.  A failure is logged loudly (never silently degraded — VERDICT
     round 1 flagged the silent except here) so a bench run on a slow fallback
@@ -113,7 +113,7 @@ def _flash_usable(q, fn=None, k=None, ds=False) -> bool:
     from deepspeed_tpu.utils.logging import logger
     fn = fn or flash_causal_attention
     kv = q if k is None else k
-    if ds and not _ds_vmem_ok(q):
+    if ds and not _ds_vmem_ok(q, packed=packed):
         return False
     key = (q.shape[1], q.shape[3], kv.shape[2],
            getattr(fn, "__name__", "bidirectional"))
@@ -150,7 +150,7 @@ def _local_causal_attention(q, k, v, impl: str = "auto", segment_ids=None):
             return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                       causal=True)
         if impl == "auto" and _on_tpu() and q.shape[1] >= 256 \
-                and _ds_vmem_ok(q):
+                and _ds_vmem_ok(q, packed=True):
             try:
                 return ds_flash_attention(q, k, v,
                                           segment_ids=segment_ids,
@@ -236,7 +236,7 @@ def bidirectional_attention(q, k, v, pad_mask=None, impl: str = "auto"):
         # padded: probe the segment-capable kernel the same (loudly
         # logged) way the unpadded path probes the stock wrapper
         if pad_mask is not None and _flash_usable(q, fn=flash_padded,
-                                                  ds=True):
+                                                  ds=True, packed=True):
             return flash_padded(q, k, v)
     return xla_bidirectional_attention(q, k, v, pad_mask)
 

@@ -32,14 +32,18 @@ def _causal_kblocks(iq, block_q, block_k, seq_len):
     return jnp.minimum((iq + 1) * block_q // block_k, seq_len // block_k)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_q, block_k, seq_len):
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, seq_len,
+                has_seg):
+    if has_seg:
+        q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale       # [Bq, hd]
     q_pos = iq * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    segq = segq_ref[0]                                   # [Bq, 1]
+    segq = segq_ref[0] if has_seg else None              # [Bq, 1]
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -53,15 +57,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
         v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        segk = segk_ref[0, :, pl.dslice(j * block_k, block_k)]   # [1, Bk]
-        mask = segq == segk
+        mask = None
+        if has_seg:
+            segk = segk_ref[0, :, pl.dslice(j * block_k, block_k)]  # [1,Bk]
+            mask = segq == segk
         if causal:
-            mask &= q_pos >= (j * block_k + k_base)
-        s = jnp.where(mask, s, NEG_INF)
+            cm = q_pos >= (j * block_k + k_base)
+            mask = cm if mask is None else (mask & cm)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -74,22 +83,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                segq_ref, segk_ref, dk_ref, dv_ref, *,
-                sm_scale, causal, block_q, block_k, seq_len, rep):
+def _dkv_kernel(*refs, sm_scale, causal, block_q, block_k, seq_len, rep,
+                has_seg):
     """Grid (B, S//block_k, H) with the Q-head dim INNERMOST: consecutive
     grid steps within one rep-group revisit the same dk/dv output block
     (index h//rep), which persists in VMEM — the kernel accumulates into
     it, so VMEM holds one head's tiles regardless of the GQA group size.
-    dk/dv outputs are fp32 (exact accumulation across the group)."""
+    dk/dv outputs are fp32 (exact accumulation across the group).
+
+    Scores live TRANSPOSED ([Bk, Bq] — k along sublanes, q along lanes) so
+    the per-q statistics (lse/delta) broadcast as cheap [1, Bq] rows: a
+    per-q [Bq, 1] column layout tile-pads the lane dim x128 and blows the
+    VMEM budget at long S (16k-fp32-class working sets)."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
     ik = pl.program_id(1)
     ih = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)                  # [Bk, hd]
     v = v_ref[0, 0].astype(jnp.float32)
     k_pos = ik * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    q_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    segk = segk_ref[0, :, pl.dslice(ik * block_k, block_k)]  # [1, Bk]
+        jnp.int32, (block_k, block_q), 0)
+    q_base = lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
+    segk = segk_ref[0] if has_seg else None              # [Bk, 1]
 
     dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dv0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
@@ -100,23 +119,28 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(jnp.float32)
         do = do_ref[0, 0, pl.dslice(j * block_q, block_q)].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(j * block_q, block_q)]     # [Bq, 1]
-        delta = delta_ref[0, 0, pl.dslice(j * block_q, block_q)]
-        s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        segq = segq_ref[0, pl.dslice(j * block_q, block_q)]      # [Bq, 1]
-        mask = segq == segk
+        lse = lse_ref[0, 0, :, pl.dslice(j * block_q, block_q)]  # [1, Bq]
+        delta = delta_ref[0, 0, :, pl.dslice(j * block_q, block_q)]
+        s_t = lax.dot_general(k, q * sm_scale, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # [Bk,Bq]
+        mask = None
+        if has_seg:
+            segq = segq_ref[0, :, pl.dslice(j * block_q, block_q)]  # [1,Bq]
+            mask = segk == segq
         if causal:
-            mask &= (j * block_q + q_base) >= k_pos
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            cm = (j * block_q + q_base) >= k_pos
+            mask = cm if mask is None else (mask & cm)
+        p_t = jnp.exp(s_t - lse)
+        if mask is not None:
+            p_t = jnp.where(mask, p_t, 0.0)
         dv_new = dv + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_t, do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        dp_t = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta) * sm_scale
         dk_new = dk + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds_t, q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -133,18 +157,28 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_ref[0, 0] + dv
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               segq_ref, segk_ref, dq_ref, *,
-               sm_scale, causal, block_q, block_k, seq_len):
+def _dq_kernel(*refs, sm_scale, causal, block_q, block_k, seq_len,
+               has_seg):
+    """Transposed score space, like _dkv_kernel (lse/delta as [1, Bq]
+    rows); the dq accumulator itself stays [Bq, hd] (contraction over the
+    sublane k dim of ds_t)."""
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         segq_ref, segk_ref, dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
     iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                                  # [Bq, 1]
-    delta = delta_ref[0, 0]
+    # rows staged whole-S (always lane-legal: S == array dim) and sliced
+    # by the q-block index here — a [1, Bq] block would need bq % 128 == 0
+    qs = pl.dslice(iq * block_q, block_q)
+    lse = lse_ref[0, 0, :, qs]                           # [1, Bq]
+    delta = delta_ref[0, 0, :, qs]
     q_pos = iq * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_base = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    segq = segq_ref[0]                                   # [Bq, 1]
+        jnp.int32, (block_k, block_q), 1)
+    k_base = lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
+    segq = segq_ref[0, :, qs] if has_seg else None       # [1, Bq]
 
     dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     n_kblocks = (_causal_kblocks(iq, block_q, block_k, seq_len)
@@ -153,18 +187,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(j, dq):
         k = k_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
         v = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
-        s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        segk = segk_ref[0, :, pl.dslice(j * block_k, block_k)]   # [1, Bk]
-        mask = segq == segk
+        s_t = lax.dot_general(k, q * sm_scale, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # [Bk,Bq]
+        mask = None
+        if has_seg:
+            segk = segk_ref[0, pl.dslice(j * block_k, block_k)]  # [Bk, 1]
+            mask = segk == segq
         if causal:
-            mask &= q_pos >= (j * block_k + k_base)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+            cm = q_pos >= (j * block_k + k_base)
+            mask = cm if mask is None else (mask & cm)
+        p_t = jnp.exp(s_t - lse)
+        if mask is not None:
+            p_t = jnp.where(mask, p_t, 0.0)
+        dp_t = lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta) * sm_scale
         return dq + lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds_t, k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, n_kblocks, body, dq0)
@@ -194,18 +233,20 @@ def _choose_blocks(seq_len, block_q, block_k):
 
 
 def vmem_fits(seq_len, head_dim, itemsize, block_q=512, block_k=512,
-              budget_bytes=None):
+              budget_bytes=None, packed=False):
     """Whether one (batch, head) grid step's VMEM working set fits on-core.
 
     The kernels stage the full-sequence K/V (forward/dq) or Q/dO (dk/dv
     pass) per grid step via whole-S BlockSpecs, so the dominant term is
-    2*S*hd*itemsize; Pallas double-buffers the pipelined blocks, hence the
-    factor 2 on top, plus per-row fp32 lse/delta/segments and the
-    [block_q, hd] tiles.  The dispatch layer calls this before selecting
-    the kernel — ``jax.eval_shape`` probes only shapes and would pass a
-    16k-fp32 sequence that Mosaic then rejects at compile time (advisor
-    round 3).  Budget defaults to 12 MiB of the ~16 MiB/core VMEM;
-    override with DS_FLASH_VMEM_MB."""
+    2*S*hd_padded*itemsize (the lane dim pads to a multiple of 128);
+    Pallas double-buffers the pipelined blocks, hence the factor 2 on
+    top, plus the [1, S] fp32 lse/delta rows (sublane-padded x8) and the
+    block tiles.  ``packed`` adds the dq pass's whole-S segment column,
+    whose single-lane layout pads x128.  The dispatch layer calls this
+    before selecting the kernel — ``jax.eval_shape`` probes only shapes
+    and would pass a 16k-fp32 sequence that Mosaic then rejects at
+    compile time (advisor round 3).  Budget defaults to 12 MiB of the
+    ~16 MiB/core VMEM; override with DS_FLASH_VMEM_MB."""
     import os
     if budget_bytes is None:
         budget_bytes = int(os.environ.get("DS_FLASH_VMEM_MB", "12")) << 20
@@ -213,9 +254,12 @@ def vmem_fits(seq_len, head_dim, itemsize, block_q=512, block_k=512,
         bq, bk = _choose_blocks(seq_len, block_q, block_k)
     except ValueError:
         return False
-    full_kv = 2 * seq_len * head_dim * itemsize      # K+V (or Q+dO) whole-S
-    rows = 16 * seq_len                              # lse/delta/2×segments
-    tiles = (bq + bk) * head_dim * (itemsize + 2 * 4)  # in tiles + fp32 acc
+    hd_pad = -(-head_dim // 128) * 128
+    full_kv = 2 * seq_len * hd_pad * itemsize        # K+V (or Q+dO) whole-S
+    rows = 2 * 8 * seq_len * 4                       # lse+delta [1,S] fp32
+    if packed:
+        rows += seq_len * 128 * 4                    # dq segk [S,1] column
+    tiles = (bq + bk) * hd_pad * (itemsize + 2 * 4)  # in tiles + fp32 acc
     return 2 * (full_kv + rows) + tiles <= budget_bytes
 
 
@@ -223,26 +267,42 @@ def ds_flash_attention(q, k, v, segment_ids=None, causal=True,
                        sm_scale=None, block_q=512, block_k=512):
     """q [B, S, H, hd], k/v [B, S, KV, hd] -> [B, S, H, hd].  KV may
     divide H (grouped-query attention — KV streams once per group).
-    ``segment_ids``: None or a [B, S] int array; packed sequences attend
-    only within their own segment (non-differentiable — it rides the VJP
-    closure)."""
+    ``segment_ids``: None or a [B, S] array (any integer or float dtype —
+    cast to int32 here, ONCE, so the custom_vjp's float0 cotangent always
+    matches an integer primal); packed sequences attend only within their
+    own segment (non-differentiable — a proper custom_vjp argument, NOT a
+    closure capture: closed-over tracers break under jit/scan train
+    steps)."""
+    if segment_ids is not None:
+        segment_ids = segment_ids.astype(jnp.int32)
+    return _ds_flash(q, k, v, segment_ids, causal, sm_scale, block_q,
+                     block_k)
 
-    @jax.custom_vjp
-    def f(q, k, v):
-        o, _ = _fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
-                    block_k)
-        return o
 
-    def fwd(q, k, v):
-        return _fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
-                    block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ds_flash(q, k, v, segment_ids, causal, sm_scale, block_q, block_k):
+    o, _ = _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k)
+    return o
 
-    def bwd(res, do):
-        return _bwd_rule(segment_ids, causal, sm_scale, block_q, block_k,
-                         res, do)
 
-    f.defvjp(fwd, bwd)
-    return f(q, k, v)
+def _ds_flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
+                  block_k):
+    o, res = _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k)
+    return o, (res, segment_ids)
+
+
+def _ds_flash_bwd(causal, sm_scale, block_q, block_k, res_seg, do):
+    res, segment_ids = res_seg
+    dq, dk, dv = _bwd_rule(segment_ids, causal, sm_scale, block_q,
+                           block_k, res, do)
+    if segment_ids is None:
+        return dq, dk, dv, None
+    import numpy as np
+    dseg = np.zeros(segment_ids.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+_ds_flash.defvjp(_ds_flash_fwd, _ds_flash_bwd)
 
 
 def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
@@ -259,28 +319,33 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
     sm = sm_scale if sm_scale is not None else hd ** -0.5
     bq, bk = _choose_blocks(S, block_q, block_k)
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
-           else jnp.zeros((B, S), jnp.int32))
-    # TPU-legal layouts for per-row operands: segment ids travel twice —
-    # as a [B, S, 1] column (q side) and a [B, 1, S] row (k side) — so the
-    # in-kernel mask is a plain (Bq,1)==(1,Bk) broadcast; lse rides a
-    # trailing singleton dim (Mosaic requires the last two block dims to
-    # divide (8, 128) or equal the array dims — a bare [B, S] block fails)
-    seg_col, seg_row = seg[:, :, None], seg[:, None, :]
+    has_seg = segment_ids is not None
+    # TPU-legal layouts for per-row operands (Mosaic requires the last two
+    # block dims to divide (8, 128) or equal the array dims — a bare
+    # [B, S] block fails): segment ids (int32, cast once in the public
+    # wrapper) travel twice — as a [B, S, 1] column (q side) and a
+    # [B, 1, S] row (k side) — so the in-kernel mask is a plain
+    # (Bq,1)==(1,Bk) broadcast; lse rides a trailing singleton dim.
+    # Unpacked batches drop the segment operands entirely.
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
-        seq_len=S)
+        seq_len=S, has_seg=has_seg)
+    operands = [qT, kT, vT]
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, S, hd),
+                     lambda b, h, i: (b, h // rep, 0, 0)),
+        pl.BlockSpec((1, 1, S, hd),
+                     lambda b, h, i: (b, h // rep, 0, 0)),
+    ]
+    if has_seg:
+        seg = segment_ids
+        operands += [seg[:, :, None], seg[:, None, :]]
+        in_specs += [pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
+                     pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0))]
     oT, lse = pl.pallas_call(
         kernel, grid=(B, H, S // bq), **_ikw,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, S, hd),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, S, hd),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
@@ -288,7 +353,7 @@ def _fwd(q, k, v, segment_ids, causal, sm_scale, block_q, block_k,
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
             jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
-        ])(qT, kT, vT, seg_col, seg_row)
+        ])(*operands)
     o = jnp.transpose(oT, (0, 2, 1, 3))
     return o, (q, k, v, o, lse[..., 0])
 
@@ -317,30 +382,55 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
     bq, bk = _choose_blocks(S, block_q, block_k)
     qT, kT, vT = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     doT = _to_bhsd(do)
-    seg = (segment_ids.astype(jnp.int32) if segment_ids is not None
-           else jnp.zeros((B, S), jnp.int32))
-    # same TPU-legal layout scheme as the forward (see _fwd)
-    seg_col, seg_row = seg[:, :, None], seg[:, None, :]
-    lse4, delta4 = lse[..., None], delta[..., None]      # [B, H, S, 1]
+    has_seg = segment_ids is not None
+    # per-q stats travel as [B, H, 1, S] ROWS (sublane-padded x8, vs the
+    # x128 lane padding a [..., S, 1] column layout would cost in both
+    # VMEM and HBM); the backward kernels consume them transposed
+    lse_r = lse[:, :, None, :]
+    delta_r = delta[:, :, None, :]
 
     # dK/dV: Q-head-innermost grid; rep-group steps accumulate into the
     # shared (b, h//rep, i) fp32 output block
     dkv_kernel = functools.partial(
         _dkv_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
-        seq_len=S, rep=rep)
+        seq_len=S, rep=rep, has_seg=has_seg)
+    dkv_in = [qT, kT, vT, doT, lse_r, delta_r]
+    dkv_specs = [
+        pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, i, h: (b, h // rep, i, 0)),
+        pl.BlockSpec((1, 1, bk, hd),
+                     lambda b, i, h: (b, h // rep, i, 0)),
+        pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, S), lambda b, i, h: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, S), lambda b, i, h: (b, h, 0, 0))]
+    dq_in = [qT, kT, vT, doT, lse_r, delta_r]
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, S, hd),
+                     lambda b, h, i: (b, h // rep, 0, 0)),
+        pl.BlockSpec((1, 1, S, hd),
+                     lambda b, h, i: (b, h // rep, 0, 0)),
+        pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, 1, S), lambda b, h, i: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, S), lambda b, h, i: (b, h, 0, 0)),
+    ]
+    if has_seg:
+        seg = segment_ids
+        seg_col, seg_row = seg[:, :, None], seg[:, None, :]
+        # dkv: segq row slices [1, Bq] (whole-S row), segk column block
+        # [Bk, 1] indexed by the k grid dim (no whole-S column staging)
+        dkv_in += [seg_row, seg_col]
+        dkv_specs += [pl.BlockSpec((1, 1, S), lambda b, i, h: (b, 0, 0)),
+                      pl.BlockSpec((1, bk, 1), lambda b, i, h: (b, i, 0))]
+        # dq: segq whole-S row (sliced [1, Bq] in-kernel), segk whole-S
+        # column (sliced [Bk, 1] per key block in-kernel)
+        dq_in += [seg_row, seg_col]
+        dq_specs += [pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+                     pl.BlockSpec((1, S, 1), lambda b, h, i: (b, 0, 0))]
     dkT, dvT = pl.pallas_call(
         dkv_kernel, grid=(B, S // bk, H), **_ikw,
-        in_specs=[
-            pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, i, h: (b, h // rep, i, 0)),
-            pl.BlockSpec((1, 1, bk, hd),
-                         lambda b, i, h: (b, h // rep, i, 0)),
-            pl.BlockSpec((1, 1, S, hd), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, 1), lambda b, i, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, S, 1), lambda b, i, h: (b, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, i, h: (b, 0, 0))],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, hd),
                          lambda b, i, h: (b, h // rep, i, 0)),
@@ -348,29 +438,18 @@ def _bwd_calls(q, k, v, do, lse, delta, segment_ids, causal, sm_scale,
                          lambda b, i, h: (b, h // rep, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32),
                    jax.ShapeDtypeStruct((B, KV, S, hd), jnp.float32)],
-    )(qT, kT, vT, doT, lse4, delta4, seg_col, seg_row)
+    )(*dkv_in)
 
     dq_kernel = functools.partial(
         _dq_kernel, sm_scale=sm, causal=causal, block_q=bq, block_k=bk,
-        seq_len=S)
+        seq_len=S, has_seg=has_seg)
     dqT = pl.pallas_call(
         dq_kernel, grid=(B, H, S // bq), **_ikw,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, S, hd),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, S, hd),
-                         lambda b, h, i: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, h, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(
             (B, H, S, hd), jnp.float32 if keep_fp32 else q.dtype),
-    )(qT, kT, vT, doT, lse4, delta4, seg_col, seg_row)
+    )(*dq_in)
 
     dq = jnp.transpose(dqT, (0, 2, 1, 3))
     dk = jnp.transpose(dkT, (0, 2, 1, 3))

@@ -24,8 +24,8 @@ import jax.numpy as jnp
 
 
 def main():
-    on_tpu = jax.devices()[0].platform == "tpu" or \
-        "tpu" in str(jax.devices()[0]).lower()
+    from deepspeed_tpu.ops.attention import _on_tpu
+    on_tpu = _on_tpu()
     if on_tpu:
         B = int(os.environ.get("FLASH_AB_B", 12))
         S = int(os.environ.get("FLASH_AB_S", 1024))
@@ -97,9 +97,7 @@ def main():
     # THIS chip's bf16 peak bounds any sane reading (2x headroom for
     # slope noise); a negative slope means tunnel jitter swallowed the
     # measurement
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from bench import chip_peak_tflops
+    from bench import chip_peak_tflops    # repo root on sys.path (line 19)
     timing_suspect = on_tpu and (
         mm_ms <= 0 or mm_tflops > 2.0 * chip_peak_tflops())
     print(json.dumps({"calibration": "matmul", "ms": round(mm_ms, 4),

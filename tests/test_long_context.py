@@ -488,8 +488,9 @@ def test_ds_flash_vmem_guard_routes_oversized_to_xla():
                                                       segment_ids=s),
         q, q, q, seg)
     assert out.shape == (B, S, H, hd)
-    key = ("vmem", S, hd, 4)
-    assert att._FLASH_STATUS.get(key) is not True  # guard fired
+    key = ("vmem", S, hd, 4, True)
+    assert key in att._FLASH_STATUS          # guard probed this shape
+    assert att._FLASH_STATUS[key] is not True  # and fired (routed away)
     att._FLASH_STATUS.clear()
 
 
@@ -622,3 +623,30 @@ def test_packed_training_through_engine(devices8):
         loss = engine.train_batch(batch={"input_ids": ids,
                                          "segment_ids": seg})
         assert np.isfinite(float(loss))
+
+
+def test_ds_flash_packed_segment_ids_are_tracer_safe(interpret_pallas):
+    """Packed segment_ids must ride the kernel as a real custom_vjp
+    argument: a closure capture breaks with 'No constant handler for
+    DynamicJaxprTracer' once a jitted train step scans the blocks and
+    segment_ids is a tracer (caught on the first real-TPU packed train
+    drive, round 4 — unit tests only ever called the kernel with concrete
+    arrays).  eval_shape reproduces the exact failure mode (tracing)
+    without executing."""
+    from deepspeed_tpu.ops.pallas.ds_flash_attention import \
+        ds_flash_attention
+
+    B, S, H, hd = 1, 512, 2, 64
+
+    def step(q, seg):
+        def body(x, _):
+            o = ds_flash_attention(x, x, x, segment_ids=seg, causal=True)
+            return o, None
+        out, _ = jax.lax.scan(body, q, None, length=2)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    grad_fn = jax.jit(jax.grad(step))
+    q = jax.ShapeDtypeStruct((B, S, H, hd), jnp.bfloat16)
+    seg = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    dq = jax.eval_shape(grad_fn, q, seg)
+    assert dq.shape == (B, S, H, hd)
