@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, maybe_stream, scan_blocks
+from deepspeed_tpu.models.model import Model, maybe_stream, scan_blocks, resolve_size
 from deepspeed_tpu.ops.attention import bidirectional_attention
 
 
@@ -216,7 +216,7 @@ def count_params(config: BertConfig) -> int:
 
 
 def bert_model(size: str = "base", **overrides) -> Model:
-    cfg_kwargs = dict(BERT_SIZES[size]) if size in BERT_SIZES else {}
+    cfg_kwargs = resolve_size(BERT_SIZES, size, "bert")
     cfg_kwargs.update(overrides)
     config = BertConfig(**cfg_kwargs)
     n_params = count_params(config)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, resolve_size
 from deepspeed_tpu.models.neox import _ln
 
 
@@ -233,7 +233,7 @@ def _serving_fns(config: BloomConfig):
 
 
 def bloom_model(size: str = "tiny", **overrides) -> Model:
-    cfg_kwargs = dict(BLOOM_SIZES[size]) if size in BLOOM_SIZES else {}
+    cfg_kwargs = resolve_size(BLOOM_SIZES, size, "bloom")
     cfg_kwargs.update(overrides)
     config = BloomConfig(**cfg_kwargs)
     n_params = count_params(config)

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, maybe_stream
+from deepspeed_tpu.models.model import Model, maybe_stream, resolve_size
 from deepspeed_tpu.ops.attention import causal_attention
 
 
@@ -432,7 +432,7 @@ def head(params, x, config: GPT2Config):
 
 
 def gpt2_model(size: str = "125m", **overrides) -> Model:
-    cfg_kwargs = dict(GPT2_SIZES[size]) if size in GPT2_SIZES else {}
+    cfg_kwargs = resolve_size(GPT2_SIZES, size, "gpt2")
     cfg_kwargs.update(overrides)
     config = GPT2Config(**cfg_kwargs)
     n_params = count_params(config)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, resolve_size
 from deepspeed_tpu.models import gpt2 as _g
 
 
@@ -169,7 +169,7 @@ def gptneo_model(size: str = "tiny", **overrides) -> Model:
         "2.7b": dict(vocab_size=50257, max_seq_len=2048, num_layers=32,
                      num_heads=20, d_model=2560),
     }
-    cfg_kwargs = dict(sizes[size]) if size in sizes else {}
+    cfg_kwargs = resolve_size(sizes, size, "gptneo")
     cfg_kwargs.update(overrides)
     config = GPTNeoConfig(**cfg_kwargs)
     g2 = _gpt2_cfg(config)

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, resolve_size
 from deepspeed_tpu.ops.attention import causal_attention
 
 
@@ -317,7 +317,7 @@ def head(params, x, config: LlamaConfig):
 
 
 def llama_model(size: str = "7b", **overrides) -> Model:
-    cfg_kwargs = dict(LLAMA_SIZES[size]) if size in LLAMA_SIZES else {}
+    cfg_kwargs = resolve_size(LLAMA_SIZES, size, "llama")
     cfg_kwargs.update(overrides)
     config = LlamaConfig(**cfg_kwargs)
     n_params = count_params(config)

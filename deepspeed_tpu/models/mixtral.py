@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, resolve_size
 from deepspeed_tpu.models.llama import _rms_norm, rope
 from deepspeed_tpu.moe.layer import MoEConfig, moe_layer
 from deepspeed_tpu.moe.sharded_moe import topkgating
@@ -235,7 +235,7 @@ def count_params(config: MixtralConfig) -> int:
 
 def mixtral_model(size: str = "8x7b", **overrides) -> Model:
     import optax
-    cfg_kwargs = dict(MIXTRAL_SIZES[size]) if size in MIXTRAL_SIZES else {}
+    cfg_kwargs = resolve_size(MIXTRAL_SIZES, size, "mixtral")
     cfg_kwargs.update(overrides)
     config = MixtralConfig(**cfg_kwargs)
     n_params = count_params(config)

@@ -225,6 +225,20 @@ def scan_blocks(block_fn, x, blocks, rng, batch, num_layers: int,
     return out
 
 
+def resolve_size(sizes: dict, size: str, family: str) -> dict:
+    """Look up a size preset, refusing typos: an unknown ``size`` silently
+    falling through to the dataclass defaults once shipped a 50M-param
+    default NeoX into a serving benchmark labelled 160M (round-4 PERF).
+    ``size="custom"`` opts into defaults+overrides explicitly."""
+    if size in sizes:
+        return dict(sizes[size])
+    if size == "custom":
+        return {}
+    raise ValueError(
+        f"{family}: unknown size {size!r}; valid sizes: "
+        f"{sorted(sizes)} or 'custom' (config defaults + overrides)")
+
+
 @dataclass
 class Model:
     config: Any = None

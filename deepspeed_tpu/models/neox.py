@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model
+from deepspeed_tpu.models.model import Model, resolve_size
 from deepspeed_tpu.models.llama import rope
 from deepspeed_tpu.ops.attention import causal_attention
 
@@ -248,7 +248,7 @@ def _serving_fns(config: NeoXConfig):
 
 
 def neox_model(size: str = "tiny", **overrides) -> Model:
-    cfg_kwargs = dict(NEOX_SIZES[size]) if size in NEOX_SIZES else {}
+    cfg_kwargs = resolve_size(NEOX_SIZES, size, "neox")
     cfg_kwargs.update(overrides)
     config = NeoXConfig(**cfg_kwargs)
     n_params = count_params(config)

@@ -93,15 +93,16 @@ def main():
     mm = jax.jit(lambda x: jnp.tanh(x @ w))
     mm_ms = timed_chain(mm, w, steps)
     mm_tflops = (2 * calib_n ** 3 / (mm_ms * 1e-3) / 1e12
-                 if mm_ms > 0 else float("inf"))
+                 if mm_ms > 0 else None)
     # THIS chip's bf16 peak bounds any sane reading (2x headroom for
     # slope noise); a negative slope means tunnel jitter swallowed the
     # measurement
     from bench import chip_peak_tflops    # repo root on sys.path (line 19)
     timing_suspect = on_tpu and (
-        mm_ms <= 0 or mm_tflops > 2.0 * chip_peak_tflops())
+        mm_tflops is None or mm_tflops > 2.0 * chip_peak_tflops())
     print(json.dumps({"calibration": "matmul", "ms": round(mm_ms, 4),
-                      "apparent_tflops": round(mm_tflops, 1),
+                      "apparent_tflops": (round(mm_tflops, 1)
+                                          if mm_tflops else None),
                       "timing_suspect": timing_suspect}))
 
     causal = True
