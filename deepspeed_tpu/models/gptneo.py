@@ -158,8 +158,7 @@ def _serving_fns(config: GPTNeoConfig):
     )
 
 
-def gptneo_model(size: str = "tiny", **overrides) -> Model:
-    sizes = {
+GPTNEO_SIZES = {
         "tiny": dict(vocab_size=256, max_seq_len=64, num_layers=2,
                      num_heads=4, d_model=32, window_size=16),
         "125m": dict(vocab_size=50257, max_seq_len=2048, num_layers=12,
@@ -168,8 +167,11 @@ def gptneo_model(size: str = "tiny", **overrides) -> Model:
                      num_heads=16, d_model=2048),
         "2.7b": dict(vocab_size=50257, max_seq_len=2048, num_layers=32,
                      num_heads=20, d_model=2560),
-    }
-    cfg_kwargs = resolve_size(sizes, size, "gptneo")
+}
+
+
+def gptneo_model(size: str = "tiny", **overrides) -> Model:
+    cfg_kwargs = resolve_size(GPTNEO_SIZES, size, "gptneo")
     cfg_kwargs.update(overrides)
     config = GPTNeoConfig(**cfg_kwargs)
     g2 = _gpt2_cfg(config)

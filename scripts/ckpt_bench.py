@@ -1,0 +1,49 @@
+"""Async-checkpoint overlap bench: steps/s with an in-flight save vs sync save."""
+import json, os, shutil, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_model
+
+def run(async_save):
+    tag_dir = f"/tmp/ckpt_bench_{'async' if async_save else 'sync'}"
+    shutil.rmtree(tag_dir, ignore_errors=True)
+    model = gpt2_model("350m", max_seq_len=1024, dtype="bfloat16", remat=True)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 12, "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True}, "zero_optimization": {"stage": 2},
+        "checkpoint": {"async_save": bool(async_save)},
+        "steps_per_print": 0})
+    rng = np.random.default_rng(0)
+    def batch():
+        return {"input_ids": rng.integers(0, 50257, size=(1, 12, 1024), dtype=np.int32)}
+    for _ in range(3):
+        loss = engine.train_batch(batch=batch())
+    float(loss)
+    # baseline steps/s without a save
+    t0 = time.time()
+    for _ in range(6):
+        loss = engine.train_batch(batch=batch())
+    float(loss); base = (time.time() - t0) / 6
+
+    # save + train while in flight
+    t0 = time.time()
+    engine.save_checkpoint(tag_dir, tag="t0")
+    t_save_call = time.time() - t0
+    t0 = time.time()
+    for _ in range(6):
+        loss = engine.train_batch(batch=batch())
+    float(loss)
+    during = (time.time() - t0) / 6
+    # commit barrier (async waits here; sync already durable)
+    t0 = time.time()
+    engine.wait_pending_checkpoint()
+    barrier = time.time() - t0
+    return {"mode": "async" if async_save else "sync",
+            "baseline_step_s": round(base, 3),
+            "save_call_s": round(t_save_call, 3),
+            "step_s_during_save": round(during, 3),
+            "commit_barrier_s": round(barrier, 3)}
+
+print(json.dumps(run(async_save=bool(int(os.environ.get("ASYNC", "1"))))))

@@ -1,0 +1,48 @@
+"""RLHF loop rate: LoRA train step + fused-weight generate, measuring the
+rebind cost per policy update (queue item: expect ~zero vs full re-cast)."""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+from deepspeed_tpu.runtime.lora import wrap_lora
+from deepspeed_tpu.models.gpt2 import gpt2_model
+
+model = wrap_lora(gpt2_model("350m", max_seq_len=512, dtype="bfloat16",
+                             remat=True), rank=16, alpha=32.0)
+engine = DeepSpeedHybridEngine(config={
+    "train_micro_batch_size_per_gpu": 8, "gradient_accumulation_steps": 1,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+    "bf16": {"enabled": True}, "zero_optimization": {"stage": 2},
+    "steps_per_print": 0}, model=model)
+rng = np.random.default_rng(0)
+def batch():
+    return {"input_ids": rng.integers(0, 50257, size=(1, 8, 512), dtype=np.int32)}
+prompts = rng.integers(1, 50257, (4, 64)).astype(np.int32)
+
+# warm both paths
+float(engine.train_batch(batch=batch()))
+np.asarray(engine.generate(prompts, max_new_tokens=32))
+float(engine.train_batch(batch=batch()))
+np.asarray(engine.generate(prompts, max_new_tokens=32))
+
+# train-only rate
+t0 = time.time()
+for _ in range(5): loss = engine.train_batch(batch=batch())
+float(loss); train_s = (time.time() - t0) / 5
+
+# full RLHF cycle: train step + rebind + generate 32 tokens
+t0 = time.time()
+for _ in range(3):
+    loss = engine.train_batch(batch=batch())
+    toks = np.asarray(engine.generate(prompts, max_new_tokens=32))
+cycle_s = (time.time() - t0) / 3
+
+# generate-only (no intervening update -> no rebind)
+t0 = time.time()
+for _ in range(3):
+    toks = np.asarray(engine.generate(prompts, max_new_tokens=32))
+gen_s = (time.time() - t0) / 3
+rebind_s = cycle_s - train_s - gen_s
+print(json.dumps({"model": "gpt2-350m+lora16", "train_step_s": round(train_s,3),
+                  "generate32_s": round(gen_s,3), "rlhf_cycle_s": round(cycle_s,3),
+                  "rebind_overhead_s": round(rebind_s,3)}))
