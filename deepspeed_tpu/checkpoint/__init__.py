@@ -1,0 +1,10 @@
+"""Reference-checkpoint interop (mirrors the reference's
+``deepspeed.checkpoint`` package): torch-free readers for existing
+DeepSpeed/Megatron checkpoint directories and ZeRO fp32 reconstruction."""
+from deepspeed_tpu.checkpoint.torch_pickle import load_pt
+from deepspeed_tpu.checkpoint.ds_ingest import (
+    DeepSpeedCheckpoint, load_reference_checkpoint, merge_tp_shards,
+    megatron_gpt_from_ds_dir)
+
+__all__ = ["load_pt", "DeepSpeedCheckpoint", "load_reference_checkpoint",
+           "merge_tp_shards", "megatron_gpt_from_ds_dir"]

@@ -18,8 +18,28 @@ Cache pytree: ``{"k","v": [L,B,S,KV,hd]}``, plus ``{"k_s","v_s":
 [L,B,S,KV] fp32}`` when the cache dtype is "int8" (per-vector symmetric
 scales, ops/pallas/decode_attention.py helpers).
 """
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def write_token(c, l, new, lengths):
+    """Write one decode step's vectors ``new`` [B, ...] at per-row fill
+    positions ``lengths`` [B] into layer ``l`` of the stacked cache
+    ``c`` [L, B, S, ...].
+
+    Formulated as a one-hot select over the layer slice + a static-index
+    dynamic_update_slice — NOT a scatter: on TPU the batched scatter
+    lowering costs ~0.6 ms/step for a 12-layer model where this select
+    costs ~0.1 ms (measured, scripts/decode_profile.py; the select is one
+    fused VPU pass at layer-slice bandwidth and updates in place inside
+    the decode loop carry)."""
+    S = c.shape[2]
+    m = jnp.arange(S)[None, :] == lengths[:, None]          # [B, S]
+    m = m.reshape(m.shape + (1,) * (c.ndim - 3))
+    upd = jnp.where(m, new[:, None].astype(c.dtype), c[l])
+    return lax.dynamic_update_slice(
+        c, upd[None], (l,) + (0,) * (c.ndim - 1))
 
 
 def init_cache(num_layers, num_kv_heads, head_dim, batch_size, max_len,
@@ -79,47 +99,47 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     """One decode step: tokens [B], lengths [B] current fill counts.
     Rotary positions are per-row; the GQA cache stays compact (KV heads) —
     the decode kernel handles the query-group mapping.  ``alibi_slopes``
-    [H] selects the BLOOM additive-bias form in the decode kernel."""
-    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    [H] selects the BLOOM additive-bias form in the decode kernel.
+
+    The layer loop is python-unrolled (not lax.scan): decode is
+    latency-bound, and the scan form dynamic-slices every layer's weights
+    (an extra weight-bandwidth copy per token) and double-buffers the full
+    cache through xs/ys.  Unroll + in-place one-hot writes measured
+    2.2x faster end-to-end (scripts/decode_profile.py)."""
+    from deepspeed_tpu.models.model import maybe_stream
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, quantize_kv)
     B = tokens.shape[0]
     H = num_heads
     x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
-    rows = jnp.arange(B)
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
-    def body(carry, layer_kv):
-        if quantized:
-            layer, kc, vc, ksc, vsc = layer_kv
-        else:
-            layer, kc, vc = layer_kv
-            ksc = vsc = None
-        from deepspeed_tpu.models.model import maybe_stream
-        layer = maybe_stream(layer)      # dequant / host-stream per layer
-        q, kk, v = qkv_fn(carry[:, None, :], layer, lengths[:, None])
+    kc, vc = cache["k"], cache["v"]
+    ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
+    L = kc.shape[0]
+    for l in range(L):
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]))
+        q, kk, v = qkv_fn(x[:, None, :], layer, lengths[:, None])
         hd = q.shape[-1]
         if quantized:
-            from deepspeed_tpu.ops.pallas.decode_attention import (
-                quantize_token_into_cache)
-            kc, vc, ksc, vsc = quantize_token_into_cache(
-                kc, vc, ksc, vsc, rows, lengths, kk[:, 0], v[:, 0])
+            kq, ks1 = quantize_kv(kk[:, 0])
+            vq, vs1 = quantize_kv(v[:, 0])
+            kc = write_token(kc, l, kq, lengths)
+            vc = write_token(vc, l, vq, lengths)
+            ksc = write_token(ksc, l, ks1, lengths)
+            vsc = write_token(vsc, l, vs1, lengths)
         else:
-            kc = kc.at[rows, lengths].set(kk[:, 0].astype(kc.dtype))
-            vc = vc.at[rows, lengths].set(v[:, 0].astype(vc.dtype))
-        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
-                                k_scale=ksc, v_scale=vsc,
-                                alibi_slopes=alibi_slopes)
-        out = finish_fn(carry[:, None, :],
-                        attn.reshape(B, 1, H * hd).astype(carry.dtype),
-                        layer)[:, 0, :]
-        return out, ((kc, vc, ksc, vsc) if quantized else (kc, vc))
-
-    xs = (params["blocks"], cache["k"], cache["v"])
-    if quantized:
-        xs += (cache["k_s"], cache["v_s"])
-    x, ys = lax.scan(body, x, xs)
+            kc = write_token(kc, l, kk[:, 0], lengths)
+            vc = write_token(vc, l, v[:, 0], lengths)
+        attn = decode_attention(
+            q[:, 0], kc[l], vc[l], lengths + 1,
+            k_scale=ksc[l] if quantized else None,
+            v_scale=vsc[l] if quantized else None,
+            alibi_slopes=alibi_slopes)
+        x = finish_fn(x[:, None, :],
+                      attn.reshape(B, 1, H * hd).astype(x.dtype),
+                      layer)[:, 0, :]
     logits = head_fn(params, x[:, None, :])[:, 0]
     if quantized:
-        ks, vs, kss, vss = ys
-        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
-    ks, vs = ys
-    return logits, {"k": ks, "v": vs}
+        return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
+    return logits, {"k": kc, "v": vc}

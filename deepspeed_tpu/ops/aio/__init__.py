@@ -26,12 +26,18 @@ class AsyncIOHandle:
         self._lib.ds_aio_inflight.restype = ctypes.c_long
         self._lib.ds_aio_pread.restype = ctypes.c_int
         self._lib.ds_aio_pwrite.restype = ctypes.c_int
+        self._lib.ds_aio_submit_pread.restype = ctypes.c_long
+        self._lib.ds_aio_submit_pwrite.restype = ctypes.c_long
+        self._lib.ds_aio_wait_req.restype = ctypes.c_int
+        self._lib.ds_aio_backend.restype = ctypes.c_int
         self._h = ctypes.c_void_p(
             self._lib.ds_aio_handle_new(ctypes.c_int(thread_count)))
         self.block_size = block_size
         self.thread_count = thread_count
-        # keep submitted buffers alive until wait()
+        # keep submitted buffers alive until wait(); per-request buffers
+        # keyed by id so wait_req can release them individually
         self._pinned = []
+        self._pinned_by_id = {}
 
     def _buf_ptr(self, arr: np.ndarray):
         assert arr.flags.c_contiguous
@@ -53,6 +59,43 @@ class AsyncIOHandle:
             self._pinned.append(buffer)
         return rc
 
+    def submit_pread(self, buffer: np.ndarray, filename: str,
+                     offset: int = 0) -> int:
+        """Submit a read; returns a positive request id for wait_req, or
+        raises on submit failure.  The buffer stays pinned until its
+        wait_req (or a full wait())."""
+        rid = self._lib.ds_aio_submit_pread(
+            self._h, filename.encode(), self._buf_ptr(buffer),
+            ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
+        if rid <= 0:
+            raise IOError(f"aio submit_pread failed for {filename}")
+        self._pinned_by_id[rid] = buffer
+        return int(rid)
+
+    def submit_pwrite(self, buffer: np.ndarray, filename: str,
+                      offset: int = 0) -> int:
+        """Submit a write; returns a positive request id for wait_req."""
+        rid = self._lib.ds_aio_submit_pwrite(
+            self._h, filename.encode(), self._buf_ptr(buffer),
+            ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
+        if rid <= 0:
+            raise IOError(f"aio submit_pwrite failed for {filename}")
+        self._pinned_by_id[rid] = buffer
+        return int(rid)
+
+    def wait_req(self, rid: int) -> int:
+        """Block until request ``rid`` completes (others may stay in
+        flight — THE point of the queue-depth backend).  Returns 0 on
+        success, -1 on I/O failure.  Each id may be waited once."""
+        err = self._lib.ds_aio_wait_req(self._h, ctypes.c_long(rid))
+        self._pinned_by_id.pop(rid, None)
+        return int(err)
+
+    def backend(self) -> str:
+        """"io_uring" (queue-depth kernel submission) or "threadpool"."""
+        return ("io_uring" if self._lib.ds_aio_backend(self._h)
+                else "threadpool")
+
     def sync_pread(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
         rc = self.async_pread(buffer, filename, offset)
         if rc == 0:
@@ -68,6 +111,7 @@ class AsyncIOHandle:
     def wait(self) -> int:
         errors = self._lib.ds_aio_wait(self._h)
         self._pinned.clear()
+        self._pinned_by_id.clear()
         return int(errors)
 
     def inflight(self) -> int:

@@ -57,7 +57,18 @@ def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
     if segment_ids is not None or not prefer_stock:
         from deepspeed_tpu.ops.pallas.ds_flash_attention import \
             ds_flash_attention
-        if not fallback or _ds_vmem_ok(q, segment_ids is not None):
+        vmem_ok = _ds_vmem_ok(q, segment_ids is not None)
+        if not fallback and not vmem_ok:
+            # explicit impl="flash" on an oversized shape: name the knob
+            # instead of surfacing an opaque Mosaic scoped-VMEM error
+            budget = int(os.environ.get("DS_FLASH_VMEM_MB", "12"))
+            raise ValueError(
+                f"impl='flash': q shape {tuple(q.shape)} ({q.dtype}) "
+                f"exceeds the flash kernel's VMEM budget "
+                f"(DS_FLASH_VMEM_MB={budget} MiB). Raise DS_FLASH_VMEM_MB "
+                f"(the check holds a safety margin), shorten the sequence, "
+                f"or use impl='auto' to allow the XLA fallback.")
+        if vmem_ok:
             try:
                 return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                           causal=True)

@@ -185,17 +185,8 @@ def quantize_prefill_into_cache(cache, ks, vs):
     }
 
 
-def quantize_token_into_cache(kc, vc, ksc, vsc, rows, lengths, k_new, v_new):
-    """Quantize one decode step's K/V vectors ([B, KV, hd]) and write them
-    at each row's fill position (shared by every KV-cache model)."""
-    kq, ks1 = quantize_kv(k_new)
-    vq, vs1 = quantize_kv(v_new)
-    return (kc.at[rows, lengths].set(kq), vc.at[rows, lengths].set(vq),
-            ksc.at[rows, lengths].set(ks1), vsc.at[rows, lengths].set(vs1))
-
-
 def decode_attention_pallas(q, k_cache, v_cache, cache_len,
-                            sm_scale=None, block_s: int = 512,
+                            sm_scale=None, block_s: int = 1024,
                             k_scale=None, v_scale=None, alibi_slopes=None,
                             min_pos=None):
     """q: [B, H, hd]; k/v_cache: [B, S_max, KV, hd]; cache_len: [B] int32.
@@ -208,13 +199,21 @@ def decode_attention_pallas(q, k_cache, v_cache, cache_len,
     quantized = k_scale is not None
     if sm_scale is None:
         sm_scale = hd ** -0.5
-    # pick the largest tile-aligned block that divides S_max; pad the cache
-    # as a last resort (a copy — callers should size caches to a multiple of
-    # 64 to avoid it; the engine aligns its cache buffer to 64)
-    for cand in (block_s, 256, 128, 64, 32, 16, 8):
-        if cand <= S_max and S_max % cand == 0:
-            block_s = cand
-            break
+    # Pick the LARGEST tile-aligned divisor of S_max under the VMEM budget:
+    # decode is launch-bound at short caches (each extra grid cell costs
+    # more than the bytes it streams — a 384-cache at block 128 ran 0.26 ms
+    # slower per 12-layer step than at block 384, scripts/decode_profile.py),
+    # so fewer S-blocks beats finer block-skipping.  ``block_s`` acts as an
+    # upper cap; the VMEM cap keeps k+v double-buffered blocks in budget.
+    Dk_bytes = KV * hd * (1 if quantized else jnp.dtype(q.dtype).itemsize)
+    vmem_cap = max(64, (6 << 20) // max(1, 4 * Dk_bytes) // 8 * 8)
+    cap = min(block_s, vmem_cap, S_max)
+    best = 0
+    for cand in range(8, cap + 1, 8):
+        if S_max % cand == 0:
+            best = cand
+    if best:
+        block_s = best
     else:
         pad = -S_max % 128
         k_cache = jnp.pad(k_cache, ((0, 0), (0, pad), (0, 0), (0, 0)))

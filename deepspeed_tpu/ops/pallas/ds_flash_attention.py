@@ -259,6 +259,11 @@ def vmem_fits(seq_len, head_dim, itemsize, block_q=512, block_k=512,
     rows = 2 * 8 * seq_len * 4                       # lse+delta [1,S] fp32
     if packed:
         rows += seq_len * 128 * 4                    # dq segk [S,1] column
+        # whole-S [1, S] int32 segment rows staged by the fwd/dkv/dq
+        # passes (x8 sublane pad) — small next to the column term, but
+        # keeps the heuristic conservative if the budget is ever raised
+        # above the ~4 MiB slack it currently rides on
+        rows += 8 * seq_len * 4
     tiles = (bq + bk) * hd_pad * (itemsize + 2 * 4)  # in tiles + fp32 acc
     return 2 * (full_kv + rows) + tiles <= budget_bytes
 

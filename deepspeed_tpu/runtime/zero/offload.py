@@ -136,17 +136,26 @@ class HostOffloadOptimizer:
         new_leaves = []
         nvme_names = [[f"{p}.m{j}" for j in range(self.n_moments)]
                       for p in self.paths]
+        if self.nvme is not None and self.paths:
+            # double-buffered swap pipeline (reference
+            # pipelined_optimizer_swapper.py): tensor i+1's reads are
+            # submitted before blocking on tensor i's, and tensor i-1's
+            # write-backs stay in flight underneath — the per-request aio
+            # completions make all three overlap for real
+            for nm in nvme_names[0]:
+                self.nvme.prefetch(nm)
+            if self.masters_on_nvme:
+                self.nvme.prefetch(f"{self.paths[0]}.w")
         for i, (path, g) in enumerate(zip(self.paths, grads)):
             if self.nvme is not None:
-                # prefetch next tensor's state while this one updates
-                moments = [self.nvme.swap_in(nm) for nm in nvme_names[i]]
-                p = (self.nvme.swap_in(f"{path}.w") if self.masters_on_nvme
-                     else self.master[path])
                 if i + 1 < len(self.paths):
                     for nm in nvme_names[i + 1]:
                         self.nvme.prefetch(nm)
                     if self.masters_on_nvme:
                         self.nvme.prefetch(f"{self.paths[i + 1]}.w")
+                moments = [self.nvme.swap_in(nm) for nm in nvme_names[i]]
+                p = (self.nvme.swap_in(f"{path}.w") if self.masters_on_nvme
+                     else self.master[path])
             else:
                 moments = self.moments[path]
                 p = self.master[path]

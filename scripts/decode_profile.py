@@ -1,0 +1,330 @@
+"""Decode-step variant profiling: where does the per-token millisecond go?
+
+VERDICT round-4 item 1: per-family decode rates sit 4-5x above the
+weight-streaming floor.  This script times the gpt2 decode step in
+structural variants to attribute the residue:
+
+  scan_scatter   — the shipped round-4 path: lax.scan over layers with the
+                   cache in xs/ys (full cache copy per token) and scatter
+                   cache writes
+  unroll_scatter — python-unrolled layers, cache updated in place on the
+                   carried stacked array (static layer index + scatter)
+  unroll_mask    — unrolled, cache row written via an iota==length mask
+                   select instead of scatter
+  weights_floor  — one dummy matmul chain streaming the same weight bytes
+                   (the floor decode can never beat)
+
+Timing uses the on-device fori_loop slope discipline from flash_ab.py
+(the axon tunnel charges ~100 ms per blocking round trip; only slopes
+between step counts are trustworthy).
+
+    python scripts/decode_profile.py            # gpt2 125m, B=4, S=384
+    DEC_B=8 DEC_S=512 python scripts/decode_profile.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed_chain(step_fn, state0, n, warmup=3):
+    """On-device loop slope (see flash_ab.py): time m and 5m chained steps,
+    report the per-step slope in ms."""
+    @jax.jit
+    def run(state, m):
+        state = lax.fori_loop(0, m, lambda i, s: step_fn(s), state)
+        return jnp.sum(state[0].astype(jnp.float32))
+
+    jax.block_until_ready(run(state0, warmup))
+
+    def once(m):
+        t0 = time.time()
+        jax.block_until_ready(run(state0, m))
+        return time.time() - t0
+
+    t_small = min(once(n), once(n))
+    t_big = min(once(5 * n), once(5 * n))
+    return (t_big - t_small) / (4 * n) * 1e3
+
+
+def main():
+    on_tpu = "tpu" in str(jax.devices()[0]).lower()
+    B = int(os.environ.get("DEC_B", 4))
+    S = int(os.environ.get("DEC_S", 384))
+    size = os.environ.get("DEC_MODEL", "125m" if on_tpu else "custom")
+    steps = int(os.environ.get("DEC_STEPS", 20 if on_tpu else 2))
+
+    from deepspeed_tpu.models import gpt2 as G
+    kwargs = {} if on_tpu else dict(vocab_size=256, num_layers=2,
+                                    num_heads=4, d_model=32)
+    model = G.gpt2_model(size, dtype="bfloat16" if on_tpu else "float32",
+                         max_seq_len=max(1024, S), **kwargs)
+    cfg = model.config
+    params = jax.jit(model.init_fn)(jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda x: x.astype(cfg.dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    L = cfg.num_layers
+    dtype = jnp.dtype(cfg.dtype)
+
+    cache = G.init_cache(cfg, B, S)
+    # warm cache with realistic fill
+    rng = np.random.default_rng(0)
+    cache = {k: jnp.asarray(rng.standard_normal(v.shape), v.dtype)
+             for k, v in cache.items()}
+    lengths0 = jnp.full((B,), S // 2, jnp.int32)
+    tok0 = jnp.zeros((B,), jnp.int32)
+
+    from deepspeed_tpu.models.model import maybe_stream
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    rows = jnp.arange(B)
+
+    def embed(tokens, lengths):
+        return (params["wte"].astype(dtype)[tokens] +
+                params["wpe"].astype(dtype)[lengths])
+
+    def logits_of(x):
+        return G.head(params, x[:, None, :], cfg)[:, 0]
+
+    def next_state(logits, cache, lengths):
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # stay in bounds over long chains while keeping the data dependency
+        lengths = jnp.minimum(lengths + 1, S - 1)
+        return (tok, cache, lengths)
+
+    # ---------------------------------------------------------- variants
+    def scan_scatter(state):
+        tok, cache, lengths = state
+        logits, cache = G.decode_step(params, tok, cache, lengths, cfg)
+        return next_state(logits, cache, lengths)
+
+    def unroll_common(state, write):
+        tok, cache, lengths = state
+        x = embed(tok, lengths)
+        kc, vc = cache["k"], cache["v"]
+        for l in range(L):
+            layer = maybe_stream(jax.tree.map(lambda a: a[l],
+                                              params["blocks"]))
+            q, kk, v = G._block_qkv(x[:, None, :], layer, cfg)
+            kc = write(kc, l, kk[:, 0], lengths)
+            vc = write(vc, l, v[:, 0], lengths)
+            attn = decode_attention(q[:, 0], kc[l], vc[l], lengths + 1)
+            x = G._block_finish(x[:, None, :],
+                                attn.reshape(B, 1, cfg.d_model), layer,
+                                cfg)[:, 0]
+        return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
+
+    def scatter_write(c, l, new, lengths):
+        return c.at[l, rows, lengths].set(new.astype(c.dtype))
+
+    def mask_write(c, l, new, lengths):
+        # [B, S] one-hot row mask -> select; dense-bandwidth on ONE layer
+        m = (jnp.arange(c.shape[2])[None, :] ==
+             lengths[:, None])[..., None, None]           # [B, S, 1, 1]
+        upd = jnp.where(m, new[:, None].astype(c.dtype), c[l])
+        return lax.dynamic_update_slice(
+            c, upd[None], (l, 0, 0, 0, 0))
+
+    def rowdus_write(c, l, new, lengths):
+        # B tiny in-place dynamic_update_slices (one per row)
+        new = new.astype(c.dtype)
+        for b in range(B):
+            c = lax.dynamic_update_slice(
+                c, new[b][None, None, None],
+                (l, b, lengths[b], 0, 0))
+        return c
+
+    def unroll_uniform(state):
+        # all rows share one position (the engine's common case: equal
+        # right-padded prompts) -> ONE dus writes every row's new vector
+        tok, cache, lengths = state
+        pos = lengths[0]
+        x = embed(tok, lengths)
+        kc, vc = cache["k"], cache["v"]
+        for l in range(L):
+            layer = maybe_stream(jax.tree.map(lambda a: a[l],
+                                              params["blocks"]))
+            q, kk, v = G._block_qkv(x[:, None, :], layer, cfg)
+            kc = lax.dynamic_update_slice(
+                kc, kk.astype(kc.dtype)[None], (l, 0, pos, 0, 0))
+            vc = lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype)[None], (l, 0, pos, 0, 0))
+            attn = decode_attention(q[:, 0], kc[l], vc[l], lengths + 1)
+            x = G._block_finish(x[:, None, :],
+                                attn.reshape(B, 1, cfg.d_model), layer,
+                                cfg)[:, 0]
+        return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
+
+    variants = {
+        "scan_scatter": scan_scatter,
+        "unroll_scatter": lambda s: unroll_common(s, scatter_write),
+        "unroll_mask": lambda s: unroll_common(s, mask_write),
+        "unroll_rowdus": lambda s: unroll_common(s, rowdus_write),
+        "unroll_uniform": unroll_uniform,
+    }
+
+    # ------------------------------------------------- component ablations
+    def ablate(state, *, attn=True, write=True, mlp=True, layers=True):
+        tok, cache, lengths = state
+        x = embed(tok, lengths)
+        kc, vc = cache["k"], cache["v"]
+        if layers:
+            for l in range(L):
+                layer = maybe_stream(jax.tree.map(lambda a: a[l],
+                                                  params["blocks"]))
+                q, kk, v = G._block_qkv(x[:, None, :], layer, cfg)
+                if write:
+                    kc = mask_write(kc, l, kk[:, 0], lengths)
+                    vc = mask_write(vc, l, v[:, 0], lengths)
+                if attn:
+                    a = decode_attention(q[:, 0], kc[l], vc[l], lengths + 1)
+                else:
+                    a = q[:, 0]
+                a = a.reshape(B, 1, cfg.d_model)
+                if mlp:
+                    x = G._block_finish(x[:, None, :], a, layer, cfg)[:, 0]
+                else:
+                    x = (x[:, None, :] + a @ layer["proj_w"].astype(x.dtype)
+                         )[:, 0]
+        return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
+
+    def mask_write(c, l, new, lengths):  # noqa: F811 (reuse above def)
+        m = (jnp.arange(c.shape[2])[None, :] ==
+             lengths[:, None])[..., None, None]
+        upd = jnp.where(m, new[:, None].astype(c.dtype), c[l])
+        return lax.dynamic_update_slice(c, upd[None], (l, 0, 0, 0, 0))
+
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention_pallas, decode_attention_xla)
+
+    def ablate_attn_impl(state, attn_fn):
+        tok, cache, lengths = state
+        x = embed(tok, lengths)
+        kc, vc = cache["k"], cache["v"]
+        for l in range(L):
+            layer = maybe_stream(jax.tree.map(lambda a: a[l],
+                                              params["blocks"]))
+            q, kk, v = G._block_qkv(x[:, None, :], layer, cfg)
+            kc = mask_write(kc, l, kk[:, 0], lengths)
+            vc = mask_write(vc, l, v[:, 0], lengths)
+            a = attn_fn(q[:, 0], kc[l], vc[l], lengths + 1)
+            x = G._block_finish(x[:, None, :],
+                                a.reshape(B, 1, cfg.d_model), layer,
+                                cfg)[:, 0]
+        return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
+
+    variants.update({
+        "ab_attn_block384": lambda s: ablate_attn_impl(
+            s, lambda q, k, v, cl: decode_attention_pallas(
+                q, k, v, cl, block_s=S)),
+        "ab_attn_xla": lambda s: ablate_attn_impl(
+            s, decode_attention_xla),
+        "ab_full": lambda s: ablate(s),
+        "ab_no_attn": lambda s: ablate(s, attn=False),
+        "ab_no_write": lambda s: ablate(s, write=False),
+        "ab_no_mlp": lambda s: ablate(s, mlp=False),
+        "ab_embed_head": lambda s: ablate(s, layers=False),
+    })
+
+    # mimic the engine's _build_cached_generate scan exactly (decode_fn is
+    # the NEW unrolled path): measures what the generate-loop scaffolding
+    # (scan ys, done flags, argmax placement) adds per token
+    def engine_scan(state):
+        tok, cache, lengths = state
+
+        def body(carry, _):
+            cache, tok, lens, done = carry
+            logits, cache = G.decode_step(params, tok, cache, lens, cfg)
+            new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, new, jnp.minimum(lens + 1, S - 1), done), new
+
+        done = jnp.zeros((B,), bool)
+        (cache, tok, lengths, _), ys = lax.scan(
+            body, (cache, tok, lengths, done), None, length=8)
+        return (tok + jnp.sum(ys) * 0, cache, lengths)
+
+    def engine_scan_steps(n):
+        # per-token cost inside the mimic scan, from the fori slope over
+        # chains of 8-token scans
+        ms = timed_chain(engine_scan, state0, max(2, n // 8))
+        return ms / 8
+
+    variants = dict(variants)
+
+    # weights floor: stream every weight byte once per step through dots
+    # that produce a [B, ...] activation (mimics decode's memory traffic
+    # with zero overhead ops)
+    flat = [x for x in jax.tree.leaves(params)
+            if jnp.issubdtype(x.dtype, jnp.floating)]
+    mats = [x.reshape(-1, x.shape[-1]) for x in flat if x.size >= 1 << 16]
+    wbytes = sum(int(x.size) * x.dtype.itemsize for x in flat)
+
+    def weights_floor(state):
+        tok, cache, lengths = state
+        x = jnp.zeros((B, 8), dtype)
+        acc = jnp.float32(0)
+        for m in mats:
+            r = m.shape[0]
+            y = x[:, :1] * jnp.float32(1e-6) + jnp.ones((B, 1), dtype)
+            acc = acc + jnp.sum((y @ m.reshape(1, -1)[:, :1].T))
+        tok = (tok + acc.astype(jnp.int32) * 0) % cfg.vocab_size
+        return (tok, cache, lengths)
+
+    # a matmul-shaped floor is fairer: one [B, r] @ [r, c] per weight
+    def weights_floor2(state):
+        tok, cache, lengths = state
+        acc = jnp.zeros((B, 1), jnp.float32)
+        for m in mats:
+            r, c = m.shape
+            y = jnp.broadcast_to(tok[:, None].astype(dtype), (B, r))
+            acc = acc + jnp.sum(y @ m, axis=-1, keepdims=True)
+        tok = (tok + jnp.sum(acc).astype(jnp.int32) * 0) % cfg.vocab_size
+        return (tok, cache, lengths)
+
+    variants["weights_floor"] = weights_floor2
+
+    cal = jnp.asarray(rng.standard_normal((2048, 2048)), jnp.bfloat16)
+    mm = lambda s: (jnp.tanh(s[0] @ cal), s[1], s[2])
+    mm_ms = timed_chain(mm, (cal, 0, 0), steps)
+    mm_tf = 2 * 2048 ** 3 / (mm_ms * 1e-3) / 1e12 if mm_ms > 0 else None
+    print(json.dumps({"calibration": "matmul2048", "ms": round(mm_ms, 4),
+                      "apparent_tflops": round(mm_tf, 1) if mm_tf else None,
+                      "weight_bytes_mb": round(wbytes / 1e6, 1),
+                      "floor_ms_at_819GBs": round(wbytes / 819e9 * 1e3, 3)}))
+
+    only = [s for s in os.environ.get("DEC_ONLY", "").split(",") if s]
+    if only:
+        variants = {k: v for k, v in variants.items() if k in only}
+
+    state0 = (tok0, cache, lengths0)
+    try:
+        if only and "engine_scan_mimic" not in only:
+            raise KeyError("skipped")
+        ms8 = engine_scan_steps(steps)
+        print(json.dumps({"variant": "engine_scan_mimic",
+                          "step_ms": round(ms8, 4),
+                          "tok_per_s_B": (round(B / (ms8 * 1e-3))
+                                          if ms8 > 0 else None)}))
+    except Exception as e:
+        print(json.dumps({"variant": "engine_scan_mimic",
+                          "error": str(e)[:300]}))
+    for name, fn in variants.items():
+        try:
+            ms = timed_chain(fn, state0, steps)
+            print(json.dumps({"variant": name, "step_ms": round(ms, 4),
+                              "tok_per_s_B": (round(B / (ms * 1e-3))
+                                              if ms > 0 else None)}))
+        except Exception as e:  # keep profiling the rest
+            print(json.dumps({"variant": name,
+                              "error": str(e)[:300]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,12 @@ t0 = time.time()
 for _ in range(3):
     toks = np.asarray(engine.generate(prompts, max_new_tokens=32))
 gen_s = (time.time() - t0) / 3
-rebind_s = cycle_s - train_s - gen_s
+# rebind is DERIVED from three short-loop means, so timing noise can push
+# the raw difference slightly negative; clamp and report the raw value so
+# the JSON never shows a nonsensical negative overhead
+rebind_raw = cycle_s - train_s - gen_s
 print(json.dumps({"model": "gpt2-350m+lora16", "train_step_s": round(train_s,3),
                   "generate32_s": round(gen_s,3), "rlhf_cycle_s": round(cycle_s,3),
-                  "rebind_overhead_s": round(rebind_s,3)}))
+                  "rebind_overhead_s": round(max(0.0, rebind_raw),3),
+                  "rebind_raw_s": round(rebind_raw,3),
+                  "note": "rebind is derived (cycle - train - gen) and noise-bounded"}))

@@ -1,0 +1,110 @@
+"""Swap-tier I/O bandwidth bench (VERDICT r4 item 4).
+
+Measures the async I/O layer the ZeRO-Infinity NVMe tier rides:
+  - streaming write and read bandwidth at queue depth,
+  - the pipelined swap loop (prefetch i+1 / step i / write-back i-1)
+    vs the round-4 serialized form (drain ALL writes before any read).
+
+    python scripts/swap_bench.py                 # 32 x 32 MB tensors
+    SWAP_MB=64 SWAP_N=16 python scripts/swap_bench.py
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    mb = int(os.environ.get("SWAP_MB", 32))
+    n = int(os.environ.get("SWAP_N", 32))
+    root = os.environ.get("SWAP_DIR") or tempfile.mkdtemp(prefix="ds_swap_")
+
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    h = AsyncIOHandle(thread_count=4)
+    total = n * mb / 1024  # GB
+
+    # streaming write at queue depth
+    bufs = [np.random.default_rng(i).integers(
+        0, 255, mb << 20, dtype=np.uint8) for i in range(min(n, 4))]
+    t0 = time.time()
+    ids = [h.submit_pwrite(bufs[i % len(bufs)],
+                           os.path.join(root, f"w{i}.bin"))
+           for i in range(n)]
+    for i in ids:
+        h.wait_req(i)
+    w_s = time.time() - t0
+
+    t0 = time.time()
+    outs = [np.empty(mb << 20, np.uint8) for _ in range(min(n, 4))]
+    ids = [h.submit_pread(outs[i % len(outs)],
+                          os.path.join(root, f"w{i}.bin"))
+           for i in range(n)]
+    for i in ids:
+        h.wait_req(i)
+    r_s = time.time() - t0
+
+    # pipelined swap loop vs serialized: emulate the optimizer sweep —
+    # read tensor i, "step" it (tiny CPU work), write it back, while
+    # prefetching i+1.  The serialized variant drains before each read
+    # (round-4 behavior).
+    sw = AsyncTensorSwapper(os.path.join(root, "pipe"))
+    names = [f"t{i}" for i in range(n)]
+    for i, nm in enumerate(names):
+        sw.swap_out(nm, bufs[i % len(bufs)])
+    sw.drain()
+
+    def sweep(pipelined):
+        t0 = time.time()
+        if pipelined:
+            sw.prefetch(names[0])
+        for i, nm in enumerate(names):
+            if pipelined and i + 1 < n:
+                sw.prefetch(names[i + 1])
+            if not pipelined:
+                sw.drain()          # the round-4 global barrier
+            x = sw.swap_in(nm)
+            x[:4096] += 1           # the "optimizer step"
+            sw.swap_out(nm, x)
+        sw.drain()
+        return time.time() - t0
+
+    # alternate A/B twice with a sync between phases: page-cache dirty
+    # throttling from a previous phase otherwise lands on whichever sweep
+    # runs later (first measured run of this bench showed exactly that)
+    def synced(fn, *a):
+        os.sync()
+        return fn(*a)
+
+    serial_s = min(synced(sweep, False), synced(sweep, False))
+    pipe_s = min(synced(sweep, True), synced(sweep, True))
+
+    import multiprocessing
+    cores = multiprocessing.cpu_count()
+    print(json.dumps({
+        "metric": "swap_io",
+        "backend": h.backend(),
+        "tensor_mb": mb, "tensors": n,
+        "write_GBps": round(total / w_s, 2),
+        "read_GBps": round(total / r_s, 2),
+        "sweep_serialized_s": round(serial_s, 3),
+        "sweep_pipelined_s": round(pipe_s, 3),
+        "pipeline_speedup": round(serial_s / pipe_s, 2),
+        "cores": cores,
+        "note": ("page-cache I/O on a 1-core host is memcpy-bound: overlap "
+                 "cannot beat serial here (it adds scheduling); the overlap "
+                 "CONTRACT (read completes under write backlog) is asserted "
+                 "by tests/test_native_ops.py, and the pipeline pays off on "
+                 "multi-core NVMe hosts where the CPU idles during DMA"
+                 if cores == 1 else ""),
+        "dir": root,
+    }))
+
+
+if __name__ == "__main__":
+    main()
