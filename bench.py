@@ -80,6 +80,14 @@ def main():
         "zero_optimization": {"stage": ZERO_STAGE},
         "steps_per_print": 0,
     }
+    # optimizer-phase byte diet (runtime/bf16_optimizer.py): bf16 moments /
+    # Kahan bf16 masters / bf16 grad accumulation.  BENCH_PRECISION=diet
+    # turns all three on (the honest labeled variant row; default stays
+    # fp32 states).
+    if os.environ.get("BENCH_PRECISION", "") == "diet":
+        config["bf16"].update(master_weights_dtype="bfloat16",
+                              optimizer_states_dtype="bfloat16")
+        config["data_types"] = {"grad_accum_dtype": "bf16"}
     if OFFLOAD:
         # ZeRO-Infinity tier: params+optimizer state in pinned host DRAM,
         # streamed per layer (models beyond one chip's HBM, e.g. 1.3B+ fp32
@@ -119,6 +127,8 @@ def main():
         "metric": ((MODEL_SIZE if MODEL_SIZE.startswith(("bert", "mixtral"))
                     else f"gpt2_{MODEL_SIZE}")
                    + f"_bf16_zero{ZERO_STAGE}"
+                   + ("_diet" if os.environ.get("BENCH_PRECISION", "")
+                      == "diet" else "")
                    + ("_offload" if OFFLOAD else "") + "_mfu"),
         "value": round(mfu, 4),
         "unit": "MFU_fraction",

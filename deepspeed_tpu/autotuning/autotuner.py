@@ -60,9 +60,32 @@ class Autotuner:
                  warmup_steps: int = 1, seq_len: Optional[int] = None,
                  results_dir: str = "autotuning_results",
                  tuner_type: str = "gridsearch",
-                 tuner_early_stopping: int = 0):
+                 tuner_early_stopping: int = 0,
+                 isolation: str = "in_process",
+                 model_spec: Optional[str] = None,
+                 model_kwargs: Optional[dict] = None,
+                 trial_timeout_s: float = 900.0):
+        """``isolation="subprocess"`` runs every trial as a child process
+        (the reference scheduler's contract, scheduler.py:1): a candidate
+        that OOM-kills or hard-crashes its process is recorded as
+        infeasible and tuning continues.  Requires ``model_spec`` (the
+        string form the child re-resolves — a live factory callable
+        cannot cross the process boundary).  In-process remains the
+        default: on TPU a fresh process pays a full XLA compile per
+        trial, and most infeasibilities surface as catchable allocation
+        errors — but only the subprocess mode survives hard crashes."""
         self.base_config = dict(base_config)
         self.model_factory = model_factory
+        self.isolation = isolation
+        self.model_spec = model_spec
+        self.model_kwargs = dict(model_kwargs or {})
+        self.trial_timeout_s = float(trial_timeout_s)
+        if isolation == "subprocess" and not model_spec:
+            raise ValueError(
+                "isolation='subprocess' needs model_spec (an 'arch:size' "
+                "or 'pkg.module:fn' string the child process can resolve)")
+        if isolation not in ("in_process", "subprocess"):
+            raise ValueError(f"unknown isolation {isolation!r}")
         self.stages = tuple(stages)
         self.micro_batches = tuple(sorted(micro_batches))
         self.remat_policies = tuple(remat_policies)
@@ -86,8 +109,51 @@ class Autotuner:
         cfg.setdefault("steps_per_print", 0)
         return cfg
 
+    def _run_trial_subprocess(self, stage: int, micro_batch: int,
+                              remat: str) -> TrialResult:
+        """Launch the candidate as a child job and parse its result line;
+        every failure mode (crash, OOM kill, timeout, garbage output)
+        becomes an infeasible TrialResult."""
+        import subprocess
+        import sys
+        cfg = self._candidate_config(stage, micro_batch)
+        payload = json.dumps({
+            "base_config": cfg, "model": self.model_spec,
+            "model_kwargs": self.model_kwargs, "stage": stage,
+            "micro_batch": micro_batch, "remat": remat,
+            "steps": self.steps, "warmup_steps": self.warmup_steps,
+            "seq_len": self.seq_len})
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.autotuning.trial_worker"],
+                input=payload, capture_output=True, text=True,
+                timeout=self.trial_timeout_s)
+        except subprocess.TimeoutExpired:
+            return TrialResult(cfg, micro_batch, stage, remat, False,
+                               error=f"trial timed out "
+                                     f"({self.trial_timeout_s:.0f}s)")
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("DS_TRIAL_RESULT "):
+                try:
+                    row = json.loads(line[len("DS_TRIAL_RESULT "):])
+                    return TrialResult(
+                        cfg, micro_batch, stage, remat, bool(row["ok"]),
+                        samples_per_sec=float(row["samples_per_sec"]),
+                        step_time_s=float(row["step_time_s"]),
+                        error=row.get("error", ""))
+                except (ValueError, KeyError) as e:
+                    return TrialResult(cfg, micro_batch, stage, remat,
+                                       False, error=f"bad result line: {e}")
+        tail = (proc.stderr or proc.stdout or "")[-300:]
+        return TrialResult(
+            cfg, micro_batch, stage, remat, False,
+            error=f"trial process died (exit {proc.returncode}): {tail}")
+
     def _run_trial(self, stage: int, micro_batch: int, remat: str
                    ) -> TrialResult:
+        if self.isolation == "subprocess":
+            return self._run_trial_subprocess(stage, micro_batch, remat)
         import jax
         import deepspeed_tpu
         from deepspeed_tpu.comm import reset_topology
@@ -299,7 +365,11 @@ def tune_from_config(base: dict) -> Optional[TrialResult]:
         seq_len=tuning.get("seq_len"),
         results_dir=tuning.get("results_dir", "autotuning_results"),
         tuner_type=tuning.get("tuner_type", "gridsearch"),
-        tuner_early_stopping=int(tuning.get("tuner_early_stopping", 0)))
+        tuner_early_stopping=int(tuning.get("tuner_early_stopping", 0)),
+        isolation=tuning.get("trial_isolation", "in_process"),
+        model_spec=tuning.get("model", "125m"),
+        model_kwargs=tuning.get("model_kwargs"),
+        trial_timeout_s=float(tuning.get("trial_timeout_s", 900)))
     return tuner.tune()
 
 

@@ -1,0 +1,32 @@
+"""One autotuning trial in an isolated child process (reference:
+deepspeed/autotuning/scheduler.py:1 — every experiment is a launched job,
+so a crashing candidate cannot take the tuner down).
+
+Protocol: the parent writes a JSON payload on stdin
+``{"base_config", "model", "model_kwargs", "stage", "micro_batch",
+"remat", "steps", "warmup_steps", "seq_len"}`` and reads one
+``DS_TRIAL_RESULT {...}`` line (the TrialResult row) from stdout.
+Anything else — nonzero exit, OOM kill, missing result line — the parent
+records as an infeasible candidate and tuning continues.
+"""
+import json
+import sys
+
+
+def main():
+    payload = json.loads(sys.stdin.read())
+    from deepspeed_tpu.autotuning.autotuner import (Autotuner,
+                                                    resolve_model_factory)
+    factory = resolve_model_factory(payload["model"],
+                                    payload.get("model_kwargs"))
+    tuner = Autotuner(payload["base_config"], factory,
+                      steps=int(payload.get("steps", 3)),
+                      warmup_steps=int(payload.get("warmup_steps", 1)),
+                      seq_len=payload.get("seq_len"))
+    r = tuner._run_trial(payload["stage"], payload["micro_batch"],
+                         payload["remat"])
+    print("DS_TRIAL_RESULT " + json.dumps(r.row()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

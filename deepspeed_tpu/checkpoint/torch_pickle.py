@@ -76,6 +76,11 @@ class _StubBase:
             self.__dict__["_state"] = state
 
     def __getattr__(self, k):
+        # dunders must miss honestly: returning None for __array__ &co
+        # makes hasattr() duck-typing see capabilities the stub lacks
+        # (np.asarray(stub) would raise instead of being skippable)
+        if k.startswith("__") and k.endswith("__"):
+            raise AttributeError(k)
         return None
 
     def __repr__(self):
@@ -130,6 +135,10 @@ class _TorchUnpickler(pickle.Unpickler):
                 return _rebuild_parameter
         if module in ("torch", "torch.storage") and name in _STORAGE_DTYPES:
             return _StorageType(name)
+        if module == "torch" and name == "Size":
+            # torch.Size pickles as GLOBAL('torch','Size') + REDUCE with a
+            # tuple payload; real DeepSpeed param_shapes are torch.Size
+            return lambda *a: tuple(a[0]) if a else ()
         if module == "collections" and name == "OrderedDict":
             import collections
             return collections.OrderedDict

@@ -82,6 +82,19 @@ def _looks_multihost() -> bool:
     if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") or \
             os.environ.get("JAX_COORDINATOR_ADDRESS"):
         return True
+    # GCE TPU-VM pods: the metadata server provisions TPU_WORKER_ID on
+    # every worker; a non-zero id, or an accelerator topology naming more
+    # chips than one host carries, means a pod slice (jax auto-discovers
+    # the coordinator from the same metadata)
+    wid = os.environ.get("TPU_WORKER_ID")
+    if wid is not None and wid.strip() not in ("", "0"):
+        return True
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    try:
+        if "-" in acc and int(acc.rsplit("-", 1)[1]) > 8:
+            return True
+    except ValueError:
+        pass
     for m in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
         try:
             if int(os.environ.get(m, "1")) > 1:

@@ -59,24 +59,39 @@ def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
             ds_flash_attention
         vmem_ok = _ds_vmem_ok(q, segment_ids is not None)
         if not fallback and not vmem_ok:
-            # explicit impl="flash" on an oversized shape: name the knob
-            # instead of surfacing an opaque Mosaic scoped-VMEM error
+            # explicit impl="flash" on a shape the VMEM heuristic rejects:
+            # raise EAGERLY at trace time — under jit the Mosaic
+            # scoped-VMEM failure happens at XLA compile time where no
+            # except block here could wrap it, so a late opaque error is
+            # the only alternative.  DS_FLASH_VMEM_MB is the escape hatch
+            # for shapes the conservative margin mis-rejects.
             budget = int(os.environ.get("DS_FLASH_VMEM_MB", "12"))
             raise ValueError(
                 f"impl='flash': q shape {tuple(q.shape)} ({q.dtype}) "
                 f"exceeds the flash kernel's VMEM budget "
-                f"(DS_FLASH_VMEM_MB={budget} MiB). Raise DS_FLASH_VMEM_MB "
-                f"(the check holds a safety margin), shorten the sequence, "
-                f"or use impl='auto' to allow the XLA fallback.")
-        if vmem_ok:
+                f"(DS_FLASH_VMEM_MB={budget} MiB; the check holds a "
+                f"safety margin — raise it if this shape is known to "
+                f"compile). Shorten the sequence or use impl='auto' for "
+                f"the XLA fallback.")
+        if vmem_ok or not fallback:
             try:
                 return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                           causal=True)
-            except ValueError:
-                # with fallback: shape does not block-decompose — degrade
-                # below; explicit flash contract: surface the real error
+            except Exception as e:
+                # the eager guard above means not-fallback implies vmem_ok
                 if not fallback:
-                    raise
+                    if isinstance(e, ValueError):
+                        raise   # genuine shape error, already actionable
+                    budget = int(os.environ.get("DS_FLASH_VMEM_MB", "12"))
+                    raise ValueError(
+                        f"impl='flash': q shape {tuple(q.shape)} "
+                        f"({q.dtype}) failed in the flash kernel despite "
+                        f"passing the VMEM heuristic (budget "
+                        f"DS_FLASH_VMEM_MB={budget} MiB). Lower the "
+                        f"budget or use impl='auto' for the XLA "
+                        f"fallback.") from e
+                if not isinstance(e, ValueError):
+                    raise       # fallback covers shape rejections only
         if segment_ids is not None:
             # only the ds kernel masks segments: exact XLA path
             return xla_causal_attention(q, k, v, segment_ids)

@@ -34,6 +34,12 @@ class BF16Config(DeepSpeedConfigModel):
     enabled: bool = False
     # accumulate gradients in fp32 master buffers (reference bf16_optimizer)
     immediate_grad_update: bool = False
+    # TPU-native extensions (runtime/bf16_optimizer.py): the optimizer
+    # phase is HBM-streaming-bound, so state dtypes are the lever.
+    # "bfloat16" masters are Kahan-compensated (no silent update loss);
+    # moments in bf16 keep fp32 math and fp32's exponent range.
+    master_weights_dtype: str = "float32"      # float32 | bfloat16 (Kahan)
+    optimizer_states_dtype: Optional[str] = None   # None=float32 | bfloat16
 
 
 # --------------------------------------------------------------------------- zero

@@ -144,6 +144,19 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.bfloat16
         else:
             self.compute_dtype = jnp.float32
+        # bf16 state-dtype extensions (runtime/bf16_optimizer.py): masters
+        # stored in compute dtype with Kahan compensation, and/or Adam
+        # moments in bf16 — the HBM diet for the optimizer phase
+        self._bf16_master = (
+            self._config.bf16.enabled
+            and jnp.dtype(self._config.bf16.master_weights_dtype)
+            == jnp.bfloat16)
+        self._opt_states_dtype = self._config.bf16.optimizer_states_dtype
+        # reference data_types.grad_accum_dtype: gradient storage /
+        # accumulation dtype (default fp32 master accumulation)
+        _gad = self._config.data_types_config.grad_accum_dtype
+        self.grad_dtype = (jnp.bfloat16 if _gad in ("bf16", "bfloat16")
+                           else jnp.float32)
 
         # ---- ZeRO sharding policy -------------------------------------------
         zc = self._config.zero_config
@@ -200,7 +213,9 @@ class DeepSpeedEngine:
             self._offload and self._offload_param
             and self._offload_device == "cpu"
             and opt_name in ("adam", "adamw"))
-        storage_dtype = self.compute_dtype if self._offload else jnp.float32
+        storage_dtype = (self.compute_dtype
+                         if (self._offload or self._bf16_master)
+                         else jnp.float32)
         shapes = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(s.shape, storage_dtype)
             if jnp.issubdtype(s.dtype, jnp.floating) else s, shapes)
@@ -232,12 +247,9 @@ class DeepSpeedEngine:
                 "zero_quantized_gradients engages only in train_batch's "
                 "compiled step without optimizer/param offload; this "
                 "config reduces gradients in full precision")
-        if (zc.zero_hpz_partition_size > 1 and
-                self.topology.axis_size(("seq", "model")) > 1):
-            logger.warning(
-                "zero_hpz_partition_size with seq/model parallelism: hpz "
-                "group members are seq*model apart in device order and may "
-                "not be intra-host — verify your pod layout")
+        # hpz locality under seq/model parallelism is handled by the mesh
+        # factory (comm/mesh.py lays hpz groups tp-adjacent and verifies
+        # process locality against the actual device ownership)
         self.param_shardings = self.zero_policy.shardings(self.param_specs)
         if self._offload_param:
             bk = getattr(model, "blocks_key", "blocks")
@@ -419,9 +431,14 @@ class DeepSpeedEngine:
                     optimizer, optax.GradientTransformation):
                 inner = optimizer
             else:
-                inner = build_optimizer(self._config.optimizer_name,
-                                        self._config.optimizer_params,
-                                        lr_schedule=self.lr_schedule)
+                inner = build_optimizer(
+                    self._config.optimizer_name,
+                    self._config.optimizer_params,
+                    lr_schedule=self.lr_schedule,
+                    mu_dtype=self._opt_states_dtype,
+                    nu_dtype=self._opt_states_dtype,
+                    master_dtype=("bfloat16" if self._bf16_master
+                                  else "float32"))
             mask = getattr(model, "trainable_mask", None)
             if mask is not None:
                 # frozen leaves (reference: requires_grad=False params —
@@ -1165,18 +1182,18 @@ class DeepSpeedEngine:
                     # the sum lands on the global-batch mean
                     loss, g = jax.value_and_grad(loss_fn)(
                         p, mb, r, s / (gas * n_manual))
-                    g = _tree_cast(g, jnp.float32)
+                    g = _tree_cast(g, self.grad_dtype)
                     return (jax.tree.map(jnp.add, g_acc, g),
                             l_acc + loss), None
 
                 zeros = jax.tree.map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), p)
+                    lambda x: jnp.zeros(x.shape, self.grad_dtype), p)
                 if pipeline and pipe_chunks == 1:
                     # whole stack through the pipeline in one pass (the
                     # pipelined loss averages microbatches internally)
                     local_l, local_g = jax.value_and_grad(loss_fn)(
                         p, b, r, s / n_manual)
-                    local_g = _tree_cast(local_g, jnp.float32)
+                    local_g = _tree_cast(local_g, self.grad_dtype)
                 elif pipeline:
                     chunks = jax.tree.map(
                         lambda x: x.reshape(pipe_chunks, gas // pipe_chunks,
@@ -1186,7 +1203,7 @@ class DeepSpeedEngine:
                         g_acc, l_acc = carry
                         l, g = jax.value_and_grad(loss_fn)(
                             p, cb, r, s / (pipe_chunks * n_manual))
-                        g = _tree_cast(g, jnp.float32)
+                        g = _tree_cast(g, self.grad_dtype)
                         return (jax.tree.map(jnp.add, g_acc, g),
                                 l_acc + l), None
 
@@ -1377,13 +1394,13 @@ class DeepSpeedEngine:
                     grads_acc, loss_acc = carry
                     loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
                         params, mb, rng, scale / gas, cs)
-                    grads = _tree_cast(grads, jnp.float32)
+                    grads = _tree_cast(grads, self.grad_dtype)
                     grads = policy.constrain_grads(grads, grad_specs)
                     grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
                     return (grads_acc, loss_acc + loss), None
 
                 zero_grads = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, self.grad_dtype), params)
                 zero_grads = policy.constrain_grads(zero_grads, grad_specs)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zero_grads, jnp.float32(0.0)), stacked_batch)
@@ -1495,19 +1512,19 @@ class DeepSpeedEngine:
                     g_acc, l_acc = carry
                     l, g = jax.value_and_grad(loss_of_chunk)(
                         params, chunk, rng, scale / n_chunks, cs)
-                    g = _tree_cast(g, jnp.float32)
+                    g = _tree_cast(g, self.grad_dtype)
                     g = policy.constrain_grads(g, grad_specs)
                     return (jax.tree.map(jnp.add, g_acc, g), l_acc + l), None
 
                 zeros = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, self.grad_dtype), params)
                 zeros = policy.constrain_grads(zeros, grad_specs)
                 # each chunk is already weighted by scale/n_chunks, so the
                 # sum over chunks is the full-batch mean at full scale
                 (grads, loss), _ = jax.lax.scan(
                     body, (zeros, jnp.float32(0.0)), chunks)
 
-            grads = _tree_cast(grads, jnp.float32)
+            grads = _tree_cast(grads, self.grad_dtype)
             grads = policy.constrain_grads(grads, grad_specs)
             new_state, metrics = self._apply_grads(state, grads)
             metrics["loss"] = loss / scale
@@ -1547,7 +1564,7 @@ class DeepSpeedEngine:
                 lambda h, lp: model.block_fn(lp, h), model.embed_fn,
                 head_loss, cparams, model.blocks_key, stacked_batch,
                 n_stages)
-            grads = _tree_cast(grads, jnp.float32)
+            grads = _tree_cast(grads, self.grad_dtype)
             grads = policy.constrain_grads(grads, grad_specs)
             new_state, metrics = self._apply_grads(state, grads)
             metrics["loss"] = loss_sum / scale
@@ -1663,7 +1680,7 @@ class DeepSpeedEngine:
                     state["params"], batch, rng, scale / gas,
                     state["step"] if self._compression_plans is not None
                     else None)
-                grads = _tree_cast(grads, jnp.float32)
+                grads = _tree_cast(grads, self.grad_dtype)
                 grads = self.zero_policy.constrain_grads(grads, self.grad_specs)
                 grads = jax.tree.map(jnp.add, grads_acc, grads)
                 return loss / scale * gas, grads
@@ -1686,13 +1703,13 @@ class DeepSpeedEngine:
                     grads_acc, loss_acc = carry
                     loss, grads = jax.value_and_grad(self._scaled_loss_fn)(
                         params, mb, rng, scale / gas)
-                    grads = _tree_cast(grads, jnp.float32)
+                    grads = _tree_cast(grads, self.grad_dtype)
                     grads = policy.constrain_grads(grads, grad_specs)
                     return (jax.tree.map(jnp.add, grads_acc, grads),
                             loss_acc + loss), None
 
                 zeros = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, self.grad_dtype), params)
                 zeros = policy.constrain_grads(zeros, grad_specs)
                 (grads, loss_sum), _ = jax.lax.scan(
                     micro, (zeros, jnp.float32(0.0)), stacked_batch)
@@ -1736,7 +1753,7 @@ class DeepSpeedEngine:
         elif name == "zero_grads":
             def make_zeros(params):
                 return jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, self.grad_dtype), params)
             fn = jax.jit(make_zeros, out_shardings=self._grad_out_shardings())
         else:
             raise KeyError(name)

@@ -9,6 +9,7 @@ selected by the engine, not a different math.
 """
 from typing import Optional
 
+import numpy as np
 import optax
 
 from deepspeed_tpu.runtime import constants as C
@@ -23,17 +24,36 @@ def _adam_args(params: dict):
 
 
 def build_optimizer(name: Optional[str], params: Optional[dict],
-                    lr_schedule=None) -> optax.GradientTransformation:
+                    lr_schedule=None, mu_dtype=None, nu_dtype=None,
+                    master_dtype: str = "float32"
+                    ) -> optax.GradientTransformation:
     """Build the inner (post-ZeRO) optimizer transform.
 
-    ``lr_schedule`` overrides the config's static lr when given (the engine wires
-    the "scheduler" section here).
+    ``lr_schedule`` overrides the config's static lr when given (the engine
+    wires the "scheduler" section here).  ``mu_dtype``/``nu_dtype``/
+    ``master_dtype`` select mixed-precision optimizer states
+    (runtime/bf16_optimizer.py) — Adam family only.
     """
     params = dict(params or {})
     lr = lr_schedule if lr_schedule is not None else float(params.get("lr", 1e-3))
     name = (name or C.ADAM_OPTIMIZER).lower()
     wd = float(params.get("weight_decay", 0.0))
 
+    mp_states = (mu_dtype or nu_dtype
+                 or np.dtype(master_dtype) != np.dtype("float32"))
+    if mp_states:
+        adam_family = (C.ADAM_OPTIMIZER, C.FUSED_ADAM, C.CPU_ADAM,
+                       C.ADAMW_OPTIMIZER)
+        if name not in adam_family:
+            raise ValueError(
+                "bf16.master_weights_dtype/optimizer_states_dtype require "
+                f"an Adam-family optimizer, got {name!r}")
+        from deepspeed_tpu.runtime.bf16_optimizer import mp_adamw
+        if name != C.ADAMW_OPTIMIZER and not params.get("adam_w_mode", True):
+            wd = 0.0
+        return mp_adamw(lr, weight_decay=wd, mu_dtype=mu_dtype,
+                        nu_dtype=nu_dtype, master_dtype=master_dtype,
+                        **_adam_args(params))
     if name in (C.ADAM_OPTIMIZER, C.FUSED_ADAM, C.CPU_ADAM):
         if params.get("adam_w_mode", True) and wd > 0:
             return optax.adamw(lr, weight_decay=wd, **_adam_args(params))

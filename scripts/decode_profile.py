@@ -195,12 +195,6 @@ def main():
                          )[:, 0]
         return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
 
-    def mask_write(c, l, new, lengths):  # noqa: F811 (reuse above def)
-        m = (jnp.arange(c.shape[2])[None, :] ==
-             lengths[:, None])[..., None, None]
-        upd = jnp.where(m, new[:, None].astype(c.dtype), c[l])
-        return lax.dynamic_update_slice(c, upd[None], (l, 0, 0, 0, 0))
-
     from deepspeed_tpu.ops.pallas.decode_attention import (
         decode_attention_pallas, decode_attention_xla)
 
@@ -258,26 +252,13 @@ def main():
 
     variants = dict(variants)
 
-    # weights floor: stream every weight byte once per step through dots
-    # that produce a [B, ...] activation (mimics decode's memory traffic
-    # with zero overhead ops)
+    # weights floor: one [B, r] @ [r, c] matmul per large weight matrix —
+    # streams every weight byte once per step with zero overhead ops
     flat = [x for x in jax.tree.leaves(params)
             if jnp.issubdtype(x.dtype, jnp.floating)]
     mats = [x.reshape(-1, x.shape[-1]) for x in flat if x.size >= 1 << 16]
     wbytes = sum(int(x.size) * x.dtype.itemsize for x in flat)
 
-    def weights_floor(state):
-        tok, cache, lengths = state
-        x = jnp.zeros((B, 8), dtype)
-        acc = jnp.float32(0)
-        for m in mats:
-            r = m.shape[0]
-            y = x[:, :1] * jnp.float32(1e-6) + jnp.ones((B, 1), dtype)
-            acc = acc + jnp.sum((y @ m.reshape(1, -1)[:, :1].T))
-        tok = (tok + acc.astype(jnp.int32) * 0) % cfg.vocab_size
-        return (tok, cache, lengths)
-
-    # a matmul-shaped floor is fairer: one [B, r] @ [r, c] per weight
     def weights_floor2(state):
         tok, cache, lengths = state
         acc = jnp.zeros((B, 1), jnp.float32)

@@ -1,0 +1,93 @@
+"""LayerNorm/residual bandwidth probe (VERDICT r4 item 2 precursor).
+
+Measures what XLA's fusion already achieves for the LN+residual pattern
+at the 760M training shape, fwd and fwd+bwd, against the HBM roofline —
+decides whether a Pallas fused-LN kernel has headroom to win before one
+is written (the flash-kernel A/B discipline).
+
+    python scripts/ln_probe.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed_chain(step_fn, x0, n, warmup=3):
+    @jax.jit
+    def run(x, m):
+        x = lax.fori_loop(0, m, lambda i, xx: step_fn(xx), x)
+        return jnp.sum(x.astype(jnp.float32))
+
+    jax.block_until_ready(run(x0, warmup))
+
+    def once(m):
+        t0 = time.time()
+        jax.block_until_ready(run(x0, m))
+        return time.time() - t0
+
+    t_small = min(once(n), once(n))
+    t_big = min(once(5 * n), once(5 * n))
+    return (t_big - t_small) / (4 * n) * 1e3
+
+
+def main():
+    on_tpu = "tpu" in str(jax.devices()[0]).lower()
+    B, S, D = (12, 1024, 1536) if on_tpu else (2, 64, 32)
+    steps = int(os.environ.get("LN_STEPS", 50 if on_tpu else 2))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, S, D)), jnp.bfloat16)
+    r = jnp.asarray(rng.standard_normal((B, S, D)), jnp.bfloat16)
+    scale = jnp.ones((D,), jnp.float32)
+    bias = jnp.zeros((D,), jnp.float32)
+
+    from deepspeed_tpu.models.gpt2 import _layer_norm
+
+    nbytes = x.size * 2
+    peak = 819e9  # v5e HBM
+
+    def ln_fwd(x):
+        return _layer_norm(x, scale, bias, 1e-5)
+
+    def resln_fwd(x):
+        y = x + r
+        return _layer_norm(y, scale, bias, 1e-5)
+
+    g_ln = jax.grad(lambda x: jnp.sum(ln_fwd(x).astype(jnp.float32) ** 2))
+    g_resln = jax.grad(
+        lambda x: jnp.sum(resln_fwd(x).astype(jnp.float32) ** 2))
+
+    cal = jnp.asarray(rng.standard_normal((2048, 2048)), jnp.bfloat16)
+    mm_ms = timed_chain(lambda s: jnp.tanh(s @ cal), cal, steps)
+    mm_tf = 2 * 2048 ** 3 / (mm_ms * 1e-3) / 1e12 if mm_ms > 0 else 0
+    print(json.dumps({"calibration_tflops": round(mm_tf, 1),
+                      "tensor_mb": round(nbytes / 1e6, 1),
+                      "suspect": bool(on_tpu and (mm_tf <= 0 or mm_tf > 400))}))
+
+    cases = {
+        "ln_fwd": (ln_fwd, 2 * nbytes),             # read x, write y
+        "resln_fwd": (resln_fwd, 3 * nbytes),       # read x,r, write y
+        "ln_fwd_bwd": (lambda x: x + 1e-6 * g_ln(x).astype(x.dtype),
+                       6 * nbytes),
+        "resln_fwd_bwd": (lambda x: x + 1e-6 * g_resln(x).astype(x.dtype),
+                          7 * nbytes),
+    }
+    for name, (fn, ideal_bytes) in cases.items():
+        ms = timed_chain(fn, x, steps)
+        ideal_ms = ideal_bytes / peak * 1e3
+        print(json.dumps({
+            "case": name, "ms": round(ms, 4),
+            "ideal_ms": round(ideal_ms, 4),
+            "xla_vs_roofline": round(ms / ideal_ms, 2) if ms > 0 else None,
+        }))
+
+
+if __name__ == "__main__":
+    main()

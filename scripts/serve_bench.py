@@ -33,13 +33,32 @@ def main():
     quant = bool(int(os.environ.get("SERVE_INT8_WEIGHTS", "0")))
 
     from deepspeed_tpu import models as M
+
+    def _opt_model(size, **kw):
+        # OPT serves through the gpt2-family scaffold (pre-LN + ReLU —
+        # what opt_from_hf converts onto); this is the native-arch
+        # equivalent for rate measurement
+        return M.gpt2_model(size, activation="relu", **kw)
+
+    def _internlm_model(size, **kw):
+        # InternLM = llama block + biased q/k/v/o (llama_from_hf alias);
+        # "1b" picks InternLM-1.8B-like dims (no in-tree llama preset
+        # at this scale)
+        if size in ("1b", ""):
+            kw = dict(num_layers=16, num_heads=16, num_kv_heads=16,
+                      d_model=2048, d_mlp=5504, vocab_size=50000, **kw)
+            size = "custom"
+        return M.llama_model(size, attn_bias=True, **kw)
+
     arch, _, size = spec.partition(":")
     registry = {"gpt2": M.gpt2_model, "llama": M.llama_model,
                 "mixtral": M.mixtral_model, "neox": M.neox_model,
-                "bloom": M.bloom_model, "gptneo": M.gptneo_model}
+                "bloom": M.bloom_model, "gptneo": M.gptneo_model,
+                "opt": _opt_model, "megatron": M.gpt2_model,
+                "internlm": _internlm_model}
     if on_tpu:
         kwargs = {}
-    elif arch in ("llama", "mixtral"):
+    elif arch in ("llama", "mixtral", "internlm"):
         # these archs have their own tiny presets with consistent
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"

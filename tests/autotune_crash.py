@@ -1,0 +1,13 @@
+"""Entry-point model factory for the autotuner crash-isolation test:
+hard-kills its process (no catchable exception) for one grid leg."""
+import os
+
+from tests.util import tiny_gpt2
+
+
+def factory(**kw):
+    if kw.get("remat_policy") == "save_attn":
+        # simulate the uncatchable failure class (OOM-killer, Mosaic
+        # compiler abort): nothing in-process could survive this
+        os._exit(13)
+    return tiny_gpt2(**kw)

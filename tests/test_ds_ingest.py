@@ -77,8 +77,11 @@ def _write_mp_checkpoint(tmp_path, sd, tp_degree, iteration=100):
     for tp in range(tp_degree):
         shard = _tp_shard(sd, tp, tp_degree)
         torch.save({"module": shard, "iteration": iteration,
+                    # real DeepSpeed saves torch.Size values here — keep
+                    # them as Size to exercise the torch-free reader's
+                    # GLOBAL('torch','Size') mapping
                     "param_shapes": [collections.OrderedDict(
-                        (k, tuple(v.shape)) for k, v in shard.items())],
+                        (k, v.shape) for k, v in shard.items())],
                     "dp_world_size": 1},
                    d / f"mp_rank_{tp:02d}_model_states.pt")
         shards.append(shard)
