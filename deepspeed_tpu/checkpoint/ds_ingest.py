@@ -300,3 +300,29 @@ def megatron_gpt_from_ds_dir(ckpt_dir: str, num_heads: int, **overrides):
     from deepspeed_tpu.models.hf import megatron_gpt_from_sd
     sd = load_reference_checkpoint(ckpt_dir)
     return megatron_gpt_from_sd(sd, num_heads=num_heads, **overrides)
+
+
+def main(argv=None):
+    """CLI: ``python -m deepspeed_tpu.checkpoint.ds_ingest <dir> -o out.npz``
+    — merge a reference-layout checkpoint into one npz of named fp32
+    arrays (the offline counterpart of the reference's zero_to_fp32.py
+    script, runnable with no torch installed)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="Torch-free DeepSpeed/Megatron checkpoint merge")
+    parser.add_argument("ckpt_dir")
+    parser.add_argument("-o", "--output", default="merged_fp32.npz")
+    parser.add_argument("--no-zero", action="store_true",
+                        help="skip ZeRO fp32 reconstruction (module "
+                             "weights only)")
+    args = parser.parse_args(argv)
+    sd = load_reference_checkpoint(args.ckpt_dir,
+                                   prefer_zero_fp32=not args.no_zero)
+    np.savez(args.output, **{k: np.asarray(v) for k, v in sd.items()})
+    total = sum(int(np.asarray(v).size) for v in sd.values())
+    print(f"wrote {args.output}: {len(sd)} tensors, "
+          f"{total / 1e6:.1f}M parameters")
+
+
+if __name__ == "__main__":
+    main()

@@ -106,23 +106,31 @@ class MeshTopology:
             0, 1, 2, 4, 3, 5)
         self.mesh = Mesh(device_array, MESH_AXIS_ORDER)
         if hpz > 1:
-            self._check_hpz_locality(device_array)
+            self._check_axis_locality(device_array, 3, "hpZ",
+                                      "the secondary weight gather")
+        if hpz > 1 and sp > 1:
+            # the hpz-inner layout moved the seq stride from tp to
+            # hpz*tp; seq all-to-alls are per-layer traffic, so audit
+            # the displaced groups too
+            self._check_axis_locality(device_array, 4, "seq",
+                                      "the per-layer Ulysses/ring "
+                                      "all-to-all")
 
-    def _check_hpz_locality(self, device_array):
+    @staticmethod
+    def _check_axis_locality(device_array, axis, name, traffic):
         """Warn (accurately — by inspecting process ids, not geometry
-        guesses) if any hpZ group spans processes."""
-        hpz_groups = np.moveaxis(device_array, 3, -1).reshape(
-            -1, device_array.shape[3])
-        for grp in hpz_groups:
+        guesses) if any group along ``axis`` spans processes."""
+        groups = np.moveaxis(device_array, axis, -1).reshape(
+            -1, device_array.shape[axis])
+        for grp in groups:
             procs = {getattr(d, "process_index", 0) for d in grp}
             if len(procs) > 1:
                 from deepspeed_tpu.utils.logging import logger
                 logger.warning(
-                    "zero_hpz_partition_size %d: an hpZ group spans "
-                    "processes %s — the secondary gather will ride DCN, "
-                    "not ICI; shrink hpz or the model/seq axes so "
-                    "hpz*tp fits one host", device_array.shape[3],
-                    sorted(procs))
+                    "%s groups of size %d span processes %s — %s will "
+                    "ride DCN, not ICI; shrink the group or re-balance "
+                    "the mesh so it fits one host", name,
+                    device_array.shape[axis], sorted(procs), traffic)
                 return
 
     # ------------------------------------------------------------------ groups

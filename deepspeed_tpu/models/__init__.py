@@ -6,6 +6,7 @@ from deepspeed_tpu.models.bert import bert_model, BertConfig
 from deepspeed_tpu.models.neox import neox_model, NeoXConfig
 from deepspeed_tpu.models.gptneo import gptneo_model, GPTNeoConfig
 from deepspeed_tpu.models.bloom import bloom_model, BloomConfig
+from deepspeed_tpu.models.unet import unet_model, UNetConfig
 from deepspeed_tpu.models.hf import (gpt2_from_hf, llama_from_hf,
                                      bert_from_hf, mixtral_from_hf,
                                      opt_from_hf, neox_from_hf,

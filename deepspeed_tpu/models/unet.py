@@ -1,0 +1,301 @@
+"""Diffusion UNet through the Model protocol (reference capability:
+model_implementations/diffusers/{unet,vae,clip_encoder}.py:1 + the
+csrc/spatial NHWC kernels).
+
+COVERAGE.md round 4 scoped the reference's diffusers *wrappers* out (they
+are torch-pipeline glue for fp16 casts + CUDA-graph capture — properties
+every jitted JAX model gets from ``jit``), with the written claim that a
+diffusion model "plugs in with no framework changes".  This module
+proves that claim with a DDPM-style UNet2D built TPU-native:
+
+- NHWC layout end to end (TPU convs want channels minor; the reference's
+  csrc/spatial bias-adds exist to repair NCHW torch layouts — nothing to
+  port);
+- a mid-stack of spatial self-attention transformer blocks stored as the
+  stacked ``params["blocks"]`` subtree, so the SAME engine machinery that
+  serves LMs applies unchanged: int8 weight-only serving quantizes the
+  stack, TP logical specs shard it Megatron-style, ZeRO shards the rest;
+- the denoising-MSE ``loss_fn`` makes ``deepspeed_tpu.initialize`` train
+  it like any other model (timestep sampling + noising inside the jitted
+  step, rng threaded by the engine).
+"""
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.model import Model, maybe_stream, resolve_size
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+    image_size: int = 32
+    in_channels: int = 3
+    base_channels: int = 64
+    channel_mult: tuple = (1, 2)      # one downsample between stages
+    num_mid_blocks: int = 2           # stacked attention blocks at the mid
+    num_heads: int = 4
+    time_dim: int = 128
+    diffusion_steps: int = 1000
+    dtype: str = "float32"
+    group_norm_groups: int = 8
+
+    @property
+    def mid_channels(self) -> int:
+        return self.base_channels * self.channel_mult[-1]
+
+
+UNET_SIZES = {
+    "tiny": dict(image_size=8, base_channels=16, num_mid_blocks=2,
+                 num_heads=2, time_dim=32, group_norm_groups=4),
+    "small": dict(image_size=32, base_channels=64, num_mid_blocks=2),
+    "base": dict(image_size=64, base_channels=128, num_mid_blocks=4,
+                 num_heads=8, time_dim=512),
+}
+
+
+# ------------------------------------------------------------------- layers
+def _conv(x, w, b):
+    """NHWC 3x3 same conv: w [3, 3, Cin, Cout]."""
+    y = lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + b.astype(x.dtype)
+
+
+def _group_norm(x, scale, bias, groups, eps=1e-5):
+    B, H, W, C = x.shape
+    g = x.astype(jnp.float32).reshape(B, H, W, groups, C // groups)
+    mu = g.mean(axis=(1, 2, 4), keepdims=True)
+    var = ((g - mu) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+    g = (g - mu) * lax.rsqrt(var + eps)
+    return (g.reshape(B, H, W, C) * scale + bias).astype(x.dtype)
+
+
+def _res_block(x, p, temb, groups):
+    """GroupNorm -> silu -> conv, twice, with a timestep shift injected
+    between, plus the residual (1x1 when channels change)."""
+    h = _group_norm(x, p["n1_s"], p["n1_b"], groups)
+    h = _conv(jax.nn.silu(h), p["c1_w"], p["c1_b"])
+    h = h + (temb @ p["t_w"].astype(h.dtype)
+             + p["t_b"].astype(h.dtype))[:, None, None, :]
+    h = _group_norm(h, p["n2_s"], p["n2_b"], groups)
+    h = _conv(jax.nn.silu(h), p["c2_w"], p["c2_b"])
+    if "skip_w" in p:
+        x = jnp.einsum("bhwc,cd->bhwd", x, p["skip_w"].astype(x.dtype))
+    return x + h
+
+
+def _attn_block(x_tokens, layer, cfg: UNetConfig):
+    """One mid transformer block over spatial tokens [B, HW, C] — the
+    Megatron shape: column-parallel QKV/MLP-in, row-parallel proj/out."""
+    B, T, C = x_tokens.shape
+    Hn = cfg.num_heads
+    hd = C // Hn
+    h = _ln(x_tokens, layer["ln1_s"], layer["ln1_b"])
+    qkv = h @ layer["qkv_w"].astype(h.dtype) + layer["qkv_b"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, Hn, hd)
+    k = k.reshape(B, T, Hn, hd)
+    v = v.reshape(B, T, Hn, hd)
+    # diffusion self-attention is BIdirectional (no causal mask); spatial
+    # T is small (HW tokens at the mid resolution) — the plain einsum is
+    # the right tool, XLA fuses the chain
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    probs = jax.nn.softmax(scores * (hd ** -0.5), axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, C)
+    x_tokens = x_tokens + (attn @ layer["proj_w"].astype(h.dtype)
+                           + layer["proj_b"].astype(h.dtype))
+    h = _ln(x_tokens, layer["ln2_s"], layer["ln2_b"])
+    h = jax.nn.gelu(h @ layer["mlp_in_w"].astype(h.dtype)
+                    + layer["mlp_in_b"].astype(h.dtype))
+    return x_tokens + (h @ layer["mlp_out_w"].astype(h.dtype)
+                       + layer["mlp_out_b"].astype(h.dtype))
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half, dtype=jnp.float32)
+                    / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+
+# ------------------------------------------------------------------- params
+def init_params(config: UNetConfig, rng) -> dict:
+    C0 = config.base_channels
+    C1 = config.mid_channels
+    Cin = config.in_channels
+    TD = config.time_dim
+    L = config.num_mid_blocks
+    k = iter(jax.random.split(rng, 64))
+    n = lambda *s: jax.random.normal(next(k), s, jnp.float32)
+
+    def conv_p(cin, cout, scale=0.02):
+        return {"w": n(3, 3, cin, cout) * scale, "b": jnp.zeros((cout,))}
+
+    def res_p(cin, cout):
+        p = {"n1_s": jnp.ones((cin,)), "n1_b": jnp.zeros((cin,)),
+             "c1_w": n(3, 3, cin, cout) * 0.02, "c1_b": jnp.zeros((cout,)),
+             "t_w": n(TD, cout) * 0.02, "t_b": jnp.zeros((cout,)),
+             "n2_s": jnp.ones((cout,)), "n2_b": jnp.zeros((cout,)),
+             "c2_w": n(3, 3, cout, cout) * 0.02, "c2_b": jnp.zeros((cout,))}
+        if cin != cout:
+            p["skip_w"] = n(cin, cout) * 0.02
+        return p
+
+    blocks = {
+        "ln1_s": jnp.ones((L, C1)), "ln1_b": jnp.zeros((L, C1)),
+        "qkv_w": n(L, C1, 3 * C1) * 0.02, "qkv_b": jnp.zeros((L, 3 * C1)),
+        "proj_w": n(L, C1, C1) * 0.02, "proj_b": jnp.zeros((L, C1)),
+        "ln2_s": jnp.ones((L, C1)), "ln2_b": jnp.zeros((L, C1)),
+        "mlp_in_w": n(L, C1, 4 * C1) * 0.02,
+        "mlp_in_b": jnp.zeros((L, 4 * C1)),
+        "mlp_out_w": n(L, 4 * C1, C1) * 0.02,
+        "mlp_out_b": jnp.zeros((L, C1)),
+    }
+    return {
+        "time_mlp_in": n(TD, TD) * 0.02, "time_mlp_in_b": jnp.zeros((TD,)),
+        "time_mlp_out": n(TD, TD) * 0.02, "time_mlp_out_b": jnp.zeros((TD,)),
+        "stem": conv_p(Cin, C0),
+        "down1": res_p(C0, C0),
+        "down_sample": conv_p(C0, C1),     # stride-2 applied in forward
+        "down2": res_p(C1, C1),
+        "blocks": blocks,
+        "up1": res_p(2 * C1, C1),
+        "up2": res_p(C1 + C0, C0),
+        "head_n_s": jnp.ones((C0,)), "head_n_b": jnp.zeros((C0,)),
+        "head": conv_p(C0, Cin, scale=1e-3),
+    }
+
+
+def logical_specs(config: UNetConfig) -> dict:
+    """Megatron TP on the mid transformer stack; conv stages replicate
+    (their channel counts are small next to the mid stack)."""
+    # abstract init: structure only, no tensors materialize
+    shapes = jax.eval_shape(partial(init_params, config),
+                            jax.random.PRNGKey(0))
+    none = lambda p: jax.tree.map(lambda _: P(), p)
+    return {
+        "time_mlp_in": P(), "time_mlp_in_b": P(),
+        "time_mlp_out": P(), "time_mlp_out_b": P(),
+        "stem": {"w": P(), "b": P()},
+        "down1": none(shapes["down1"]),
+        "down_sample": {"w": P(), "b": P()},
+        "down2": none(shapes["down2"]),
+        "blocks": {
+            "ln1_s": P(), "ln1_b": P(),
+            "qkv_w": P(None, None, "model"), "qkv_b": P(None, "model"),
+            "proj_w": P(None, "model", None), "proj_b": P(),
+            "ln2_s": P(), "ln2_b": P(),
+            "mlp_in_w": P(None, None, "model"), "mlp_in_b": P(None, "model"),
+            "mlp_out_w": P(None, "model", None), "mlp_out_b": P(),
+        },
+        "up1": none(shapes["up1"]),
+        "up2": none(shapes["up2"]),
+        "head_n_s": P(), "head_n_b": P(),
+        "head": {"w": P(), "b": P()},
+    }
+
+
+# ------------------------------------------------------------------ forward
+def forward(params, batch, config: UNetConfig, rng=None):
+    """eps prediction: batch {"images" [B,H,W,C] noised, "timesteps" [B]}
+    -> eps_hat [B,H,W,C]."""
+    dtype = jnp.dtype(config.dtype)
+    x = batch["images"].astype(dtype)
+    t = batch["timesteps"]
+    g = config.group_norm_groups
+
+    temb = _timestep_embedding(t, config.time_dim).astype(dtype)
+    temb = jax.nn.silu(temb @ params["time_mlp_in"].astype(dtype)
+                       + params["time_mlp_in_b"].astype(dtype))
+    temb = (temb @ params["time_mlp_out"].astype(dtype)
+            + params["time_mlp_out_b"].astype(dtype))
+
+    h0 = _conv(x, params["stem"]["w"], params["stem"]["b"])
+    h0 = _res_block(h0, params["down1"], temb, g)
+    # stride-2 downsample into the mid width
+    h1 = lax.conv_general_dilated(
+        h0, params["down_sample"]["w"].astype(dtype), (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")
+    ) + params["down_sample"]["b"].astype(dtype)
+    h1 = _res_block(h1, params["down2"], temb, g)
+
+    # mid: spatial self-attention transformer stack (lax.scan over the
+    # stacked blocks — the LM machinery's layout)
+    B, Hh, Ww, C1 = h1.shape
+    tokens = h1.reshape(B, Hh * Ww, C1)
+
+    def body(carry, layer):
+        layer = maybe_stream(layer)
+        return _attn_block(carry, layer, config), None
+
+    tokens, _ = lax.scan(body, tokens, params["blocks"])
+    hm = tokens.reshape(B, Hh, Ww, C1)
+
+    u = _res_block(jnp.concatenate([hm, h1], axis=-1), params["up1"],
+                   temb, g)
+    # nearest-neighbour upsample back to the stem resolution
+    u = jnp.repeat(jnp.repeat(u, 2, axis=1), 2, axis=2)
+    u = _res_block(jnp.concatenate([u, h0], axis=-1), params["up2"],
+                   temb, g)
+    u = jax.nn.silu(_group_norm(u, params["head_n_s"], params["head_n_b"],
+                                g))
+    return _conv(u, params["head"]["w"], params["head"]["b"])
+
+
+def ddpm_loss(params, batch, config: UNetConfig, rng=None):
+    """Denoising objective: sample t and eps inside the jitted step, noise
+    the clean images with the DDPM cosine-free linear schedule, and
+    regress the predicted eps (Ho et al. 2020 — public formulation)."""
+    clean = batch["images"]
+    B = clean.shape[0]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    t_key, e_key = jax.random.split(jax.random.fold_in(rng, 1))
+    t = jax.random.randint(t_key, (B,), 0, config.diffusion_steps)
+    eps = jax.random.normal(e_key, clean.shape, jnp.float32)
+    beta = jnp.linspace(1e-4, 0.02, config.diffusion_steps)
+    abar = jnp.cumprod(1.0 - beta)[t][:, None, None, None]
+    noised = (jnp.sqrt(abar) * clean.astype(jnp.float32)
+              + jnp.sqrt(1.0 - abar) * eps)
+    pred = forward(params, {"images": noised, "timesteps": t}, config, rng)
+    return jnp.mean((pred.astype(jnp.float32) - eps) ** 2)
+
+
+def count_params(config: UNetConfig) -> int:
+    p = jax.eval_shape(partial(init_params, config), jax.random.PRNGKey(0))
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(p))
+
+
+def unet_model(size: str = "small", **overrides) -> Model:
+    cfg_kwargs = resolve_size(UNET_SIZES, size, "unet")
+    cfg_kwargs.update(overrides)
+    config = UNetConfig(**cfg_kwargs)
+    if config.mid_channels % config.num_heads:
+        raise ValueError(
+            f"num_heads ({config.num_heads}) must divide the mid channel "
+            f"count ({config.mid_channels})")
+    n_params = count_params(config)
+    return Model(
+        config=config,
+        init_fn=partial(init_params, config),
+        apply_fn=lambda p, b, rng=None: forward(p, b, config, rng),
+        loss_fn=lambda p, b, rng=None: ddpm_loss(p, b, config, rng),
+        logical_specs=logical_specs(config),
+        meta={"name": f"unet-{size}", "n_params": n_params,
+              "modality": "diffusion"},
+    )

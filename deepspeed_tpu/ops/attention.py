@@ -73,7 +73,7 @@ def flash_causal_attention(q, k, v, segment_ids=None, fallback=True):
                 f"safety margin — raise it if this shape is known to "
                 f"compile). Shorten the sequence or use impl='auto' for "
                 f"the XLA fallback.")
-        if vmem_ok or not fallback:
+        if vmem_ok:    # the eager guard makes not-fallback imply vmem_ok
             try:
                 return ds_flash_attention(q, k, v, segment_ids=segment_ids,
                                           causal=True)

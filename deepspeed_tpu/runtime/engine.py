@@ -153,10 +153,20 @@ class DeepSpeedEngine:
             == jnp.bfloat16)
         self._opt_states_dtype = self._config.bf16.optimizer_states_dtype
         # reference data_types.grad_accum_dtype: gradient storage /
-        # accumulation dtype (default fp32 master accumulation)
+        # accumulation dtype (default fp32 master accumulation).
+        # Whitelisted so a typo (or the unsupported fp16) fails loudly
+        # instead of silently accumulating in fp32.
         _gad = self._config.data_types_config.grad_accum_dtype
-        self.grad_dtype = (jnp.bfloat16 if _gad in ("bf16", "bfloat16")
-                           else jnp.float32)
+        if _gad in (None, "fp32", "float32"):
+            self.grad_dtype = jnp.float32
+        elif _gad in ("bf16", "bfloat16"):
+            self.grad_dtype = jnp.bfloat16
+        else:
+            raise ValueError(
+                f"data_types.grad_accum_dtype={_gad!r}: supported values "
+                "are 'fp32' and 'bf16' (fp16 accumulation is not offered "
+                "— the fp16 path accumulates into fp32 masters, as the "
+                "reference's default does)")
 
         # ---- ZeRO sharding policy -------------------------------------------
         zc = self._config.zero_config
@@ -429,6 +439,16 @@ class DeepSpeedEngine:
         else:
             if optimizer is not None and isinstance(
                     optimizer, optax.GradientTransformation):
+                if self._bf16_master or self._opt_states_dtype:
+                    # a plain optax transform has no Kahan compensation —
+                    # bf16 masters without it silently DROP sub-ulp
+                    # updates (the failure the feature exists to prevent)
+                    raise ValueError(
+                        "bf16.master_weights_dtype/optimizer_states_dtype "
+                        "cannot be combined with a user-provided optimizer "
+                        "instance; configure an Adam-family optimizer by "
+                        "name instead (the engine builds the Kahan-"
+                        "compensated transform)")
                 inner = optimizer
             else:
                 inner = build_optimizer(

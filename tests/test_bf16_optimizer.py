@@ -143,3 +143,21 @@ def test_non_adam_rejects_state_dtypes(devices8):
         deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
             optimizer={"type": "Lamb", "params": {"lr": 1e-3}},
             bf16={"enabled": True, "optimizer_states_dtype": "bfloat16"}))
+
+
+def test_user_optimizer_instance_rejects_state_dtypes(devices8):
+    """A plain optax transform has no Kahan compensation; combining it
+    with bf16 masters would silently drop sub-ulp updates — reject."""
+    import optax
+    with pytest.raises(ValueError, match="user-provided optimizer"):
+        deepspeed_tpu.initialize(
+            model=tiny_gpt2(), optimizer=optax.adamw(1e-3),
+            config=base_config(
+                bf16={"enabled": True,
+                      "master_weights_dtype": "bfloat16"}))
+
+
+def test_grad_accum_dtype_whitelist(devices8):
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
+            data_types={"grad_accum_dtype": "fp17"}))

@@ -1,0 +1,83 @@
+"""Diffusion UNet through the Model protocol (VERDICT r4 item 10 — the
+reference's diffusers trio, model_implementations/diffusers/unet.py:1):
+proves COVERAGE.md's claim that diffusion models plug into the engine,
+TP, and int8 serving with no framework changes."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.unet import unet_model
+from tests.util import base_config
+
+
+def _image_batch(B=8, size=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"images": rng.standard_normal((1, B, size, size, 3))
+            .astype(np.float32)}
+
+
+def test_unet_trains_through_engine(devices8):
+    """deepspeed_tpu.initialize + train_batch on the denoising objective:
+    the engine's rng threading drives timestep/noise sampling inside the
+    jitted step, ZeRO-2 shards the optimizer."""
+    model = unet_model("tiny")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=base_config(
+        zero_optimization={"stage": 2}))
+    losses = []
+    for i in range(3):
+        losses.append(float(engine.train_batch(
+            batch=_image_batch(seed=i))))
+    assert np.isfinite(losses).all()
+    # the head starts near zero, so loss starts near E[eps^2] = 1 and the
+    # optimizer should not blow it up
+    assert losses[-1] < 3.0
+
+
+def test_unet_tp_matches_dp(devices8):
+    """AutoTP applied to the mid transformer stack: tp=2 losses match the
+    pure-DP run (the Megatron column/row specs on qkv/proj/mlp)."""
+    a, *_ = deepspeed_tpu.initialize(
+        model=unet_model("tiny"), config=base_config())
+    b, *_ = deepspeed_tpu.initialize(
+        model=unet_model("tiny"),
+        config=base_config(mesh={"model_parallel_size": 2}))
+    la = [float(a.train_batch(batch=_image_batch(seed=i)))
+          for i in range(2)]
+    lb = [float(b.train_batch(batch=_image_batch(seed=i)))
+          for i in range(2)]
+    np.testing.assert_allclose(lb, la, rtol=2e-4, atol=2e-5)
+
+
+def test_unet_int8_serving_forward(devices8):
+    """Weight-only int8 serving quantizes the stacked mid blocks (the
+    same `blocks` machinery as the LMs) and the eps prediction stays
+    close to full precision."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    model = unet_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "quant": {"enabled": True}},
+        model_parameters=params)
+    is_q = lambda x: isinstance(x, QuantizedTensor)
+    qleaves = [x for x in jax.tree_util.tree_leaves(
+        eng.params["blocks"], is_leaf=is_q) if is_q(x)]
+    assert qleaves, "mid transformer stack should quantize"
+
+    ref = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32"}, model_parameters=params)
+    batch = {"images": jnp.asarray(
+        np.random.default_rng(3).standard_normal((2, 8, 8, 3)),
+        jnp.float32), "timesteps": jnp.asarray([10, 500], jnp.int32)}
+    out_q = np.asarray(eng.forward(batch))
+    out_f = np.asarray(ref.forward(batch))
+    assert out_q.shape == (2, 8, 8, 3)
+    # int8 blocks only perturb the mid stack; eps maps are close
+    assert np.max(np.abs(out_q - out_f)) < 0.1
+
+
+def test_unet_unknown_size_raises():
+    with pytest.raises(ValueError, match="unet"):
+        unet_model("7b")
