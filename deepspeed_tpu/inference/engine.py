@@ -129,6 +129,14 @@ class InferenceEngine:
                     # negligible bytes, free accuracy).  q/s inherit the
                     # weight's TP layout so int8 serving composes with
                     # tensor parallelism.
+                    if (isinstance(x, np.ndarray)
+                            and np.issubdtype(x.dtype, np.floating)):
+                        # host arrays cast to compute dtype ON HOST: the
+                        # fp32->int8 donation cannot alias (different
+                        # byte sizes), so an fp32 transfer doubles both
+                        # the wire bytes and the device-side peak — at
+                        # 7B the difference between fitting 16 GB or not
+                        x = x.astype(self.dtype)
                     x = jnp.asarray(x)
                     if not jnp.issubdtype(x.dtype, jnp.floating):
                         return x        # non-float buffers pass through
@@ -299,32 +307,36 @@ class InferenceEngine:
             done = (jnp.full((B,), False) if eos_id is None
                     else nxt == eos_id)
 
-            # fori_loop, not lax.scan: with the KV cache in the carry, scan's
-            # ys stacking + carry plumbing measured +0.12 ms/token on chip
-            # (scripts/decode_profile.py engine_scan_mimic vs unroll_mask);
-            # the fori body updates the cache and the token buffer in place
-            gen0 = jnp.zeros((B, max_new), jnp.int32)
-            gen0 = jax.lax.dynamic_update_slice(gen0, nxt[:, None], (0, 0))
-
-            def body(i, carry):
-                cache, tok, lens, rng, done, out = carry
+            # lax.scan with ys-emitted tokens: A/B'd against a fori_loop +
+            # in-place token buffer on chip — the scan form is ~0.1 ms/token
+            # FASTER (the per-step dynamic_update_slice into the output
+            # buffer costs more than scan's ys stacking;
+            # scripts/decode_profile.py engine_{scan,fori}_mimic)
+            def body(carry, _):
+                cache, tok, lens, rng, done = carry
                 logits, cache = model.decode_fn(params, tok, cache, lens)
                 cache = pin(cache)
                 if do_sample:
                     rng, sub = jax.random.split(rng)
                 else:
-                    sub = rng
+                    sub = rng       # greedy: keep threefry out of the loop
                 new = sample(logits, sub, do_sample=do_sample,
                              temperature=temperature, top_k=top_k, top_p=top_p)
                 if eos_id is not None:
                     new = jnp.where(done, jnp.int32(eos_id), new)
-                    done = jnp.logical_or(done, new == eos_id)
-                out = jax.lax.dynamic_update_slice(out, new[:, None], (0, i))
-                return (cache, new, lens + 1, rng, done, out)
+                    new_done = jnp.logical_or(done, new == eos_id)
+                else:
+                    new_done = done
+                return (cache, new, lens + 1, rng, new_done), new
 
             # max_new-1 decode steps: the prefill already sampled token 0
-            _, _, _, _, _, gen_tokens = jax.lax.fori_loop(
-                1, max_new, body, (cache, nxt, lengths, rng, done, gen0))
+            _, rest = jax.lax.scan(
+                body, (cache, nxt, lengths, rng, done), None,
+                length=max_new - 1)
+            gen_tokens = jnp.concatenate(
+                [nxt[:, None],
+                 rest.T.astype(nxt.dtype)],
+                axis=1)                                      # [B, max_new]
             # write generated tokens at each row's true positions
             out = jnp.zeros((B, total), jnp.int32)
             out = jax.lax.dynamic_update_slice(out, tokens_padded, (0, 0))

@@ -78,7 +78,16 @@ def main():
         dtype="bfloat16" if on_tpu else "float32",
         quant={"enabled": quant},
         kv_cache_dtype=kv_dtype)
-    eng = InferenceEngine(model, cfg)
+    params = None
+    n_params = model.meta.get("n_params", 0)
+    if quant and n_params * 2 > 8e9 and model.numpy_init_fn is not None:
+        # int8 serving of models beyond HBM at full precision (the MoQ
+        # big-model path): init on HOST, quantize leaf-by-leaf on device
+        # — device-side init would materialize the full bf16 tree first
+        print(f"# host-init {n_params/1e9:.1f}B params for int8 serving",
+              file=sys.stderr)
+        params = model.numpy_init_fn(seed=0)
+    eng = InferenceEngine(model, cfg, model_parameters=params)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
