@@ -362,7 +362,7 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
     scale (GPT-Neo's unscaled form passes 1.0); ``min_pos_fn(idx,
     lengths) -> [B]`` supplies a per-layer sliding-window floor for the
     decode kernel."""
-    from deepspeed_tpu.models.serving import write_token
+    from deepspeed_tpu.models.serving import use_scan_decode, write_token
     from deepspeed_tpu.ops.pallas.decode_attention import (
         decode_attention, quantize_kv)
     B = tokens.shape[0]
@@ -372,6 +372,22 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
          params["wpe"].astype(dtype)[lengths])              # [B, D]
 
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
+
+    if (use_scan_decode(params["blocks"])
+            and sm_scale is None and min_pos_fn is None):
+        # large int8 models: scan serializes the per-layer dequant (the
+        # unrolled loop lets XLA materialize every layer's bf16 weights
+        # at once — see serving.quantized_layer_bytes).  The GPT-Neo
+        # hooks (sm_scale/min_pos_fn) keep the unrolled form — those
+        # variants don't reach this scale quantized.
+        from deepspeed_tpu.models import serving as _sv
+        return _sv.decode_step_scan(
+            params, x, cache, lengths,
+            qkv_fn=lambda xx, layer, pos: _block_qkv(xx, layer, config),
+            finish_fn=lambda xx, attn, layer: _block_finish(
+                xx, attn, layer, config),
+            head_fn=lambda p, xx: head(p, xx, config),
+            num_heads=config.num_heads)
 
     # python-unrolled layer loop with in-place one-hot cache writes: 2.2x
     # faster than the round-4 lax.scan + scatter form (the scan

@@ -23,6 +23,35 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def quantized_layer_bytes(blocks) -> int:
+    """Total compute-dtype bytes a full dequantization of ``blocks``
+    would materialize (0 when nothing is quantized).  The decode
+    dispatchers use this to pick the loop form: the python-unrolled
+    decode gives XLA freedom to hoist per-layer dequants ACROSS layers
+    (nothing in layer l+1's dequant depends on layer l's output), and
+    past ~0.5 GB of dequantized weights that freedom turns into
+    materialized copies that crush throughput (gpt2-760M int8 measured
+    459 tok/s unrolled vs the scan form's sequential dequant; 125M —
+    where everything fuses — measured 8,688 unrolled)."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            total += jnp.dtype(leaf.dtype).itemsize * int(leaf.q.size)
+    return total
+
+
+QUANT_SCAN_THRESHOLD = 512 << 20
+
+
+def use_scan_decode(blocks) -> bool:
+    """The ONE dispatch rule for the decode loop form (both the shared
+    scaffold and gpt2's own decode call this): scan when a full dequant
+    of the quantized blocks would exceed the threshold."""
+    return quantized_layer_bytes(blocks) > QUANT_SCAN_THRESHOLD
+
+
 def write_token(c, l, new, lengths):
     """Write one decode step's vectors ``new`` [B, ...] at per-row fill
     positions ``lengths`` [B] into layer ``l`` of the stacked cache
@@ -34,12 +63,18 @@ def write_token(c, l, new, lengths):
     costs ~0.1 ms (measured, scripts/decode_profile.py; the select is one
     fused VPU pass at layer-slice bandwidth and updates in place inside
     the decode loop carry)."""
-    S = c.shape[2]
-    m = jnp.arange(S)[None, :] == lengths[:, None]          # [B, S]
-    m = m.reshape(m.shape + (1,) * (c.ndim - 3))
-    upd = jnp.where(m, new[:, None].astype(c.dtype), c[l])
+    upd = select_token(c[l], new, lengths)
     return lax.dynamic_update_slice(
         c, upd[None], (l,) + (0,) * (c.ndim - 1))
+
+
+def select_token(c_l, new, lengths):
+    """One-hot position select on a single layer's cache slice
+    ``c_l`` [B, S, ...] — the shared cache-write idiom (see write_token
+    for why a select, not a scatter)."""
+    m = jnp.arange(c_l.shape[1])[None, :] == lengths[:, None]   # [B, S]
+    m = m.reshape(m.shape + (1,) * (c_l.ndim - 2))
+    return jnp.where(m, new[:, None].astype(c_l.dtype), c_l)
 
 
 def init_cache(num_layers, num_kv_heads, head_dim, batch_size, max_len,
@@ -114,6 +149,11 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
+    if use_scan_decode(params["blocks"]):
+        return decode_step_scan(
+            params, x, cache, lengths, qkv_fn=qkv_fn, finish_fn=finish_fn,
+            head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes)
+
     kc, vc = cache["k"], cache["v"]
     ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
     L = kc.shape[0]
@@ -143,3 +183,55 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     if quantized:
         return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
     return logits, {"k": kc, "v": vc}
+
+
+def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
+                     head_fn, num_heads, alibi_slopes=None):
+    """lax.scan decode body for LARGE int8-quantized models: scan
+    semantics serialize the per-layer dequant, so at most one layer's
+    bf16 weights exist at a time (see ``quantized_layer_bytes``)."""
+    from deepspeed_tpu.models.model import maybe_stream
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, quantize_kv)
+    B = x.shape[0]
+    H = num_heads
+    q_cache = "k_s" in cache
+
+    def write_slice(c_l, new):
+        return select_token(c_l, new, lengths)
+
+    def body(carry, layer_kv):
+        if q_cache:
+            layer, kc, vc, ksc, vsc = layer_kv
+        else:
+            layer, kc, vc = layer_kv
+            ksc = vsc = None
+        layer = maybe_stream(layer)
+        q, kk, v = qkv_fn(carry[:, None, :], layer, lengths[:, None])
+        hd = q.shape[-1]
+        if q_cache:
+            kq, ks1 = quantize_kv(kk[:, 0])
+            vq, vs1 = quantize_kv(v[:, 0])
+            kc, vc = write_slice(kc, kq), write_slice(vc, vq)
+            ksc, vsc = write_slice(ksc, ks1), write_slice(vsc, vs1)
+        else:
+            kc = write_slice(kc, kk[:, 0])
+            vc = write_slice(vc, v[:, 0])
+        attn = decode_attention(q[:, 0], kc, vc, lengths + 1,
+                                k_scale=ksc, v_scale=vsc,
+                                alibi_slopes=alibi_slopes)
+        out = finish_fn(carry[:, None, :],
+                        attn.reshape(B, 1, H * hd).astype(carry.dtype),
+                        layer)[:, 0, :]
+        return out, ((kc, vc, ksc, vsc) if q_cache else (kc, vc))
+
+    xs = (params["blocks"], cache["k"], cache["v"])
+    if q_cache:
+        xs += (cache["k_s"], cache["v_s"])
+    x, ys = lax.scan(body, x, xs)
+    logits = head_fn(params, x[:, None, :])[:, 0]
+    if q_cache:
+        ks, vs, kss, vss = ys
+        return logits, {"k": ks, "v": vs, "k_s": kss, "v_s": vss}
+    ks, vs = ys
+    return logits, {"k": ks, "v": vs}

@@ -247,19 +247,22 @@ def main():
     def engine_fori(state):
         # the REJECTED generate-loop alternative (the engine ships the
         # scan form): fori_loop with an in-place token buffer — measured
-        # ~0.1 ms/token slower than scan's ys emission
+        # ~0.1 ms/token slower than scan's ys emission.  Carries the
+        # same done flag as engine_scan so the A/B isolates the
+        # token-emission mechanism alone.
         tok, cache, lengths = state
         out0 = jnp.zeros((B, 8), jnp.int32)
+        done0 = jnp.zeros((B,), bool)
 
         def body(i, carry):
-            cache, tok, lens, out = carry
+            cache, tok, lens, done, out = carry
             logits, cache = G.decode_step(params, tok, cache, lens, cfg)
             new = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             out = lax.dynamic_update_slice(out, new[:, None], (0, i))
-            return (cache, new, jnp.minimum(lens + 1, S - 1), out)
+            return (cache, new, jnp.minimum(lens + 1, S - 1), done, out)
 
-        cache, tok, lengths, out = lax.fori_loop(
-            0, 8, body, (cache, tok, lengths, out0))
+        cache, tok, lengths, _, out = lax.fori_loop(
+            0, 8, body, (cache, tok, lengths, done0, out0))
         return (tok + out[:, -1] * 0, cache, lengths)
 
     def engine_scan_steps(n, fn=None):

@@ -321,3 +321,34 @@ def test_ds_bench_runs_on_virtual_mesh():
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=240)
     assert "all_reduce" in r.stdout, r.stderr[-1500:]
+
+
+def test_ds_migrate_cli(tmp_path, capsys):
+    """Round-5 migration CLI: merges a reference-layout dir to npz,
+    torch-free at read time (the fixture is written by real torch)."""
+    import runpy
+    torch = pytest.importorskip("torch")
+    import collections
+    d = tmp_path / "ck" / "global_step3"
+    d.mkdir(parents=True)
+    (tmp_path / "ck" / "latest").write_text("global_step3")
+    sd = collections.OrderedDict([("w", torch.arange(6.).reshape(2, 3))])
+    torch.save({"module": sd, "iteration": 3,
+                "param_shapes": [collections.OrderedDict(
+                    (k, v.shape) for k, v in sd.items())]},
+               d / "mp_rank_00_model_states.pt")
+    out = tmp_path / "m.npz"
+    import sys as _sys
+    argv = _sys.argv
+    _sys.argv = ["ds_migrate", str(tmp_path / "ck"), "-o", str(out)]
+    try:
+        runpy.run_path(str(REPO_BIN / "ds_migrate"), run_name="__main__")
+    except SystemExit as e:
+        assert not e.code
+    finally:
+        _sys.argv = argv
+    import numpy as np
+    z = np.load(out)
+    np.testing.assert_array_equal(z["w"],
+                                  np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert "wrote" in capsys.readouterr().out
