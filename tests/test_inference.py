@@ -359,3 +359,43 @@ def test_megatron_converted_cached_generate_matches_nocache(devices8):
     b = eng.generate(prompts, max_new_tokens=10, do_sample=False,
                      use_cache=True)
     np.testing.assert_array_equal(a, b)
+
+
+def test_scan_decode_matches_unrolled(devices8, monkeypatch):
+    """The large-int8 scan decode (serving.decode_step_scan) must produce
+    the SAME generations as the unrolled path — forced here by dropping
+    QUANT_SCAN_THRESHOLD to 0 so a tiny quantized model crosses it (no
+    test-size model exceeds the real 512 MB threshold)."""
+    from deepspeed_tpu.models import serving
+    from deepspeed_tpu.models.llama import llama_model
+    m = tiny_gpt2(d_model=64, num_heads=4)
+    params = m.init(jax.random.PRNGKey(0))
+    b = random_batch(batch_size=2, seq_len=8)
+
+    def gen(th):
+        monkeypatch.setattr(serving, "QUANT_SCAN_THRESHOLD", th)
+        eng = deepspeed_tpu.init_inference(
+            model=m, config={"dtype": "float32", "quant": {"enabled": True}},
+            model_parameters=params)
+        return np.asarray(eng.generate(b["input_ids"], max_new_tokens=8))
+
+    unrolled = gen(1 << 62)
+    scanned = gen(0)
+    np.testing.assert_array_equal(unrolled, scanned)
+
+    # the rotary scaffold's scan body too (llama form), incl. int8 KV
+    lm = llama_model("tiny", dtype="float32")
+    lparams = lm.init(jax.random.PRNGKey(1))
+
+    def lgen(th, kv=None):
+        monkeypatch.setattr(serving, "QUANT_SCAN_THRESHOLD", th)
+        eng = deepspeed_tpu.init_inference(
+            model=lm, config={"dtype": "float32",
+                              "quant": {"enabled": True},
+                              "kv_cache_dtype": kv},
+            model_parameters=lparams)
+        prompts = np.asarray([[3, 5, 7, 9], [2, 4, 6, 8]], np.int32)
+        return np.asarray(eng.generate(prompts, max_new_tokens=6))
+
+    np.testing.assert_array_equal(lgen(1 << 62), lgen(0))
+    np.testing.assert_array_equal(lgen(1 << 62, kv="int8"), lgen(0, kv="int8"))
