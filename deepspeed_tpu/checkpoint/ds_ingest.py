@@ -41,6 +41,15 @@ SEQUENTIAL_SUFFIXES = (
 CAT_DIM_1_SUFFIXES = ("self_attention.dense.weight",
                       "attention.dense.weight",
                       "mlp.dense_4h_to_h.weight")
+# column-parallel layers' biases concatenate on dim 0 (reference CAT_DIM
+# rules).  Decided by NAME, never by shard equality: zero-initialized
+# column-parallel bias shards are bit-identical and equality would
+# silently replicate (and truncate) them.
+COLUMN_PARALLEL_BIAS_SUFFIXES = (
+    # endswith-matches the self_attention./attention./mlp. prefixed forms
+    "query_key_value.bias",
+    "dense_h_to_4h.bias",
+)
 
 _MP_RE = re.compile(r"mp_rank_(\d+)_model_states\.pt$")
 _LAYER_RE = re.compile(r"layer_(\d+)-model_(\d+)-model_states\.pt$")
@@ -90,10 +99,14 @@ def merge_tp_shards(shards: List[Dict[str, np.ndarray]],
             # scalar or ragged (shouldn't happen in TP shards): take rank 0
             merged[key] = parts[0]
             continue
-        if first.ndim == 1 and key.endswith((".bias", "norm.weight")) \
-                and override is None:
-            # biases of column-parallel layers concat; norms replicate —
-            # replicated shards are bit-identical, so detect by equality
+        if first.ndim == 1 and override is None \
+                and not key.endswith(COLUMN_PARALLEL_BIAS_SUFFIXES) \
+                and key.endswith((".bias", "norm.weight")):
+            # 1-D leaves with no reference CAT_DIM name: norms and
+            # row-parallel biases replicate.  Shard equality is only a
+            # secondary signal here — shards that DIFFER cannot be
+            # replicas, so they fall through to concat; known
+            # column-parallel biases never take this branch at all.
             if all(np.array_equal(np.asarray(p), first) for p in parts[1:]):
                 merged[key] = parts[0]
                 continue

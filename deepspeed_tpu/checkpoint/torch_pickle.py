@@ -20,8 +20,14 @@ rebuild as (possibly strided) numpy views, copied to own their memory.
 
 Unknown globals (Megatron args Namespaces, optimizer classes, ...) resolve
 to inert stub objects — attribute bags that absorb REDUCE/BUILD without
-executing anything, which also makes this loader safer than an
-unrestricted ``torch.load``.
+running the named callable.  numpy globals are restricted to an explicit
+allowlist of data reconstructors (``_NUMPY_ALLOWLIST``); a module-level
+wildcard would hand out executing callables like
+``numpy.testing._private.utils.runstring``.  This makes the loader far
+safer than an unrestricted ``torch.load``, but it is a hardened surface,
+not a proven sandbox: the pickle VM still drives the allowlisted
+reconstructors and dict/list machinery, so treat checkpoints from
+untrusted parties with the usual suspicion.
 """
 import io
 import pickle
@@ -58,7 +64,8 @@ class _StubBase:
     Namespaces, Megatron classes, torch dtypes...).  Construction absorbs
     any arguments; BUILD state lands in ``__dict__``; lookups of missing
     attributes return None so downstream ``getattr`` probing stays
-    harmless.  Nothing from the checkpoint executes."""
+    harmless.  No checkpoint-named callable body runs — construction and
+    BUILD only fill ``__dict__`` (hardening, not a formal sandbox)."""
 
     def __new__(cls, *a, **kw):
         return object.__new__(cls)
@@ -120,6 +127,25 @@ def _rebuild_parameter(data, requires_grad=False, backward_hooks=None):
     return data
 
 
+# The only numpy globals a tensor/ndarray/scalar pickle legitimately
+# references (both the pre- and post-numpy-2.0 module paths).  Everything
+# else under numpy.* resolves to an inert stub — numpy is full of
+# callables that execute on REDUCE (numpy.testing._private.utils.runstring
+# runs arbitrary code strings).
+_NUMPY_ALLOWLIST = frozenset([
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    # pickle protocol >= 5 ndarrays reconstruct through _frombuffer
+    # (bytes -> array; data-only)
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+])
+
+
 class _TorchUnpickler(pickle.Unpickler):
     def __init__(self, data_pkl: bytes, load_storage):
         super().__init__(io.BytesIO(data_pkl))
@@ -147,14 +173,26 @@ class _TorchUnpickler(pickle.Unpickler):
                                              "complex", "bytearray"):
             import builtins
             return getattr(builtins, name)
-        if module.split(".")[0] == "numpy":
+        if module == "_codecs" and name == "encode":
+            # protocol-2 ndarray states carry their bytes as
+            # latin-1-encoded str + _codecs.encode (a pure str->bytes
+            # conversion; safe)
+            import codecs
+            return codecs.encode
+        if (module, name) in _NUMPY_ALLOWLIST or (
+                # numpy dtype classes (numpy.dtypes.Float32DType ...):
+                # zero-arg reconstructors for dtype pickles, data only
+                module == "numpy.dtypes" and name.endswith("DType")):
             import importlib
             try:
                 return getattr(importlib.import_module(module), name)
             except Exception:
                 pass
         # torch dtype globals (torch.float32 ...), argparse.Namespace,
-        # Megatron/DeepSpeed classes: inert stubs
+        # Megatron/DeepSpeed classes, and EVERYTHING else — including the
+        # rest of numpy (numpy.testing._private.utils.runstring executes
+        # arbitrary strings; a module wildcard would hand it out): inert
+        # stubs
         return _make_stub(f"{module}.{name}")
 
     def persistent_load(self, pid):

@@ -87,6 +87,27 @@ class WandbMonitor(Monitor):
             self.wandb.log({name: value}, step=step)
 
 
+class InMemoryMonitor(Monitor):
+    """Process-local sink: keeps the latest value per metric name (plus a
+    bounded history).  The serving subsystem's default sink — the
+    /metrics endpoint and tests read ``latest`` without a writer dep."""
+
+    HISTORY = 1024
+
+    def __init__(self, config=None):
+        self.config = config
+        self.enabled = True
+        self.latest = {}                   # name -> (value, step)
+        self.history: List[Event] = []
+
+    def write_events(self, events: List[Event]):
+        for name, value, step in events:
+            self.latest[name] = (value, step)
+            self.history.append((name, value, step))
+        if len(self.history) > self.HISTORY:
+            del self.history[:len(self.history) - self.HISTORY]
+
+
 class MonitorMaster(Monitor):
     """Dispatches to all enabled sinks; only process 0 writes (reference
     monitor.py:29 checks rank 0)."""

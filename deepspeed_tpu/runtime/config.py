@@ -268,6 +268,70 @@ class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
 
+class ServingConfig(DeepSpeedConfigModel):
+    """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
+    sizing, iteration-level scheduler budgets, admission control.  TPU-
+    native addition — the reference's inference config has no serving
+    loop to configure."""
+    #: tokens per physical KV-cache block (the paging granularity)
+    block_size: int = 16
+    #: physical pool blocks, INCLUDING the reserved trash block 0;
+    #: pool HBM = (num_blocks*block_size) x layers x kv_heads x head_dim
+    num_blocks: int = 256
+    #: decode-batch width = max concurrently running sequences
+    max_num_seqs: int = 8
+    #: admission control: queued requests beyond this reject 429-style
+    max_queued: int = 128
+    #: per-step prefill token budget (iteration-level scheduling knob)
+    max_num_batched_tokens: int = 2048
+    #: per-sequence block-table length cap; 0 = model context / block_size
+    max_blocks_per_seq: int = 0
+    #: default queued-request timeout (seconds); 0 = wait forever
+    request_timeout_s: float = 0.0
+    #: scheduler steps between monitor-sink metric emissions
+    monitor_interval: int = 16
+    #: multi-step decode fusion cap: up to this many decode iterations run
+    #: inside ONE jitted lax.scan when the window provably cannot change a
+    #: scheduling decision (window = min remaining tokens over active
+    #: rows, so it ends exactly when the first row could retire).
+    #: Amortizes per-step dispatch; 1 disables.  Power of two.
+    max_fused_steps: int = 8
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.block_size < 1:
+            raise ValueError(f"serving.block_size={self.block_size}: "
+                             "must be >= 1")
+        if self.num_blocks < 2:
+            raise ValueError(f"serving.num_blocks={self.num_blocks}: need "
+                             ">= 2 (block 0 is the reserved trash block)")
+        if self.max_num_seqs < 1:
+            raise ValueError(
+                f"serving.max_num_seqs={self.max_num_seqs}: must be >= 1")
+        if self.max_queued < 1:
+            raise ValueError(
+                f"serving.max_queued={self.max_queued}: must be >= 1")
+        if self.max_num_batched_tokens < 1:
+            raise ValueError("serving.max_num_batched_tokens="
+                             f"{self.max_num_batched_tokens}: must be >= 1")
+        if self.max_blocks_per_seq < 0:
+            raise ValueError("serving.max_blocks_per_seq="
+                             f"{self.max_blocks_per_seq}: must be >= 0 "
+                             "(0 = model context / block_size)")
+        if self.request_timeout_s < 0:
+            raise ValueError("serving.request_timeout_s="
+                             f"{self.request_timeout_s}: must be >= 0 "
+                             "(0 = wait forever)")
+        if self.monitor_interval < 1:
+            raise ValueError("serving.monitor_interval="
+                             f"{self.monitor_interval}: must be >= 1")
+        if self.max_fused_steps < 1 or (
+                self.max_fused_steps & (self.max_fused_steps - 1)):
+            raise ValueError(
+                f"serving.max_fused_steps={self.max_fused_steps}: must be "
+                "a power of two >= 1 (one compiled program per size)")
+
+
 # --------------------------------------------------------------------------- root
 class DeepSpeedConfig:
     """Parses the JSON dict / file and exposes typed sub-configs + batch math."""
@@ -334,6 +398,7 @@ class DeepSpeedConfig:
         self.elasticity_config = ElasticityConfig(**d.get("elasticity", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
         self.data_types_config = DataTypesConfig(**d.get("data_types", {}))
+        self.serving_config = ServingConfig(**d.get("serving", {}))
         self.compression_config = d.get("compression_training", {})
         self.autotuning_config = d.get("autotuning", {})
         self.sparse_gradients_enabled = bool(d.get("sparse_gradients", False))

@@ -151,7 +151,24 @@ class DeepSpeedEngine:
             self._config.bf16.enabled
             and jnp.dtype(self._config.bf16.master_weights_dtype)
             == jnp.bfloat16)
+        if not self._config.bf16.enabled and jnp.dtype(
+                self._config.bf16.master_weights_dtype) != jnp.float32:
+            raise ValueError(
+                "bf16.master_weights_dtype="
+                f"{self._config.bf16.master_weights_dtype!r} requires "
+                "bf16.enabled (Kahan-compensated bf16 masters pair with "
+                "bf16 compute; remove the key or enable bf16)")
         self._opt_states_dtype = self._config.bf16.optimizer_states_dtype
+        if self._opt_states_dtype is not None \
+                and not self._config.bf16.enabled:
+            # the byte-diet state dtypes are bf16-training features —
+            # silently ignoring them under fp32/fp16 would misreport the
+            # optimizer HBM the user configured
+            raise ValueError(
+                "bf16.optimizer_states_dtype="
+                f"{self._opt_states_dtype!r} requires bf16.enabled "
+                "(the reduced-precision optimizer states pair with bf16 "
+                "compute; remove the key or enable bf16)")
         # reference data_types.grad_accum_dtype: gradient storage /
         # accumulation dtype (default fp32 master accumulation).
         # Whitelisted so a typo (or the unsupported fp16) fails loudly
@@ -160,6 +177,12 @@ class DeepSpeedEngine:
         if _gad in (None, "fp32", "float32"):
             self.grad_dtype = jnp.float32
         elif _gad in ("bf16", "bfloat16"):
+            if not self._config.bf16.enabled:
+                raise ValueError(
+                    f"data_types.grad_accum_dtype={_gad!r} requires "
+                    "bf16.enabled: bf16 gradient accumulation exists to "
+                    "halve the bf16 path's gradient-buffer bytes; under "
+                    "fp32/fp16 it would silently degrade accumulation")
             self.grad_dtype = jnp.bfloat16
         else:
             raise ValueError(

@@ -1,0 +1,29 @@
+"""Continuous-batching serving subsystem (ISSUE 1 tentpole).
+
+Iteration-level scheduling (Orca, OSDI '22) + block-granular KV-cache
+management (vLLM PagedAttention, SOSP '23) layered on the existing
+KV-cache machinery (`models/serving.py` cache layout, the Pallas decode
+kernel, `InferenceEngine` prefill/decode fns):
+
+- `request.py`   — typed request/response lifecycle
+  (QUEUED → PREFILL → DECODE → FINISHED, with EVICTED and REJECTED arcs)
+- `block_manager.py` — free-list allocator over a pool of fixed-size
+  token blocks; per-request block tables
+- `scheduler.py` — iteration-level engine loop: admits prefills up to a
+  token budget, packs the active decode set through the jitted decode
+  step via block-table gathers, retires finished rows mid-batch,
+  preempts (recompute-on-resume) under pool pressure
+- `server.py`    — stdlib HTTP front-end (/generate, /healthz, /metrics)
+  driving the scheduler on a background thread (bin/ds_serve)
+"""
+from deepspeed_tpu.serving.request import (RequestState, SamplingParams,
+                                           ServeRequest, AdmissionError,
+                                           QueueFullError, RequestTooLongError)
+from deepspeed_tpu.serving.block_manager import BlockManager
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+__all__ = [
+    "RequestState", "SamplingParams", "ServeRequest",
+    "AdmissionError", "QueueFullError", "RequestTooLongError",
+    "BlockManager", "ContinuousBatchingScheduler",
+]

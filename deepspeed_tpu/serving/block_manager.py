@@ -1,0 +1,80 @@
+"""Block-granular KV-cache accounting: a free-list allocator over a pool
+of fixed-size token blocks (vLLM PagedAttention's physical layer, minus
+swap — preempted requests recompute on resume).
+
+The physical cache itself lives in the scheduler as a position-flat
+pytree ``[L, num_blocks * block_size, ...]`` (the `models/serving.py`
+`init_cache` layout with the batch dim collapsed into the pool); this
+class owns only the integer bookkeeping.  Block 0 is reserved as the
+trash block: padding rows in the packed decode batch point their tables
+at it, so their (ignored) cache writes can never land in a live block.
+"""
+from typing import Dict, List, Optional
+
+
+class BlockManager:
+    TRASH_BLOCK = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: need >= 2 "
+                             "(block 0 is the reserved trash block)")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size}: need >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: recently-freed blocks are re-handed first, so a
+        # drained-and-refilled pool stays compact
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: Dict[int, List[int]] = {}     # request_id -> blocks
+
+    # -------------------------------------------------------------- sizes
+    @property
+    def num_usable_blocks(self) -> int:
+        return self.num_blocks - 1          # minus the trash block
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated_blocks(self) -> int:
+        return self.num_usable_blocks - self.num_free_blocks
+
+    def utilization(self) -> float:
+        return self.num_allocated_blocks / max(self.num_usable_blocks, 1)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        return max(1, -(-num_tokens // self.block_size))
+
+    def fits_ever(self, num_tokens: int) -> bool:
+        """Could a request of this total length run on an EMPTY pool?"""
+        return self.blocks_for_tokens(num_tokens) <= self.num_usable_blocks
+
+    # ---------------------------------------------------------- allocate
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, request_id: int, n: int) -> Optional[List[int]]:
+        """Append ``n`` fresh blocks to the request's table; None (and no
+        state change) when the pool can't supply them."""
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._tables.setdefault(request_id, []).extend(got)
+        return got
+
+    def block_table(self, request_id: int) -> List[int]:
+        return self._tables.get(request_id, [])
+
+    def free(self, request_id: int):
+        """Return every block of the request to the pool (retire/evict)."""
+        for b in self._tables.pop(request_id, []):
+            self._free.append(b)
+
+    # ---------------------------------------------------------- addressing
+    def position_index(self, request_id: int, pos: int) -> int:
+        """Flat pool position for the request's logical token ``pos``."""
+        table = self._tables[request_id]
+        return table[pos // self.block_size] * self.block_size \
+            + pos % self.block_size

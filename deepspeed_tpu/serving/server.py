@@ -1,0 +1,196 @@
+"""Stdlib-only HTTP front-end for the continuous-batching scheduler
+(bin/ds_serve).
+
+Endpoints:
+  POST /generate  {"input_ids": [...], "max_new_tokens": 16,
+                   "temperature": .., "top_k": .., "top_p": ..,
+                   "do_sample": false, "eos_token_id": .., "seed": ..,
+                   "priority": 0}
+                  -> 200 {"request_id", "output_ids", "ttft_ms", ...}
+                  -> 429 when the queue is full / the request times out
+                  -> 400 for malformed bodies or impossible lengths
+  GET  /healthz   -> 200 {"status": "ok", "active": n, "queued": m}
+  GET  /metrics   -> text/plain ``name value`` lines (Prometheus-style)
+
+The scheduler loop runs on ONE background thread (the engine step is the
+unit of concurrency — iteration-level scheduling happens inside it);
+HTTP handler threads only enqueue and wait on the request's done event.
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
+                                           SamplingParams)
+from deepspeed_tpu.utils.logging import logger
+
+
+def model_from_spec(spec: str, **overrides):
+    """``arch:size`` -> Model via the in-tree registry (the serve_bench /
+    ds_autotune spec convention), e.g. ``gpt2:125m``, ``llama:tiny``."""
+    from deepspeed_tpu import models as M
+    registry = {"gpt2": M.gpt2_model, "llama": M.llama_model,
+                "mixtral": M.mixtral_model, "neox": M.neox_model,
+                "bloom": M.bloom_model, "gptneo": M.gptneo_model,
+                "bert": M.bert_model}
+    arch, _, size = spec.partition(":")
+    if arch not in registry:
+        raise ValueError(f"unknown model arch {arch!r}; "
+                         f"choose from {sorted(registry)}")
+    return registry[arch](size or "custom", **overrides)
+
+
+class ServingLoop:
+    """Background thread driving scheduler.step(); idles when drained."""
+
+    IDLE_SLEEP_S = 0.002
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ds-serve-loop")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            if self.scheduler.has_work():
+                try:
+                    self.scheduler.step()
+                except Exception:            # pragma: no cover - last resort
+                    logger.exception("serving loop: step failed")
+                    time.sleep(0.1)
+            else:
+                time.sleep(self.IDLE_SLEEP_S)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # injected by make_server
+    scheduler = None
+    default_timeout_s = 0.0
+
+    def log_message(self, fmt, *args):       # route through our logger
+        logger.debug("ds_serve: " + fmt % args)
+
+    # ------------------------------------------------------------ helpers
+    def _send_json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):
+        sched = self.scheduler
+        if self.path == "/healthz":
+            self._send_json(200, {
+                "status": "ok",
+                "active": len(sched.active_requests()),
+                "queued": sched.queue_depth()})
+            return
+        if self.path == "/metrics":
+            lines = []
+            for name, value in sorted(sched.metrics_snapshot().items()):
+                lines.append(f"{name.replace('/', '_')} {value}")
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            input_ids = body["input_ids"]
+            sampling = SamplingParams(
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                do_sample=bool(body.get("do_sample", False)),
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                eos_token_id=body.get("eos_token_id"),
+                seed=int(body.get("seed", 0)))
+            priority = int(body.get("priority", 0))
+            timeout_s = float(body.get("timeout_s",
+                                       self.default_timeout_s))
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            req = self.scheduler.submit(input_ids, sampling,
+                                        priority=priority,
+                                        timeout_s=timeout_s)
+        except QueueFullError as e:
+            self._send_json(429, {"error": str(e)})
+            return
+        except AdmissionError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:   # bad ids (empty, ragged...)
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        # wait for completion.  timeout_s bounds QUEUE wait (the
+        # scheduler's expiry path) — an admitted request may legitimately
+        # decode for a long time, so the handler only bails when the
+        # scheduler loop stops making progress for ~10 minutes (one STEP
+        # can hold the lock for minutes while XLA compiles a fresh
+        # prompt-bucket/fused-window program on a real model)
+        last_step, stuck = -1, 0
+        while not req.done.wait(timeout=60):
+            cur = self.scheduler.step_count
+            stuck = stuck + 1 if cur == last_step else 0
+            if stuck >= 10:
+                self._send_json(503, {"error": "serving loop stalled"})
+                return
+            last_step = cur
+        resp = req.to_response()
+        if req.reject_reason is not None:
+            self._send_json(429, resp)
+            return
+        self._send_json(200, resp)
+
+
+def make_server(scheduler, host: str = "127.0.0.1", port: int = 8000,
+                default_timeout_s: float = 0.0):
+    """(ThreadingHTTPServer, ServingLoop) — caller starts/joins both.
+    ``port=0`` binds an ephemeral port (tests)."""
+    handler = type("Handler", (_Handler,),
+                   {"scheduler": scheduler,
+                    "default_timeout_s": default_timeout_s})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    loop = ServingLoop(scheduler)
+    return httpd, loop
+
+
+def serve_forever(scheduler, host: str = "127.0.0.1", port: int = 8000,
+                  default_timeout_s: float = 0.0):  # pragma: no cover
+    httpd, loop = make_server(scheduler, host, port, default_timeout_s)
+    loop.start()
+    logger.info(f"ds_serve: listening on http://{host}:{httpd.server_port} "
+                f"(pool={scheduler.cfg.num_blocks}x"
+                f"{scheduler.cfg.block_size} tokens, "
+                f"max_num_seqs={scheduler.cfg.max_num_seqs})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        loop.shutdown()
+        httpd.server_close()

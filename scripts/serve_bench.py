@@ -1,5 +1,8 @@
 """Serving benchmark: steady-state decode tokens/s through the
-InferenceEngine (KV cache + Pallas decode kernel).
+InferenceEngine (KV cache + Pallas decode kernel), plus the
+continuous-batching mode (SERVE_MODE=cb) comparing the
+`deepspeed_tpu/serving/` scheduler against the static-batch baseline on
+a mixed-length workload.
 
 On-chip queue item (PERF.md): MoE int8-KV serving rate, plus rates for
 the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
@@ -7,8 +10,11 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     python scripts/serve_bench.py                          # gpt2 125m
     SERVE_MODEL=mixtral:1b-moe SERVE_KV=int8 python scripts/serve_bench.py
     SERVE_MODEL=bloom:560m SERVE_B=8 python scripts/serve_bench.py
+    SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
 
-Prints one JSON line: prefill ms + steady decode tokens/s.
+Static mode prints one JSON line: prefill ms + steady decode tokens/s.
+CB mode prints one JSON line: continuous-batching vs static-batch tok/s
+on the same mixed-length workload + p50/p99 TTFT.
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import json
@@ -63,13 +69,22 @@ def main():
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"
         kwargs = {}
+    elif os.environ.get("SERVE_MODE") == "cb":
+        # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
+        # ALL dispatch overhead and measures nothing — use the smallest
+        # shape where device compute is non-trivial
+        kwargs = dict(vocab_size=1024, num_layers=4, num_heads=4,
+                      d_model=128)
     else:
         kwargs = dict(vocab_size=256, num_layers=2, num_heads=4,
                       d_model=32)
+    # cb mode sizes its own heavy-tailed workload (bench_continuous_batching)
+    cb_ctx = (0 if os.environ.get("SERVE_MODE") != "cb"
+              else (768 + 384 if on_tpu else 96))
     model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
                            else "float32",
                            max_seq_len=max(2048 if on_tpu else 64,
-                                           prompt_len + new_tokens),
+                                           prompt_len + new_tokens, cb_ctx),
                            **kwargs)
 
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
@@ -88,6 +103,9 @@ def main():
               file=sys.stderr)
         params = model.numpy_init_fn(seed=0)
     eng = InferenceEngine(model, cfg, model_parameters=params)
+
+    if os.environ.get("SERVE_MODE") == "cb":
+        return bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -134,6 +152,104 @@ def main():
                    "new_tokens": new_tokens,
                    "prefill_ms": round(t_prefill * 1e3, 2),
                    "total_s": round(t_full, 3)},
+    }))
+
+
+def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu):
+    """Mixed-length workload through the iteration-level scheduler vs the
+    static-batch baseline (rectangular pad, batch drains as a unit).
+
+    The static baseline processes the same requests in arrival order in
+    batches of ``max_num_seqs``, padded to the batch max prompt and
+    decoding the batch max new_tokens — what `generate` alone offers.
+    Useful tokens (each request's own max_new_tokens) over wall time."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 32 if on_tpu else 16))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    # heavy-tailed lengths — the regime continuous batching exists for
+    # (a static batch pads every row to the batch max in BOTH dims)
+    p_lo, p_hi = ((32, 768) if on_tpu else (4, 48))
+    n_lo, n_hi = ((8, 384) if on_tpu else (2, 48))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    workload = [
+        (rng.integers(1, V, (int(pl),)).astype(np.int32), int(nn))
+        for pl, nn in zip(rng.integers(p_lo, p_hi, n_reqs),
+                          rng.integers(n_lo, n_hi, n_reqs))]
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 4
+    need = -(-(max_len) // bs) + 1
+    cfg = ServingConfig(
+        block_size=bs, max_num_seqs=max_seqs,
+        num_blocks=1 + need * max_seqs,     # full batch fits: measures
+        max_num_batched_tokens=1 << 30)     # scheduling, not preemption
+
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+
+    def run_cb():
+        # one scheduler across warmup+measurement: its jitted step fns
+        # (and their compiles) persist, as in a long-lived server
+        t0 = _time.time()
+        reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                for p, nn in workload]
+        sched.run_until_idle()
+        dt = _time.time() - t0
+        assert all(len(r.output_ids) == nn
+                   for r, (_, nn) in zip(reqs, workload))
+        ttfts = sorted(r.ttft_s for r in reqs)
+        return dt, ttfts
+
+    def run_static():
+        t0 = _time.time()
+        ttfts = []
+        for i in range(0, n_reqs, max_seqs):
+            batch = workload[i:i + max_seqs]
+            plen = max(p.size for p, _ in batch)
+            new = max(nn for _, nn in batch)
+            toks = np.zeros((len(batch), plen), np.int32)
+            for j, (p, _) in enumerate(batch):
+                toks[j, :p.size] = p        # right-padded rectangle
+            t_b = _time.time()
+            np.asarray(eng.generate(toks, max_new_tokens=new,
+                                    do_sample=False))
+            # static batches emit every token before ANY request returns:
+            # TTFT = the whole batch latency, for every request in it
+            ttfts.extend([_time.time() - t_b] * len(batch))
+        return _time.time() - t0, sorted(ttfts)
+
+    # warm both paths' compiles out of the measurement; then min-of-3
+    # (same convention as the static-mode slope measurement)
+    run_cb()
+    run_static()
+    cb_s, cb_ttft = min((run_cb() for _ in range(3)),
+                        key=lambda r: r[0])
+    st_s, st_ttft = min((run_static() for _ in range(3)),
+                        key=lambda r: r[0])
+    pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)
+    print(json.dumps({
+        "metric": f"{spec}_serve_cb"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / cb_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "requests": n_reqs, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "block_size": bs,
+            "cb_tok_s": round(useful / cb_s, 1),
+            "static_tok_s": round(useful / st_s, 1),
+            "speedup_vs_static": round(st_s / cb_s, 3),
+            "cb_ttft_p50_ms": pct(cb_ttft, 50),
+            "cb_ttft_p99_ms": pct(cb_ttft, 99),
+            "static_ttft_p50_ms": pct(st_ttft, 50),
+            "static_ttft_p99_ms": pct(st_ttft, 99),
+            "decode_steps_total": int(
+                sched.metrics.counters["decode_steps"]),
+        },
     }))
 
 

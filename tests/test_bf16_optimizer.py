@@ -161,3 +161,33 @@ def test_grad_accum_dtype_whitelist(devices8):
     with pytest.raises(ValueError, match="grad_accum_dtype"):
         deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
             data_types={"grad_accum_dtype": "fp17"}))
+
+
+def test_state_dtypes_require_bf16_enabled(devices8):
+    """The byte-diet dtypes are bf16-training features: without
+    bf16.enabled they must reject loudly (matching the
+    master_weights_dtype gate), not silently configure nothing."""
+    with pytest.raises(ValueError, match="optimizer_states_dtype"):
+        deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
+            bf16={"enabled": False, "optimizer_states_dtype": "bfloat16"}))
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
+            data_types={"grad_accum_dtype": "bf16"}))
+
+
+def test_state_dtypes_accepted_with_bf16_enabled(devices8):
+    """Gate's other branch: with bf16.enabled the same keys configure the
+    engine (bf16 grad accumulation + bf16 moments)."""
+    eng, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
+        bf16={"enabled": True, "optimizer_states_dtype": "bfloat16"},
+        data_types={"grad_accum_dtype": "bf16"}))
+    assert eng.grad_dtype == jnp.bfloat16
+    assert eng._opt_states_dtype == "bfloat16"
+
+
+def test_master_weights_dtype_requires_bf16_enabled(devices8):
+    """All three byte-diet keys gate identically — the master dtype used
+    to be silently ignored without bf16."""
+    with pytest.raises(ValueError, match="master_weights_dtype"):
+        deepspeed_tpu.initialize(model=tiny_gpt2(), config=base_config(
+            bf16={"enabled": False, "master_weights_dtype": "bfloat16"}))

@@ -115,3 +115,22 @@ def test_fp16_defaults():
     assert c.fp16.initial_scale_power == 16
     assert c.fp16.loss_scale == 0.0
     assert c.fp16.hysteresis == 2
+
+
+def test_serving_section_parses():
+    """ISSUE 1: the DS-style JSON `serving` section configures the
+    continuous-batching scheduler (deepspeed_tpu/serving/)."""
+    import pytest
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"block_size": 32, "num_blocks": 512,
+                                     "max_num_seqs": 16,
+                                     "request_timeout_s": 2.5}},
+                        mesh_topology=FakeTopo(1))
+    s = c.serving_config
+    assert (s.block_size, s.num_blocks, s.max_num_seqs) == (32, 512, 16)
+    assert s.request_timeout_s == 2.5
+    assert s.max_queued == 128            # defaults fill in
+    with pytest.raises(ValueError, match="max_fused_steps"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"max_fused_steps": 3}},
+                        mesh_topology=FakeTopo(1))

@@ -202,3 +202,74 @@ def test_megatron_logits_parity_from_ds_dir(tmp_path):
     lb = jax.jit(model_b.apply_fn)(params_b, {"input_ids": tokens})
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------- restricted unpickler
+def test_unpickler_rejects_numpy_executing_callables(tmp_path):
+    """ISSUE 1 satellite (ADVICE high): the numpy allowlist must NOT hand
+    out executing callables.  numpy.testing._private.utils.runstring
+    exec()s an arbitrary string — a module-level ``numpy.*`` wildcard
+    resolves it and a crafted checkpoint achieves code execution."""
+    import pickle
+    import zipfile
+
+    canary = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            import numpy.testing._private.utils as u
+            return (u.runstring,
+                    (f"open(r'{canary}', 'w').write('pwned')", {}))
+
+    path = tmp_path / "evil.pt"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("archive/data.pkl", pickle.dumps({"payload": Evil()}))
+        zf.writestr("archive/version", "3")
+    out = load_pt(str(path))
+    assert not canary.exists(), "checkpoint-controlled code executed!"
+    # the global resolved to an inert stub, not the real callable
+    assert "runstring" in type(out["payload"]).__name__
+
+
+def test_unpickler_allowlist_keeps_numpy_data(tmp_path):
+    """The flip side: legitimate numpy payloads (arrays, scalars, dtypes)
+    still reconstruct through the explicit allowlist."""
+    payload = {"arr": np.arange(6, dtype=np.float32).reshape(2, 3),
+               "scalar": np.float64(3.5),
+               "dt": np.dtype(np.int16)}
+    for proto in (2, 5):    # proto>=5 ndarrays ride _frombuffer instead
+        path = tmp_path / f"np{proto}.pt"
+        torch.save(payload, path, pickle_protocol=proto)
+        out = load_pt(str(path))
+        np.testing.assert_array_equal(out["arr"], payload["arr"])
+        assert float(out["scalar"]) == 3.5
+        assert np.dtype(out["dt"]) == np.int16
+
+
+def test_merge_tp_shards_zero_bias_concats_by_name():
+    """ISSUE 1 satellite (ADVICE medium): zero-initialized
+    column-parallel bias shards are bit-identical — the old equality
+    heuristic replicated (truncated) them.  The reference CAT_DIM name
+    rules must win."""
+    from deepspeed_tpu.checkpoint.ds_ingest import merge_tp_shards
+    qkv = "transformer.layers.0.self_attention.query_key_value.bias"
+    h4h = "transformer.layers.0.mlp.dense_h_to_4h.bias"
+    row_bias = "transformer.layers.0.self_attention.dense.bias"
+    norm = "transformer.layers.0.input_layernorm.weight"
+    shards = [
+        {qkv: np.zeros(6, np.float32), h4h: np.zeros(8, np.float32),
+         row_bias: np.full(4, 0.5, np.float32), norm: np.ones(4)},
+        {qkv: np.zeros(6, np.float32), h4h: np.zeros(8, np.float32),
+         row_bias: np.full(4, 0.5, np.float32), norm: np.ones(4)},
+    ]
+    merged = merge_tp_shards(shards)
+    assert merged[qkv].shape == (12,)          # concat, despite equality
+    assert merged[h4h].shape == (16,)
+    assert merged[row_bias].shape == (4,)      # row-parallel: replicated
+    assert merged[norm].shape == (4,)
+    # unknown-name 1-D biases that DIFFER still concat (equality is only
+    # a fallback signal, never an override of the name rules)
+    odd = "some_custom.proj.bias"
+    m2 = merge_tp_shards([{odd: np.zeros(3, np.float32)},
+                          {odd: np.ones(3, np.float32)}])
+    assert m2[odd].shape == (6,)
