@@ -195,22 +195,24 @@ def ppermute(tensor, perm, group=None):
 
 
 def axis_index(group=None):
+    from deepspeed_tpu.utils.jax_compat import axis_size
     ax = _axis(group)
     if isinstance(ax, str):
         return lax.axis_index(ax)
     idx = lax.axis_index(ax[0])
     for a in ax[1:]:
-        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        idx = idx * axis_size(a) + lax.axis_index(a)
     return idx
 
 
 def axis_size_in_jit(group=None):
+    from deepspeed_tpu.utils.jax_compat import axis_size
     ax = _axis(group)
     if isinstance(ax, str):
-        return lax.axis_size(ax)
+        return axis_size(ax)
     n = 1
     for a in ax:
-        n *= lax.axis_size(a)
+        n *= axis_size(a)
     return n
 
 

@@ -103,7 +103,7 @@ class InferenceEngine:
                         # last dim still divides over its axis; otherwise
                         # replicate that dim (tiny tensor)
                         C = x.shape[-1]
-                        nb = C // BLOCK if C % BLOCK == 0 else 1
+                        nb = -(-C // BLOCK)
                         last = tuple(spec)[-1] if len(spec) else None
                         tp_n = (int(np.prod([self.mesh.shape[a] for a in
                                              ((last,) if isinstance(

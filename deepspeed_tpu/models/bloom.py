@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, resolve_size
+from deepspeed_tpu.models.model import Model, qdot, resolve_size
 from deepspeed_tpu.models.neox import _ln
 
 
@@ -129,18 +129,18 @@ def _block_qkv(x, layer, config: BloomConfig, positions=None):
     H, hd = config.num_heads, config.head_dim
     dt = x.dtype
     h = _ln(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
-    qkv = h @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
+    qkv = qdot(h, layer["qkv_w"]) + layer["qkv_b"].astype(dt)
     return jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
 
 
 def _block_finish(x, attn_flat, layer, config: BloomConfig):
     dt = x.dtype
-    x = x + (attn_flat @ layer["dense_w"].astype(dt)
+    x = x + (qdot(attn_flat, layer["dense_w"])
              + layer["dense_b"].astype(dt))
     h = _ln(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
-    m = jax.nn.gelu(h @ layer["mlp_in_w"].astype(dt)
+    m = jax.nn.gelu(qdot(h, layer["mlp_in_w"])
                     + layer["mlp_in_b"].astype(dt), approximate=True)
-    return x + m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
+    return x + qdot(m, layer["mlp_out_w"]) + layer["mlp_out_b"].astype(dt)
 
 
 def _block(x, layer, config: BloomConfig, slopes, rng=None,

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, maybe_stream, resolve_size
+from deepspeed_tpu.models.model import (Model, maybe_stream, qdot,
+                                        resolve_size)
 from deepspeed_tpu.ops.attention import causal_attention
 
 
@@ -226,7 +227,7 @@ def _block_qkv(x, layer, config: GPT2Config):
     B, S, D = x.shape
     H, hd = config.num_heads, config.head_dim
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
-    qkv = h @ layer["qkv_w"].astype(h.dtype) + layer["qkv_b"].astype(h.dtype)
+    qkv = qdot(h, layer["qkv_w"]) + layer["qkv_b"].astype(h.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
     return (q.reshape(B, S, H, hd), kk.reshape(B, S, H, hd),
             v.reshape(B, S, H, hd))
@@ -234,14 +235,14 @@ def _block_qkv(x, layer, config: GPT2Config):
 
 def _block_finish(x, attn, layer, config: GPT2Config):
     """Post-attention half: proj + residual + MLP; x/attn [B, S, D]."""
-    x = x + attn @ layer["proj_w"].astype(x.dtype) + layer["proj_b"].astype(x.dtype)
+    x = x + qdot(attn, layer["proj_w"]) + layer["proj_b"].astype(x.dtype)
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
-    h = h @ layer["mlp_in_w"].astype(h.dtype) + layer["mlp_in_b"].astype(h.dtype)
+    h = qdot(h, layer["mlp_in_w"]) + layer["mlp_in_b"].astype(h.dtype)
     if config.activation == "relu":
         h = jax.nn.relu(h)
     else:
         h = jax.nn.gelu(h, approximate=config.activation != "gelu_exact")
-    x = x + h @ layer["mlp_out_w"].astype(x.dtype) + layer["mlp_out_b"].astype(x.dtype)
+    x = x + qdot(h, layer["mlp_out_w"]) + layer["mlp_out_b"].astype(x.dtype)
     return x
 
 
@@ -392,11 +393,16 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
     # python-unrolled layer loop with in-place one-hot cache writes: 2.2x
     # faster than the round-4 lax.scan + scatter form (the scan
     # dynamic-sliced every layer's weights and double-buffered the cache;
-    # TPU scatter alone cost ~0.6 ms/step — scripts/decode_profile.py)
+    # TPU scatter alone cost ~0.6 ms/step — scripts/decode_profile.py).
+    # int8 weights ride the fused-dequant qgemm path (keep_quantized):
+    # no compute-dtype dequant exists for XLA to hoist across layers
+    from deepspeed_tpu.models.serving import qgemm_active
+    keep_q = qgemm_active(params["blocks"])
     kc, vc = cache["k"], cache["v"]
     ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
     for l in range(config.num_layers):
-        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]))
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
+                             keep_quantized=keep_q)
         q, kk, v = _block_qkv(x[:, None, :], layer, config)
         if quantized:
             kq, ks1 = quantize_kv(kk[:, 0])

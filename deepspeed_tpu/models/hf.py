@@ -25,7 +25,13 @@ def _to_np(t) -> np.ndarray:
         # checkpoints load as bf16 with torch_dtype="auto")
         t = t.float()
     if hasattr(t, "numpy"):
-        return np.asarray(t.numpy(), dtype=np.float32)
+        # copy=True: for fp32 tensors .numpy() is a zero-copy view of
+        # torch-OWNED memory, and np.asarray keeps it zero-copy.  The jax
+        # CPU backend can alias such host buffers into its arrays, and a
+        # donated/freed aliased buffer corrupts the heap (glibc "corrupted
+        # size vs. prev_size" mid-train, torch's allocator vs XLA's) —
+        # every converted leaf must own its storage
+        return np.array(t.numpy(), dtype=np.float32, copy=True)
     return np.asarray(t, dtype=np.float32)
 
 

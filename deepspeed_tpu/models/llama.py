@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, resolve_size
+from deepspeed_tpu.models.model import Model, qdot, resolve_size
 from deepspeed_tpu.ops.attention import causal_attention
 
 
@@ -192,9 +192,9 @@ def _block_qkv(x, layer, config: LlamaConfig, positions=None):
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     dt = h.dtype
-    q = h @ layer["wq"].astype(dt)
-    kk = h @ layer["wk"].astype(dt)
-    v = h @ layer["wv"].astype(dt)
+    q = qdot(h, layer["wq"])
+    kk = qdot(h, layer["wk"])
+    v = qdot(h, layer["wv"])
     if config.attn_bias:
         q = q + layer["wq_b"].astype(dt)
         kk = kk + layer["wk_b"].astype(dt)
@@ -209,13 +209,13 @@ def _block_qkv(x, layer, config: LlamaConfig, positions=None):
 
 def _block_finish(x, attn, layer, config: LlamaConfig):
     dt = x.dtype
-    attn_out = attn @ layer["wo"].astype(dt)
+    attn_out = qdot(attn, layer["wo"])
     if config.attn_bias:
         attn_out = attn_out + layer["wo_b"].astype(dt)
     x = x + attn_out
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    gated = jax.nn.silu(h @ layer["w_gate"].astype(dt)) * (h @ layer["w_up"].astype(dt))
-    x = x + gated @ layer["w_down"].astype(dt)
+    gated = jax.nn.silu(qdot(h, layer["w_gate"])) * qdot(h, layer["w_up"])
+    x = x + qdot(gated, layer["w_down"])
     return x
 
 
@@ -311,9 +311,8 @@ def embed(params, batch, config: LlamaConfig):
 
 
 def head(params, x, config: LlamaConfig):
-    dtype = jnp.dtype(config.dtype)
     x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
-    return x @ params["lm_head"].astype(dtype)
+    return qdot(x, params["lm_head"])
 
 
 def llama_model(size: str = "7b", **overrides) -> Model:

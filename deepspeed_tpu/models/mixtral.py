@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, resolve_size
+from deepspeed_tpu.models.model import Model, qdot, resolve_size
 from deepspeed_tpu.models.llama import _rms_norm, rope
 from deepspeed_tpu.moe.layer import MoEConfig, moe_layer
 from deepspeed_tpu.moe.sharded_moe import topkgating
@@ -129,20 +129,18 @@ def _qkv(x, layer, config: MixtralConfig, positions=None):
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-    dt = h.dtype
-    q = rope((h @ layer["wq"].astype(dt)).reshape(B, S, H, hd),
+    q = rope(qdot(h, layer["wq"]).reshape(B, S, H, hd),
              config.rope_theta, positions)
-    kk = rope((h @ layer["wk"].astype(dt)).reshape(B, S, KV, hd),
+    kk = rope(qdot(h, layer["wk"]).reshape(B, S, KV, hd),
               config.rope_theta, positions)
-    v = (h @ layer["wv"].astype(dt)).reshape(B, S, KV, hd)
+    v = qdot(h, layer["wv"]).reshape(B, S, KV, hd)
     return q, kk, v
 
 
 def _moe_finish(x, attn_flat, layer, config: MixtralConfig, train: bool,
                 rng=None):
     """Attention output projection + residual + routed-expert FFN."""
-    dt = x.dtype
-    x = x + attn_flat @ layer["wo"].astype(dt)
+    x = x + qdot(attn_flat, layer["wo"])
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
     moe_out, aux = moe_layer(layer["moe"], h, config.moe, train=train,
                              rng=rng)

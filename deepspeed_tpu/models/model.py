@@ -76,7 +76,26 @@ class QuantizedTensor:
         return cls(children[0], children[1], dtype)
 
 
-def _maybe_dequant(tree):
+def qdot(x, w):
+    """Projection matmul that consumes quantized weights IN PLACE:
+    ``QuantizedTensor`` leaves route through the fused-dequant int8 GEMM
+    kernel (``ops/pallas/qgemm.ds_qgemm`` — the weight stays int8 in HBM
+    and dequantizes tile-wise in VMEM), plain arrays take the ordinary
+    ``x @ w.astype(x.dtype)``.  Every model family's QKV / attention-out
+    / MLP / head projection calls this, so the serving decode paths can
+    skip the layer-granularity ``maybe_stream`` dequant entirely."""
+    if isinstance(w, QuantizedTensor):
+        from deepspeed_tpu.ops.pallas.qgemm import ds_qgemm
+        return ds_qgemm(x, w.q, w.s, out_dtype=x.dtype)
+    return x @ w.astype(x.dtype)
+
+
+def _maybe_dequant(tree, keep_gemm_weights: bool = False):
+    """Reconstruct ``QuantizedTensor`` leaves in compute dtype.  With
+    ``keep_gemm_weights`` the 2-D (already layer-sliced) weights that the
+    qgemm path consumes directly stay quantized — only leaves qdot cannot
+    take as-is (e.g. stacked MoE expert tensors fed to einsums) dequantize.
+    """
     is_q = lambda x: isinstance(x, QuantizedTensor)
     if not any(map(is_q, jax.tree_util.tree_leaves(tree, is_leaf=is_q))):
         return tree
@@ -84,6 +103,8 @@ def _maybe_dequant(tree):
 
     def dq(x):
         if is_q(x):
+            if keep_gemm_weights and x.q.ndim == 2:
+                return x
             import jax.numpy as jnp
             return block_dequantize_int8(x.q, x.s).astype(
                 jnp.dtype(x.dtype))
@@ -92,13 +113,19 @@ def _maybe_dequant(tree):
     return jax.tree_util.tree_map(dq, tree, is_leaf=is_q)
 
 
-def maybe_stream(layer_tree):
+def maybe_stream(layer_tree, keep_quantized: bool = False):
     """Inside a layer-scan body: move this layer's (possibly host-resident)
     params to device memory, and/or reconstruct int8-quantized weights
     (``QuantizedTensor`` leaves) in compute dtype.  No-op otherwise.
     Call *inside* the remat boundary so the backward pass re-streams the
-    layer instead of pinning its device copy in HBM."""
-    layer_tree = _maybe_dequant(layer_tree)
+    layer instead of pinning its device copy in HBM.
+
+    ``keep_quantized`` (serving decode paths): leave the layer's 2-D
+    quantized projection weights as ``QuantizedTensor`` — the model's
+    ``qdot`` call sites feed them to the fused-dequant qgemm kernel, so
+    no compute-dtype copy of the layer's weights is ever materialized."""
+    layer_tree = _maybe_dequant(layer_tree,
+                                keep_gemm_weights=keep_quantized)
     cfg = _PARAM_STREAM.get()
     if not cfg:
         return layer_tree

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.model import Model, resolve_size
+from deepspeed_tpu.models.model import Model, qdot, resolve_size
 from deepspeed_tpu.models.llama import rope
 from deepspeed_tpu.ops.attention import causal_attention
 
@@ -138,7 +138,7 @@ def _block_qkv(x, layer, config: NeoXConfig, positions=None):
     dt = x.dtype
     h1 = _ln(x, layer["ln1_scale"], layer["ln1_bias"],
              config.layer_norm_eps)
-    qkv = h1 @ layer["qkv_w"].astype(dt) + layer["qkv_b"].astype(dt)
+    qkv = qdot(h1, layer["qkv_w"]) + layer["qkv_b"].astype(dt)
     q, kk, v = jnp.split(qkv.reshape(B, S, H, 3 * hd), 3, axis=-1)
     q = _partial_rope(q, config, positions)
     kk = _partial_rope(kk, config, positions)
@@ -148,15 +148,15 @@ def _block_qkv(x, layer, config: NeoXConfig, positions=None):
 def _block_finish(x, attn_flat, layer, config: NeoXConfig):
     """Output projection + MLP with the parallel/serial residual form."""
     dt = x.dtype
-    attn_out = (attn_flat @ layer["dense_w"].astype(dt)
+    attn_out = (qdot(attn_flat, layer["dense_w"])
                 + layer["dense_b"].astype(dt))
     h2_in = x if config.use_parallel_residual else x + attn_out
     h2 = _ln(h2_in, layer["ln2_scale"], layer["ln2_bias"],
              config.layer_norm_eps)
-    m = jax.nn.gelu(h2 @ layer["mlp_in_w"].astype(dt)
+    m = jax.nn.gelu(qdot(h2, layer["mlp_in_w"])
                     + layer["mlp_in_b"].astype(dt),
                     approximate=config.gelu_approximate)
-    mlp_out = m @ layer["mlp_out_w"].astype(dt) + layer["mlp_out_b"].astype(dt)
+    mlp_out = qdot(m, layer["mlp_out_w"]) + layer["mlp_out_b"].astype(dt)
     if config.use_parallel_residual:
         return x + attn_out + mlp_out       # gpt-j style parallel residual
     return h2_in + mlp_out

@@ -18,12 +18,15 @@ Cache pytree: ``{"k","v": [L,B,S,KV,hd]}``, plus ``{"k_s","v_s":
 [L,B,S,KV] fp32}`` when the cache dtype is "int8" (per-vector symmetric
 scales, ops/pallas/decode_attention.py helpers).
 """
+import contextlib
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def quantized_layer_bytes(blocks) -> int:
+def quantized_layer_bytes(blocks, residual_only: bool = False) -> int:
     """Total compute-dtype bytes a full dequantization of ``blocks``
     would materialize (0 when nothing is quantized).  The decode
     dispatchers use this to pick the loop form: the python-unrolled
@@ -32,24 +35,137 @@ def quantized_layer_bytes(blocks) -> int:
     past ~0.5 GB of dequantized weights that freedom turns into
     materialized copies that crush throughput (gpt2-760M int8 measured
     459 tok/s unrolled vs the scan form's sequential dequant; 125M —
-    where everything fuses — measured 8,688 unrolled)."""
+    where everything fuses — measured 8,688 unrolled).
+
+    ``residual_only``: count only the leaves the fused-dequant qgemm
+    path will NOT consume in place (stacked-2-D weights — q.ndim == 3 —
+    go straight to ``ds_qgemm`` and never dequantize; higher-rank leaves
+    like MoE expert stacks still do)."""
     from deepspeed_tpu.models.model import QuantizedTensor
     total = 0
     for leaf in jax.tree_util.tree_leaves(
             blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
         if isinstance(leaf, QuantizedTensor):
+            if residual_only and leaf.q.ndim == 3:
+                continue
             total += jnp.dtype(leaf.dtype).itemsize * int(leaf.q.size)
     return total
 
 
+#: module default; the ``serving.quant_scan_threshold_mb`` config key and
+#: the DS_QUANT_SCAN_THRESHOLD_MB env override both route through
+#: ``get_quant_scan_threshold`` (monkeypatching this constant still works
+#: when neither is set).
 QUANT_SCAN_THRESHOLD = 512 << 20
+_configured_scan_threshold = None
+
+
+def set_quant_scan_threshold(nbytes):
+    """Install the ``serving`` config section's threshold (bytes); None
+    resets to the module default.  Called by the continuous-batching
+    scheduler when its ServingConfig carries a non-default value."""
+    global _configured_scan_threshold
+    _configured_scan_threshold = nbytes
+
+
+def get_quant_scan_threshold() -> int:
+    """Resolution order: DS_QUANT_SCAN_THRESHOLD_MB env (operator
+    override) > configured ``serving.quant_scan_threshold_mb`` > the
+    module constant."""
+    env = os.environ.get("DS_QUANT_SCAN_THRESHOLD_MB")
+    if env:
+        return int(env) << 20
+    if _configured_scan_threshold is not None:
+        return _configured_scan_threshold
+    return QUANT_SCAN_THRESHOLD
+
+
+# --------------------------------------------------------- qgemm routing
+_qgemm_forced = None        # qgemm_scope override; None = env default
+
+
+@contextlib.contextmanager
+def qgemm_scope(enabled: bool):
+    """Force the fused-dequant qgemm path on/off for code TRACED inside
+    this scope (A/B benches and the fallback-path tests).  The choice
+    bakes into compiled programs at trace time and is not part of any
+    jit cache key — build a fresh engine / jitted fn inside each scope;
+    re-calling an already-compiled generate under a different scope
+    silently reuses the old path."""
+    global _qgemm_forced
+    prev, _qgemm_forced = _qgemm_forced, enabled
+    try:
+        yield
+    finally:
+        _qgemm_forced = prev
+
+
+def qgemm_enabled() -> bool:
+    """Default: on when the Pallas kernel is REAL (TPU, or interpret mode
+    forced for tests).  Off-TPU ds_qgemm degenerates to the jnp reference
+    — a full per-projection dequant inside the decode loop — so routing
+    the scaffold through it there would silently drop the scan-threshold
+    defense against materialized dequants.  ``qgemm_scope`` overrides
+    both directions (explicit test/bench intent)."""
+    if _qgemm_forced is not None:
+        return _qgemm_forced
+    env = os.environ.get("DS_QGEMM")
+    if env == "0":
+        return False
+    if env == "1":          # explicit force (serve_bench A/B off-chip)
+        return True
+    if os.environ.get("DS_QGEMM_INTERPRET") == "1":
+        return True
+    from deepspeed_tpu.ops.attention import _on_tpu
+    # single-device only for now: on multi-device meshes ds_qgemm itself
+    # falls back to the jnp reference (no GSPMD rule for the custom
+    # call), so the scaffold must keep the dequant + scan-threshold path
+    return _on_tpu() and jax.device_count() == 1
+
+
+def qgemm_kernel_real() -> bool:
+    """Whether ds_qgemm will run the actual Pallas kernel (single TPU
+    device, or interpret mode) rather than its jnp dequant reference.
+    ``qgemm_scope`` counts as real — explicit test/bench intent.  The
+    scan-threshold dispatch keys on this: a DS_QGEMM=1 force where the
+    kernel degenerates to the reference must NOT drop the defense
+    against materialized dequants."""
+    if _qgemm_forced is not None:
+        return _qgemm_forced
+    if os.environ.get("DS_QGEMM_INTERPRET") == "1":
+        return True
+    from deepspeed_tpu.ops.attention import _on_tpu
+    return _on_tpu() and jax.device_count() == 1
+
+
+def qgemm_active(blocks) -> bool:
+    """True when the decode paths should hand the layer's quantized 2-D
+    projection weights to ``ds_qgemm`` in place of the ``maybe_stream``
+    dequant (i.e. qgemm is enabled and the tree holds stacked-2-D
+    ``QuantizedTensor`` leaves)."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    if not qgemm_enabled():
+        return False
+    return any(isinstance(leaf, QuantizedTensor) and leaf.q.ndim == 3
+               for leaf in jax.tree_util.tree_leaves(
+                   blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
 
 
 def use_scan_decode(blocks) -> bool:
     """The ONE dispatch rule for the decode loop form (both the shared
     scaffold and gpt2's own decode call this): scan when a full dequant
-    of the quantized blocks would exceed the threshold."""
-    return quantized_layer_bytes(blocks) > QUANT_SCAN_THRESHOLD
+    of the quantized blocks that the qgemm KERNEL does not absorb would
+    exceed the threshold.  With the real kernel active the dense
+    projections never dequantize, so the threshold guards only the
+    residual (e.g. MoE expert stacks) — the scan form is the FALLBACK
+    defense, not the default, and large dense int8 models keep the
+    faster unrolled loop.  When qgemm is merely FORCED onto the jnp
+    reference (DS_QGEMM=1 off-chip / multi-device), every projection
+    still dequantizes per matmul, so all bytes count and the scan
+    defense re-engages."""
+    residual_only = qgemm_active(blocks) and qgemm_kernel_real()
+    residual = quantized_layer_bytes(blocks, residual_only=residual_only)
+    return residual > get_quant_scan_threshold()
 
 
 def write_token(c, l, new, lengths):
@@ -154,11 +270,17 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
             params, x, cache, lengths, qkv_fn=qkv_fn, finish_fn=finish_fn,
             head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes)
 
+    # int8 weights: the 2-D projection weights stay QuantizedTensor and
+    # the hooks' qdot sites feed them to ds_qgemm — no layer-sized
+    # compute-dtype dequant exists for XLA to hoist, so the unrolled
+    # loop is safe at any model scale
+    keep_q = qgemm_active(params["blocks"])
     kc, vc = cache["k"], cache["v"]
     ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
     L = kc.shape[0]
     for l in range(L):
-        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]))
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
+                             keep_quantized=keep_q)
         q, kk, v = qkv_fn(x[:, None, :], layer, lengths[:, None])
         hd = q.shape[-1]
         if quantized:
@@ -196,6 +318,7 @@ def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
     B = x.shape[0]
     H = num_heads
     q_cache = "k_s" in cache
+    keep_q = qgemm_active(params["blocks"])
 
     def write_slice(c_l, new):
         return select_token(c_l, new, lengths)
@@ -206,7 +329,7 @@ def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
         else:
             layer, kc, vc = layer_kv
             ksc = vsc = None
-        layer = maybe_stream(layer)
+        layer = maybe_stream(layer, keep_quantized=keep_q)
         q, kk, v = qkv_fn(carry[:, None, :], layer, lengths[:, None])
         hd = q.shape[-1]
         if q_cache:

@@ -113,10 +113,11 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     # falls back to replicate-then-repartition on the backward transposes
     tok = P(tuple(get_topology().zero_shard_axes))
     tok_sh = jax.sharding.NamedSharding(mesh, tok)
+    from deepspeed_tpu.models.model import qdot
     xt = wsc(x.reshape(T, D), tok_sh)
-    logits = wsc(
-        xt.astype(jnp.float32) @ params["router"].astype(jnp.float32),
-        tok_sh)
+    # qdot: int8 serving keeps the (stacked-2-D) router quantized — the
+    # fused-dequant qgemm consumes it; plain arrays take the same matmul
+    logits = wsc(qdot(xt.astype(jnp.float32), params["router"]), tok_sh)
     cf = config.capacity_factor if train else config.eval_capacity_factor
     noise = rng if (train and config.noisy_gate_policy) else None
     gate: GateOutput = topkgating(logits, config.top_k, cf,
@@ -141,14 +142,13 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
         # the experts, mixed by a learned per-token softmax coefficient
         dt = x.dtype
         if config.activation == "silu_glu":
-            h = (jax.nn.silu(x @ params["res_gate"].astype(dt))
-                 * (x @ params["res_in"].astype(dt)))
+            h = (jax.nn.silu(qdot(x, params["res_gate"]))
+                 * qdot(x, params["res_in"]))
         else:
-            h = jax.nn.gelu(x @ params["res_in"].astype(dt),
-                            approximate=True)
-        res = h @ params["res_out"].astype(dt)
+            h = jax.nn.gelu(qdot(x, params["res_in"]), approximate=True)
+        res = qdot(h, params["res_out"])
         coef = jax.nn.softmax(
-            (x @ params["coef_w"].astype(dt)
+            (qdot(x, params["coef_w"])
              + params["coef_b"].astype(dt)).astype(jnp.float32), axis=-1)
         coef = coef.astype(dt)
         moe_out = moe_out * coef[..., 0:1] + res * coef[..., 1:]

@@ -1,1 +1,2 @@
 from deepspeed_tpu.ops.attention import causal_attention
+from deepspeed_tpu.ops.pallas.qgemm import ds_qgemm

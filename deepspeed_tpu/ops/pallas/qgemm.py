@@ -1,0 +1,222 @@
+"""Fused-dequant int8 GEMM Pallas kernel (``ds_qgemm``).
+
+Reference capability: DeepSpeed's quantized-GEMM inference kernels
+(csrc/quantization + the MoQ int8 serving path).  The serving problem it
+solves is measured in PERF.md round 5: past ~350M params XLA stops fusing
+weight dequantization into the consuming matmuls, so int8 decoding pays
+int8-read + bf16-write + bf16-re-read (~6.6 GB/step at gpt2-1.3B → 238
+tok/s against an int8 weight-stream floor several× higher).
+
+``ds_qgemm(x, q, scales)`` computes ``x @ W`` where ``W`` stays int8 in
+HBM in the ``block_quantize_int8`` layout (ops/pallas/quantization.py):
+``q`` int8 ``[K, N]`` with one fp32 scale per ``[1, qblock]`` group of
+lanes, ``scales`` ``[K, ceil(N/qblock)]``.  Each grid step DMAs one
+``[bk, bn]`` int8 weight tile into VMEM, expands its scale columns with a
+tiny select-matmul (the decode-attention blockdiag idiom), dequantizes on
+the VPU, and feeds the MXU — **no layer-sized compute-dtype copy of W
+ever exists**; the only HBM weight traffic is the int8 bytes.
+
+Grid ``(M/bm, N/bn, K/bk)`` with K innermost: the fp32 accumulator tile
+persists in VMEM scratch across the K steps of one output block (the
+ds_flash_attention accumulation pattern).  Block shapes are sweepable
+(``scripts/qgemm_sweep.py``, slope-timed on chip); TPU-legal defaults
+keep int8 tiles on (32, 128) multiples.
+
+A jnp reference path (``_ref_qgemm``) serves CPU meshes; interpret mode
+runs the real kernel in the CPU suite (tests/test_qgemm.py).
+"""
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# default tile shapes: bm caps at the MXU row dim, bk/bn sized so the int8
+# weight tile (the dominant VMEM tenant: bk*bn bytes, double-buffered)
+# stays ~512 KB — override per call or with DS_QGEMM_BLOCKS="bm,bk,bn"
+DEFAULT_BLOCK_M = 128
+DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_N = 1024
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _env_blocks():
+    env = os.environ.get("DS_QGEMM_BLOCKS")
+    if not env:
+        return None
+    bm, bk, bn = (int(v) for v in env.split(","))
+    return bm, bk, bn
+
+
+def _ref_qgemm(x, q, scales, out_dtype=None):
+    """jnp reference: dequantize (per-group scales over the last dim of
+    ``q``) and matmul in ``x``'s dtype — numerically identical to the
+    pre-qgemm ``maybe_stream`` dequant + dense matmul path."""
+    from deepspeed_tpu.ops.pallas.quantization import block_dequantize_int8
+    w = block_dequantize_int8(q, scales).astype(x.dtype)
+    out = x @ w
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def _qgemm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, qblock, block_n,
+                  n_k, precision):
+    """One (i, j, k) grid step: dequantize the [bk, bn] int8 tile in VMEM
+    and accumulate x_tile @ w_tile into the fp32 scratch.
+
+    Scale expansion: ``s_ref`` stages the k-tile's FULL scale rows
+    [bk, nb] (nb is tiny — ceil(N/qblock) — and a full trailing dim is
+    always Mosaic-legal where a narrow column-slice block is not).  The
+    tile's columns select their group via one [bk, nb] x [nb, bn] matmul
+    against a computed 0/1 selector — MXU-cheap next to the main matmul,
+    and the dequantized tile never leaves VMEM."""
+    j = pl.program_id(1)
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:]                                    # [bm, bk]
+    qt = q_ref[:]                                   # [bk, bn] int8
+    s = s_ref[:]                                    # [bk, nb] fp32
+    nb = s.shape[1]
+    # selector[g, n] = 1 where global column j*bn+n belongs to scale
+    # group g (general: works for bn % qblock != 0 and ragged last group)
+    g_iota = jax.lax.broadcasted_iota(jnp.int32, (nb, block_n), 0)
+    col = j * block_n + jax.lax.broadcasted_iota(
+        jnp.int32, (nb, block_n), 1)
+    sel = (g_iota == col // qblock).astype(jnp.float32)
+    s_exp = jax.lax.dot(s, sel,
+                        preferred_element_type=jnp.float32)  # [bk, bn]
+    w = (qt.astype(jnp.float32) * s_exp).astype(x.dtype)
+    acc_ref[:] += jax.lax.dot(x, w, preferred_element_type=jnp.float32,
+                              precision=precision)
+
+    @pl.when(k_idx == n_k - 1)
+    def _finalize():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _fit_block(dim, requested, quantum=128):
+    """Largest quantum-multiple block <= requested that DIVIDES dim, when
+    dim is quantum-aligned.  Padding a non-dividing weight dim would
+    materialize a padded int8 copy of the whole weight inside the traced
+    decode (loop-invariant → XLA hoists it → a second HBM-resident copy,
+    exactly the residency this kernel exists to avoid); every real model
+    dim is 128-aligned, so shrinking to a divisor costs only tile size.
+    Ragged dims (tests, odd adapters) keep the requested block and pad."""
+    b = min(requested, _round_up(dim, quantum))
+    if dim % quantum == 0:
+        # sub-quantum requests bump up to the quantum (always a divisor
+        # here) — returning them unchanged would re-introduce the pad
+        for cand in range(max(b - b % quantum, quantum), quantum - 1,
+                          -quantum):
+            if dim % cand == 0:
+                return cand
+    return b
+
+
+def _pallas_qgemm(x, q, scales, out_dtype, block_m, block_k, block_n,
+                  interpret):
+    M, K = x.shape
+    K2, N = q.shape
+    assert K == K2, (x.shape, q.shape)
+    nb = scales.shape[-1]
+    qblock = -(-N // nb)        # group width (last group may be ragged)
+
+    # sublane alignment for x/out: bf16 tiles are (16, 128), fp32 (8, 128)
+    m_align = 16 if x.dtype == jnp.bfloat16 else 8
+    bm = min(block_m, _round_up(M, m_align))
+    M_pad = _round_up(M, bm)
+    bk = _fit_block(K, block_k)
+    K_pad = _round_up(K, bk)
+    bn = _fit_block(N, block_n)
+    N_pad = _round_up(N, bn)
+
+    if M_pad != M:
+        x = jnp.pad(x, ((0, M_pad - M), (0, 0)))
+    if K_pad != K:
+        # zero x-columns and weight rows: padded K contributes nothing
+        x = jnp.pad(x, ((0, 0), (0, K_pad - K)))
+        q = jnp.pad(q, ((0, K_pad - K), (0, 0)))
+        scales = jnp.pad(scales, ((0, K_pad - K), (0, 0)),
+                         constant_values=1.0)
+    if N_pad != N:
+        # padded columns carry q == 0; their (out-of-range) group index
+        # matches no selector row, so the dequantized value is 0 either way
+        q = jnp.pad(q, ((0, 0), (0, N_pad - N)))
+
+    n_k = K_pad // bk
+    # fp32 x needs full-precision MXU passes (default lowering runs
+    # bf16-grade multiplies even for f32 operands — decode_attention.py)
+    precision = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else None)
+    kernel = functools.partial(_qgemm_kernel, qblock=qblock, block_n=bn,
+                               n_k=n_k, precision=precision)
+    out = pl.pallas_call(
+        kernel,
+        grid=(M_pad // bm, N_pad // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bk, nb), lambda i, j, k: (k, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M_pad, N_pad), out_dtype),
+        scratch_shapes=[
+            # fp32 accumulator, persistent across the K steps of one
+            # (i, j) output block (K is the innermost grid dim)
+            pltpu.VMEM((bm, bn), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, q, scales.astype(jnp.float32))
+    return out[:M, :N]
+
+
+def ds_qgemm(x, q, scales, out_dtype=None, block_m=None, block_k=None,
+             block_n=None, interpret=None):
+    """``x [..., K] @ dequant(q [K, N], scales [K, ceil(N/qblock)])``.
+
+    Weights stay int8 end-to-end in HBM; dequantization happens tile-wise
+    in VMEM inside the kernel.  Leading dims of ``x`` flatten to the GEMM
+    M dim.  ``out_dtype`` defaults to ``x.dtype``.  ``interpret=True``
+    forces the Pallas kernel in interpret mode (CPU tests); off-TPU the
+    jnp reference runs unless ``DS_QGEMM_INTERPRET=1``.
+    """
+    *lead, K = x.shape
+    out_dtype = jnp.dtype(out_dtype or x.dtype)
+    if q.ndim != 2 or scales.ndim != 2:
+        raise ValueError(
+            f"ds_qgemm expects a 2-D quantized weight (q {q.shape}, "
+            f"scales {scales.shape}); stacked weights slice per layer "
+            "before the matmul")
+    if interpret is None:
+        if os.environ.get("DS_QGEMM_INTERPRET") == "1":
+            interpret = True
+        else:
+            from deepspeed_tpu.ops.attention import _on_tpu
+            if not _on_tpu():
+                return _ref_qgemm(x, q, scales, out_dtype)
+            if jax.device_count() > 1:
+                # multi-device mesh: GSPMD has no partitioning rule for
+                # the pallas custom call (see quantization.py's identical
+                # gate), and TP-sharded q/s operands would force a
+                # gather.  The jnp reference keeps tp>1 int8 serving
+                # correct; a shard_map-wrapped kernel is the follow-up.
+                return _ref_qgemm(x, q, scales, out_dtype)
+            interpret = False
+    env = _env_blocks()
+    bm = block_m or (env[0] if env else DEFAULT_BLOCK_M)
+    bk = block_k or (env[1] if env else DEFAULT_BLOCK_K)
+    bn = block_n or (env[2] if env else DEFAULT_BLOCK_N)
+    M = 1
+    for d in lead:
+        M *= d
+    out = _pallas_qgemm(x.reshape(M, K), q, scales, out_dtype, bm, bk, bn,
+                        interpret)
+    return out.reshape(*lead, q.shape[-1])

@@ -18,20 +18,40 @@ BLOCK = 256
 
 
 def _ref_quantize(x, block=BLOCK):
+    """Symmetric per-block quantization over the last dim.  When ``block``
+    does not divide ``C`` the row splits into ``nb = ceil(C/block)``
+    near-equal groups of width ``ceil(C/nb)`` (last group ragged) — the
+    SAME shape contract as the exact-multiple path, so every consumer can
+    recover the group width as ``ceil(C / scales.shape[-1])`` (see
+    ``block_dequantize_int8``; the pre-fix fallback collapsed to ONE
+    whole-row group, which both coarsened the scales and made the group
+    width unrecoverable from the shapes)."""
     *lead, C = x.shape
-    nb = C // block
-    xb = x.astype(jnp.float32).reshape(*lead, nb, block)
+    nb = -(-C // block)
+    gw = -(-C // nb)                    # effective group width
+    pad = nb * gw - C
+    xf = x.astype(jnp.float32)
+    if pad:
+        xf = jnp.pad(xf, [(0, 0)] * len(lead) + [(0, pad)])
+    xb = xf.reshape(*lead, nb, gw)
     amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
-    return q.reshape(*lead, C), scale[..., 0].reshape(*lead, nb)
+    return (q.reshape(*lead, nb * gw)[..., :C],
+            scale[..., 0].reshape(*lead, nb))
 
 
-def _ref_dequantize(q, scales, block=BLOCK):
+def _ref_dequantize(q, scales):
     *lead, C = q.shape
-    nb = C // block
-    qb = q.reshape(*lead, nb, block).astype(jnp.float32)
-    return (qb * scales.reshape(*lead, nb, 1)).reshape(*lead, C)
+    nb = scales.shape[-1]
+    gw = -(-C // nb)                    # group width (last may be ragged)
+    pad = nb * gw - C
+    qf = q.astype(jnp.float32)
+    if pad:
+        qf = jnp.pad(qf, [(0, 0)] * len(lead) + [(0, pad)])
+    qb = qf.reshape(*lead, nb, gw)
+    return (qb * scales.reshape(*lead, nb, 1)).reshape(
+        *lead, nb * gw)[..., :C]
 
 
 def _quant_kernel(x_ref, q_ref, s_ref, *, block):
@@ -67,8 +87,9 @@ def block_quantize_int8(x, block=BLOCK):
     """x [..., C] -> (q int8 [..., C], scales fp32 [..., C//block])."""
     C = x.shape[-1]
     if C % block != 0:
-        # fall back to one block per row
-        return _ref_quantize(x, block=C)
+        # ragged fallback: ceil(C/block) near-equal groups — same scales
+        # shape contract as the main path (see _ref_quantize)
+        return _ref_quantize(x, block=block)
     # the Pallas kernel serves eager / op-level calls; inside a traced
     # (possibly SPMD-partitioned) program the jnp reference path is used —
     # GSPMD has no partitioning rule for the pallas custom call, and XLA
@@ -84,5 +105,10 @@ def block_quantize_int8(x, block=BLOCK):
     return _ref_quantize(x, block)
 
 
-def block_dequantize_int8(q, scales, block=BLOCK):
-    return _ref_dequantize(q, scales, block=q.shape[-1] // scales.shape[-1])
+def block_dequantize_int8(q, scales):
+    """Inverse of ``block_quantize_int8``.  The group width is recovered
+    from the shapes as ``ceil(C / nb)`` — exact for the multiple-of-block
+    layout and, by construction, for the ragged fallback layout too (no
+    ``block`` parameter: a caller-supplied width that disagreed with the
+    layout would silently dequantize wrong)."""
+    return _ref_dequantize(q, scales)

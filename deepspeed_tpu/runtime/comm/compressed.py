@@ -58,7 +58,8 @@ def compressed_allreduce(v: jnp.ndarray, error: jnp.ndarray,
         third element when ``server_error`` was given.
     """
     if n is None:
-        n = int(lax.axis_size(axis_name))
+        from deepspeed_tpu.utils.jax_compat import axis_size
+        n = int(axis_size(axis_name))
     corrected = v.astype(jnp.float32) + error
     sign, scale = compress(corrected)
     new_error = corrected - scale * sign.astype(jnp.float32)

@@ -296,6 +296,11 @@ class ServingConfig(DeepSpeedConfigModel):
     #: rows, so it ends exactly when the first row could retire).
     #: Amortizes per-step dispatch; 1 disables.  Power of two.
     max_fused_steps: int = 8
+    #: int8-weights decode loop-form threshold (MB of dequantized bytes
+    #: NOT absorbed by the fused-dequant qgemm kernel above which the
+    #: decode dispatches to the lax.scan form — models/serving.py
+    #: use_scan_decode).  DS_QUANT_SCAN_THRESHOLD_MB overrides.
+    quant_scan_threshold_mb: int = 512
 
     def __init__(self, **data):
         super().__init__(**data)
@@ -330,6 +335,10 @@ class ServingConfig(DeepSpeedConfigModel):
             raise ValueError(
                 f"serving.max_fused_steps={self.max_fused_steps}: must be "
                 "a power of two >= 1 (one compiled program per size)")
+        if self.quant_scan_threshold_mb < 0:
+            raise ValueError(
+                "serving.quant_scan_threshold_mb="
+                f"{self.quant_scan_threshold_mb}: must be >= 0")
 
 
 # --------------------------------------------------------------------------- root

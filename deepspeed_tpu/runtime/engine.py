@@ -997,6 +997,20 @@ class DeepSpeedEngine:
                 "data/hpz mesh axis to exchange over; reducing dense in "
                 "full precision")
             return None
+        from deepspeed_tpu.utils.jax_compat import HAS_PARTIAL_AUTO_SHARD_MAP
+        if (not HAS_PARTIAL_AUTO_SHARD_MAP
+                and any(mesh.shape[a] > 1 for a in mesh.shape
+                        if a not in manual)):
+            # the tier's shard_map is manual over data/hpz but AUTO over
+            # model/expert/seq/pipe; on this jax the partial-auto lowering
+            # aborts the process inside backend_compile when any auto axis
+            # is wider than 1 — fall back to the dense GSPMD exchange
+            logger.warning(
+                "zero_quantized_gradients/sparse/1-bit exchange needs "
+                "partially-auto shard_map, unsupported on this jax with a "
+                "wide model/expert/seq/pipe axis; reducing dense in full "
+                "precision")
+            return None
         n_manual = 1
         for a in manual:
             n_manual *= mesh.shape[a]
@@ -1153,7 +1167,8 @@ class DeepSpeedEngine:
         (loss, grads[, new_ob]) via the generalized quantized/sparse/1-bit
         gradient exchange (see ``_get_qgz_plan``), or None when the tier
         cannot engage."""
-        from jax import shard_map, lax
+        from jax import lax
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from deepspeed_tpu.runtime.zero.zeropp import (
             gather_with_quantized_grad, quantized_psum_scatter)
         from deepspeed_tpu.runtime.sparse_tensor import (

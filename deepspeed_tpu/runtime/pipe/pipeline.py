@@ -36,7 +36,8 @@ def _pipe_sharding():
     manual shard_map (engine._qgz_grad_fn), the constraint must carry
     that context's axis types (data/hpz Manual, pipe Auto), not the
     all-auto concrete mesh."""
-    cur = jax.sharding.get_abstract_mesh()
+    from deepspeed_tpu.utils.jax_compat import get_abstract_mesh
+    cur = get_abstract_mesh()
     if cur is not None and not cur.empty:
         return NamedSharding(cur, P(PIPE_AXIS))
     return NamedSharding(get_topology().mesh, P(PIPE_AXIS))

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from deepspeed_tpu.ops.pallas.quantization import (
     block_quantize_int8, block_dequantize_int8)

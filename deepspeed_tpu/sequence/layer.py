@@ -13,7 +13,7 @@ from functools import partial
 import jax
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from deepspeed_tpu.comm.mesh import get_topology, SEQ_AXIS, MODEL_AXIS
 

@@ -150,6 +150,15 @@ class ContinuousBatchingScheduler:
         self.kv_cache_dtype = kv_cache_dtype
         self.monitor = monitor
         self.block_mgr = BlockManager(config.num_blocks, config.block_size)
+        # int8-weights decode dispatch: install this config's threshold so
+        # the model-side use_scan_decode sees it (env override still wins
+        # inside get_quant_scan_threshold).  Only an EXPLICITLY supplied
+        # key installs — a defaulted config leaves the module default (and
+        # any test monkeypatch of it) in force
+        if "quant_scan_threshold_mb" in config.model_fields_set:
+            from deepspeed_tpu.models import serving as _serving
+            _serving.set_quant_scan_threshold(
+                int(config.quant_scan_threshold_mb) << 20)
 
         bs = config.block_size
         model_ctx = int(getattr(model.config, "max_seq_len", 1 << 30))

@@ -1,0 +1,62 @@
+"""jax version-compatibility shims.
+
+The container bakes a jax where ``shard_map`` still lives in
+``jax.experimental.shard_map`` and spells its replication-check kwarg
+``check_rep``; current jax exposes ``jax.shard_map`` with ``check_vma``.
+The codebase is written against the current API — every ``shard_map``
+import routes through here so both toolchains drive the same call sites.
+"""
+try:                                    # current jax
+    from jax import shard_map as _shard_map
+    _CURRENT = True
+except ImportError:                     # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CURRENT = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None, **kw):
+    """``axis_names`` (current API: the axes mapped MANUALLY) translates
+    to the old API's complement kwarg ``auto`` (the axes left to the
+    partitioner)."""
+    if _CURRENT:
+        kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+    else:
+        kw["check_rep"] = check_vma
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+#: partially-auto shard_map (manual over some mesh axes, partitioner-auto
+#: over others) is only sound on current jax — the old experimental
+#: lowering CHECK-aborts the PROCESS inside backend_compile when the auto
+#: set contains a >1-sized axis.  Callers gate their partial-auto tiers on
+#: this and fall back to fully-automatic GSPMD.
+HAS_PARTIAL_AUTO_SHARD_MAP = _CURRENT
+
+
+def get_abstract_mesh():
+    """Current trace context's abstract mesh, or None when this jax
+    predates ``jax.sharding.get_abstract_mesh``.  None is always sound on
+    old jax: the only caller that needs the trace-context mesh is the
+    partial-auto shard_map tier, which is gated off there — callers fall
+    back to the concrete topology mesh."""
+    import jax
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    return None
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside a shard_map/pmap body —
+    ``jax.lax.axis_size`` on current jax; recovered from the trace-time
+    axis env on older jax (still a python int, not a tracer)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax._src import core
+    return core.get_axis_env().axis_size(axis_name)

@@ -273,6 +273,63 @@ def main():
 
     variants = dict(variants)
 
+    # ------------------------------------------- int8-weight variants
+    # the ISSUE-2 A/B: fused-dequant qgemm unrolled decode vs the
+    # maybe_stream dequant form, plus the int8 weight-stream floor the
+    # qgemm path is chasing (PERF.md round 5: 1.3B int8 238 tok/s on the
+    # scan-dequant path vs an int8 floor several× higher)
+    from deepspeed_tpu.models.model import QuantizedTensor
+    from deepspeed_tpu.ops.pallas.quantization import block_quantize_int8
+
+    def _pack(x):
+        if x.ndim >= 3 and jnp.issubdtype(x.dtype, jnp.floating):
+            qq, ss = block_quantize_int8(x.astype(dtype))
+            return QuantizedTensor(qq, ss, str(dtype))
+        return x
+
+    qblocks = jax.tree.map(_pack, params["blocks"])
+
+    def unroll_int8(state, keep_quantized):
+        tok, cache, lengths = state
+        x = embed(tok, lengths)
+        kc, vc = cache["k"], cache["v"]
+        for l in range(L):
+            layer = maybe_stream(jax.tree.map(lambda a: a[l], qblocks),
+                                 keep_quantized=keep_quantized)
+            q, kk, v = G._block_qkv(x[:, None, :], layer, cfg)
+            kc = mask_write(kc, l, kk[:, 0], lengths)
+            vc = mask_write(vc, l, v[:, 0], lengths)
+            attn = decode_attention(q[:, 0], kc[l], vc[l], lengths + 1)
+            x = G._block_finish(x[:, None, :],
+                                attn.reshape(B, 1, cfg.d_model), layer,
+                                cfg)[:, 0]
+        return next_state(logits_of(x), {"k": kc, "v": vc}, lengths)
+
+    variants["unroll_int8_qgemm"] = lambda s: unroll_int8(s, True)
+    variants["unroll_int8_dequant"] = lambda s: unroll_int8(s, False)
+
+    qmats = [leaf.q.reshape(-1, leaf.q.shape[-1])
+             for leaf in jax.tree.leaves(
+                 qblocks, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+             if isinstance(leaf, QuantizedTensor)]
+    qbytes = sum(int(m.size) for m in qmats)
+
+    def weights_floor_int8(state):
+        # one int8 [B, r] x [r, c] matmul per quantized matrix: streams
+        # every int8 byte once per step with a tok data dependency (the
+        # bf16 weights_floor idiom at 1 byte/param)
+        tok, cache, lengths = state
+        acc = jnp.zeros((B, 1), jnp.int32)
+        for m in qmats:
+            r, c = m.shape
+            y = jnp.broadcast_to(tok[:, None].astype(jnp.int8), (B, r))
+            d = lax.dot(y, m, preferred_element_type=jnp.int32)
+            acc = acc + jnp.sum(d, axis=-1, keepdims=True)
+        tok = (tok + jnp.sum(acc) * 0) % cfg.vocab_size
+        return (tok, cache, lengths)
+
+    variants["weights_floor_int8"] = weights_floor_int8
+
     # weights floor: one [B, r] @ [r, c] matmul per large weight matrix —
     # streams every weight byte once per step with zero overhead ops
     flat = [x for x in jax.tree.leaves(params)
@@ -299,7 +356,10 @@ def main():
     print(json.dumps({"calibration": "matmul2048", "ms": round(mm_ms, 4),
                       "apparent_tflops": round(mm_tf, 1) if mm_tf else None,
                       "weight_bytes_mb": round(wbytes / 1e6, 1),
-                      "floor_ms_at_819GBs": round(wbytes / 819e9 * 1e3, 3)}))
+                      "floor_ms_at_819GBs": round(wbytes / 819e9 * 1e3, 3),
+                      "int8_weight_bytes_mb": round(qbytes / 1e6, 1),
+                      "int8_floor_ms_at_819GBs": round(
+                          qbytes / 819e9 * 1e3, 3)}))
 
     only = [s for s in os.environ.get("DEC_ONLY", "").split(",") if s]
     if only:

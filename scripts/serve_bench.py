@@ -37,6 +37,11 @@ def main():
     new_tokens = int(os.environ.get("SERVE_TOKENS", 256 if on_tpu else 8))
     kv_dtype = os.environ.get("SERVE_KV") or None
     quant = bool(int(os.environ.get("SERVE_INT8_WEIGHTS", "0")))
+    # int8-qgemm mode (default on): SERVE_QGEMM=0 falls back to the
+    # layer-granularity maybe_stream dequant + scan-threshold defense —
+    # the A/B pair for the fused-dequant kernel rows in PERF.md
+    if "SERVE_QGEMM" in os.environ:
+        os.environ["DS_QGEMM"] = os.environ["SERVE_QGEMM"]
 
     from deepspeed_tpu import models as M
 
@@ -142,10 +147,12 @@ def main():
         rate = None
     else:
         rate = round(toks / decode_s, 1)
+    from deepspeed_tpu.models.serving import qgemm_enabled
     print(json.dumps({
         "metric": f"{spec}_serve"
                   + ("_int8kv" if kv_dtype == "int8" else "")
-                  + ("_int8w" if quant else ""),
+                  + (("_int8w_qgemm" if qgemm_enabled() else "_int8w_dq")
+                     if quant else ""),
         "value": rate,
         "unit": "decode_tokens_per_sec",
         "detail": {"batch": B, "prompt_len": prompt_len,

@@ -14,6 +14,12 @@ if "xla_backend_optimization_level" not in _flags:
     # tests measure correctness, not codegen quality: backend opt level 0
     # cuts CPU compile time ~33% on this suite (compile-bound on 1 core)
     _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+if "xla_cpu_use_thunk_runtime" not in _flags:
+    # this jaxlib's new CPU thunk runtime corrupts the glibc heap under
+    # the engine's donated train steps with torch loaded in-process
+    # ("corrupted size vs. prev_size" → SIGSEGV kills the whole pytest
+    # run at a random later test); the legacy runtime is stable
+    _flags = (_flags + " --xla_cpu_use_thunk_runtime=false").strip()
 os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402

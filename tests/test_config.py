@@ -117,6 +117,40 @@ def test_fp16_defaults():
     assert c.fp16.hysteresis == 2
 
 
+def test_serving_quant_scan_threshold_roundtrip(monkeypatch):
+    """ISSUE 2 satellite: `serving.quant_scan_threshold_mb` rides the
+    JSON config into the model-side decode dispatch (the scheduler
+    installs it), and the DS_QUANT_SCAN_THRESHOLD_MB env override wins."""
+    from deepspeed_tpu.models import serving
+    monkeypatch.delenv("DS_QUANT_SCAN_THRESHOLD_MB", raising=False)
+    monkeypatch.setattr(serving, "_configured_scan_threshold", None)
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"quant_scan_threshold_mb": 64}},
+                        mesh_topology=FakeTopo(1))
+    assert c.serving_config.quant_scan_threshold_mb == 64
+    # scheduler construction installs the configured value
+    from deepspeed_tpu.serving import ContinuousBatchingScheduler
+    from tests.util import tiny_gpt2
+    import deepspeed_tpu
+    m = tiny_gpt2()
+    eng = deepspeed_tpu.init_inference(model=m,
+                                       config={"dtype": "float32"})
+    ContinuousBatchingScheduler(m, eng.params, c.serving_config)
+    assert serving.get_quant_scan_threshold() == 64 << 20
+    # env override beats both config and module default
+    monkeypatch.setenv("DS_QUANT_SCAN_THRESHOLD_MB", "3")
+    assert serving.get_quant_scan_threshold() == 3 << 20
+    monkeypatch.delenv("DS_QUANT_SCAN_THRESHOLD_MB")
+    # default config leaves the module constant (and monkeypatches of
+    # it) in force
+    monkeypatch.setattr(serving, "_configured_scan_threshold", None)
+    assert serving.get_quant_scan_threshold() == serving.QUANT_SCAN_THRESHOLD
+    with pytest.raises(ValueError, match="quant_scan_threshold_mb"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"quant_scan_threshold_mb": -1}},
+                        mesh_topology=FakeTopo(1))
+
+
 def test_serving_section_parses():
     """ISSUE 1: the DS-style JSON `serving` section configures the
     continuous-batching scheduler (deepspeed_tpu/serving/)."""

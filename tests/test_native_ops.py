@@ -170,6 +170,50 @@ def test_aio_read_completes_while_writes_in_flight(tmp_path):
     assert h.wait() == 0
 
 
+def test_block_quantize_ragged_scales_shape_contract():
+    """ISSUE 2 satellite: the non-multiple-of-BLOCK fallback must keep the
+    main path's scales shape contract — nb = ceil(C/block) near-equal
+    groups whose width every consumer recovers as ceil(C/nb) — instead of
+    collapsing to ONE whole-row group (coarser scales, unrecoverable
+    width)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas.quantization import (
+        block_dequantize_int8, block_quantize_int8)
+    rng = np.random.default_rng(0)
+    for C, block, nb_expect in ((300, 128, 3), (520, 256, 3),
+                                (100, 256, 1), (384, 256, 2),
+                                (512, 256, 2)):
+        x = jnp.asarray(rng.standard_normal((5, C)).astype(np.float32))
+        q, s = block_quantize_int8(x, block=block)
+        assert q.shape == x.shape and q.dtype == jnp.int8
+        assert s.shape == (5, nb_expect), (C, block, s.shape)
+        deq = np.asarray(block_dequantize_int8(q, s))
+        gw = -(-C // nb_expect)
+        # per-group error bound: |err| <= group amax / 254
+        pad = nb_expect * gw - C
+        xp = np.pad(np.asarray(x), ((0, 0), (0, pad)))
+        amax = np.abs(xp).reshape(5, nb_expect, gw).max(-1)
+        bound = np.repeat(amax / 254.0, gw, axis=-1).reshape(
+            5, nb_expect * gw)[:, :C] + 1e-6
+        assert (np.abs(deq - np.asarray(x)) <= bound + 1e-6).all(), (C, block)
+
+
+def test_block_quantize_row_shapes_off_row_tile():
+    """R % row_tile != 0 and odd lead shapes go through the reference
+    path with the same (q, scales) contract as tile-aligned rows."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas.quantization import (
+        block_dequantize_int8, block_quantize_int8)
+    rng = np.random.default_rng(1)
+    for shape in ((3, 512), (7, 5, 512), (255, 256), (1, 256)):
+        x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+        q, s = block_quantize_int8(x)
+        assert q.shape == x.shape
+        assert s.shape == shape[:-1] + (-(-shape[-1] // 256),)
+        np.testing.assert_allclose(np.asarray(block_dequantize_int8(q, s)),
+                                   np.asarray(x), atol=0.05)
+
+
 def test_op_builder_cache():
     from op_builder import CPUAdamBuilder
     b = CPUAdamBuilder()
