@@ -5,9 +5,29 @@ loop).
 
 The torch-elastic machinery maps to a plain supervisor around the per-node
 launcher: start the worker process with the JAX coordination env, poll it,
-and on failure restart (up to ``max_restarts``), re-deriving a valid world
-size from the elasticity config each round so the job continues when hosts
-come or go."""
+and on failure restart, re-deriving a valid world size from the elasticity
+config each round so the job continues when hosts come or go.
+
+Resilience semantics (ISSUE 3):
+
+- **Backoff**: restart delays grow exponentially (``restart_delay_s`` base,
+  ``backoff_factor``) up to ``backoff_max_s``, with ±``backoff_jitter``
+  fractional jitter so a pod of agents doesn't restart in lockstep.
+- **Sliding-window budget**: only restarts within the last
+  ``restart_window_s`` seconds count against ``max_restarts`` — a job that
+  crashes once a day keeps running for months, while a crash-loop burns
+  the budget in minutes and fails loudly (it can never "succeed on attempt
+  4 of forever").  ``restart_window_s=None`` keeps the legacy all-time
+  budget.
+- **Preemption resume**: a worker that exits with
+  :data:`~deepspeed_tpu.resilience.preemption.PREEMPTED_EXIT_CODE` (the
+  drain handler's code after writing an emergency checkpoint) is restarted
+  with ``DS_RESUME=latest`` in its environment and does NOT consume the
+  failure budget; ``always_resume=True`` sets the resume env after crash
+  restarts too (for workers that checkpoint periodically).
+"""
+import os
+import random
 import subprocess
 import sys
 import time
@@ -16,7 +36,21 @@ from typing import Callable, List, Optional
 
 from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
                                                  ElasticityError)
+from deepspeed_tpu.resilience.preemption import (PREEMPTED_EXIT_CODE,
+                                                 RESUME_ENV)
 from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class AttemptRecord:
+    """One worker run: its exit code, how long it lived, and the backoff
+    the agent slept before launching the NEXT attempt (0 for the final
+    one)."""
+    rc: int
+    duration_s: float
+    backoff_s: float = 0.0
+    preempted: bool = False
+    resumed: bool = False
 
 
 @dataclass
@@ -24,7 +58,13 @@ class AgentResult:
     success: bool
     restarts: int
     return_code: int
-    history: List[int] = field(default_factory=list)
+    history: List[AttemptRecord] = field(default_factory=list)
+    #: preemption-drain restarts (not counted against the failure budget)
+    preempt_restarts: int = 0
+
+    @property
+    def return_codes(self) -> List[int]:
+        return [a.rc for a in self.history]
 
 
 class DSElasticAgent:
@@ -34,7 +74,17 @@ class DSElasticAgent:
                  restart_delay_s: float = 0.5, env: Optional[dict] = None,
                  ds_config: Optional[dict] = None,
                  monitor_interval_s: float = 0.1,
-                 on_restart: Optional[Callable[[int], None]] = None):
+                 on_restart: Optional[Callable[[int], None]] = None,
+                 backoff_factor: float = 2.0,
+                 backoff_max_s: float = 30.0,
+                 backoff_jitter: float = 0.1,
+                 backoff_seed: Optional[int] = None,
+                 restart_window_s: Optional[float] = None,
+                 preempt_exit_code: int = PREEMPTED_EXIT_CODE,
+                 max_preempt_restarts: int = 64,
+                 always_resume: bool = False,
+                 resume_env: str = RESUME_ENV,
+                 resume_value: str = "latest"):
         self.cmd = list(cmd)
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
@@ -42,6 +92,17 @@ class DSElasticAgent:
         self.ds_config = ds_config
         self.monitor_interval_s = monitor_interval_s
         self.on_restart = on_restart
+        self.backoff_factor = backoff_factor
+        self.backoff_max_s = backoff_max_s
+        self.backoff_jitter = backoff_jitter
+        self._rng = random.Random(backoff_seed)
+        self.restart_window_s = restart_window_s
+        self.preempt_exit_code = preempt_exit_code
+        self.max_preempt_restarts = max_preempt_restarts
+        self.always_resume = always_resume
+        self.resume_env = resume_env
+        self.resume_value = resume_value
+        self._sleep = time.sleep          # injectable for tests
 
     def _validate_world(self, world_size: int):
         """Re-derive a compatible batch config for the current world
@@ -52,29 +113,97 @@ class DSElasticAgent:
             return
         compute_elastic_config(self.ds_config, world_size=world_size)
 
+    def _backoff_s(self, consecutive_failures: int) -> float:
+        """Exponential in the CONSECUTIVE failure count (a success or a
+        preemption resets the ladder), capped, jittered."""
+        k = max(0, consecutive_failures - 1)
+        delay = min(self.restart_delay_s * (self.backoff_factor ** k),
+                    self.backoff_max_s)
+        if self.backoff_jitter > 0:
+            delay *= 1.0 + self._rng.uniform(-self.backoff_jitter,
+                                             self.backoff_jitter)
+        return max(0.0, delay)
+
+    def _budget_used(self, failure_times: List[float], now: float) -> int:
+        """Failures that still count: all of them (legacy) or only those
+        inside the sliding window."""
+        if self.restart_window_s is None:
+            return len(failure_times)
+        cutoff = now - self.restart_window_s
+        # prune in place so the list can't grow unboundedly
+        failure_times[:] = [t for t in failure_times if t >= cutoff]
+        return len(failure_times)
+
     def run(self, world_size: int = 1) -> AgentResult:
         """The reference's _invoke_run loop (:118): run → monitor → on
-        failure restart within budget."""
+        failure restart within budget; on preemption restart with the
+        resume env set."""
         self._validate_world(world_size)
-        history: List[int] = []
+        history: List[AttemptRecord] = []
+        failure_times: List[float] = []
         restarts = 0
+        preempt_restarts = 0
+        consecutive_failures = 0
+        resume_next = False
         while True:
-            proc = subprocess.Popen(self.cmd, env=self.env)
+            env = dict(self.env if self.env is not None else os.environ)
+            if resume_next:
+                env[self.resume_env] = self.resume_value
+            t0 = time.monotonic()
+            proc = subprocess.Popen(self.cmd, env=env)
             while proc.poll() is None:
-                time.sleep(self.monitor_interval_s)
+                self._sleep(self.monitor_interval_s)
             rc = proc.returncode
-            history.append(rc)
+            duration = time.monotonic() - t0
+            attempt = AttemptRecord(rc=rc, duration_s=duration,
+                                    preempted=rc == self.preempt_exit_code,
+                                    resumed=resume_next)
+            history.append(attempt)
             if rc == 0:
-                return AgentResult(True, restarts, 0, history)
-            if restarts >= self.max_restarts:
+                return AgentResult(True, restarts, 0, history,
+                                   preempt_restarts)
+            if attempt.preempted:
+                # graceful drain: the worker wrote an emergency checkpoint
+                # and asked to be resumed — not a failure
+                if preempt_restarts >= self.max_preempt_restarts:
+                    logger.error(
+                        "elastic agent: worker preempted "
+                        f"{preempt_restarts} times; giving up")
+                    return AgentResult(False, restarts, rc, history,
+                                       preempt_restarts)
+                preempt_restarts += 1
+                consecutive_failures = 0
+                resume_next = True
+                logger.warning(
+                    f"elastic agent: worker preempted (rc={rc}) after "
+                    f"{duration:.1f}s; resuming from latest checkpoint "
+                    f"({self.resume_env}={self.resume_value}, preempt "
+                    f"restart {preempt_restarts})")
+                if self.on_restart is not None:
+                    self.on_restart(restarts + preempt_restarts)
+                continue
+            now = time.monotonic()
+            failure_times.append(now)
+            used = self._budget_used(failure_times, now)
+            if used > self.max_restarts:
+                window = ("all time" if self.restart_window_s is None
+                          else f"last {self.restart_window_s}s")
                 logger.error(
                     f"elastic agent: worker failed rc={rc}; restart budget "
-                    f"({self.max_restarts}) exhausted")
-                return AgentResult(False, restarts, rc, history)
+                    f"exhausted ({used - 1} restarts over {window}, max "
+                    f"{self.max_restarts})")
+                return AgentResult(False, restarts, rc, history,
+                                   preempt_restarts)
             restarts += 1
+            consecutive_failures += 1
+            resume_next = self.always_resume
+            delay = self._backoff_s(consecutive_failures)
+            attempt.backoff_s = delay
             logger.warning(
-                f"elastic agent: worker failed rc={rc}; restart "
-                f"{restarts}/{self.max_restarts}")
+                f"elastic agent: worker failed rc={rc} after "
+                f"{duration:.1f}s; restart {restarts} "
+                f"(budget {used}/{self.max_restarts}, backoff "
+                f"{delay:.2f}s)")
             if self.on_restart is not None:
                 self.on_restart(restarts)
-            time.sleep(self.restart_delay_s)
+            self._sleep(delay)

@@ -6,6 +6,8 @@ from typing import List, Tuple
 
 import jax
 
+from deepspeed_tpu.utils.logging import logger
+
 Event = Tuple[str, float, int]     # (name, value, step)
 
 
@@ -16,6 +18,10 @@ class Monitor:
 
     def write_events(self, events: List[Event]):
         raise NotImplementedError
+
+    def write_event(self, name: str, value: float, step: int):
+        """Single-event convenience (health transitions, counters)."""
+        self.write_events([(name, float(value), int(step))])
 
 
 class CSVMonitor(Monitor):
@@ -125,4 +131,11 @@ class MonitorMaster(Monitor):
 
     def write_events(self, events: List[Event]):
         for s in self.sinks:
-            s.write_events(events)
+            # a flaky sink (wandb outage, full disk) must never take the
+            # training or serving loop down with it — log and move on
+            try:
+                s.write_events(events)
+            except Exception as e:
+                logger.warning(
+                    f"monitor: {type(s).__name__} sink failed ({e}); "
+                    "dropping events")

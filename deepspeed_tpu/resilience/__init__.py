@@ -1,0 +1,47 @@
+"""Fault tolerance layer (ISSUE 3 tentpole) — the reference framework's
+elastic-restart + Nebula durable-checkpoint capabilities, rebuilt for
+preemptible TPU pods:
+
+- `faults.py`     — deterministic fault injection (``DS_FAULTS`` /
+  ``resilience.faults`` spec grammar); every failure mode below has a
+  reproducible test because of it
+- `retry.py`      — the shared exponential-backoff + jitter + deadline
+  policy all checkpoint I/O goes through
+- `ckpt.py`       — crash-safe checkpoint protocol: staged ``<tag>.tmp``
+  dirs, fsynced manifests, atomic publish, newest-valid-tag fallback,
+  ``keep_last_k`` retention that never deletes the fallback
+- `health.py`     — serving health state machine (starting → ready →
+  draining/degraded) + the scheduler watchdog
+- `preemption.py` — SIGTERM drain for training: emergency checkpoint +
+  the distinct exit code the elastic agent resumes from
+
+See docs/tutorials/resilience.md for the durability contract and the
+fault-spec syntax.
+"""
+from deepspeed_tpu.resilience.faults import (FaultInjected, FaultInjector,
+                                             FaultSpec, NULL_INJECTOR,
+                                             parse_spec, resolve_injector)
+from deepspeed_tpu.resilience.retry import RetryDeadlineExceeded, retry_call
+from deepspeed_tpu.resilience.ckpt import (CheckpointCorruptError,
+                                           find_valid_tag, gc_tags,
+                                           publish_latest, verify_tag)
+from deepspeed_tpu.resilience.health import (HealthMonitor, HealthState,
+                                             SchedulerWatchdog, STATE_CODE)
+from deepspeed_tpu.resilience.preemption import (PREEMPTED_EXIT_CODE,
+                                                 PreemptionHandler,
+                                                 RESUME_ENV, drain_and_exit,
+                                                 emergency_save,
+                                                 resume_tag_from_env,
+                                                 run_resilient_training)
+
+__all__ = [
+    "FaultInjected", "FaultInjector", "FaultSpec", "NULL_INJECTOR",
+    "parse_spec", "resolve_injector",
+    "RetryDeadlineExceeded", "retry_call",
+    "CheckpointCorruptError", "find_valid_tag", "gc_tags",
+    "publish_latest", "verify_tag",
+    "HealthMonitor", "HealthState", "SchedulerWatchdog", "STATE_CODE",
+    "PREEMPTED_EXIT_CODE", "PreemptionHandler", "RESUME_ENV",
+    "drain_and_exit", "emergency_save", "resume_tag_from_env",
+    "run_resilient_training",
+]

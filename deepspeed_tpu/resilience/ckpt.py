@@ -1,0 +1,304 @@
+"""Crash-safe checkpoint protocol helpers (ISSUE 3 tentpole).
+
+The durability contract (docs/tutorials/resilience.md):
+
+1. A tag is staged under ``<tag>.tmp`` — Orbax state, metadata, aux npz
+   files, and finally a *manifest* recording the step, every leaf's
+   shape/dtype (+ optional crc32), and the on-disk file inventory.  The
+   manifest is fsynced before the tag is published.
+2. Publication is a single ``os.replace(<tag>.tmp, <tag>)`` — a crash at
+   ANY earlier point leaves only a ``.tmp`` directory that readers never
+   consider a tag.
+3. The ``latest`` pointer is itself written tmp + ``os.replace``.
+4. ``find_valid_tag`` resolves what to load: the ``latest`` pointer if it
+   names a tag that passes manifest verification, else the newest (by
+   manifest step) tag that does.  A torn pointer or a corrupted tag can
+   therefore delay a restore by one checkpoint interval but never fail
+   it while any valid tag exists.
+5. ``gc_tags`` retains the newest ``keep_last_k`` *valid* tags (plus
+   anything explicitly protected — the publish path protects the tag it
+   just wrote and whatever ``latest`` names), so retention can never
+   delete the fallback.
+
+Torn-write faults (``ckpt.manifest:truncate@K`` etc.) deliberately
+bypass the tmp+rename machinery — they model the state an old
+non-atomic writer or a dying disk leaves behind, which is exactly what
+verification has to catch.
+"""
+import json
+import os
+import shutil
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST_FILE = "ds_manifest.json"
+LATEST_FILE = "latest"
+TMP_SUFFIX = ".tmp"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No tag under the checkpoint root passed manifest verification."""
+
+
+# ------------------------------------------------------------------ fs io
+def fsync_path(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes,
+                       injector: FaultInjector = NULL_INJECTOR,
+                       site: Optional[str] = None):
+    """Durable publish of a small file: tmp in the same directory, fsync,
+    ``os.replace``, fsync the directory.  A ``truncate`` fault at
+    ``site`` instead writes a torn prefix straight to ``path`` (the
+    failure mode this function exists to prevent)."""
+    if site is not None:
+        keep = injector.truncate_bytes(site, len(data))
+        if keep is not None:
+            with open(path, "wb") as f:
+                f.write(data[:keep])
+            return
+    tmp = path + TMP_SUFFIX
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        fsync_path(os.path.dirname(path) or ".")
+    except OSError:          # some filesystems refuse directory fsync
+        pass
+
+
+# ---------------------------------------------------------------- manifest
+def leaf_summary(state: Any, checksums: bool = True) -> Dict[str, Dict]:
+    """Per-leaf shape/dtype (+ crc32 of the raw bytes) keyed by tree
+    path.  With ``checksums`` the leaves are fetched to host — callers on
+    the async path do this on the already-snapshotted state."""
+    import jax
+    out = {}
+    pairs, _ = jax.tree_util.tree_flatten_with_path(state)
+    for kp, leaf in pairs:
+        key = "/".join(str(getattr(k, "key", k)) for k in kp)
+        entry = {"shape": list(np.shape(leaf)),
+                 "dtype": str(getattr(leaf, "dtype", np.asarray(leaf).dtype)),
+                 "crc32": None}
+        if checksums:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            entry["crc32"] = zlib.crc32(arr.tobytes())
+        out[key] = entry
+    return out
+
+
+def _inventory(ckpt_dir: str, skip: Tuple[str, ...] = (MANIFEST_FILE,)
+               ) -> Dict[str, int]:
+    """relpath -> size for every regular file under the tag dir (the
+    manifest itself excluded — it can't checksum its own inventory)."""
+    inv = {}
+    for root, _dirs, files in os.walk(ckpt_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, ckpt_dir)
+            if rel in skip:
+                continue
+            inv[rel] = os.path.getsize(full)
+    return inv
+
+
+def write_manifest(ckpt_dir: str, step: int, tag: str,
+                   leaves: Dict[str, Dict],
+                   injector: FaultInjector = NULL_INJECTOR):
+    """Fsynced manifest over everything already staged in ``ckpt_dir``.
+    Must be the LAST write before the tag is published."""
+    manifest = {"version": 1, "tag": str(tag), "step": int(step),
+                "leaves": leaves, "files": _inventory(ckpt_dir)}
+    data = json.dumps(manifest, indent=1).encode()
+    atomic_write_bytes(os.path.join(ckpt_dir, MANIFEST_FILE), data,
+                       injector=injector, site="ckpt.manifest")
+
+
+def read_manifest(ckpt_dir: str) -> Optional[Dict]:
+    path = os.path.join(ckpt_dir, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def verify_tag(ckpt_dir: str) -> Tuple[bool, str]:
+    """Structural verification: the manifest parses and every file it
+    inventories is present with the recorded size.  Cheap enough to run
+    on every load and on every GC decision.
+
+    Tags predating the manifest protocol (a state dir but no manifest)
+    verify as legacy-valid so existing on-disk checkpoints stay
+    loadable."""
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import STATE_DIR
+    if not os.path.isdir(ckpt_dir):
+        return False, "missing tag directory"
+    try:
+        manifest = read_manifest(ckpt_dir)
+    except (json.JSONDecodeError, OSError) as e:
+        return False, f"unreadable manifest: {e}"
+    if manifest is None:
+        if os.path.isdir(os.path.join(ckpt_dir, STATE_DIR)):
+            return True, "legacy tag (no manifest)"
+        return False, "no manifest and no state dir"
+    if not isinstance(manifest.get("files"), dict):
+        return False, "manifest missing file inventory"
+    for rel, size in manifest["files"].items():
+        full = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(full):
+            return False, f"missing file {rel}"
+        actual = os.path.getsize(full)
+        if actual != size:
+            return False, f"size mismatch {rel}: {actual} != {size}"
+    return True, "ok"
+
+
+def verify_restored(state: Any, manifest: Optional[Dict]) -> List[str]:
+    """Deep verification: crc32 of every restored leaf against the
+    manifest (``resilience.verify_checkpoint: "full"``).  Returns the
+    list of mismatches (empty = clean)."""
+    if not manifest or not manifest.get("leaves"):
+        return []
+    recorded = manifest["leaves"]
+    mismatches = []
+    for key, entry in leaf_summary(state, checksums=True).items():
+        want = recorded.get(key)
+        if want is None:
+            mismatches.append(f"leaf {key} missing from manifest")
+        elif want.get("crc32") is not None \
+                and want["crc32"] != entry["crc32"]:
+            mismatches.append(f"leaf {key} checksum mismatch")
+    return mismatches
+
+
+# ------------------------------------------------------------- tag lookup
+def tag_step(load_dir: str, tag: str) -> int:
+    """Ordering key for fallback: manifest step, else metadata step, else
+    -1 (legacy tags sort last)."""
+    from deepspeed_tpu.runtime.checkpoint_engine.engine import METADATA_FILE
+    ckpt_dir = os.path.join(load_dir, tag)
+    try:
+        manifest = read_manifest(ckpt_dir)
+        if manifest is not None and isinstance(manifest.get("step"), int):
+            return manifest["step"]
+    except (json.JSONDecodeError, OSError):
+        pass
+    meta = os.path.join(ckpt_dir, METADATA_FILE)
+    if os.path.exists(meta):
+        try:
+            with open(meta) as f:
+                return int(json.load(f).get("global_steps", -1))
+        except (json.JSONDecodeError, OSError, TypeError, ValueError):
+            pass
+    return -1
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """Published (non-``.tmp``) tag directories under the root."""
+    if not os.path.isdir(load_dir):
+        return []
+    return sorted(
+        name for name in os.listdir(load_dir)
+        if os.path.isdir(os.path.join(load_dir, name))
+        and not name.endswith(TMP_SUFFIX))
+
+
+def read_latest(load_dir: str) -> Optional[str]:
+    path = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            tag = f.read().strip()
+    except OSError:
+        return None
+    return tag or None
+
+
+def publish_latest(save_dir: str, tag: str,
+                   injector: FaultInjector = NULL_INJECTOR):
+    atomic_write_bytes(os.path.join(save_dir, LATEST_FILE),
+                       str(tag).encode(), injector=injector,
+                       site="ckpt.latest")
+
+
+def find_valid_tag(load_dir: str) -> Optional[str]:
+    """Resolve the tag to restore: the newest (by manifest step) tag that
+    passes verification.  The ``latest`` pointer is a fast path — trusted
+    only when it names the newest valid tag; a torn pointer, a pointer to
+    a corrupted tag, or a pointer left stale by a crash between the tag
+    rename and the pointer publish all fall back transparently.  None
+    when the root holds no tags at all; :class:`CheckpointCorruptError`
+    when tags exist but none verify."""
+    tags = list_tags(load_dir)
+    if not tags:
+        return None
+    latest = read_latest(load_dir)
+    candidates = sorted(tags, key=lambda t: (tag_step(load_dir, t), t),
+                        reverse=True)
+    for tag in candidates:
+        ok, reason = verify_tag(os.path.join(load_dir, tag))
+        if ok:
+            if tag != latest:
+                if latest is not None and \
+                        verify_tag(os.path.join(load_dir, latest))[0]:
+                    # the pointer names a VALID but older tag — the
+                    # signature of a crash between the tag publish and
+                    # the pointer update.  (To pin an older checkpoint
+                    # on purpose, pass it explicitly via tag=.)
+                    logger.warning(
+                        f"checkpoint: 'latest' -> {latest!r} is stale; "
+                        f"restoring newer valid tag {tag!r} "
+                        f"(step {tag_step(load_dir, tag)})")
+                else:
+                    logger.warning(
+                        f"checkpoint: 'latest' -> {latest!r} is missing, "
+                        f"torn, or corrupt; restoring newest valid tag "
+                        f"{tag!r} (step {tag_step(load_dir, tag)})")
+            return tag
+        logger.warning(f"checkpoint: skipping tag {tag!r}: {reason}")
+    raise CheckpointCorruptError(
+        f"no tag under {load_dir} passed manifest verification "
+        f"(checked {candidates})")
+
+
+# -------------------------------------------------------------- retention
+def gc_tags(save_dir: str, keep_last_k: int, protect: Tuple[str, ...] = ()):
+    """Delete all but the newest ``keep_last_k`` VALID tags.  Invalid
+    tags don't count against the budget (so retention can never reduce
+    the set of restorable checkpoints below k) and protected tags — the
+    one just published and whatever ``latest`` names — are never removed.
+    Stale ``.tmp`` staging dirs from crashed saves are swept too."""
+    if keep_last_k <= 0:
+        return
+    protected = set(protect)
+    latest = read_latest(save_dir)
+    if latest:
+        protected.add(latest)
+    valid = [t for t in list_tags(save_dir)
+             if verify_tag(os.path.join(save_dir, t))[0]]
+    valid.sort(key=lambda t: (tag_step(save_dir, t), t), reverse=True)
+    for tag in valid[keep_last_k:]:
+        if tag in protected:
+            continue
+        logger.info(f"checkpoint: retention (keep_last_k={keep_last_k}) "
+                    f"removing tag {tag!r}")
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+    if os.path.isdir(save_dir):
+        for name in os.listdir(save_dir):
+            full = os.path.join(save_dir, name)
+            if name.endswith(TMP_SUFFIX) and os.path.isdir(full) \
+                    and name[:-len(TMP_SUFFIX)] not in protected:
+                shutil.rmtree(full, ignore_errors=True)

@@ -1,0 +1,212 @@
+"""Deterministic fault injection (ISSUE 3 tentpole).
+
+Every failure mode the resilience layer defends against — a crash mid-
+checkpoint, a torn ``latest`` write, a wedged serving step, a KV pool
+that suddenly cannot allocate — is reproducible on demand through a
+:class:`FaultInjector`.  Production code calls ``injector.check(site)``
+(or the caller-handled ``deny``/``truncate_bytes`` variants) at named
+sites; a fault spec decides, per site-invocation index, whether the
+fault fires.  With no specs armed every hook is a dict lookup + integer
+increment — safe to leave in hot-ish paths.
+
+Spec grammar (``DS_FAULTS`` env var or the ``resilience.faults`` config
+key; specs separated by ``;`` or whitespace)::
+
+    site:action[=param]@when
+
+    site    dotted hook name: ckpt.save ckpt.aux ckpt.manifest
+            ckpt.publish ckpt.latest train.step serve.step kv.alloc ...
+    action  raise      raise FaultInjected at the site
+            kill       os._exit(param or 1) — a hard crash, no cleanup
+            sigterm    deliver SIGTERM to this process (preemption)
+            stall      time.sleep(param seconds)
+            deny       site-specific refusal (kv.alloc returns no blocks)
+            truncate   site-specific torn write (keep first param bytes,
+                       default half)
+    when    K          the K-th invocation of the site (0-based)
+            K+         every invocation from the K-th on
+            *          every invocation
+            pP sS      fire with probability P, seeded by S (deterministic
+                       per invocation index): ``p0.25s42``
+
+Examples::
+
+    DS_FAULTS="ckpt.save:raise@1"             # 2nd save crashes
+    DS_FAULTS="train.step:kill=9@5"           # hard-kill at step 5
+    DS_FAULTS="serve.step:stall=0.2@3+"       # slow loop from step 3
+    DS_FAULTS="kv.alloc:deny@*"               # pool always exhausted
+"""
+import hashlib
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+from deepspeed_tpu.utils.logging import logger
+
+ENV_VAR = "DS_FAULTS"
+ACTIONS = ("raise", "kill", "sigterm", "stall", "deny", "truncate")
+
+_SPEC_RE = re.compile(
+    r"^(?P<site>[\w.]+):(?P<action>[a-z]+)(?:=(?P<param>[-\w.]+))?"
+    r"@(?P<when>\*|\d+\+?|p[0-9.]+s\d+)$")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by ``raise``-action faults; carries the site for asserts."""
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(f"injected fault at {site} (invocation "
+                         f"{invocation})")
+        self.site = site
+        self.invocation = invocation
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    site: str
+    action: str
+    param: Optional[float] = None
+    start: int = 0                 #: first firing invocation index
+    repeat: bool = False           #: fire on every invocation >= start
+    prob: Optional[float] = None   #: probabilistic mode (seeded)
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        m = _SPEC_RE.match(text.strip())
+        if not m:
+            raise ValueError(
+                f"bad fault spec {text!r}: expected "
+                "site:action[=param]@when (when = K, K+, *, or pPsS)")
+        action = m.group("action")
+        if action not in ACTIONS:
+            raise ValueError(f"bad fault spec {text!r}: unknown action "
+                             f"{action!r}; choose from {ACTIONS}")
+        param = m.group("param")
+        when = m.group("when")
+        kw = dict(site=m.group("site"), action=action,
+                  param=float(param) if param is not None else None)
+        if when == "*":
+            kw.update(start=0, repeat=True)
+        elif when.startswith("p"):
+            p, _, s = when[1:].partition("s")
+            kw.update(prob=float(p), seed=int(s), repeat=True)
+        elif when.endswith("+"):
+            kw.update(start=int(when[:-1]), repeat=True)
+        else:
+            kw.update(start=int(when))
+        return cls(**kw)
+
+    def fires_at(self, invocation: int) -> bool:
+        if self.prob is not None:
+            # deterministic per (seed, invocation): hash-derived uniform
+            h = hashlib.sha256(
+                f"{self.seed}:{self.site}:{invocation}".encode()).digest()
+            u = int.from_bytes(h[:8], "big") / float(1 << 64)
+            return u < self.prob
+        if self.repeat:
+            return invocation >= self.start
+        return invocation == self.start
+
+
+def parse_spec(text: Optional[str]) -> List[FaultSpec]:
+    """Parse a ``;``/whitespace-separated spec string (None/empty → [])."""
+    if not text:
+        return []
+    return [FaultSpec.parse(part)
+            for part in re.split(r"[;\s]+", text.strip()) if part]
+
+
+class FaultInjector:
+    """Deterministic per-site fault firing.  Thread-safe enough for the
+    serving loop: invocation counters are per-site ints mutated under the
+    GIL, and specs are immutable after construction."""
+
+    def __init__(self, specs: Union[str, Sequence[FaultSpec], None] = None):
+        if isinstance(specs, str):
+            specs = parse_spec(specs)
+        self.specs: List[FaultSpec] = list(specs or [])
+        self._by_site: Dict[str, List[FaultSpec]] = {}
+        for s in self.specs:
+            self._by_site.setdefault(s.site, []).append(s)
+        self.invocations: Dict[str, int] = {}
+        #: site -> number of faults actually fired (test/smoke asserts)
+        self.fired: Dict[str, int] = {}
+
+    def __bool__(self):
+        return bool(self.specs)
+
+    # ------------------------------------------------------------- firing
+    def _fire(self, site: str) -> Optional[FaultSpec]:
+        n = self.invocations.get(site, 0)
+        self.invocations[site] = n + 1
+        for spec in self._by_site.get(site, ()):
+            if spec.fires_at(n):
+                self.fired[site] = self.fired.get(site, 0) + 1
+                logger.warning(f"fault injector: firing {spec.action} at "
+                               f"{site} (invocation {n})")
+                return spec
+        return None
+
+    def check(self, site: str):
+        """Hook for inline actions (raise / kill / sigterm / stall).
+        ``deny``/``truncate`` specs at the site are ignored here — use the
+        dedicated helpers at sites that can honor them."""
+        spec = self._fire(site)
+        if spec is None:
+            return
+        if spec.action == "raise":
+            raise FaultInjected(site, self.invocations[site] - 1)
+        if spec.action == "kill":
+            os._exit(int(spec.param) if spec.param is not None else 1)
+        if spec.action == "sigterm":
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif spec.action == "stall":
+            time.sleep(spec.param if spec.param is not None else 1.0)
+
+    def deny(self, site: str) -> bool:
+        """True when a ``deny`` fault fires at the site (inline actions at
+        the same site still execute)."""
+        spec = self._fire(site)
+        if spec is None:
+            return False
+        if spec.action == "raise":
+            raise FaultInjected(site, self.invocations[site] - 1)
+        if spec.action == "stall":
+            time.sleep(spec.param if spec.param is not None else 1.0)
+            return False
+        return spec.action == "deny"
+
+    def truncate_bytes(self, site: str, total: int) -> Optional[int]:
+        """For torn-write simulation: None = write everything; an int =
+        keep only that many leading bytes (and the caller should skip any
+        atomicity machinery — a truncate fault models the torn state an
+        OLD non-atomic writer or a failing disk leaves behind)."""
+        spec = self._fire(site)
+        if spec is None:
+            return None
+        if spec.action == "raise":
+            raise FaultInjected(site, self.invocations[site] - 1)
+        if spec.action == "kill":
+            os._exit(int(spec.param) if spec.param is not None else 1)
+        if spec.action == "truncate":
+            keep = int(spec.param) if spec.param is not None else total // 2
+            return max(0, min(keep, total))
+        return None
+
+
+#: shared no-op injector (every hook is a cheap early-out through it)
+NULL_INJECTOR = FaultInjector([])
+
+
+def resolve_injector(config_spec: Optional[str] = None,
+                     env: Optional[dict] = None) -> FaultInjector:
+    """Build the effective injector: config-supplied specs plus anything
+    armed through ``DS_FAULTS`` (env appended, so it can extend a config
+    matrix from the outside — the chaos smoke runner does this)."""
+    env = os.environ if env is None else env
+    specs = parse_spec(config_spec) + parse_spec(env.get(ENV_VAR))
+    return FaultInjector(specs) if specs else NULL_INJECTOR

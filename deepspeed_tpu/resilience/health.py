@@ -1,0 +1,183 @@
+"""Serving health state machine + scheduler watchdog (ISSUE 3 tentpole).
+
+States::
+
+    STARTING --ready--> READY --drain--> DRAINING --stopped--> STOPPED
+        |                 |                 |
+        +---------------- degraded ---------+        (sticky until stop)
+
+- ``/healthz`` maps READY -> 200, everything else -> 503 with the state
+  and reason in the body — a load balancer pulls the replica the moment
+  a drain or degradation begins.
+- DRAINING still *finishes* admitted work; only new work is refused.
+- DEGRADED means the loop itself is broken (consecutive step failures,
+  or the watchdog saw ``step_count`` stop advancing); waiting handlers
+  give up with 503 instead of hanging.
+"""
+import enum
+import threading
+import time
+from typing import Callable, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class HealthState(enum.Enum):
+    STARTING = "starting"
+    READY = "ready"
+    DRAINING = "draining"
+    DEGRADED = "degraded"
+    STOPPED = "stopped"
+
+
+#: numeric encoding for gauges/metrics (larger = further from serving)
+STATE_CODE = {HealthState.READY: 0, HealthState.STARTING: 1,
+              HealthState.DRAINING: 2, HealthState.DEGRADED: 3,
+              HealthState.STOPPED: 4}
+
+_ALLOWED = {
+    HealthState.STARTING: {HealthState.READY, HealthState.DRAINING,
+                           HealthState.DEGRADED, HealthState.STOPPED},
+    HealthState.READY: {HealthState.DRAINING, HealthState.DEGRADED,
+                        HealthState.STOPPED},
+    HealthState.DRAINING: {HealthState.DEGRADED, HealthState.STOPPED},
+    # DEGRADED -> READY: the watchdog clears a stall verdict when
+    # step_count advances again (a legitimately long XLA compile must
+    # not brick the replica until manual restart)
+    HealthState.DEGRADED: {HealthState.READY, HealthState.DRAINING,
+                           HealthState.STOPPED},
+    HealthState.STOPPED: set(),
+}
+
+
+class HealthMonitor:
+    """Thread-safe state holder; ``on_transition(state, reason)`` fires
+    under no lock (sinks update metrics/monitors)."""
+
+    def __init__(self, on_transition: Optional[
+            Callable[[HealthState, str], None]] = None):
+        self._lock = threading.Lock()
+        self._state = HealthState.STARTING
+        self._reason = "starting"
+        self._since = time.monotonic()
+        self._on_transition = on_transition
+        self.drain_started = threading.Event()
+
+    # ------------------------------------------------------------ queries
+    @property
+    def state(self) -> HealthState:
+        return self._state
+
+    @property
+    def reason(self) -> str:
+        return self._reason
+
+    def is_accepting(self) -> bool:
+        """May new requests be admitted?"""
+        return self._state is HealthState.READY
+
+    def is_degraded(self) -> bool:
+        return self._state is HealthState.DEGRADED
+
+    def is_draining(self) -> bool:
+        return self._state is HealthState.DRAINING
+
+    def snapshot(self) -> dict:
+        return {"status": self._state.value, "reason": self._reason,
+                "since_s": round(time.monotonic() - self._since, 3)}
+
+    def http_status(self) -> int:
+        return 200 if self._state is HealthState.READY else 503
+
+    # -------------------------------------------------------- transitions
+    def _to(self, state: HealthState, reason: str) -> bool:
+        with self._lock:
+            if state is self._state:
+                return False
+            if state not in _ALLOWED[self._state]:
+                logger.warning(f"health: ignoring {self._state.value} -> "
+                               f"{state.value} ({reason})")
+                return False
+            logger.info(f"health: {self._state.value} -> {state.value} "
+                        f"({reason})")
+            self._state = state
+            self._reason = reason
+            self._since = time.monotonic()
+        if state is HealthState.DRAINING:
+            self.drain_started.set()
+        if self._on_transition is not None:
+            self._on_transition(state, reason)
+        return True
+
+    def mark_ready(self, reason: str = "serving") -> bool:
+        return self._to(HealthState.READY, reason)
+
+    def begin_drain(self, reason: str = "drain requested") -> bool:
+        return self._to(HealthState.DRAINING, reason)
+
+    def mark_degraded(self, reason: str) -> bool:
+        return self._to(HealthState.DEGRADED, reason)
+
+    def mark_stopped(self, reason: str = "shutdown") -> bool:
+        return self._to(HealthState.STOPPED, reason)
+
+
+class SchedulerWatchdog:
+    """Marks the server degraded when the scheduler has work but
+    ``step_count`` stops advancing for ``stall_timeout_s`` — the global
+    replacement for the old per-handler stall heuristic (each do_POST
+    privately counting step_count polls).  One watchdog, one verdict,
+    surfaced through health + a ``stalls`` metric counter."""
+
+    def __init__(self, scheduler, health: HealthMonitor,
+                 stall_timeout_s: float, poll_interval_s: float = None):
+        self.scheduler = scheduler
+        self.health = health
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.poll_interval_s = (poll_interval_s if poll_interval_s
+                                is not None
+                                else max(0.05, min(self.stall_timeout_s / 4,
+                                                   1.0)))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self.stall_timeout_s <= 0:        # 0 disables the watchdog
+            return self
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ds-serve-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        # lock-free reads only: a wedged step() holds the scheduler lock
+        # for its whole duration, so has_work() (which acquires it) would
+        # block the watchdog on exactly the stall it exists to detect
+        has_work = getattr(self.scheduler, "has_work_unlocked",
+                           self.scheduler.has_work)
+        last_count = self.scheduler.step_count
+        last_advance = time.monotonic()
+        flagged = False
+        while not self._stop.wait(self.poll_interval_s):
+            cur = self.scheduler.step_count
+            now = time.monotonic()
+            if cur != last_count or not has_work():
+                last_count, last_advance = cur, now
+                if flagged:
+                    # the stall cleared (e.g. a minutes-long compile
+                    # finished): un-brick the replica
+                    flagged = False
+                    self.health.mark_ready("scheduler recovered: "
+                                           f"step_count advanced to {cur}")
+                continue
+            if not flagged and now - last_advance >= self.stall_timeout_s:
+                flagged = True
+                self.scheduler.metrics.counters["stalls"] += 1
+                self.health.mark_degraded(
+                    f"scheduler stalled: step_count={cur} unchanged for "
+                    f"{now - last_advance:.1f}s with work pending")

@@ -1,0 +1,158 @@
+"""Preemption-aware drain for training (ISSUE 3 tentpole).
+
+TPU pod preemption delivers SIGTERM with a grace window.  The drain
+protocol: finish the in-flight step, write an *emergency checkpoint*
+(through the same crash-safe protocol as periodic saves), and exit with
+:data:`PREEMPTED_EXIT_CODE` — a code the elastic agent recognizes as
+"resume me" rather than "I crashed": the restarted worker gets
+``DS_RESUME=latest`` in its environment and picks up from the emergency
+tag.
+
+``run_resilient_training`` is the reference loop the e2e tests and the
+chaos smoke runner drive; real training scripts can use it directly or
+copy its shape (install handler → check ``should_stop`` each step →
+``drain_and_exit`` on preemption).
+"""
+import os
+import signal
+import sys
+import threading
+from typing import Callable, Iterable, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+#: distinct from shell/signal conventions (1, 2, 126+) so the elastic
+#: agent can tell a graceful preemption drain from a crash
+PREEMPTED_EXIT_CODE = 86
+
+RESUME_ENV = "DS_RESUME"
+EMERGENCY_TAG_PREFIX = "emergency_step"
+
+
+def resume_tag_from_env(env: Optional[dict] = None) -> Optional[str]:
+    """``DS_RESUME=latest`` (or an explicit tag) set by the elastic agent
+    on restart; None = fresh start.  ``latest`` means "resolve through
+    the crash-safe fallback chain" and maps to ``tag=None`` in
+    ``load_checkpoint``."""
+    env = os.environ if env is None else env
+    val = env.get(RESUME_ENV, "").strip()
+    return val or None
+
+
+class PreemptionHandler:
+    """Latches SIGTERM/SIGINT into a flag the training loop polls at
+    step boundaries (never interrupts a step mid-flight).  A second
+    signal while draining escalates to the previous handler (so a
+    double Ctrl-C still kills a wedged drain)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = threading.Event()
+        self.signum: Optional[int] = None
+        self._previous = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionHandler":
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    @property
+    def should_stop(self) -> bool:
+        return self.requested.is_set()
+
+    def _on_signal(self, signum, frame):
+        if self.requested.is_set():
+            # second signal: restore + re-raise so a stuck drain dies
+            logger.warning(f"preemption: second signal {signum} during "
+                           "drain; escalating")
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self.signum = signum
+        logger.warning(f"preemption: received signal {signum}; will drain "
+                       "after the in-flight step")
+        self.requested.set()
+
+
+def emergency_save(engine, save_dir: str) -> str:
+    """Write the emergency checkpoint through the normal (crash-safe)
+    save path and make it durable before returning — a preemption grace
+    window is no place for an in-flight async save."""
+    tag = f"{EMERGENCY_TAG_PREFIX}{engine.global_steps}"
+    engine.save_checkpoint(save_dir, tag=tag, save_latest=True)
+    engine.wait_pending_checkpoint()
+    log_dist(f"preemption: emergency checkpoint {tag!r} durable in "
+             f"{save_dir}", ranks=[0])
+    return tag
+
+
+def drain_and_exit(engine, save_dir: str,
+                   _exit: Callable[[int], None] = sys.exit):
+    """Emergency-save then exit with the preemption code (the elastic
+    agent turns that code into a resume-from-latest restart)."""
+    emergency_save(engine, save_dir)
+    _exit(PREEMPTED_EXIT_CODE)
+
+
+def run_resilient_training(engine, batches: Iterable, save_dir: str,
+                           num_steps: int,
+                           save_interval: int = 0,
+                           handler: Optional[PreemptionHandler] = None,
+                           resume: Optional[str] = None,
+                           on_step: Optional[Callable[[int, float],
+                                                      None]] = None,
+                           _exit: Callable[[int], None] = sys.exit):
+    """Preemption-aware training loop: optional resume, periodic
+    checkpoints every ``save_interval`` steps, drain-on-signal.
+
+    ``batches`` is indexed by GLOBAL step (a callable ``step -> batch``
+    or a sequence), so a resumed run replays exactly the batches an
+    uninterrupted run would have seen.  Returns the last loss.
+    """
+    own_handler = handler is None
+    handler = handler if handler is not None else PreemptionHandler()
+    if own_handler:
+        handler.install()
+    resume = resume if resume is not None else resume_tag_from_env()
+    if resume:
+        tag = None if resume == "latest" else resume
+        loaded = engine.load_checkpoint(save_dir, tag=tag)
+        if loaded is None or loaded[0] is None:
+            log_dist(f"resume requested ({resume!r}) but no checkpoint "
+                     f"found in {save_dir}; starting fresh", ranks=[0])
+    loss = None
+    try:
+        while engine.global_steps < num_steps:
+            step = engine.global_steps
+            batch = (batches(step) if callable(batches)
+                     else batches[step])
+            loss = engine.train_batch(batch=batch)
+            if on_step is not None:
+                on_step(engine.global_steps, float(loss))
+            if handler.should_stop:
+                drain_and_exit(engine, save_dir, _exit=_exit)
+                return loss            # _exit was stubbed out (tests)
+            if save_interval and engine.global_steps % save_interval == 0:
+                engine.save_checkpoint(save_dir)
+        engine.wait_pending_checkpoint()
+        return loss
+    finally:
+        if own_handler:
+            handler.uninstall()

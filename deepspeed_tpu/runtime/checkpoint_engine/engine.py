@@ -127,7 +127,23 @@ class AsyncOrbaxCheckpointEngine(CheckpointEngine):
         return self._ckptr
 
     def save(self, state_dict, path: str):
-        self._async_checkpointer().save(os.path.abspath(path), state_dict,
+        # snapshot to host BEFORE handing off: the engine's train step
+        # donates its state buffers, and this orbax's AsyncCheckpointer
+        # keeps zero-copy views — without a private copy the background
+        # serialization races the next train step and writes the
+        # post-mutation bytes (observed: restored state == mutated state
+        # whenever the compile cache made the next step fast enough).
+        # An all-numpy tree is already a caller-owned host snapshot (the
+        # runtime engine hands one over when manifest checksums forced
+        # the fetch anyway) — don't copy it a second time.
+        # At multi-host scale this becomes a per-addressable-shard copy.
+        if all(isinstance(l, np.ndarray)
+               for l in jax.tree.leaves(state_dict)):
+            snapshot = state_dict
+        else:
+            snapshot = jax.tree.map(lambda a: np.array(a, copy=True),
+                                    state_dict)
+        self._async_checkpointer().save(os.path.abspath(path), snapshot,
                                         force=True)
 
     def load(self, path: str, template=None, shardings=None):
@@ -155,7 +171,18 @@ class NpzCheckpointEngine(CheckpointEngine):
             key = "/".join(str(getattr(k, "key", k)) for k in kp)
             flat[key] = np.asarray(leaf)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+        final = path if path.endswith(".npz") else path + ".npz"
+        # tmp + atomic rename: a crash mid-serialization must never leave
+        # a torn .npz at the published name (resilience/ckpt.py contract)
+        tmp = final + ".tmp.npz"
+        try:
+            np.savez(tmp, **flat)
+            with open(tmp, "rb+") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     def load(self, path: str, template=None, shardings=None):
         f = path if path.endswith(".npz") else path + ".npz"

@@ -268,6 +268,66 @@ class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
 
+class RetryConfig(DeepSpeedConfigModel):
+    """Backoff policy for checkpoint I/O (resilience/retry.py
+    retry_call: exponential backoff + full jitter + deadline)."""
+    attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    #: wall-clock budget across all attempts; None = attempts-bounded only
+    deadline_s: Optional[float] = None
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.attempts < 1:
+            raise ValueError(
+                f"resilience.retry.attempts={self.attempts}: must be >= 1")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("resilience.retry delays must be >= 0")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"resilience.retry.deadline_s={self.deadline_s}: must be "
+                "> 0 (omit for no deadline)")
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """Fault tolerance (deepspeed_tpu/resilience/): crash-safe
+    checkpoint protocol knobs + deterministic fault injection.  TPU-
+    native framing of the reference's nebula/elasticity durability
+    features."""
+    #: fault-injection spec string (resilience/faults.py grammar);
+    #: DS_FAULTS env specs are appended to these
+    faults: str = ""
+    #: retain only the newest k VALID checkpoint tags after each publish
+    #: (0 = keep everything); the fallback tag is never deleted
+    keep_last_k: int = 0
+    #: record per-leaf crc32s in the checkpoint manifest (costs one host
+    #: fetch of the state at save time; shapes/dtypes are always recorded)
+    checkpoint_checksums: bool = True
+    #: load-time verification: "off", "manifest" (structural: the
+    #: manifest parses and its file inventory matches on disk), or
+    #: "full" (also re-checksums every restored leaf)
+    verify_checkpoint: str = "manifest"
+    retry: RetryConfig = Field(default_factory=RetryConfig)
+
+    def __init__(self, **data):
+        if isinstance(data.get("retry"), dict):
+            data["retry"] = RetryConfig(**data["retry"])
+        super().__init__(**data)
+        # parse eagerly so a typo'd spec fails at config time, not at the
+        # fault site mid-run
+        from deepspeed_tpu.resilience.faults import parse_spec
+        parse_spec(self.faults)
+        if self.keep_last_k < 0:
+            raise ValueError(
+                f"resilience.keep_last_k={self.keep_last_k}: must be >= 0 "
+                "(0 = keep all tags)")
+        if self.verify_checkpoint not in ("off", "manifest", "full"):
+            raise ValueError(
+                f"resilience.verify_checkpoint={self.verify_checkpoint!r}: "
+                "choose from 'off', 'manifest', 'full'")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -301,6 +361,16 @@ class ServingConfig(DeepSpeedConfigModel):
     #: decode dispatches to the lax.scan form — models/serving.py
     #: use_scan_decode).  DS_QUANT_SCAN_THRESHOLD_MB overrides.
     quant_scan_threshold_mb: int = 512
+    #: scheduler watchdog: seconds of pending work with step_count frozen
+    #: before the server goes DEGRADED (waiting /generate handlers then
+    #: 503 instead of hanging).  Generous default = the old handler-local
+    #: heuristic's 10 x 60 s — one step legitimately holds the lock for
+    #: minutes while XLA compiles a fresh bucket on a real model.
+    #: DS_SERVE_STALL_TIMEOUT_S overrides; 0 disables the watchdog.
+    stall_timeout_s: float = 600.0
+    #: consecutive serving-loop step() failures before the server goes
+    #: DEGRADED instead of retrying forever; 0 = never degrade
+    max_loop_failures: int = 8
 
     def __init__(self, **data):
         super().__init__(**data)
@@ -339,6 +409,23 @@ class ServingConfig(DeepSpeedConfigModel):
             raise ValueError(
                 "serving.quant_scan_threshold_mb="
                 f"{self.quant_scan_threshold_mb}: must be >= 0")
+        if self.stall_timeout_s < 0:
+            raise ValueError(
+                f"serving.stall_timeout_s={self.stall_timeout_s}: must be "
+                ">= 0 (0 disables the stall watchdog)")
+        if self.max_loop_failures < 0:
+            raise ValueError(
+                f"serving.max_loop_failures={self.max_loop_failures}: "
+                "must be >= 0 (0 = never degrade on step failures)")
+
+    def resolved_stall_timeout_s(self) -> float:
+        """Config value with the DS_SERVE_STALL_TIMEOUT_S env override
+        applied (the quant_scan_threshold pattern: env wins at use
+        site)."""
+        env = os.environ.get("DS_SERVE_STALL_TIMEOUT_S")
+        if env is not None and env.strip():
+            return float(env)
+        return self.stall_timeout_s
 
 
 # --------------------------------------------------------------------------- root
@@ -406,6 +493,7 @@ class DeepSpeedConfig:
         self.debug_config = DebugConfig(**d.get("debug", {}))
         self.elasticity_config = ElasticityConfig(**d.get("elasticity", {}))
         self.checkpoint_config = CheckpointConfig(**d.get("checkpoint", {}))
+        self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
         self.data_types_config = DataTypesConfig(**d.get("data_types", {}))
         self.serving_config = ServingConfig(**d.get("serving", {}))
         self.compression_config = d.get("compression_training", {})

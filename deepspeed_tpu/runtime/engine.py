@@ -136,6 +136,11 @@ class DeepSpeedEngine:
         # async Orbax engine overlaps saves with subsequent train steps
         self.checkpoint_engine = None
         self._pending_ckpt = None
+        # deterministic fault injection (resilience/faults.py): config
+        # specs + DS_FAULTS env; a no-op injector when neither is armed
+        from deepspeed_tpu.resilience.faults import resolve_injector
+        self.fault_injector = resolve_injector(
+            self._config.resilience_config.faults)
 
         # ---- precision -------------------------------------------------------
         if self._config.fp16.enabled:
@@ -1973,6 +1978,7 @@ class DeepSpeedEngine:
         micro-batches (reference: PipelineEngine.train_batch,
         runtime/pipe/engine.py:297; plain-engine equivalent is GAS×
         forward/backward + step)."""
+        self.fault_injector.check("train.step")
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         if batch is None:
@@ -2264,45 +2270,90 @@ class DeepSpeedEngine:
         return self.checkpoint_engine
 
     def wait_pending_checkpoint(self):
-        """Block until an in-flight async save is durable, then publish its
-        ``latest`` pointer.  No-op for sync engines / no pending save.
-        Called automatically before the next save/load, so at most one
-        save overlaps training."""
+        """Block until an in-flight async save is durable, then publish it
+        (manifest → atomic tag rename → ``latest`` pointer → retention).
+        No-op for sync engines / no pending save.  Called automatically
+        before the next save/load, so at most one save overlaps
+        training."""
         if self._pending_ckpt is None:
             return
-        save_dir, tag, save_latest, aux_thread = self._pending_ckpt
+        tag, aux_thread, finalize = self._pending_ckpt
         self._pending_ckpt = None
         if aux_thread is not None:
             aux_thread.join()
         self._get_checkpoint_engine().commit(tag)
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-        log_dist(f"committed checkpoint {os.path.join(save_dir, str(tag))}",
-                 ranks=[0])
+        ckpt_dir = finalize()
+        log_dist(f"committed checkpoint {ckpt_dir}", ranks=[0])
+
+    def _ckpt_retry(self, fn, *args, describe="", **kwargs):
+        """All checkpoint I/O goes through the shared retry policy
+        (resilience/retry.py: exponential backoff + jitter + deadline)."""
+        from deepspeed_tpu.resilience.retry import retry_call
+        r = self._config.resilience_config.retry
+        return retry_call(fn, *args, attempts=r.attempts,
+                          base_delay_s=r.base_delay_s,
+                          max_delay_s=r.max_delay_s,
+                          deadline_s=r.deadline_s,
+                          describe=describe, **kwargs)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        """Crash-safe save (resilience/ckpt.py protocol): everything is
+        staged under ``<tag>.tmp`` and published by one atomic rename
+        AFTER the fsynced manifest lands, so a crash at any point leaves
+        either the previous checkpoint set intact or the new tag fully
+        durable — never a torn tag that ``latest`` resolves to."""
         from deepspeed_tpu.runtime.checkpoint_engine.engine import (
             METADATA_FILE, STATE_DIR)
+        from deepspeed_tpu.resilience import ckpt as rckpt
+        import shutil
         self.wait_pending_checkpoint()
         ckpt_engine = self._get_checkpoint_engine()
-        tag = tag or f"global_step{self.global_steps}"
+        inj = self.fault_injector
+        rcfg = self._config.resilience_config
+        step = self.global_steps
+        tag = tag or f"global_step{step}"
         ckpt_dir = os.path.join(save_dir, str(tag))
+        tmp_dir = ckpt_dir + rckpt.TMP_SUFFIX
         extra = {
-            "global_steps": self.global_steps,
+            "global_steps": step,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
             "micro_steps": self.micro_steps,
+            # host-side rng chain: restoring it makes a resumed run
+            # bitwise-identical to one that never crashed (dropout and
+            # any other trained stochasticity included)
+            "rng_key": np.asarray(self._rng).tolist(),
             "client_state": client_state or {},
             "config": self._config._param_dict,
         }
-        os.makedirs(ckpt_dir, exist_ok=True)
+        is_rank0 = jax.process_index() == 0
+        is_async = getattr(ckpt_engine, "is_async", False)
+        if is_rank0 and os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)          # staging left by a crashed save
+        os.makedirs(tmp_dir, exist_ok=True)
+        # manifest leaf summary now, while the state snapshot is coherent
+        # (the async engine's caller may mutate/donate state immediately
+        # after save returns); checksums cost one host fetch — disable via
+        # resilience.checkpoint_checksums for bandwidth-bound saves.  On
+        # the async path the fetch doubles as the engine's donation-safe
+        # snapshot, so manifest + save share ONE device->host transfer
+        # (the async engine skips its own copy for an all-numpy tree).
+        save_src = self.state
+        if is_async and rcfg.checkpoint_checksums:
+            import numpy as _np
+            save_src = jax.tree.map(lambda a: _np.array(a, copy=True),
+                                    self.state)
+        leaves = rckpt.leaf_summary(
+            save_src, checksums=rcfg.checkpoint_checksums)
         ckpt_engine.create(tag)
-        ckpt_engine.save(self.state, os.path.join(ckpt_dir, STATE_DIR))
-        if jax.process_index() == 0:
+        inj.check("ckpt.save")
+        self._ckpt_retry(ckpt_engine.save, save_src,
+                         os.path.join(tmp_dir, STATE_DIR),
+                         describe=f"checkpoint save {tag}")
+        if is_rank0:
             import json as _json
-            with open(os.path.join(ckpt_dir, METADATA_FILE), "w") as f:
+            with open(os.path.join(tmp_dir, METADATA_FILE), "w") as f:
                 _json.dump(extra, f, indent=2, default=str)
         is_async = getattr(ckpt_engine, "is_async", False)
         # host-side optimizer tiers: snapshot synchronously (their pinned /
@@ -2323,12 +2374,61 @@ class DeepSpeedEngine:
                     flat[f"moment{j}::{p}"] = np_.array(mbuf, copy=is_async)
             aux_flats["host_optimizer.npz"] = flat
 
+        aux_errs = []
+
         def _write_aux():
-            for name, payload in aux_flats.items():
-                np_.savez(os.path.join(ckpt_dir, name), **payload)
+            try:
+                inj.check("ckpt.aux")
+                for name, payload in aux_flats.items():
+                    self._ckpt_retry(
+                        np_.savez, os.path.join(tmp_dir, name), **payload,
+                        describe=f"checkpoint aux {name}")
+            except BaseException as e:       # surfaces at finalize time
+                aux_errs.append(e)
+
+        def _finalize():
+            """Publish: manifest (fsynced, LAST staged write) → atomic
+            tag rename → atomic ``latest`` → retention GC.  Any failure
+            before the rename leaves only the .tmp staging dir."""
+            if aux_errs:
+                raise aux_errs[0]
+            if is_rank0:
+                rckpt.write_manifest(tmp_dir, step, tag, leaves,
+                                     injector=inj)
+                if os.path.isdir(ckpt_dir):
+                    # overwriting an existing tag: the old one moves to
+                    # `<tag>.prev` — deliberately NOT a .tmp name, so if
+                    # we crash inside the window between the two renames
+                    # it is still a discoverable, verifying tag and the
+                    # fallback scan restores it (a .tmp name would hide
+                    # BOTH checkpoints and the next GC would sweep them)
+                    stale = ckpt_dir + ".prev"
+                    if os.path.isdir(stale):
+                        shutil.rmtree(stale)
+                    os.replace(ckpt_dir, stale)
+                    inj.check("ckpt.publish")    # the crash window
+                    os.replace(tmp_dir, ckpt_dir)
+                else:
+                    inj.check("ckpt.publish")
+                    os.replace(tmp_dir, ckpt_dir)
+                # the new tag is durable: drop the displaced old copy —
+                # including one left by a previous crashed overwrite
+                shutil.rmtree(ckpt_dir + ".prev", ignore_errors=True)
+                try:
+                    rckpt.fsync_path(save_dir)
+                except OSError:
+                    pass
+                if save_latest:
+                    self._ckpt_retry(rckpt.publish_latest, save_dir, tag,
+                                     injector=inj,
+                                     describe="latest pointer")
+                if rcfg.keep_last_k:
+                    rckpt.gc_tags(save_dir, rcfg.keep_last_k,
+                                  protect=(str(tag),))
+            return ckpt_dir
 
         if is_async:
-            # commit + `latest` publish are deferred until the background
+            # commit + publish are deferred until the background
             # serialization finishes (wait_pending_checkpoint); training
             # continues immediately against the already-snapshotted state
             import atexit
@@ -2339,10 +2439,10 @@ class DeepSpeedEngine:
                 aux_thread = threading.Thread(target=_write_aux,
                                               daemon=False)
                 aux_thread.start()
-            self._pending_ckpt = (save_dir, tag, save_latest, aux_thread)
+            self._pending_ckpt = (tag, aux_thread, _finalize)
             if not getattr(self, "_ckpt_atexit", False):
-                # the last save of a run must still publish `latest` even
-                # if the script exits without another checkpoint call
+                # the last save of a run must still publish even if the
+                # script exits without another checkpoint call
                 ref = weakref.ref(self)
                 atexit.register(
                     lambda: ref() and ref().wait_pending_checkpoint())
@@ -2351,9 +2451,7 @@ class DeepSpeedEngine:
             return True
         _write_aux()
         ckpt_engine.commit(tag)
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+        _finalize()
         log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
         return True
 
@@ -2363,19 +2461,42 @@ class DeepSpeedEngine:
                         load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import (
             METADATA_FILE, STATE_DIR)
+        from deepspeed_tpu.resilience import ckpt as rckpt
+        from deepspeed_tpu.resilience.ckpt import CheckpointCorruptError
         self.wait_pending_checkpoint()
         ckpt_engine = self._get_checkpoint_engine()
+        verify = self._config.resilience_config.verify_checkpoint
         if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                log_dist(f"no 'latest' file in {load_dir}", ranks=[0])
+            if verify == "off":
+                tag = rckpt.read_latest(load_dir)
+            else:
+                # crash-safe resolution: the `latest` pointer when it
+                # names a verifying tag, else the newest valid tag (a
+                # torn pointer or corrupted tag never fails the restore
+                # while any valid tag exists)
+                tag = rckpt.find_valid_tag(load_dir)
+            if tag is None:
+                log_dist(f"no restorable checkpoint in {load_dir}",
+                         ranks=[0])
                 return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
+        elif verify != "off":
+            ok, reason = rckpt.verify_tag(os.path.join(load_dir, str(tag)))
+            if not ok:
+                raise CheckpointCorruptError(
+                    f"requested tag {tag!r} in {load_dir} failed "
+                    f"verification: {reason}")
         ckpt_dir = os.path.join(load_dir, str(tag))
-        state = ckpt_engine.load(os.path.join(ckpt_dir, STATE_DIR),
-                                 template=self.state,
-                                 shardings=self.state_shardings)
+        state = self._ckpt_retry(
+            ckpt_engine.load, os.path.join(ckpt_dir, STATE_DIR),
+            template=self.state, shardings=self.state_shardings,
+            describe=f"checkpoint load {tag}")
+        if verify == "full":
+            mismatches = rckpt.verify_restored(
+                state, rckpt.read_manifest(ckpt_dir))
+            if mismatches:
+                raise CheckpointCorruptError(
+                    f"tag {tag!r} failed checksum verification: "
+                    f"{mismatches[:5]}")
         if not (load_optimizer_states and not load_module_only):
             state = {**state, "opt_state": self.state["opt_state"]}
         extra = {}
@@ -2411,6 +2532,9 @@ class DeepSpeedEngine:
         self.global_samples = extra.get("global_samples", 0)
         self.skipped_steps = extra.get("skipped_steps", 0)
         self.micro_steps = extra.get("micro_steps", 0)
+        if extra.get("rng_key") is not None:
+            self._rng = jnp.asarray(extra["rng_key"],
+                                    dtype=self._rng.dtype)
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, extra.get("client_state", {})
 

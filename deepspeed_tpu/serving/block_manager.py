@@ -11,11 +11,14 @@ at it, so their (ignored) cache writes can never land in a live block.
 """
 from typing import Dict, List, Optional
 
+from deepspeed_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
+
 
 class BlockManager:
     TRASH_BLOCK = 0
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 injector: FaultInjector = NULL_INJECTOR):
         if num_blocks < 2:
             raise ValueError(f"num_blocks={num_blocks}: need >= 2 "
                              "(block 0 is the reserved trash block)")
@@ -23,6 +26,7 @@ class BlockManager:
             raise ValueError(f"block_size={block_size}: need >= 1")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.injector = injector
         # LIFO free list: recently-freed blocks are re-handed first, so a
         # drained-and-refilled pool stays compact
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
@@ -57,7 +61,11 @@ class BlockManager:
 
     def allocate(self, request_id: int, n: int) -> Optional[List[int]]:
         """Append ``n`` fresh blocks to the request's table; None (and no
-        state change) when the pool can't supply them."""
+        state change) when the pool can't supply them — or when a
+        ``kv.alloc`` deny fault fires (exercises the preemption /
+        recompute-on-resume path deterministically)."""
+        if self.injector.deny("kv.alloc"):
+            return None
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]

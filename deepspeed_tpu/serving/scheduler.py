@@ -139,17 +139,21 @@ class ContinuousBatchingScheduler:
     PROMPT_BUCKET = 16          # prefill compile count = distinct buckets
 
     def __init__(self, model, params, config, kv_cache_dtype=None,
-                 monitor=None):
+                 monitor=None, injector=None):
         if (model.init_cache_fn is None or model.prefill_fn is None
                 or model.decode_fn is None):
             raise ValueError("model does not expose the KV-cache serving "
                              "surface (init_cache_fn/prefill_fn/decode_fn)")
+        from deepspeed_tpu.resilience.faults import resolve_injector
         self.model = model
         self.params = params
         self.cfg = config
         self.kv_cache_dtype = kv_cache_dtype
         self.monitor = monitor
-        self.block_mgr = BlockManager(config.num_blocks, config.block_size)
+        self.injector = (injector if injector is not None
+                         else resolve_injector())
+        self.block_mgr = BlockManager(config.num_blocks, config.block_size,
+                                      injector=self.injector)
         # int8-weights decode dispatch: install this config's threshold so
         # the model-side use_scan_decode sees it (env override still wins
         # inside get_quant_scan_threshold).  Only an EXPLICITLY supplied
@@ -311,6 +315,15 @@ class ContinuousBatchingScheduler:
             return bool(self._queue) or any(
                 r is not None for r in self._slots)
 
+    def has_work_unlocked(self) -> bool:
+        """Lock-free (racy) variant for the watchdog: a wedged step()
+        holds the scheduler lock for its whole duration — exactly the
+        condition the watchdog must be able to observe without joining
+        the deadlock.  GIL-atomic list reads are plenty for a stall
+        heuristic."""
+        return bool(self._queue) or any(
+            r is not None for r in self._slots)
+
     @property
     def step_count(self) -> int:
         return self._step_count
@@ -388,8 +401,12 @@ class ContinuousBatchingScheduler:
             need = self.block_mgr.blocks_for_tokens(n_in + 1)
             if not self.block_mgr.can_allocate(need):
                 break
+            # allocate BEFORE dequeueing: a denied allocation (injected
+            # fault or free-list race) must leave the request queued, not
+            # admit it blockless
+            if self.block_mgr.allocate(req.request_id, need) is None:
+                break
             self._queue.remove(req)
-            self.block_mgr.allocate(req.request_id, need)
             req.state = RequestState.PREFILL
             req.slot = free_slots[0]
             self._slots[req.slot] = req
@@ -473,7 +490,11 @@ class ContinuousBatchingScheduler:
         if total > bm.num_free_blocks:
             return False
         for req, n in plan:
-            bm.allocate(req.request_id, n)
+            if bm.allocate(req.request_id, n) is None:
+                # denied mid-plan (injected fault): blocks already granted
+                # stay on their tables — harmless extra coverage — but the
+                # window must shrink to one it can fully back
+                return False
         return True
 
     def _choose_window(self, active) -> int:
@@ -541,6 +562,9 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------- step
     def step(self) -> List[ServeRequest]:
         """One engine iteration; returns requests finished this step."""
+        # fault site OUTSIDE the lock: an injected stall models a wedged
+        # engine without also wedging the /metrics + submit paths
+        self.injector.check("serve.step")
         with self._lock:
             self._finished_this_step = []
             self._expire_queued()

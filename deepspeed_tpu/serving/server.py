@@ -17,10 +17,13 @@ unit of concurrency — iteration-level scheduling happens inside it);
 HTTP handler threads only enqueue and wait on the request's done event.
 """
 import json
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from deepspeed_tpu.resilience.health import (HealthMonitor, HealthState,
+                                             SchedulerWatchdog, STATE_CODE)
 from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
                                            SamplingParams)
 from deepspeed_tpu.utils.logging import logger
@@ -42,39 +45,86 @@ def model_from_spec(spec: str, **overrides):
 
 
 class ServingLoop:
-    """Background thread driving scheduler.step(); idles when drained."""
+    """Background thread driving scheduler.step(); idles when drained.
+
+    Resilience semantics (ISSUE 3):
+    - ``max_loop_failures`` consecutive ``step()`` exceptions flip health
+      to DEGRADED (with a ``serving/loop_failures`` counter) and stop the
+      loop, instead of the old log-and-sleep-forever;
+    - a :class:`SchedulerWatchdog` marks the server DEGRADED when
+      ``step_count`` stops advancing with work pending — the global
+      replacement for the old per-handler stall heuristic;
+    - during a drain (health DRAINING) the loop keeps stepping until the
+      scheduler is empty — admitted work finishes — then exits cleanly
+      and health goes STOPPED.
+    """
 
     IDLE_SLEEP_S = 0.002
+    FAILURE_SLEEP_S = 0.1
 
-    def __init__(self, scheduler):
+    def __init__(self, scheduler, health=None, max_loop_failures=None,
+                 stall_timeout_s=None):
         self.scheduler = scheduler
+        self.health = health if health is not None else HealthMonitor()
+        cfg = scheduler.cfg
+        self.max_loop_failures = (
+            max_loop_failures if max_loop_failures is not None
+            else getattr(cfg, "max_loop_failures", 8))
+        if stall_timeout_s is None:
+            stall_timeout_s = (cfg.resolved_stall_timeout_s()
+                               if hasattr(cfg, "resolved_stall_timeout_s")
+                               else 600.0)
+        self.watchdog = SchedulerWatchdog(scheduler, self.health,
+                                          stall_timeout_s)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ds-serve-loop")
 
     def start(self):
         self._thread.start()
+        self.watchdog.start()
+        self.health.mark_ready()
         return self
 
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
     def _run(self):
+        failures = 0
         while not self._stop.is_set():
+            if self.health.is_draining() and not self.scheduler.has_work():
+                self.health.mark_stopped("drained")
+                break                        # clean drain exit
             if self.scheduler.has_work():
                 try:
                     self.scheduler.step()
-                except Exception:            # pragma: no cover - last resort
-                    logger.exception("serving loop: step failed")
-                    time.sleep(0.1)
+                    failures = 0
+                except Exception:
+                    failures += 1
+                    self.scheduler.metrics.counters["loop_failures"] += 1
+                    logger.exception("serving loop: step failed "
+                                     f"({failures} consecutive)")
+                    if self.max_loop_failures and \
+                            failures >= self.max_loop_failures:
+                        self.health.mark_degraded(
+                            f"{failures} consecutive step failures")
+                        break
+                    time.sleep(self.FAILURE_SLEEP_S)
             else:
                 time.sleep(self.IDLE_SLEEP_S)
+        self.watchdog.stop()
 
     def shutdown(self):
         self._stop.set()
+        self.watchdog.stop()
         self._thread.join(timeout=5)
 
 
 class _Handler(BaseHTTPRequestHandler):
     # injected by make_server
     scheduler = None
+    health = None
     default_timeout_s = 0.0
 
     def log_message(self, fmt, *args):       # route through our logger
@@ -93,10 +143,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         sched = self.scheduler
         if self.path == "/healthz":
-            self._send_json(200, {
-                "status": "ok",
-                "active": len(sched.active_requests()),
-                "queued": sched.queue_depth()})
+            payload = {"active": len(sched.active_requests()),
+                       "queued": sched.queue_depth(),
+                       "step_count": sched.step_count}
+            if self.health is None:          # legacy: no state machine
+                self._send_json(200, {"status": "ok", **payload})
+                return
+            # READY -> 200; starting/draining/degraded/stopped -> 503 so
+            # a load balancer pulls the replica the moment a drain begins
+            self._send_json(self.health.http_status(),
+                            {**self.health.snapshot(), **payload})
             return
         if self.path == "/metrics":
             lines = []
@@ -114,6 +170,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path != "/generate":
             self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        if self.health is not None and not self.health.is_accepting():
+            # drain/degradation: active requests finish, NEW ones 503
+            self.scheduler.metrics.counters["rejected_not_accepting"] += 1
+            self._send_json(503, {
+                "error": f"not accepting requests: "
+                         f"{self.health.state.value} "
+                         f"({self.health.reason})"})
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
@@ -148,18 +212,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # wait for completion.  timeout_s bounds QUEUE wait (the
         # scheduler's expiry path) — an admitted request may legitimately
-        # decode for a long time, so the handler only bails when the
-        # scheduler loop stops making progress for ~10 minutes (one STEP
-        # can hold the lock for minutes while XLA compiles a fresh
-        # prompt-bucket/fused-window program on a real model)
-        last_step, stuck = -1, 0
-        while not req.done.wait(timeout=60):
-            cur = self.scheduler.step_count
-            stuck = stuck + 1 if cur == last_step else 0
-            if stuck >= 10:
-                self._send_json(503, {"error": "serving loop stalled"})
+        # decode for a long time.  Stall detection is GLOBAL now: the
+        # SchedulerWatchdog (serving.stall_timeout_s, env-overridable)
+        # flips health to DEGRADED when step_count stops advancing, and
+        # every waiting handler gives up with 503 — replacing the old
+        # per-handler 10 x 60 s step_count poll.
+        while not req.done.wait(timeout=1.0):
+            if self.health is not None and self.health.is_degraded():
+                self._send_json(503, {
+                    "error": f"serving loop degraded: "
+                             f"{self.health.reason}"})
                 return
-            last_step = cur
         resp = req.to_response()
         if req.reject_reason is not None:
             self._send_json(429, resp)
@@ -167,22 +230,80 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, resp)
 
 
+def _wire_health(scheduler) -> HealthMonitor:
+    """HealthMonitor whose transitions surface through the scheduler's
+    metrics (``serving/health_state`` gauge + per-state counters) and,
+    when configured, the monitor sinks."""
+    def on_transition(state, reason):
+        scheduler.metrics.gauges["health_state"] = STATE_CODE[state]
+        scheduler.metrics.counters[f"health_to_{state.value}"] += 1
+        if scheduler.monitor is not None:
+            scheduler.monitor.write_events([(
+                "serving/health_state", float(STATE_CODE[state]),
+                scheduler.step_count)])
+
+    health = HealthMonitor(on_transition=on_transition)
+    scheduler.metrics.gauges["health_state"] = STATE_CODE[health.state]
+    return health
+
+
 def make_server(scheduler, host: str = "127.0.0.1", port: int = 8000,
-                default_timeout_s: float = 0.0):
+                default_timeout_s: float = 0.0, health=None,
+                max_loop_failures=None, stall_timeout_s=None):
     """(ThreadingHTTPServer, ServingLoop) — caller starts/joins both.
-    ``port=0`` binds an ephemeral port (tests)."""
+    ``port=0`` binds an ephemeral port (tests).  The loop carries the
+    health state machine (``loop.health``); watchdog/failure-cap knobs
+    default from the scheduler's ServingConfig."""
+    if health is None:
+        health = _wire_health(scheduler)
+    loop = ServingLoop(scheduler, health=health,
+                       max_loop_failures=max_loop_failures,
+                       stall_timeout_s=stall_timeout_s)
     handler = type("Handler", (_Handler,),
                    {"scheduler": scheduler,
+                    "health": health,
                     "default_timeout_s": default_timeout_s})
     httpd = ThreadingHTTPServer((host, port), handler)
-    loop = ServingLoop(scheduler)
     return httpd, loop
 
 
+def install_drain_handlers(health: HealthMonitor, httpd,
+                           signals=(signal.SIGTERM, signal.SIGINT)):
+    """SIGTERM/SIGINT → graceful drain: flip health to DRAINING (healthz
+    goes 503, new /generate gets 503, active requests keep decoding).
+    A second signal — or a signal while already degraded — stops the
+    HTTP server immediately."""
+    def _on_signal(signum, frame):
+        if health.is_degraded() or health.drain_started.is_set() \
+                or not health.begin_drain(f"signal {signum}"):
+            logger.warning(f"ds_serve: signal {signum} during "
+                           f"{health.state.value}; stopping now")
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    for sig in signals:
+        signal.signal(sig, _on_signal)
+
+
 def serve_forever(scheduler, host: str = "127.0.0.1", port: int = 8000,
-                  default_timeout_s: float = 0.0):  # pragma: no cover
+                  default_timeout_s: float = 0.0,
+                  install_signal_handlers: bool = True):  # pragma: no cover
     httpd, loop = make_server(scheduler, host, port, default_timeout_s)
+    health = loop.health
     loop.start()
+    if install_signal_handlers:
+        install_drain_handlers(health, httpd)
+
+    def _await_loop_exit():
+        # the loop thread exits when a drain completes (health STOPPED)
+        # or the loop degrades past repair with no work left to finish —
+        # either way the HTTP server should come down with it.  A
+        # DEGRADED server with handlers still waiting stays up so they
+        # can 503 and /metrics stays scrapeable until SIGTERM.
+        loop._thread.join()
+        if health.state in (HealthState.STOPPED, HealthState.DRAINING):
+            httpd.shutdown()
+
+    threading.Thread(target=_await_loop_exit, daemon=True).start()
     logger.info(f"ds_serve: listening on http://{host}:{httpd.server_port} "
                 f"(pool={scheduler.cfg.num_blocks}x"
                 f"{scheduler.cfg.block_size} tokens, "
@@ -190,7 +311,9 @@ def serve_forever(scheduler, host: str = "127.0.0.1", port: int = 8000,
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
-        pass
+        health.begin_drain("KeyboardInterrupt")
+        loop.join(timeout=30)
     finally:
         loop.shutdown()
+        health.mark_stopped()
         httpd.server_close()

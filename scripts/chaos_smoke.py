@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""Chaos smoke runner: sweep the resilience fault matrix and print a
+pass/fail table (ISSUE 3 satellite).
+
+Covers, in one process where safe and in subprocesses where the fault
+kills the process:
+
+- checkpoint write faults at every site (ckpt.save / ckpt.aux /
+  ckpt.manifest / ckpt.latest, raise + truncate + kill flavors), sync
+  and async engines: after the fault, load_checkpoint must restore the
+  newest VALID tag;
+- a torn `latest` pointer;
+- serving-loop step failures degrading health instead of spinning;
+- kv.alloc denial driving preemption + recompute-on-resume.
+
+Usage::
+
+    python scripts/chaos_smoke.py            # full sweep
+    python scripts/chaos_smoke.py --fast     # skip subprocess kill cases
+
+Exit code 0 iff every case passes.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# no persistent compile cache: donated train steps over restored state
+# under a warm cache corrupt the heap on old jaxlibs (see
+# tests/test_resilience.py), and this runner restores constantly
+os.environ.setdefault("XLA_FLAGS", "--xla_backend_optimization_level=0")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _make_engine(tmp, async_save=False):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                      num_layers=2, num_heads=4, d_model=32,
+                      dtype="float32", attention_impl="xla")
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 0,
+           "checkpoint": {"async_save": async_save}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def _train(engine, seed):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    batch = {"input_ids": rng.integers(0, 128, size=(1, 4, 16),
+                                       dtype=np.int32)}
+    engine.train_batch(batch=batch)
+
+
+def case_ckpt_fault(spec, async_save):
+    """Fault the 2nd save; the load must resolve a verifying tag."""
+    import numpy as np
+    from deepspeed_tpu.resilience import (FaultInjected, FaultInjector,
+                                          NULL_INJECTOR, verify_tag)
+    from deepspeed_tpu.resilience import ckpt as rckpt
+    with tempfile.TemporaryDirectory() as tmp:
+        engine = _make_engine(tmp, async_save)
+        _train(engine, 0)
+        engine.save_checkpoint(tmp)
+        engine.wait_pending_checkpoint()
+        _train(engine, 1)
+        engine.fault_injector = FaultInjector(spec)
+        try:
+            engine.save_checkpoint(tmp)
+            engine.wait_pending_checkpoint()
+        except Exception:
+            pass
+        engine.fault_injector = NULL_INJECTOR
+        tag = rckpt.find_valid_tag(tmp)
+        assert tag is not None, "no restorable tag"
+        ok, reason = verify_tag(os.path.join(tmp, tag))
+        assert ok, f"resolved tag invalid: {reason}"
+        loader = _make_engine(tmp, async_save)
+        path, _ = loader.load_checkpoint(tmp)
+        assert path is not None and loader.global_steps in (1, 2)
+
+
+def case_kill_during_save(spec):
+    """Subprocess flavor: the fault hard-kills the process mid-save; the
+    parent then verifies fallback."""
+    from deepspeed_tpu.resilience import verify_tag
+    from deepspeed_tpu.resilience import ckpt as rckpt
+    with tempfile.TemporaryDirectory() as tmp:
+        # the child trains one step, saves (clean), trains, saves (killed)
+        env = dict(os.environ, DS_FAULTS=spec)
+        env.pop("DS_RESUME", None)
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-ckpt", tmp],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert r.returncode != 0, "child should have been killed"
+        tag = rckpt.find_valid_tag(tmp)
+        assert tag is not None, f"no restorable tag: {r.stderr[-500:]}"
+        ok, reason = verify_tag(os.path.join(tmp, tag))
+        assert ok, reason
+
+
+def child_ckpt(save_dir):
+    """Subprocess body for the kill cases: two train/save rounds, with
+    DS_FAULTS (read by the engine's injector) arming the killer."""
+    engine = _make_engine(save_dir)
+    _train(engine, 0)
+    engine.save_checkpoint(save_dir)
+    _train(engine, 1)
+    engine.save_checkpoint(save_dir)
+    engine.wait_pending_checkpoint()
+    return 0
+
+
+def case_torn_latest():
+    from deepspeed_tpu.resilience import ckpt as rckpt
+    with tempfile.TemporaryDirectory() as tmp:
+        engine = _make_engine(tmp)
+        _train(engine, 0)
+        engine.save_checkpoint(tmp)
+        with open(os.path.join(tmp, "latest"), "w") as f:
+            f.write("global_st")           # torn pointer
+        loader = _make_engine(tmp)
+        path, _ = loader.load_checkpoint(tmp)
+        assert path is not None and loader.global_steps == 1
+
+
+def case_serving_loop_degrades():
+    from deepspeed_tpu.resilience import HealthMonitor
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving.scheduler import ServingMetrics
+    from deepspeed_tpu.serving.server import ServingLoop
+    import time
+
+    class Stub:
+        cfg = ServingConfig(max_loop_failures=3, stall_timeout_s=0)
+        metrics = ServingMetrics()
+        monitor = None
+        step_count = 0
+
+        def has_work(self):
+            return True
+
+        def step(self):
+            raise RuntimeError("chaos")
+
+    loop = ServingLoop(Stub())
+    loop.FAILURE_SLEEP_S = 0.001
+    loop.start()
+    deadline = time.monotonic() + 10
+    while not loop.health.is_degraded() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    loop.shutdown()
+    assert loop.health.is_degraded(), "loop never degraded"
+    assert Stub.metrics.counters["loop_failures"] == 3
+
+
+def case_kv_deny_preempts():
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=4, num_blocks=64, max_num_seqs=2,
+                        max_fused_steps=1)
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("kv.alloc:deny@2"))
+    rng = np.random.default_rng(0)
+    reqs = [sched.submit(rng.integers(1, 128, (6,)).astype(np.int32),
+                         SamplingParams(max_new_tokens=8), priority=p)
+            for p in (1, 0)]
+    sched.run_until_idle()
+    assert sched.metrics.counters["preemptions"] >= 1
+    assert all(r.state == RequestState.FINISHED for r in reqs)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="resilience chaos smoke")
+    p.add_argument("--fast", action="store_true",
+                   help="skip subprocess (kill-flavor) cases")
+    p.add_argument("--child-ckpt", metavar="DIR", default=None,
+                   help=argparse.SUPPRESS)   # internal: kill-case worker
+    args = p.parse_args(argv)
+    if args.child_ckpt:
+        return child_ckpt(args.child_ckpt)
+
+    cases = []
+    for async_save in (False, True):
+        kind = "async" if async_save else "sync"
+        for spec in ("ckpt.save:raise@1", "ckpt.manifest:raise@1",
+                     "ckpt.manifest:truncate@1", "ckpt.latest:truncate@1",
+                     "ckpt.latest:raise@1"):
+            cases.append((f"ckpt[{kind}] {spec}",
+                          lambda s=spec, a=async_save: case_ckpt_fault(s, a)))
+    cases.append(("ckpt[sync] ckpt.aux:raise@1",
+                  lambda: case_ckpt_fault("ckpt.aux:raise@1", False)))
+    if not args.fast:
+        for spec in ("ckpt.save:kill=9@1", "ckpt.manifest:kill=9@1"):
+            cases.append((f"ckpt[kill] {spec}",
+                          lambda s=spec: case_kill_during_save(s)))
+    cases.append(("torn latest pointer", case_torn_latest))
+    cases.append(("serving loop degrades", case_serving_loop_degrades))
+    cases.append(("kv.alloc deny preempts", case_kv_deny_preempts))
+
+    results = []
+    for name, fn in cases:
+        try:
+            fn()
+            results.append((name, True, ""))
+        except Exception as e:
+            results.append((name, False, f"{type(e).__name__}: {e}"))
+        status = "PASS" if results[-1][1] else "FAIL"
+        print(f"[{status}] {name}" +
+              (f" -- {results[-1][2]}" if not results[-1][1] else ""),
+              flush=True)
+
+    width = max(len(n) for n, _, _ in results)
+    print("\n" + "=" * (width + 8))
+    for name, ok, _err in results:
+        print(f"{name:<{width}}  {'PASS' if ok else 'FAIL'}")
+    failed = [n for n, ok, _ in results if not ok]
+    print(f"\n{len(results) - len(failed)}/{len(results)} passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

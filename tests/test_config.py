@@ -168,3 +168,72 @@ def test_serving_section_parses():
         DeepSpeedConfig({"train_batch_size": 1,
                          "serving": {"max_fused_steps": 3}},
                         mesh_topology=FakeTopo(1))
+
+
+def test_serving_stall_timeout_roundtrip(monkeypatch):
+    """ISSUE 3 satellite: the do_POST stall threshold is now the
+    `serving.stall_timeout_s` config key (driving the scheduler
+    watchdog), defaulting to the old hardcoded 10 x 60 s, with a
+    DS_SERVE_STALL_TIMEOUT_S env override that wins."""
+    monkeypatch.delenv("DS_SERVE_STALL_TIMEOUT_S", raising=False)
+    c = DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"stall_timeout_s": 7.5,
+                                     "max_loop_failures": 4}},
+                        mesh_topology=FakeTopo(1))
+    assert c.serving_config.stall_timeout_s == 7.5
+    assert c.serving_config.max_loop_failures == 4
+    assert c.serving_config.resolved_stall_timeout_s() == 7.5
+    # defaults preserve the legacy handler heuristic's budget
+    d = DeepSpeedConfig({"train_batch_size": 1},
+                        mesh_topology=FakeTopo(1))
+    assert d.serving_config.stall_timeout_s == 600.0
+    monkeypatch.setenv("DS_SERVE_STALL_TIMEOUT_S", "12.25")
+    assert c.serving_config.resolved_stall_timeout_s() == 12.25
+    # the ServingLoop picks the resolved value up at construction
+    monkeypatch.setenv("DS_SERVE_STALL_TIMEOUT_S", "9.0")
+    from deepspeed_tpu.serving.server import ServingLoop
+
+    class _Sched:
+        cfg = c.serving_config
+        metrics = None
+    loop = ServingLoop(_Sched())
+    assert loop.watchdog.stall_timeout_s == 9.0
+    with pytest.raises(ValueError, match="stall_timeout_s"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "serving": {"stall_timeout_s": -2}},
+                        mesh_topology=FakeTopo(1))
+
+
+def test_resilience_section_parses():
+    """ISSUE 3: the `resilience` section (fault specs, retention,
+    verification, retry policy) parses and validates eagerly."""
+    c = DeepSpeedConfig(
+        {"train_batch_size": 1,
+         "resilience": {"faults": "ckpt.save:raise@1; kv.alloc:deny@*",
+                        "keep_last_k": 3,
+                        "checkpoint_checksums": False,
+                        "verify_checkpoint": "full",
+                        "retry": {"attempts": 2, "deadline_s": 1.5}}},
+        mesh_topology=FakeTopo(1))
+    r = c.resilience_config
+    assert r.keep_last_k == 3 and not r.checkpoint_checksums
+    assert r.verify_checkpoint == "full"
+    assert r.retry.attempts == 2 and r.retry.deadline_s == 1.5
+    # defaults
+    d = DeepSpeedConfig({"train_batch_size": 1}, mesh_topology=FakeTopo(1))
+    assert d.resilience_config.keep_last_k == 0
+    assert d.resilience_config.verify_checkpoint == "manifest"
+    assert d.resilience_config.retry.attempts == 4
+    # a typo'd fault spec fails at CONFIG time, not at the fault site
+    with pytest.raises(ValueError, match="fault spec"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "resilience": {"faults": "ckpt.save:explode@1"}},
+                        mesh_topology=FakeTopo(1))
+    with pytest.raises(ValueError, match="verify_checkpoint"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "resilience": {"verify_checkpoint": "sometimes"}},
+                        mesh_topology=FakeTopo(1))
+    with pytest.raises(ValueError, match="keep_last_k"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "resilience": {"keep_last_k": -1}},
+                        mesh_topology=FakeTopo(1))

@@ -344,7 +344,7 @@ def test_http_server_end_to_end(served):
         assert out["ttft_ms"] > 0
         with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
             health = json.loads(resp.read())
-            assert health["status"] == "ok"
+            assert health["status"] == "ready"   # ISSUE 3 health machine
         with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
             text = resp.read().decode()
             assert "serving_completed 1.0" in text
