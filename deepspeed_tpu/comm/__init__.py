@@ -224,6 +224,10 @@ def configure(comms_logger=None):
     _COMMS_LOGGER = comms_logger
 
 
-def log_summary():
+def log_summary(monitor=None, step: int = 0, show_straggler: bool = False):
+    """Print the comms summary; with ``monitor`` (any monitor/monitor.py
+    sink) the per-op totals also land as ``comms/...`` events at
+    ``step`` — engine.log_comms_summary() wires its own monitor in."""
     if _COMMS_LOGGER is not None:
-        _COMMS_LOGGER.log_all()
+        _COMMS_LOGGER.log_all(monitor=monitor, step=step,
+                              show_straggler=show_straggler)

@@ -23,29 +23,57 @@ class Monitor:
         """Single-event convenience (health transitions, counters)."""
         self.write_events([(name, float(value), int(step))])
 
+    def close(self):
+        """Release sink resources (file handles, writers); idempotent."""
+
 
 class CSVMonitor(Monitor):
-    """reference: monitor/csv_monitor.py:12"""
+    """reference: monitor/csv_monitor.py:12
+
+    Handles stay open across ``write_events`` calls (ISSUE 4 satellite:
+    the old implementation reopened every file per event — one
+    open/close syscall pair per metric per step); each batch flushes the
+    files it touched so a crash loses at most the in-flight batch."""
 
     def __init__(self, config):
         super().__init__(config)
-        self._files = {}
+        self._files = {}                   # metric name -> (file, writer)
         if self.enabled:
             self.out_dir = os.path.join(config.output_path or "csv_monitor",
                                         config.job_name)
             os.makedirs(self.out_dir, exist_ok=True)
 
+    def _writer(self, name: str):
+        entry = self._files.get(name)
+        if entry is None:
+            fname = os.path.join(self.out_dir,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            entry = self._files[name] = (f, w)
+        return entry
+
     def write_events(self, events: List[Event]):
         if not self.enabled:
             return
+        touched = set()
         for name, value, step in events:
-            fname = os.path.join(self.out_dir, name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, value])
+            _, w = self._writer(name)
+            w.writerow([step, value])
+            touched.add(name)
+        for name in touched:
+            self._files[name][0].flush()
+
+    def close(self):
+        for f, _w in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
 
 
 class TensorBoardMonitor(Monitor):
@@ -70,6 +98,10 @@ class TensorBoardMonitor(Monitor):
         for name, value, step in events:
             self.writer.add_scalar(name, value, step)
         self.writer.flush()
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
 
 
 class WandbMonitor(Monitor):
@@ -139,3 +171,11 @@ class MonitorMaster(Monitor):
                 logger.warning(
                     f"monitor: {type(s).__name__} sink failed ({e}); "
                     "dropping events")
+
+    def close(self):
+        for s in self.sinks:
+            try:
+                s.close()
+            except Exception as e:
+                logger.warning(f"monitor: {type(s).__name__} close "
+                               f"failed ({e})")

@@ -90,6 +90,17 @@ class FlopsProfiler:
         return params_to_string(self.total_params) if as_string \
             else self.total_params
 
+    def achieved_flops_per_s(self) -> float:
+        return self.total_flops / max(self.total_duration, 1e-9)
+
+    def mfu(self, peak_flops: float) -> Optional[float]:
+        """Model FLOPs Utilization against the hardware peak (telemetry
+        layer: the engine publishes this as the ``train/profiled_mfu``
+        gauge when the profiler fires)."""
+        if peak_flops <= 0 or self.total_duration <= 0:
+            return None
+        return self.achieved_flops_per_s() / peak_flops
+
     def print_model_profile(self, profile_step: int = 1, module_depth: int = -1,
                             top_modules: int = 1, detailed: bool = True,
                             output_file: Optional[str] = None):

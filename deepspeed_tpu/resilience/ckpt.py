@@ -242,6 +242,7 @@ def find_valid_tag(load_dir: str) -> Optional[str]:
     rename and the pointer publish all fall back transparently.  None
     when the root holds no tags at all; :class:`CheckpointCorruptError`
     when tags exist but none verify."""
+    from deepspeed_tpu.telemetry import get_registry, get_tracer
     tags = list_tags(load_dir)
     if not tags:
         return None
@@ -252,6 +253,13 @@ def find_valid_tag(load_dir: str) -> Optional[str]:
         ok, reason = verify_tag(os.path.join(load_dir, tag))
         if ok:
             if tag != latest:
+                # a fallback restore is exactly the event an operator
+                # wants on the timeline: mark it and count it
+                get_registry().inc("ckpt/fallbacks")
+                get_tracer().instant(
+                    "ckpt/fallback", cat="resilience",
+                    corr=f"ckpt-{tag}",
+                    args={"latest": latest, "restored": tag})
                 if latest is not None and \
                         verify_tag(os.path.join(load_dir, latest))[0]:
                     # the pointer names a VALID but older tag — the

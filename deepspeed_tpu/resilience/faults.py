@@ -147,6 +147,15 @@ class FaultInjector:
                 self.fired[site] = self.fired.get(site, 0) + 1
                 logger.warning(f"fault injector: firing {spec.action} at "
                                f"{site} (invocation {n})")
+                # trace timeline marker (ISSUE 4): the instant inherits
+                # the enclosing span's correlation id — a fault fired
+                # inside train-step-12's checkpoint save reads as part
+                # of that step's story in the Perfetto view
+                from deepspeed_tpu.telemetry import get_tracer
+                get_tracer().instant(
+                    f"fault/{site}", cat="resilience",
+                    args={"site": site, "action": spec.action,
+                          "invocation": n})
                 return spec
         return None
 

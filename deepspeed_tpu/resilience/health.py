@@ -100,9 +100,15 @@ class HealthMonitor:
                 return False
             logger.info(f"health: {self._state.value} -> {state.value} "
                         f"({reason})")
+            prev = self._state
             self._state = state
             self._reason = reason
             self._since = time.monotonic()
+        # trace timeline marker (ISSUE 4): drains/degradations show up
+        # between the serving-iteration spans they interrupt
+        from deepspeed_tpu.telemetry import get_tracer
+        get_tracer().instant(f"health/{state.value}", cat="resilience",
+                             args={"from": prev.value, "reason": reason})
         if state is HealthState.DRAINING:
             self.drain_started.set()
         if self._on_transition is not None:

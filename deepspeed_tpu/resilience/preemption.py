@@ -88,6 +88,9 @@ class PreemptionHandler:
         self.signum = signum
         logger.warning(f"preemption: received signal {signum}; will drain "
                        "after the in-flight step")
+        from deepspeed_tpu.telemetry import get_tracer
+        get_tracer().instant("preempt/signal", cat="resilience",
+                             args={"signum": int(signum)})
         self.requested.set()
 
 
@@ -95,9 +98,14 @@ def emergency_save(engine, save_dir: str) -> str:
     """Write the emergency checkpoint through the normal (crash-safe)
     save path and make it durable before returning — a preemption grace
     window is no place for an in-flight async save."""
+    from deepspeed_tpu.telemetry import get_tracer
     tag = f"{EMERGENCY_TAG_PREFIX}{engine.global_steps}"
-    engine.save_checkpoint(save_dir, tag=tag, save_latest=True)
-    engine.wait_pending_checkpoint()
+    with get_tracer().span("preempt/drain", cat="resilience",
+                           corr=f"ckpt-{tag}",
+                           args={"tag": tag,
+                                 "step": int(engine.global_steps)}):
+        engine.save_checkpoint(save_dir, tag=tag, save_latest=True)
+        engine.wait_pending_checkpoint()
     log_dist(f"preemption: emergency checkpoint {tag!r} durable in "
              f"{save_dir}", ranks=[0])
     return tag

@@ -58,5 +58,12 @@ def retry_call(fn: Callable, *args,
             logger.warning(f"retry_call: {what} failed "
                            f"(attempt {k + 1}/{attempts}: {e}); "
                            f"retrying in {delay:.3f}s")
+            # observability (ISSUE 4): every retry counts in the
+            # process-wide registry and marks the trace timeline
+            from deepspeed_tpu.telemetry import get_registry, get_tracer
+            get_registry().inc("retry/retries", op=what)
+            get_tracer().instant("retry", cat="resilience",
+                                 args={"op": what, "attempt": k + 1,
+                                       "error": str(e)})
             _sleep(delay)
     raise last  # unreachable; satisfies type checkers

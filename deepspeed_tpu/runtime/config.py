@@ -328,6 +328,44 @@ class ResilienceConfig(DeepSpeedConfigModel):
                 "choose from 'off', 'manifest', 'full'")
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified telemetry (deepspeed_tpu/telemetry/): metrics registry +
+    Prometheus exposition, Chrome-trace span tracer, MFU/goodput gauges.
+    TPU-native framing of the reference's monitor/comms/flops trio as
+    ONE cross-cutting layer (docs/tutorials/monitoring-profiling.md)."""
+    #: master switch for the per-step registry updates (spans still obey
+    #: the trace path: an armed DS_TRACE traces even with metrics off)
+    enabled: bool = True
+    #: Chrome-trace output path; the DS_TRACE env var overrides (the
+    #: repo's env-wins convention).  None/"" = no tracing.
+    trace: Optional[str] = None
+    #: opt-in training-side /metrics HTTP endpoint: None = off,
+    #: 0 = ephemeral port (tests), N = fixed port.  Serving already
+    #: exposes the same exposition through ds_serve /metrics.
+    metrics_port: Optional[int] = None
+    #: steps between draining the registry into the Monitor sinks
+    #: (tensorboard/wandb/csv); 0 disables the bridge
+    monitor_interval: int = 1
+    #: per-device peak FLOPs for the MFU gauge; 0 = auto-detect from the
+    #: device kind (DS_PEAK_FLOPS env overrides either)
+    peak_flops: float = 0.0
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.metrics_port is not None and self.metrics_port < 0:
+            raise ValueError(
+                f"telemetry.metrics_port={self.metrics_port}: must be "
+                ">= 0 (0 = ephemeral; omit for no endpoint)")
+        if self.monitor_interval < 0:
+            raise ValueError(
+                f"telemetry.monitor_interval={self.monitor_interval}: "
+                "must be >= 0 (0 disables the monitor bridge)")
+        if self.peak_flops < 0:
+            raise ValueError(
+                f"telemetry.peak_flops={self.peak_flops}: must be >= 0 "
+                "(0 = auto-detect)")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -496,6 +534,7 @@ class DeepSpeedConfig:
         self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
         self.data_types_config = DataTypesConfig(**d.get("data_types", {}))
         self.serving_config = ServingConfig(**d.get("serving", {}))
+        self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
         self.compression_config = d.get("compression_training", {})
         self.autotuning_config = d.get("autotuning", {})
         self.sparse_gradients_enabled = bool(d.get("sparse_gradients", False))

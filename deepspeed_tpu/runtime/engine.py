@@ -19,6 +19,7 @@ API parity:
   (engine.py:2943/:2620).
 """
 import os
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -621,6 +622,30 @@ class DeepSpeedEngine:
             steps_per_output=self._config.steps_per_print)
         self.monitor = self._build_monitor()
         self.last_metrics: Dict[str, float] = {}
+
+        # ---- unified telemetry (ISSUE 4): registry + tracer + MFU ------------
+        from deepspeed_tpu.telemetry import (configure_tracer, get_registry,
+                                             peak_flops_per_device)
+        tcfg = self._config.telemetry_config
+        self.telemetry_registry = get_registry()
+        self.tracer = configure_tracer(tcfg.trace)
+        self.timers.attach_tracer(self.tracer)
+        # precedence: DS_PEAK_FLOPS env > telemetry.peak_flops config >
+        # device-kind table (None on CPU — MFU gauge simply absent)
+        from deepspeed_tpu.telemetry import PEAK_FLOPS_ENV
+        if os.environ.get(PEAK_FLOPS_ENV, "").strip():
+            peak = peak_flops_per_device()
+        else:
+            peak = tcfg.peak_flops or peak_flops_per_device()
+        #: aggregate peak over this process's local devices (per-host MFU)
+        self._peak_flops = (peak * len(jax.local_devices())
+                            if peak else None)
+        self.metrics_server = None
+        if tcfg.metrics_port is not None and jax.process_index() == 0:
+            from deepspeed_tpu.telemetry import MetricsServer
+            self.metrics_server = MetricsServer(
+                self.telemetry_registry,
+                port=tcfg.metrics_port).start()
 
         self._ltd_keep = None
         self._last_seq_len = 0
@@ -1977,7 +2002,23 @@ class DeepSpeedEngine:
         """One full training step over ``gradient_accumulation_steps``
         micro-batches (reference: PipelineEngine.train_batch,
         runtime/pipe/engine.py:297; plain-engine equivalent is GAS×
-        forward/backward + step)."""
+        forward/backward + step).
+
+        Telemetry: the whole step runs inside a ``train/step`` span
+        whose correlation id (``train-step-N``) is inherited by every
+        nested span/instant — checkpoint stages, timer phases, injected
+        faults — so a chaos run reads as one coherent timeline; step
+        latency, tokens/s, and MFU land in the metrics registry."""
+        step = self.global_steps + 1
+        t0 = time.perf_counter()
+        with self.tracer.span("train/step", cat="train",
+                              corr=f"train-step-{step}",
+                              args={"step": step}):
+            loss = self._train_batch_impl(data_iter=data_iter, batch=batch)
+        self._record_step_telemetry(time.perf_counter() - t0)
+        return loss
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
         self.fault_injector.check("train.step")
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
@@ -2034,33 +2075,44 @@ class DeepSpeedEngine:
             gas = self.gradient_accumulation_steps()
             acc = None
             losses = []
-            for i in range(gas):
-                mb = jax.tree.map(lambda x: x[i], batch)
-                with self._stream_scope(), self._ltd_scope(), \
-                        self._aq_scope():
-                    loss, grads = fn(self.state, mb, self._next_rng())
-                losses.append(loss)
-                if self.streamed_optimizer is not None:
-                    # stays on device / pinned host — no Python round trip
-                    acc = (grads if acc is None else
-                           self._get_compiled("grad_acc")(acc, grads))
-                else:
-                    g = jax.tree.map(np.asarray, grads)
-                    acc = g if acc is None else jax.tree.map(np.add, acc, g)
+            with self.tracer.span("train/fwd_bwd", cat="train",
+                                  args={"micro_batches": gas}):
+                for i in range(gas):
+                    mb = jax.tree.map(lambda x: x[i], batch)
+                    with self._stream_scope(), self._ltd_scope(), \
+                            self._aq_scope():
+                        loss, grads = fn(self.state, mb, self._next_rng())
+                    losses.append(loss)
+                    if self.streamed_optimizer is not None:
+                        # stays on device / pinned host — no Python round
+                        # trip
+                        acc = (grads if acc is None else
+                               self._get_compiled("grad_acc")(acc, grads))
+                    else:
+                        g = jax.tree.map(np.asarray, grads)
+                        acc = g if acc is None else jax.tree.map(
+                            np.add, acc, g)
             mean_loss = sum(losses) / gas        # device scalars, async
-            if self.streamed_optimizer is not None:
-                metrics = self._streamed_apply(acc, mean_loss)
-            else:
-                metrics = self._host_apply(acc, mean_loss)
+            with self.tracer.span("train/optimizer_step", cat="train"):
+                if self.streamed_optimizer is not None:
+                    metrics = self._streamed_apply(acc, mean_loss)
+                else:
+                    metrics = self._host_apply(acc, mean_loss)
         elif self._offload:
-            with self._stream_scope(), self._ltd_scope(), \
+            with self.tracer.span("train/fwd_bwd", cat="train"), \
+                    self._stream_scope(), self._ltd_scope(), \
                     self._aq_scope():
                 loss, grads = self._get_compiled("grad_step")(
                     self.state, batch, self._next_rng())
-            metrics = self._host_apply(grads, loss)
+            with self.tracer.span("train/optimizer_step", cat="train"):
+                metrics = self._host_apply(grads, loss)
         else:
             fn = self._get_compiled("train_step")
-            with self._train_scope(), self._ltd_scope(), \
+            # one fused program: fwd+bwd+apply dispatch together (the
+            # per-phase split lives in the fwd/bwd/step timers when the
+            # micro API drives them)
+            with self.tracer.span("train/fused_step", cat="train"), \
+                    self._train_scope(), self._ltd_scope(), \
                     self._aq_scope():
                 self.state, metrics = fn(self.state, batch, self._next_rng())
         self._finish_step(metrics)
@@ -2217,6 +2269,17 @@ class DeepSpeedEngine:
                 profile_step=self.global_steps,
                 module_depth=fpc.module_depth, top_modules=fpc.top_modules,
                 detailed=fpc.detailed, output_file=fpc.output_file)
+            # profiler-grade gauges (ISSUE 4): unlike the per-step MFU
+            # estimate, this pair is synced on the step outputs — the
+            # profile step pays the device round trip anyway
+            self.telemetry_registry.set_gauge(
+                "train/profiled_flops_per_s",
+                self.flops_profiler.achieved_flops_per_s())
+            if self._peak_flops:
+                pm = self.flops_profiler.mfu(self._peak_flops)
+                if pm is not None:
+                    self.telemetry_registry.set_gauge(
+                        "train/profiled_mfu", pm)
         if self._config.fp16.enabled:
             # don't force a device->host fetch of the overflow flag every
             # step — bank it and resolve at report boundaries / on access
@@ -2253,6 +2316,48 @@ class DeepSpeedEngine:
                 msg += f" loss={float(loss):.4f}"
             msg += f" grad_norm={float(metrics.get('grad_norm', 0.0)):.3f}"
             log_dist(msg, ranks=[0])
+
+    def _record_step_telemetry(self, duration_s: float):
+        """Per-step registry update + monitor bridge (ISSUE 4): step
+        latency histogram, tokens/s, and the MFU gauge — model FLOPs
+        (``flops_per_token × tokens``, the Megatron 6N convention the
+        in-tree models declare) over wall clock against the local
+        devices' peak.  Wall clock is dispatch-side (unsynced) between
+        bridge boundaries, exactly like ThroughputTimer — the bridge
+        step's sync closes the window."""
+        tcfg = self._config.telemetry_config
+        if not tcfg.enabled:
+            return
+        reg = self.telemetry_registry
+        reg.inc("train/steps")
+        reg.histogram("train/step_latency_s").observe(duration_s)
+        tokens = self.train_batch_size() * max(self._last_seq_len, 0)
+        if tokens and duration_s > 0:
+            reg.set_gauge("train/tokens_per_s", tokens / duration_s)
+        fpt = getattr(self.model, "flops_per_token", None) or 0.0
+        if fpt and tokens and duration_s > 0:
+            flops = fpt * tokens
+            reg.set_gauge("train/model_flops_per_s", flops / duration_s)
+            if self._peak_flops:
+                from deepspeed_tpu.telemetry import mfu as _mfu
+                val = _mfu(flops, duration_s, self._peak_flops)
+                if val is not None:
+                    reg.set_gauge("train/mfu", val)
+        if (self.monitor is not None and self.monitor.enabled
+                and tcfg.monitor_interval
+                and self.global_steps % tcfg.monitor_interval == 0):
+            self.monitor.write_events(reg.to_events(self.global_steps))
+
+    def log_comms_summary(self, show_straggler: bool = False):
+        """Print the comms summary AND write it through the monitor
+        sinks (ISSUE 4 satellite: CommsLogger output as monitor events,
+        not log-only)."""
+        from deepspeed_tpu import comm as _comm
+        sink = (self.monitor
+                if self.monitor is not None and self.monitor.enabled
+                else None)
+        _comm.log_summary(monitor=sink, step=self.global_steps,
+                          show_straggler=show_straggler)
 
     # ------------------------------------------------------------------ checkpoint
     def _get_checkpoint_engine(self):
@@ -2344,17 +2449,22 @@ class DeepSpeedEngine:
             import numpy as _np
             save_src = jax.tree.map(lambda a: _np.array(a, copy=True),
                                     self.state)
-        leaves = rckpt.leaf_summary(
-            save_src, checksums=rcfg.checkpoint_checksums)
-        ckpt_engine.create(tag)
-        inj.check("ckpt.save")
-        self._ckpt_retry(ckpt_engine.save, save_src,
-                         os.path.join(tmp_dir, STATE_DIR),
-                         describe=f"checkpoint save {tag}")
-        if is_rank0:
-            import json as _json
-            with open(os.path.join(tmp_dir, METADATA_FILE), "w") as f:
-                _json.dump(extra, f, indent=2, default=str)
+        ckpt_corr = f"ckpt-{tag}"
+        ckpt_t0 = time.perf_counter()
+        with self.tracer.span("ckpt/stage", cat="ckpt", corr=ckpt_corr,
+                              args={"tag": str(tag), "step": step,
+                                    "async": bool(is_async)}):
+            leaves = rckpt.leaf_summary(
+                save_src, checksums=rcfg.checkpoint_checksums)
+            ckpt_engine.create(tag)
+            inj.check("ckpt.save")
+            self._ckpt_retry(ckpt_engine.save, save_src,
+                             os.path.join(tmp_dir, STATE_DIR),
+                             describe=f"checkpoint save {tag}")
+            if is_rank0:
+                import json as _json
+                with open(os.path.join(tmp_dir, METADATA_FILE), "w") as f:
+                    _json.dump(extra, f, indent=2, default=str)
         is_async = getattr(ckpt_engine, "is_async", False)
         # host-side optimizer tiers: snapshot synchronously (their pinned /
         # in-place buffers mutate every step), serialize alongside the
@@ -2392,6 +2502,12 @@ class DeepSpeedEngine:
             before the rename leaves only the .tmp staging dir."""
             if aux_errs:
                 raise aux_errs[0]
+            with self.tracer.span("ckpt/publish", cat="ckpt",
+                                  corr=ckpt_corr,
+                                  args={"tag": str(tag), "step": step}):
+                return _publish()
+
+        def _publish():
             if is_rank0:
                 rckpt.write_manifest(tmp_dir, step, tag, leaves,
                                      injector=inj)
@@ -2448,10 +2564,19 @@ class DeepSpeedEngine:
                     lambda: ref() and ref().wait_pending_checkpoint())
                 self._ckpt_atexit = True
             log_dist(f"async checkpoint {ckpt_dir} in flight", ranks=[0])
+            # for async saves the histogram records what training
+            # actually blocked on: the synchronous staging portion
+            self.telemetry_registry.histogram(
+                "ckpt/save_duration_s").observe(
+                    time.perf_counter() - ckpt_t0)
+            self.telemetry_registry.inc("ckpt/saves")
             return True
         _write_aux()
         ckpt_engine.commit(tag)
         _finalize()
+        self.telemetry_registry.histogram("ckpt/save_duration_s").observe(
+            time.perf_counter() - ckpt_t0)
+        self.telemetry_registry.inc("ckpt/saves")
         log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
         return True
 
@@ -2486,17 +2611,25 @@ class DeepSpeedEngine:
                     f"requested tag {tag!r} in {load_dir} failed "
                     f"verification: {reason}")
         ckpt_dir = os.path.join(load_dir, str(tag))
-        state = self._ckpt_retry(
-            ckpt_engine.load, os.path.join(ckpt_dir, STATE_DIR),
-            template=self.state, shardings=self.state_shardings,
-            describe=f"checkpoint load {tag}")
-        if verify == "full":
-            mismatches = rckpt.verify_restored(
-                state, rckpt.read_manifest(ckpt_dir))
-            if mismatches:
-                raise CheckpointCorruptError(
-                    f"tag {tag!r} failed checksum verification: "
-                    f"{mismatches[:5]}")
+        restore_t0 = time.perf_counter()
+        with self.tracer.span("ckpt/restore", cat="ckpt",
+                              corr=f"ckpt-{tag}",
+                              args={"tag": str(tag), "verify": verify}):
+            state = self._ckpt_retry(
+                ckpt_engine.load, os.path.join(ckpt_dir, STATE_DIR),
+                template=self.state, shardings=self.state_shardings,
+                describe=f"checkpoint load {tag}")
+            if verify == "full":
+                mismatches = rckpt.verify_restored(
+                    state, rckpt.read_manifest(ckpt_dir))
+                if mismatches:
+                    raise CheckpointCorruptError(
+                        f"tag {tag!r} failed checksum verification: "
+                        f"{mismatches[:5]}")
+        self.telemetry_registry.histogram(
+            "ckpt/restore_duration_s").observe(
+                time.perf_counter() - restore_t0)
+        self.telemetry_registry.inc("ckpt/restores")
         if not (load_optimizer_states and not load_module_only):
             state = {**state, "opt_state": self.state["opt_state"]}
         extra = {}

@@ -80,50 +80,91 @@ def _sample_rows(logits, seeds, positions, temps, top_ks, top_ps, do_flags,
 
 
 class ServingMetrics:
-    """Serving observability: counters + latency reservoirs, rendered to
-    monitor events (monitor/monitor.py sinks) and the /metrics endpoint."""
+    """Serving observability (ISSUE 4): counters + registry-backed
+    latency histograms (TTFT, per-token decode latency, queue wait, e2e
+    latency) and occupancy histograms, rendered three ways from ONE
+    store — monitor events (monitor/monitor.py sinks), the flat
+    ``snapshot()`` dict, and Prometheus text for ``/metrics``
+    (``render_prometheus``, the telemetry registry's shared exposition
+    function)."""
 
-    LATENCY_WINDOW = 4096
+    _QUANTILES = ((50, "p50"), (90, "p90"), (99, "p99"))
+    #: histogram name -> snapshot/monitor key stem
+    _LATENCY_HISTS = (("serving/ttft_s", "ttft"),
+                      ("serving/token_latency_s", "token_latency"),
+                      ("serving/latency_s", "latency"),
+                      ("serving/queue_wait_s", "queue_wait"))
 
-    def __init__(self):
+    def __init__(self, registry=None):
+        from deepspeed_tpu.telemetry import (COUNT_BUCKETS, MetricsRegistry,
+                                             OCCUPANCY_BUCKETS)
+        #: isolated per scheduler by default; ds_serve passes the
+        #: process-wide registry so train+serve share one exposition
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
         self.counters = collections.Counter()
-        self.ttft_s = collections.deque(maxlen=self.LATENCY_WINDOW)
-        self.token_s = collections.deque(maxlen=self.LATENCY_WINDOW)
-        self.latency_s = collections.deque(maxlen=self.LATENCY_WINDOW)
         self.gauges: Dict[str, float] = {}
+        reg = self.registry
+        self.ttft_s = reg.histogram("serving/ttft_s")
+        self.token_latency_s = reg.histogram("serving/token_latency_s")
+        self.latency_s = reg.histogram("serving/latency_s")
+        self.queue_wait_s = reg.histogram("serving/queue_wait_s")
+        self.decode_occupancy = reg.histogram("serving/decode_occupancy",
+                                              buckets=OCCUPANCY_BUCKETS)
+        self.prefill_batch_tokens = reg.histogram(
+            "serving/prefill_batch_tokens", buckets=COUNT_BUCKETS)
 
     def observe_finished(self, req: ServeRequest):
         self.counters["completed"] += 1
         if req.ttft_s is not None:
-            self.ttft_s.append(req.ttft_s)
+            self.ttft_s.observe(req.ttft_s)
         if req.latency_s is not None:
-            self.latency_s.append(req.latency_s)
+            self.latency_s.observe(req.latency_s)
         times = req.token_times
         for a, b in zip(times, times[1:]):
-            self.token_s.append(b - a)
+            self.token_latency_s.observe(b - a)
 
-    @staticmethod
-    def _pct(values, q: float) -> Optional[float]:
-        if not values:
-            return None
-        return float(np.percentile(np.asarray(values), q))
+    def observe_queue_wait(self, wait_s: float):
+        self.queue_wait_s.observe(wait_s)
+
+    def _hist(self, name: str):
+        return self.registry.histogram(name)
 
     def snapshot(self) -> Dict[str, float]:
         out = {f"serving/{k}": float(v) for k, v in self.counters.items()}
         out.update({f"serving/{k}": float(v)
                     for k, v in self.gauges.items()})
-        for name, values in (("ttft", self.ttft_s),
-                             ("token_latency", self.token_s),
-                             ("latency", self.latency_s)):
-            for q in (50, 99):
-                v = self._pct(values, q)
-                if v is not None:
-                    out[f"serving/{name}_p{q}_ms"] = round(v * 1e3, 3)
+        for hist_name, stem in self._LATENCY_HISTS:
+            vals = self._hist(hist_name).quantiles(
+                tuple(q for q, _tag in self._QUANTILES))
+            if vals is None:
+                continue
+            for (_q, tag), v in zip(self._QUANTILES, vals):
+                out[f"serving/{stem}_{tag}_ms"] = round(v * 1e3, 3)
         return out
 
     def to_events(self, step: int):
         return [(name, value, step)
                 for name, value in sorted(self.snapshot().items())]
+
+    def render_prometheus(self) -> str:
+        """Single exposition path: mirror the counters/gauges (and the
+        quantile gauges the dashboards want pre-computed) into the
+        registry, then render its text format — histogram buckets
+        included."""
+        for k, v in self.counters.items():
+            self.registry.set_counter(f"serving/{k}", float(v))
+        for k, v in self.gauges.items():
+            self.registry.set_gauge(f"serving/{k}", float(v))
+        for hist_name, stem in self._LATENCY_HISTS:
+            vals = self._hist(hist_name).quantiles(
+                tuple(q for q, _tag in self._QUANTILES))
+            if vals is None:
+                continue
+            for (_q, tag), v in zip(self._QUANTILES, vals):
+                self.registry.set_gauge(
+                    f"serving/{stem}_{tag}_ms", round(v * 1e3, 3))
+        return self.registry.render_prometheus()
 
 
 class ContinuousBatchingScheduler:
@@ -139,7 +180,7 @@ class ContinuousBatchingScheduler:
     PROMPT_BUCKET = 16          # prefill compile count = distinct buckets
 
     def __init__(self, model, params, config, kv_cache_dtype=None,
-                 monitor=None, injector=None):
+                 monitor=None, injector=None, registry=None):
         if (model.init_cache_fn is None or model.prefill_fn is None
                 or model.decode_fn is None):
             raise ValueError("model does not expose the KV-cache serving "
@@ -152,6 +193,7 @@ class ContinuousBatchingScheduler:
         self.monitor = monitor
         self.injector = (injector if injector is not None
                          else resolve_injector())
+        self._telemetry_registry = registry
         self.block_mgr = BlockManager(config.num_blocks, config.block_size,
                                       injector=self.injector)
         # int8-weights decode dispatch: install this config's threshold so
@@ -183,7 +225,8 @@ class ContinuousBatchingScheduler:
             [None] * config.max_num_seqs
         self._next_id = 0
         self._step_count = 0
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(registry=self._telemetry_registry)
+        self._serve_t0 = time.monotonic()   # tokens/s accounting window
         self._prefill_fns = {}
         self._decode_fns = {}
         self._sample1_fns = {}
@@ -330,10 +373,16 @@ class ContinuousBatchingScheduler:
 
     def metrics_snapshot(self) -> Dict[str, float]:
         """Locked snapshot for readers outside the scheduler loop (the
-        /metrics endpoint) — the loop thread mutates the counter dict and
-        latency deques mid-step."""
+        /metrics endpoint) — the loop thread mutates the counter dict
+        and histograms mid-step."""
         with self._lock:
             return self.metrics.snapshot()
+
+    def render_metrics(self) -> str:
+        """Prometheus text for the /metrics endpoint (locked, same
+        exposition function as the training-side metrics server)."""
+        with self._lock:
+            return self.metrics.render_prometheus()
 
     # -------------------------------------------------------- lifecycle
     def _retire(self, req: ServeRequest, state: RequestState,
@@ -411,12 +460,31 @@ class ContinuousBatchingScheduler:
             req.slot = free_slots[0]
             self._slots[req.slot] = req
             spent += n_in
+            self.metrics.observe_queue_wait(
+                time.monotonic() - req.queued_at)
+            if resumed:
+                # goodput accounting: the generated tail re-prefilled
+                # here is work the pool preemption threw away
+                self.metrics.counters["recomputed_tokens"] += max(
+                    0, n_in - req.prompt_len)
             self._run_prefill(req, inputs, resumed)
             if resumed:
                 self.metrics.counters["resumed"] += 1
+        if spent:
+            self.metrics.prefill_batch_tokens.observe(spent)
 
     def _run_prefill(self, req: ServeRequest, inputs: np.ndarray,
                      resumed: bool):
+        from deepspeed_tpu.telemetry import get_tracer
+        with get_tracer().span("serve/prefill", cat="serving",
+                               corr=f"req-{req.request_id}",
+                               args={"request_id": req.request_id,
+                                     "tokens": int(inputs.size),
+                                     "resumed": bool(resumed)}):
+            self._run_prefill_traced(req, inputs, resumed)
+
+    def _run_prefill_traced(self, req: ServeRequest, inputs: np.ndarray,
+                            resumed: bool):
         sp = min(max(_round_up(inputs.size, self.PROMPT_BUCKET),
                      self.PROMPT_BUCKET), self.s_pad)
         padded = np.zeros((1, sp), np.int32)
@@ -561,28 +629,62 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------- step
     def step(self) -> List[ServeRequest]:
-        """One engine iteration; returns requests finished this step."""
+        """One engine iteration; returns requests finished this step.
+
+        The iteration runs inside a ``serve/step`` span (correlation id
+        ``serve-step-N``) with admit/grow/decode child spans; per-request
+        prefill spans carry ``req-<id>`` so one request's admission,
+        decode windows, and any faults line up in the trace."""
+        from deepspeed_tpu.telemetry import get_tracer
+        tracer = get_tracer()
         # fault site OUTSIDE the lock: an injected stall models a wedged
         # engine without also wedging the /metrics + submit paths
-        self.injector.check("serve.step")
-        with self._lock:
-            self._finished_this_step = []
-            self._expire_queued()
-            self._admit()
-            self._grow_tables()
-            self._decode()
-            self._step_count += 1
-            self.metrics.gauges.update(
-                queue_depth=len(self._queue),
-                active_seqs=sum(r is not None for r in self._slots),
-                block_pool_utilization=round(
-                    self.block_mgr.utilization(), 4),
-                free_blocks=self.block_mgr.num_free_blocks)
-            if self.monitor is not None and (
-                    self._step_count % self.cfg.monitor_interval == 0):
-                self.monitor.write_events(
-                    self.metrics.to_events(self._step_count))
-            return list(self._finished_this_step)
+        with tracer.span("serve/step", cat="serving",
+                         corr=f"serve-step-{self._step_count}",
+                         args={"step": self._step_count}):
+            self.injector.check("serve.step")
+            with self._lock:
+                self._finished_this_step = []
+                self._expire_queued()
+                with tracer.span("serve/admit", cat="serving"):
+                    self._admit()
+                with tracer.span("serve/grow", cat="serving"):
+                    self._grow_tables()
+                active = sum(r is not None and
+                             r.state == RequestState.DECODE
+                             for r in self._slots)
+                with tracer.span("serve/decode", cat="serving",
+                                 args={"active": active}):
+                    self._decode()
+                self._step_count += 1
+                if active:
+                    self.metrics.decode_occupancy.observe(
+                        active / self.cfg.max_num_seqs)
+                self._update_gauges()
+                if self.monitor is not None and (
+                        self._step_count % self.cfg.monitor_interval == 0):
+                    self.monitor.write_events(
+                        self.metrics.to_events(self._step_count))
+                return list(self._finished_this_step)
+
+    def _update_gauges(self):
+        """Occupancy + goodput gauges (ISSUE 4).  Goodput = generated
+        tokens that were not later thrown away to preemption recompute;
+        tokens/s is the cumulative decode rate since scheduler start."""
+        from deepspeed_tpu.telemetry import serving_goodput
+        c = self.metrics.counters
+        elapsed = time.monotonic() - self._serve_t0
+        self.metrics.gauges.update(
+            queue_depth=len(self._queue),
+            active_seqs=sum(r is not None for r in self._slots),
+            block_pool_utilization=round(
+                self.block_mgr.utilization(), 4),
+            free_blocks=self.block_mgr.num_free_blocks,
+            goodput=round(serving_goodput(
+                c["generated_tokens"], c["recomputed_tokens"]), 4))
+        if elapsed > 0 and c["generated_tokens"]:
+            self.metrics.gauges["tokens_per_s"] = round(
+                c["generated_tokens"] / elapsed, 3)
 
     def run_until_idle(self, max_steps: int = 100_000):
         """Drive step() until queue and slots drain (bench/test helper)."""

@@ -10,7 +10,9 @@ Endpoints:
                   -> 429 when the queue is full / the request times out
                   -> 400 for malformed bodies or impossible lengths
   GET  /healthz   -> 200 {"status": "ok", "active": n, "queued": m}
-  GET  /metrics   -> text/plain ``name value`` lines (Prometheus-style)
+  GET  /metrics   -> Prometheus text exposition (TYPE lines, counters/
+                     gauges, latency histogram buckets + p50/p90/p99
+                     quantile gauges — telemetry registry rendering)
 
 The scheduler loop runs on ONE background thread (the engine step is the
 unit of concurrency — iteration-level scheduling happens inside it);
@@ -155,10 +157,11 @@ class _Handler(BaseHTTPRequestHandler):
                             {**self.health.snapshot(), **payload})
             return
         if self.path == "/metrics":
-            lines = []
-            for name, value in sorted(sched.metrics_snapshot().items()):
-                lines.append(f"{name.replace('/', '_')} {value}")
-            body = ("\n".join(lines) + "\n").encode()
+            # Prometheus text exposition from the telemetry registry
+            # (ISSUE 4): counters/gauges plus TTFT/TPOT/queue-wait
+            # histogram buckets and p50/p90/p99 quantile gauges — the
+            # same render function the training metrics endpoint uses
+            body = sched.render_metrics().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))

@@ -1,0 +1,15 @@
+"""Unified telemetry (ISSUE 4): metrics registry + Prometheus
+exposition, Chrome-trace span tracer with correlation ids, and
+MFU/goodput accounting — the cross-cutting observability layer train
+and serve both report through (docs/tutorials/monitoring-profiling.md).
+"""
+from deepspeed_tpu.telemetry.registry import (      # noqa: F401
+    COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
+    OCCUPANCY_BUCKETS, get_registry)
+from deepspeed_tpu.telemetry.tracing import (       # noqa: F401
+    NULL_TRACER, SpanTracer, TRACE_ENV, configure_tracer, get_tracer,
+    reset_tracer)
+from deepspeed_tpu.telemetry.mfu import (           # noqa: F401
+    PEAK_FLOPS_ENV, mfu, peak_flops_per_device, serving_goodput,
+    tokens_per_second, total_peak_flops)
+from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

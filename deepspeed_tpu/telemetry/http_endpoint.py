@@ -1,0 +1,67 @@
+"""Opt-in training-side metrics HTTP endpoint (ISSUE 4 tentpole).
+
+``telemetry.metrics_port`` (or a direct :class:`MetricsServer`) exposes
+the process-wide :class:`~deepspeed_tpu.telemetry.registry.
+MetricsRegistry` over ``GET /metrics`` in the same Prometheus text
+format ``ds_serve`` renders — one exposition function, two front doors.
+Stdlib-only, one daemon thread; ``port=0`` binds an ephemeral port
+(tests read :attr:`MetricsServer.port` after ``start()``).
+"""
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class MetricsServer:
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_port if self._httpd is not None else None
+
+    def start(self) -> "MetricsServer":
+        registry = self.registry
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("metrics endpoint: " + fmt % args)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = registry.render_prometheus().encode()
+                    code, ctype = 200, "text/plain; charset=utf-8"
+                elif self.path == "/healthz":
+                    body, code, ctype = b"ok\n", 200, "text/plain"
+                else:
+                    body = f"no route {self.path}\n".encode()
+                    code, ctype = 404, "text/plain"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          _Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="ds-metrics")
+        self._thread.start()
+        logger.info(f"telemetry: metrics endpoint on "
+                    f"http://{self.host}:{self.port}/metrics")
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

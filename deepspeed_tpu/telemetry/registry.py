@@ -1,0 +1,296 @@
+"""Process-wide metrics registry (ISSUE 4 tentpole).
+
+One registry holds every counter, gauge, and bucketed histogram the
+framework emits — train-side (step latency, MFU, checkpoint durations,
+retry counts) and serve-side (TTFT, per-token decode latency, queue
+wait, batch occupancy).  Two render paths share it:
+
+- :meth:`MetricsRegistry.render_prometheus` — the single Prometheus-text
+  exposition function behind ``ds_serve /metrics`` and the opt-in
+  training metrics endpoint (``telemetry.metrics_port``);
+- :meth:`MetricsRegistry.to_events` — the bridge that drains the
+  registry into the existing ``monitor/monitor.py`` sinks per step.
+
+Histograms keep (a) cumulative Prometheus buckets — cheap, mergeable,
+what a scraper wants — and (b) a bounded reservoir of recent samples so
+``quantile()`` reports exact p50/p90/p99 over the observation window
+(vLLM-style serving histograms; PAPERS.md) rather than bucket-edge
+estimates.
+
+Everything is guarded by one lock per registry; observation is a
+bisect + two increments — safe for the serving loop's hot path.
+"""
+import bisect
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Event = Tuple[str, float, int]     # monitor/monitor.py event triple
+
+#: latency buckets (seconds): 0.5 ms .. 60 s, roughly 2.5x spacing —
+#: covers per-token decode (~ms) through checkpoint saves (~tens of s)
+DEFAULT_LATENCY_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: occupancy / utilization buckets (fractions of capacity)
+OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+#: token-count buckets (prefill batch sizes, queue depths)
+COUNT_BUCKETS = (1, 4, 16, 64, 256, 1024, 4096)
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric name: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = _NAME_SANITIZE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...],
+                 extra: Optional[Tuple[Tuple[str, str], ...]] = None) -> str:
+    items = list(labels) + list(extra or ())
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"'
+                    for k, v in items)
+    return "{" + body + "}"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Histogram:
+    """Bucketed histogram + bounded exact-quantile reservoir.
+
+    ``counts[i]`` is the number of observations <= ``buckets[i]``
+    (non-cumulative storage; rendering accumulates into the Prometheus
+    ``le`` convention).  The reservoir is a ring buffer of the most
+    recent ``reservoir_size`` raw samples."""
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+                 reservoir_size: int = 4096):
+        self.name = name
+        self.buckets: Tuple[float, ...] = tuple(sorted(float(b)
+                                                       for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name!r}: needs >= 1 bucket bound")
+        self.counts = [0] * (len(self.buckets) + 1)   # +1 = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._ring: List[float] = []
+        self._ring_idx = 0
+        self._ring_cap = int(reservoir_size)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float):
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+            if len(self._ring) < self._ring_cap:
+                self._ring.append(v)
+            else:
+                self._ring[self._ring_idx] = v
+                self._ring_idx = (self._ring_idx + 1) % self._ring_cap
+
+    @staticmethod
+    def _interp(data: List[float], q: float) -> float:
+        pos = (q / 100.0) * (len(data) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(data) - 1)
+        frac = pos - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the recent-sample window (None = empty).
+        ``q`` in [0, 100] (percentile convention, matching np)."""
+        out = self.quantiles((q,))
+        return out[0] if out else None
+
+    def quantiles(self, qs: Sequence[float]) -> Optional[List[float]]:
+        """All requested quantiles from ONE sort of the reservoir — the
+        snapshot/render paths ask for p50/p90/p99 together, and a
+        per-quantile sort would triple the work on every scrape."""
+        with self._lock:
+            data = sorted(self._ring)
+        if not data:
+            return None
+        return [self._interp(data, q) for q in qs]
+
+    def cumulative_counts(self) -> List[Tuple[float, int]]:
+        """[(le_bound, cumulative_count), ...] ending with (+inf, count)."""
+        out = []
+        acc = 0
+        with self._lock:
+            for bound, c in zip(self.buckets, self.counts):
+                acc += c
+                out.append((bound, acc))
+            out.append((float("inf"), acc + self.counts[-1]))
+        return out
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Labeled counters + gauges + histograms with one exposition path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (name, labelkey) -> float
+        self._counters: Dict[Tuple[str, tuple], float] = {}
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        #: (name, labelkey) -> Histogram
+        self._histograms: Dict[Tuple[str, tuple], Histogram] = {}
+
+    # ------------------------------------------------------------ writers
+    def inc(self, name: str, value: float = 1.0, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + float(value)
+
+    def set_counter(self, name: str, value: float, **labels):
+        """Absolute set for counters maintained elsewhere (the serving
+        scheduler's ``collections.Counter`` syncs through here at render
+        time).  Still rendered with the counter TYPE."""
+        with self._lock:
+            self._counters[(name, _label_key(labels))] = float(value)
+
+    def set_gauge(self, name: str, value: float, **labels):
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = float(value)
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+                  **labels) -> Histogram:
+        """Get-or-create; an existing histogram's buckets win (one bucket
+        layout per metric name — the Prometheus contract)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                h = Histogram(name, buckets=buckets)
+                self._histograms[key] = h
+            return h
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    # ------------------------------------------------------------ readers
+    def get_counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def get_gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)))
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name -> value view (labels folded into the name); used by
+        tests and the monitor bridge.  Histograms contribute _count,
+        _sum, and exact-window p50/p90/p99."""
+        out = {}
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+
+        def flat(name, labelkey):
+            if not labelkey:
+                return name
+            return name + "{" + ",".join(f"{k}={v}"
+                                         for k, v in labelkey) + "}"
+
+        for (name, lk), v in counters.items():
+            out[flat(name, lk)] = v
+        for (name, lk), v in gauges.items():
+            out[flat(name, lk)] = v
+        for (name, lk), h in hists.items():
+            base = flat(name, lk)
+            out[base + "_count"] = float(h.count)
+            out[base + "_sum"] = h.sum
+            vals = h.quantiles((50, 90, 99))
+            if vals is not None:
+                for tag, val in zip(("p50", "p90", "p99"), vals):
+                    out[f"{base}_{tag}"] = val
+        return out
+
+    # --------------------------------------------------------- exposition
+    def render_prometheus(self) -> str:
+        """THE text exposition function: Prometheus 0.0.4 text format,
+        rendered identically by ``ds_serve /metrics`` and the training
+        metrics endpoint."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._histograms.items())
+        lines: List[str] = []
+        seen_type = set()
+
+        def type_line(name, kind):
+            if name not in seen_type:
+                seen_type.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+
+        for (name, lk), v in counters:
+            n = _prom_name(name)
+            type_line(n, "counter")
+            lines.append(f"{n}{_prom_labels(lk)} {_fmt(v)}")
+        for (name, lk), v in gauges:
+            n = _prom_name(name)
+            type_line(n, "gauge")
+            lines.append(f"{n}{_prom_labels(lk)} {_fmt(v)}")
+        for (name, lk), h in hists:
+            n = _prom_name(name)
+            type_line(n, "histogram")
+            for bound, acc in h.cumulative_counts():
+                le = "+Inf" if bound == float("inf") else _fmt(bound)
+                lines.append(
+                    f"{n}_bucket{_prom_labels(lk, (('le', le),))} {acc}")
+            lines.append(f"{n}_sum{_prom_labels(lk)} {_fmt(h.sum)}")
+            lines.append(f"{n}_count{_prom_labels(lk)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------ monitor bridge
+    def to_events(self, step: int) -> List[Event]:
+        """Drain view for ``monitor/monitor.py`` sinks: every metric as a
+        (name, value, step) event.  Counters report their running total;
+        histograms report count/sum/quantiles — exactly the snapshot()
+        keys, so CSV/TensorBoard series stay stably named."""
+        return [(name, float(value), int(step))
+                for name, value in sorted(self.snapshot().items())]
+
+
+# ----------------------------------------------------------- process-wide
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (created on first use).  Subsystems that
+    want isolation (tests, multiple schedulers in one process) construct
+    their own ``MetricsRegistry`` and pass it down instead."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MetricsRegistry()
+        return _GLOBAL

@@ -1,0 +1,250 @@
+"""Chrome-trace / Perfetto span tracer (ISSUE 4 tentpole).
+
+``DS_TRACE=/path/trace.json`` (or the ``telemetry.trace`` config key)
+arms a process-wide tracer; every subsystem then emits spans into ONE
+timeline — train-step phases (fwd/bwd/step through the engine timers),
+serving scheduler iterations (admit/prefill/decode), checkpoint
+stage/publish, and resilience events (faults fired, health transitions,
+drains).  Load the file in ``chrome://tracing`` or https://ui.perfetto.dev.
+
+Correlation ids stitch the timeline together: a span opened with
+``corr="train-step-12"`` pushes that id onto a thread-local stack, and
+every nested span/instant that does not name its own id inherits it —
+so a fault injected inside step 12's checkpoint save carries
+``train-step-12`` without the fault injector knowing about steps.
+
+Event model (Chrome trace-event format):
+- spans are matched ``B``/``E`` pairs per (pid, tid) — the context
+  manager guarantees LIFO nesting, which ``scripts/trace_validate.py``
+  asserts;
+- point events are ``i`` instants (process-scoped);
+- ``flush()`` sorts by timestamp and writes ``{"traceEvents": [...]}``
+  atomically (tmp + rename); an atexit hook flushes the active tracer
+  so a drain/exit still lands the file.
+
+When no trace path is armed, every hook routes through
+:data:`NULL_TRACER` — a no-op whose ``span()`` costs one context-manager
+enter/exit, safe for hot paths.
+"""
+import atexit
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+TRACE_ENV = "DS_TRACE"
+
+
+class SpanTracer:
+    """Thread-safe in-memory trace buffer with Chrome-trace emission.
+
+    Signal-safety: resilience code emits instants from SIGTERM handlers
+    (preemption latch, serving drain → health transition), which run ON
+    the thread they interrupt — possibly while that thread holds the
+    buffer lock.  The lock is therefore an ``RLock`` (re-acquiring on
+    the same thread cannot deadlock), and the size-triggered background
+    flush is ``acquire(blocking=False)`` so a handler can never wedge on
+    file I/O either.
+
+    The buffer self-bounds: past :data:`FLUSH_EVENT_THRESHOLD` buffered
+    events the emitting thread flushes to disk (append-merge), so a
+    multi-hour traced run costs bounded host RAM and a hard kill loses
+    at most one threshold window of events, not the whole trace."""
+
+    FLUSH_EVENT_THRESHOLD = 50_000
+
+    def __init__(self, path: str):
+        self.path = path
+        self.enabled = True
+        self.pid = os.getpid()
+        self._clock = time.perf_counter
+        self._t0 = self._clock()
+        self._events = []
+        self._lock = threading.RLock()
+        self._flush_lock = threading.Lock()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------ helpers
+    def _ts_us(self) -> float:
+        return (self._clock() - self._t0) * 1e6
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_corr(self) -> Optional[str]:
+        """Innermost correlation id on this thread (None outside spans)."""
+        for corr in reversed(self._stack()):
+            if corr is not None:
+                return corr
+        return None
+
+    def _emit(self, ev: Dict[str, Any]):
+        with self._lock:
+            self._events.append(ev)
+            n = len(self._events)
+        if n >= self.FLUSH_EVENT_THRESHOLD:
+            # best-effort spill outside the buffer lock; skip rather
+            # than block if another thread is already writing
+            if self._flush_lock.acquire(blocking=False):
+                try:
+                    self._flush_locked()
+                finally:
+                    self._flush_lock.release()
+
+    def _event(self, ph: str, name: str, cat: str,
+               corr: Optional[str], args: Optional[Dict]) -> Dict[str, Any]:
+        ev = {"name": name, "ph": ph, "ts": self._ts_us(),
+              "pid": self.pid, "tid": threading.get_ident() % (1 << 31),
+              "cat": cat or "ds"}
+        a = dict(args or {})
+        if corr is not None:
+            a["corr"] = corr
+        if a:
+            ev["args"] = a
+        return ev
+
+    # -------------------------------------------------------------- spans
+    def begin(self, name: str, cat: str = "", corr: Optional[str] = None,
+              args: Optional[Dict] = None):
+        """Open a span (``E`` must follow on the same thread, LIFO)."""
+        corr = corr if corr is not None else self.current_corr()
+        self._stack().append(corr)
+        self._emit(self._event("B", name, cat, corr, args))
+
+    def end(self, name: str, args: Optional[Dict] = None):
+        st = self._stack()
+        corr = st.pop() if st else None
+        self._emit(self._event("E", name, "", corr, args))
+
+    @contextmanager
+    def span(self, name: str, cat: str = "", corr: Optional[str] = None,
+             args: Optional[Dict] = None):
+        self.begin(name, cat=cat, corr=corr, args=args)
+        try:
+            yield self
+        finally:
+            self.end(name)
+
+    def instant(self, name: str, cat: str = "", corr: Optional[str] = None,
+                args: Optional[Dict] = None):
+        """Point event (fault fired, health transition, signal)."""
+        corr = corr if corr is not None else self.current_corr()
+        ev = self._event("i", name, cat, corr, args)
+        ev["s"] = "p"                     # process-scoped instant
+        self._emit(ev)
+
+    # ------------------------------------------------------------- output
+    def drain(self):
+        """Snapshot + clear the buffer (sorted by ts); flush() callers
+        normally want the file, tests may want the raw events."""
+        with self._lock:
+            events, self._events = self._events, []
+        events.sort(key=lambda e: e["ts"])
+        return events
+
+    def flush(self) -> Optional[str]:
+        """Append-merge the buffer into ``self.path`` atomically.  Safe
+        to call repeatedly; returns the path (None when disabled)."""
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> Optional[str]:
+        events = self.drain()
+        if not events and os.path.exists(self.path):
+            return self.path               # nothing new to merge
+        merged = events
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    prior = json.load(f).get("traceEvents", [])
+                merged = prior + events
+            except (json.JSONDecodeError, OSError):
+                merged = events           # unreadable prior file: rewrite
+        merged.sort(key=lambda e: e["ts"])
+        tmp = self.path + ".tmp"
+        dirname = os.path.dirname(self.path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+
+class _NullTracer:
+    """Disabled tracer: every hook is a no-op (shared singleton)."""
+
+    enabled = False
+    path = None
+
+    def begin(self, *a, **kw):
+        pass
+
+    def end(self, *a, **kw):
+        pass
+
+    @contextmanager
+    def span(self, *a, **kw):
+        yield self
+
+    def instant(self, *a, **kw):
+        pass
+
+    def current_corr(self):
+        return None
+
+    def drain(self):
+        return []
+
+    def flush(self):
+        return None
+
+
+NULL_TRACER = _NullTracer()
+
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE = None          # None = unconfigured; NULL_TRACER-or-SpanTracer after
+_ATEXIT_INSTALLED = False
+
+
+def configure_tracer(path: Optional[str] = None):
+    """Arm (or return) the process-wide tracer.  ``DS_TRACE`` wins over
+    the explicit path (the repo's env-overrides-config convention); with
+    neither set, an already-armed tracer stays armed and otherwise the
+    null tracer is installed."""
+    global _ACTIVE, _ATEXIT_INSTALLED
+    effective = os.environ.get(TRACE_ENV, "").strip() or path
+    with _ACTIVE_LOCK:
+        if not effective:
+            if _ACTIVE is None:
+                _ACTIVE = NULL_TRACER
+            return _ACTIVE
+        if isinstance(_ACTIVE, SpanTracer) and _ACTIVE.path == effective:
+            return _ACTIVE
+        _ACTIVE = SpanTracer(effective)
+        if not _ATEXIT_INSTALLED:
+            # flush whatever tracer is active when the process exits —
+            # a preemption drain's final events must land on disk
+            atexit.register(lambda: get_tracer().flush())
+            _ATEXIT_INSTALLED = True
+        return _ACTIVE
+
+
+def reset_tracer():
+    """Disarm (tests): subsequent get_tracer() is the null tracer unless
+    DS_TRACE re-arms it."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        _ACTIVE = NULL_TRACER
+
+
+def get_tracer():
+    """The active tracer; auto-configures from DS_TRACE on first use."""
+    if _ACTIVE is None:
+        return configure_tracer()
+    return _ACTIVE

@@ -14,10 +14,12 @@ from typing import Dict
 from deepspeed_tpu.utils.logging import log_dist
 
 
-def get_caller_func(frames_back: int = 2) -> str:
-    import sys
-    f = sys._getframe(frames_back)
-    return f.f_code.co_name
+# NOTE: the reference's ``get_caller_func`` (a ``sys._getframe`` walk to
+# guess the op name from the call stack) is gone on purpose (ISSUE 4
+# satellite): every logging entry point takes the op name explicitly —
+# ``append(op_name, ...)`` / ``append_inside_jit(op_name, ...)`` — so
+# inlining, decorators, or a different wrapper depth can never mislabel
+# an op's traffic.
 
 
 def convert_size(size_bytes: int) -> str:
@@ -75,10 +77,28 @@ class CommsLogger:
             return
         self.append(op_name, size, 0.0)
 
-    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+    def to_events(self, step: int):
+        """Per-op summary as monitor events (ISSUE 4 satellite: the
+        summary feeds the monitor sinks, not just the log): calls,
+        total bytes, and total time per op under ``comms/<op>/...``."""
+        events = []
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            count = sum(rec[0] for rec in sizes.values())
+            vol = sum(size * rec[0] for size, rec in sizes.items())
+            t = sum(rec[1] for rec in sizes.values())
+            events += [(f"comms/{op_name}/calls", float(count), step),
+                       (f"comms/{op_name}/total_bytes", float(vol), step),
+                       (f"comms/{op_name}/total_time_ms",
+                        round(t * 1e3, 3), step)]
+        return events
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False,
+                monitor=None, step: int = 0):
         """Summary table (reference CommsLogger.log_all, comm/comm.py:422);
         with ``show_straggler``, per-op wait times are min-reduced across
-        ranks and the difference is reported as straggler effect."""
+        ranks and the difference is reported as straggler effect.  With
+        ``monitor``, the per-op summary also lands in the sink as
+        ``comms/...`` events at ``step``."""
         lines = ["Comms summary:",
                  f"{'op':<16}{'calls':>8}{'total volume':>16}{'total time':>14}"]
         min_times = {}
@@ -116,6 +136,11 @@ class CommsLogger:
                 straggle = t - float(min_times.get(op_name, t))
                 line += f"{straggle * 1e3:>10.2f}ms"
             lines.append(line)
+        if monitor is not None:
+            monitor.write_events(self.to_events(step))
         if print_log:
             log_dist("\n".join(lines), ranks=[0])
         return self.comms_dict
+
+    #: reference-API name (deepspeed.comm.log_summary calls through)
+    log_summary = log_all

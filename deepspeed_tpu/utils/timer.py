@@ -34,20 +34,29 @@ def _sync(obj=None):
 
 
 class SynchronizedWallClockTimer:
-    """Named timers with optional device synchronisation."""
+    """Named timers with optional device synchronisation.
+
+    With a span tracer attached (``attach_tracer``), every timer window
+    doubles as a Chrome-trace span named ``timer/<name>`` — the
+    fwd/bwd/step phase timers become trace phases for free
+    (deepspeed_tpu/telemetry/tracing.py; docs monitoring-profiling.md).
+    """
 
     class Timer:
-        def __init__(self, name: str):
+        def __init__(self, name: str, tracer=None):
             self.name_ = name
             self.started_ = False
             self.start_time = 0.0
             self.elapsed_ = 0.0
             self.count = 0
+            self.tracer = tracer
 
         def start(self):
             if self.started_:
                 return
             self.started_ = True
+            if self.tracer is not None:
+                self.tracer.begin(f"timer/{self.name_}", cat="timer")
             self.start_time = time.time()
 
         def stop(self, reset: bool = False, sync_obj=None):
@@ -55,6 +64,8 @@ class SynchronizedWallClockTimer:
                 return
             _sync(sync_obj)
             elapsed = time.time() - self.start_time
+            if self.tracer is not None:
+                self.tracer.end(f"timer/{self.name_}")
             if reset:
                 self.elapsed_ = elapsed
             else:
@@ -83,10 +94,18 @@ class SynchronizedWallClockTimer:
 
     def __init__(self):
         self.timers = OrderedDict()
+        self.tracer = None
+
+    def attach_tracer(self, tracer):
+        """Mirror every timer window as a trace span (telemetry layer);
+        existing timers pick the tracer up too."""
+        self.tracer = tracer
+        for t in self.timers.values():
+            t.tracer = tracer
 
     def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = self.Timer(name, tracer=self.tracer)
         return self.timers[name]
 
     def has(self, name: str) -> bool:

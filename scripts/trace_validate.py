@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Validate a Chrome-trace/Perfetto JSON file (ISSUE 4 CI tooling).
+
+Asserts the schema contract ``deepspeed_tpu.telemetry.tracing`` emits —
+and that chrome://tracing / ui.perfetto.dev require to render a file at
+all:
+
+- top level is ``{"traceEvents": [...]}`` (or a bare event array);
+- every event carries name/ph/ts/pid/tid; ``ph`` is one of B E X i I C M;
+- timestamps are numeric, >= 0, and globally sorted non-decreasing
+  (the tracer sorts on flush — an unsorted file means a merge bug);
+- ``X`` (complete) events carry a numeric ``dur`` >= 0;
+- ``B``/``E`` pairs balance LIFO per (pid, tid), with matching names;
+- ``args``, when present, is an object.
+
+Usage::
+
+    python scripts/trace_validate.py /tmp/ds_trace.json
+    python scripts/trace_validate.py --require-corr trace.json
+
+Exit 0 = valid; 1 = schema violations (printed one per line).  The
+tier-1 telemetry test runs ``validate()`` against a trace produced by a
+toy train + serve session.
+"""
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+REQUIRED_FIELDS = ("name", "ph", "ts", "pid", "tid")
+ALLOWED_PH = {"B", "E", "X", "i", "I", "C", "M"}
+
+
+def load_events(path: str) -> List[Dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+        return doc["traceEvents"]
+    raise ValueError("top level must be an event array or an object with "
+                     "a traceEvents array")
+
+
+def validate_events(events: List[Dict]) -> List[str]:
+    errors: List[str] = []
+    if not events:
+        return ["trace contains no events"]
+    last_ts = None
+    stacks: Dict[tuple, List[str]] = {}
+    for i, ev in enumerate(events):
+        where = f"event {i}"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        missing = [f for f in REQUIRED_FIELDS if f not in ev]
+        if missing:
+            errors.append(f"{where} ({ev.get('name')!r}): missing "
+                          f"required fields {missing}")
+            continue
+        ph = ev["ph"]
+        if ph not in ALLOWED_PH:
+            errors.append(f"{where} ({ev['name']!r}): unknown phase {ph!r}")
+        ts = ev["ts"]
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"{where} ({ev['name']!r}): bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(f"{where} ({ev['name']!r}): ts {ts} < previous "
+                          f"{last_ts} — events not sorted")
+        last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where} ({ev['name']!r}): X event needs "
+                              f"numeric dur >= 0, got {dur!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where} ({ev['name']!r}): args must be an "
+                          "object")
+        key = (ev["pid"], ev["tid"])
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev["name"])
+        elif ph == "E":
+            stack = stacks.get(key, [])
+            if not stack:
+                errors.append(f"{where}: E {ev['name']!r} with no open "
+                              f"span on {key}")
+            elif stack[-1] != ev["name"]:
+                errors.append(f"{where}: E {ev['name']!r} does not match "
+                              f"open span {stack[-1]!r} on {key}")
+                stack.pop()
+            else:
+                stack.pop()
+    for key, stack in stacks.items():
+        if stack:
+            errors.append(f"unclosed spans on {key}: {stack}")
+    return errors
+
+
+def validate(path: str, require_corr: bool = False) -> List[str]:
+    try:
+        events = load_events(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return [f"cannot load {path}: {e}"]
+    errors = validate_events(events)
+    if require_corr and not errors:
+        corrs = {ev.get("args", {}).get("corr") for ev in events
+                 if isinstance(ev, dict) and isinstance(ev.get("args"),
+                                                        dict)}
+        corrs.discard(None)
+        if not corrs:
+            errors.append("--require-corr: no event carries a correlation "
+                          "id (args.corr)")
+    return errors
+
+
+def summarize(events: List[Dict]) -> str:
+    spans = sum(1 for e in events if e.get("ph") == "B")
+    instants = sum(1 for e in events if e.get("ph") in ("i", "I"))
+    corrs = {e.get("args", {}).get("corr") for e in events
+             if isinstance(e.get("args"), dict)}
+    corrs.discard(None)
+    cats = sorted({e.get("cat", "") for e in events if e.get("cat")})
+    return (f"{len(events)} events | {spans} spans | {instants} instants "
+            f"| {len(corrs)} correlation ids | cats: {', '.join(cats)}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace_validate",
+        description="assert Chrome-trace schema on a DS_TRACE output file")
+    p.add_argument("path")
+    p.add_argument("--require-corr", action="store_true",
+                   help="also fail when no event carries args.corr")
+    p.add_argument("-q", "--quiet", action="store_true")
+    args = p.parse_args(argv)
+    errors = validate(args.path, require_corr=args.require_corr)
+    if errors:
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"OK {args.path}: {summarize(load_events(args.path))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

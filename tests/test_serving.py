@@ -347,7 +347,12 @@ def test_http_server_end_to_end(served):
             assert health["status"] == "ready"   # ISSUE 3 health machine
         with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
             text = resp.read().decode()
-            assert "serving_completed 1.0" in text
+            assert "serving_completed 1" in text
+            # ISSUE 4: /metrics is Prometheus text with latency
+            # histogram buckets + quantile gauges
+            assert "# TYPE serving_ttft_s histogram" in text
+            assert 'serving_ttft_s_bucket{le="+Inf"} 1' in text
+            assert "serving_ttft_p50_ms" in text
     finally:
         httpd.shutdown()
         loop.shutdown()
