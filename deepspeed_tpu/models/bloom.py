@@ -229,7 +229,13 @@ def _serving_fns(config: BloomConfig):
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads, alibi_slopes=slopes)
 
-    return init_cache_fn, prefill_fn, decode_fn
+    def verify_fn(p, t, c, l):
+        return serving.verify_window(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads, alibi_slopes=slopes)
+
+    return init_cache_fn, prefill_fn, decode_fn, verify_fn
 
 
 def bloom_model(size: str = "tiny", **overrides) -> Model:
@@ -245,6 +251,7 @@ def bloom_model(size: str = "tiny", **overrides) -> Model:
         flops_per_token=6.0 * n_params,
         meta={"name": f"bloom-{size}", "n_params": n_params,
               "supports_random_ltd": True, "supports_pld": True},
-        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn",
+                    "verify_fn"),
                    _serving_fns(config))),
     )

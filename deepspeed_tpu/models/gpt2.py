@@ -428,6 +428,59 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
     return logits, {"k": kc, "v": vc}
 
 
+def verify_window(params, tokens, cache, lengths, config: GPT2Config,
+                  sm_scale=None, min_pos_fn=None):
+    """Speculative-decoding verification (serving/spec): score a W-token
+    window at positions ``lengths .. lengths+W-1`` with ONE weight pass
+    per layer — the QKV/MLP/head projections run once over all W
+    positions, and each position attends causally via the same
+    ``decode_attention`` kernel ``decode_step`` uses, so position j's
+    logits match a sequential decode chain's exactly.  Returns
+    (logits [B, W, V], cache).  ``sm_scale``/``min_pos_fn`` are the
+    GPT-Neo hooks (unscaled scores, per-layer sliding-window floor)."""
+    from deepspeed_tpu.models.serving import qgemm_active, write_token
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, quantize_kv)
+    B, W = tokens.shape
+    dtype = jnp.dtype(config.dtype)
+    positions = lengths[:, None] + jnp.arange(W)[None, :]   # [B, W]
+    x = (params["wte"].astype(dtype)[tokens] +
+         params["wpe"].astype(dtype)[positions])            # [B, W, D]
+    quantized = "k_s" in cache
+    keep_q = qgemm_active(params["blocks"])
+    kc, vc = cache["k"], cache["v"]
+    ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
+    for l in range(config.num_layers):
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
+                             keep_quantized=keep_q)
+        q, kk, v = _block_qkv(x, layer, config)
+        attn_cols = []
+        for j in range(W):
+            if quantized:
+                kq, ks1 = quantize_kv(kk[:, j])
+                vq, vs1 = quantize_kv(v[:, j])
+                kc = write_token(kc, l, kq, lengths + j)
+                vc = write_token(vc, l, vq, lengths + j)
+                ksc = write_token(ksc, l, ks1, lengths + j)
+                vsc = write_token(vsc, l, vs1, lengths + j)
+            else:
+                kc = write_token(kc, l, kk[:, j], lengths + j)
+                vc = write_token(vc, l, v[:, j], lengths + j)
+            attn_cols.append(decode_attention(
+                q[:, j], kc[l], vc[l], lengths + j + 1, sm_scale=sm_scale,
+                k_scale=ksc[l] if quantized else None,
+                v_scale=vsc[l] if quantized else None,
+                min_pos=(min_pos_fn(jnp.int32(l), lengths + j)
+                         if min_pos_fn is not None else None)))
+        attn = jnp.stack(attn_cols, axis=1)                 # [B, W, H, hd]
+        x = _block_finish(x, attn.reshape(B, W, -1).astype(x.dtype),
+                          layer, config)
+    logits = head(params, x, config)                        # [B, W, V]
+    if quantized:
+        return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
+    return logits, {"k": kc, "v": vc}
+
+
 def count_params(config: GPT2Config) -> int:
     D, V, S, L, M = (config.d_model, config.vocab_size, config.max_seq_len,
                      config.num_layers, config.d_mlp)
@@ -471,4 +524,5 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         init_cache_fn=lambda bs, ml, dtype=None: init_cache(config, bs, ml, dtype),
         prefill_fn=lambda p, b, c: prefill(p, b, c, config),
         decode_fn=lambda p, t, c, l: decode_step(p, t, c, l, config),
+        verify_fn=lambda p, t, c, l: verify_window(p, t, c, l, config),
     )

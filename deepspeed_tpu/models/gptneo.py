@@ -155,6 +155,8 @@ def _serving_fns(config: GPTNeoConfig):
         lambda p, b, c: _g.prefill(p, b, c, g2, attn_fn=attn_fn),
         lambda p, t, c, l: _g.decode_step(p, t, c, l, g2, sm_scale=1.0,
                                           min_pos_fn=min_pos_fn),
+        lambda p, t, c, l: _g.verify_window(p, t, c, l, g2, sm_scale=1.0,
+                                            min_pos_fn=min_pos_fn),
     )
 
 
@@ -184,6 +186,7 @@ def gptneo_model(size: str = "tiny", **overrides) -> Model:
         flops_per_token=6.0 * n_params,
         meta={"name": f"gptneo-{size}", "n_params": n_params,
               "sparse_grad_params": {"wte": "input_ids"}},
-        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn",
+                    "verify_fn"),
                    _serving_fns(config))),
     )

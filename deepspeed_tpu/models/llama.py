@@ -294,7 +294,13 @@ def _serving_fns(config: LlamaConfig):
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads)
 
-    return init_cache_fn, prefill_fn, decode_fn
+    def verify_fn(p, t, c, l):
+        return serving.verify_window(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
+
+    return init_cache_fn, prefill_fn, decode_fn, verify_fn
 
 
 def count_params(config: LlamaConfig) -> int:
@@ -335,6 +341,7 @@ def llama_model(size: str = "7b", **overrides) -> Model:
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),
-        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn",
+                    "verify_fn"),
                    _serving_fns(config))),
     )

@@ -222,7 +222,13 @@ def _serving_fns(config: MixtralConfig):
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads)
 
-    return init_cache_fn, prefill_fn, decode_fn
+    def verify_fn(p, t, c, l):
+        return serving.verify_window(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
+
+    return init_cache_fn, prefill_fn, decode_fn, verify_fn
 
 
 def count_params(config: MixtralConfig) -> int:
@@ -258,6 +264,7 @@ def mixtral_model(size: str = "8x7b", **overrides) -> Model:
         flops_per_token=6.0 * active,
         meta={"name": f"mixtral-{size}", "n_params": n_params,
               "active_params": active},
-        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn",
+                    "verify_fn"),
                    _serving_fns(config))),
     )

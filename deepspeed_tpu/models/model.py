@@ -318,6 +318,12 @@ class Model:
     init_cache_fn: Optional[Callable] = None
     prefill_fn: Optional[Callable] = None
     decode_fn: Optional[Callable] = None
+    #: verify_fn(params, tokens [B,W], cache, lengths [B]) ->
+    #: (logits [B,W,V], cache): speculative-decoding verification —
+    #: score a W-token window at positions lengths..lengths+W-1 with ONE
+    #: weight pass per layer (serving/spec).  Optional; the spec
+    #: verifier falls back to a scan of decode_fn when absent.
+    verify_fn: Optional[Callable] = None
 
     def __post_init__(self):
         if self.loss_fn is None and self.apply_fn is not None:

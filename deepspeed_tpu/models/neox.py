@@ -244,7 +244,13 @@ def _serving_fns(config: NeoXConfig):
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads)
 
-    return init_cache_fn, prefill_fn, decode_fn
+    def verify_fn(p, t, c, l):
+        return serving.verify_window(
+            p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
+            finish_fn=finish_fn, head_fn=head_fn,
+            num_heads=config.num_heads)
+
+    return init_cache_fn, prefill_fn, decode_fn, verify_fn
 
 
 def neox_model(size: str = "tiny", **overrides) -> Model:
@@ -261,6 +267,7 @@ def neox_model(size: str = "tiny", **overrides) -> Model:
         meta={"name": f"neox-{size}", "n_params": n_params,
               "supports_random_ltd": True, "supports_pld": True,
               "sparse_grad_params": {"wte": "input_ids"}},
-        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn"),
+        **dict(zip(("init_cache_fn", "prefill_fn", "decode_fn",
+                    "verify_fn"),
                    _serving_fns(config))),
     )

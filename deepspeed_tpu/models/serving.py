@@ -307,6 +307,66 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     return logits, {"k": kc, "v": vc}
 
 
+def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
+                  finish_fn, head_fn, num_heads, alibi_slopes=None):
+    """Speculative-decoding verification: score a ``W``-token window in
+    ONE weight pass per layer (the whole point of speculation — k+1
+    drafted positions amortize a single stream of the layer weights
+    where sequential decode would stream them k+1 times).
+
+    ``tokens`` [B, W] occupy positions ``lengths .. lengths+W-1``; their
+    KV vectors are written into the cache as the window proceeds, and
+    each window position j attends causally over ``lengths+j+1`` valid
+    positions via the same ``decode_attention`` kernel plain decode uses
+    — so the logits for position j are exactly what a sequential
+    ``decode_step`` chain would have produced (greedy spec parity rides
+    on this).  Returns (logits [B, W, V], cache).
+
+    No lax.scan variant: verification is one projection matmul over W
+    positions per layer, and spec mode is a latency lever for serving —
+    the big-int8 scan defense stays a plain-decode concern."""
+    from deepspeed_tpu.models.model import maybe_stream
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, quantize_kv)
+    B, W = tokens.shape
+    H = num_heads
+    x = embed_fn(params, tokens)                            # [B, W, D]
+    quantized = "k_s" in cache
+    keep_q = qgemm_active(params["blocks"])
+    kc, vc = cache["k"], cache["v"]
+    ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
+    positions = lengths[:, None] + jnp.arange(W)[None, :]   # [B, W]
+    L = kc.shape[0]
+    for l in range(L):
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
+                             keep_quantized=keep_q)
+        q, kk, v = qkv_fn(x, layer, positions)
+        hd = q.shape[-1]
+        attn_cols = []
+        for j in range(W):
+            if quantized:
+                kq, ks1 = quantize_kv(kk[:, j])
+                vq, vs1 = quantize_kv(v[:, j])
+                kc = write_token(kc, l, kq, lengths + j)
+                vc = write_token(vc, l, vq, lengths + j)
+                ksc = write_token(ksc, l, ks1, lengths + j)
+                vsc = write_token(vsc, l, vs1, lengths + j)
+            else:
+                kc = write_token(kc, l, kk[:, j], lengths + j)
+                vc = write_token(vc, l, v[:, j], lengths + j)
+            attn_cols.append(decode_attention(
+                q[:, j], kc[l], vc[l], lengths + j + 1,
+                k_scale=ksc[l] if quantized else None,
+                v_scale=vsc[l] if quantized else None,
+                alibi_slopes=alibi_slopes))
+        attn = jnp.stack(attn_cols, axis=1)                 # [B, W, H, hd]
+        x = finish_fn(x, attn.reshape(B, W, H * hd).astype(x.dtype), layer)
+    logits = head_fn(params, x)                             # [B, W, V]
+    if quantized:
+        return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
+    return logits, {"k": kc, "v": vc}
+
+
 def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
                      head_fn, num_heads, alibi_slopes=None):
     """lax.scan decode body for LARGE int8-quantized models: scan

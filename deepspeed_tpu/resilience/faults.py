@@ -15,7 +15,8 @@ key; specs separated by ``;`` or whitespace)::
     site:action[=param]@when
 
     site    dotted hook name: ckpt.save ckpt.aux ckpt.manifest
-            ckpt.publish ckpt.latest train.step serve.step kv.alloc ...
+            ckpt.publish ckpt.latest train.step serve.step serve.spec
+            kv.alloc ...
     action  raise      raise FaultInjected at the site
             kill       os._exit(param or 1) — a hard crash, no cleanup
             sigterm    deliver SIGTERM to this process (preemption)
@@ -35,6 +36,8 @@ Examples::
     DS_FAULTS="train.step:kill=9@5"           # hard-kill at step 5
     DS_FAULTS="serve.step:stall=0.2@3+"       # slow loop from step 3
     DS_FAULTS="kv.alloc:deny@*"               # pool always exhausted
+    DS_FAULTS="serve.spec:deny@*"             # spec verify degrades to
+                                              # plain decode every step
 """
 import hashlib
 import os

@@ -366,6 +366,55 @@ class TelemetryConfig(DeepSpeedConfigModel):
                 "(0 = auto-detect)")
 
 
+class SpecDecodeConfig(DeepSpeedConfigModel):
+    """``serving.spec`` — speculative decoding (ISSUE 5): a proposer
+    drafts up to ``max_draft_tokens`` per request per iteration, the
+    target model verifies the whole window in one weight pass, and
+    rejected suffixes roll back through the paged block tables."""
+    #: off | ngram (prompt-lookup self-drafting, no second model) |
+    #: draft (a smaller checkpoint sharing the tokenizer — the scheduler
+    #: needs a DraftModelProposer handed in, see bin/ds_serve --spec)
+    mode: str = "off"
+    #: per-request draft-length cap k; each verify window scores k+1
+    #: positions (the drafts plus one bonus token from the verify logits)
+    max_draft_tokens: int = 4
+    #: per-request auto-disable: once a request's rolling acceptance-rate
+    #: EMA sits below this after a few verify passes, it decodes plain
+    #: for the rest of its life (0 = never disable)
+    min_accept_rate: float = 0.0
+    #: prompt-lookup n-gram sizes: match the last n tokens (longest
+    #: first) against the request's own prompt+output history
+    ngram_max: int = 3
+    ngram_min: int = 1
+    #: draft-model arch:size spec for ds_serve --spec draft
+    draft_model: Optional[str] = None
+    #: draft proposer's own (small) paged KV pool
+    draft_num_blocks: int = 64
+    draft_block_size: int = 16
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.mode not in ("off", "ngram", "draft"):
+            raise ValueError(f"serving.spec.mode={self.mode!r}: choose "
+                             "off | ngram | draft")
+        if self.max_draft_tokens < 1:
+            raise ValueError("serving.spec.max_draft_tokens="
+                             f"{self.max_draft_tokens}: must be >= 1")
+        if not 0.0 <= self.min_accept_rate <= 1.0:
+            raise ValueError("serving.spec.min_accept_rate="
+                             f"{self.min_accept_rate}: must be in [0, 1]")
+        if self.ngram_min < 1 or self.ngram_max < self.ngram_min:
+            raise ValueError(
+                f"serving.spec ngram sizes min={self.ngram_min} "
+                f"max={self.ngram_max}: need 1 <= min <= max")
+        if self.draft_num_blocks < 2:
+            raise ValueError("serving.spec.draft_num_blocks="
+                             f"{self.draft_num_blocks}: need >= 2")
+        if self.draft_block_size < 1:
+            raise ValueError("serving.spec.draft_block_size="
+                             f"{self.draft_block_size}: must be >= 1")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -409,9 +458,15 @@ class ServingConfig(DeepSpeedConfigModel):
     #: consecutive serving-loop step() failures before the server goes
     #: DEGRADED instead of retrying forever; 0 = never degrade
     max_loop_failures: int = 8
+    #: speculative decoding sub-section (dict in JSON; validated into a
+    #: SpecDecodeConfig below — nested pydantic construction would skip
+    #: the sub-config's __init__ validation)
+    spec: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
+        if not isinstance(self.spec, SpecDecodeConfig):
+            self.spec = SpecDecodeConfig(**(self.spec or {}))
         if self.block_size < 1:
             raise ValueError(f"serving.block_size={self.block_size}: "
                              "must be >= 1")

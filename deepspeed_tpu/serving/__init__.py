@@ -15,15 +15,20 @@ kernel, `InferenceEngine` prefill/decode fns):
   preempts (recompute-on-resume) under pool pressure
 - `server.py`    — stdlib HTTP front-end (/generate, /healthz, /metrics)
   driving the scheduler on a background thread (bin/ds_serve)
+- `spec/`        — speculative decoding (ISSUE 5): ngram/draft-model
+  proposers, one-weight-pass window verification, paged-KV rollback
 """
 from deepspeed_tpu.serving.request import (RequestState, SamplingParams,
                                            ServeRequest, AdmissionError,
                                            QueueFullError, RequestTooLongError)
 from deepspeed_tpu.serving.block_manager import BlockManager
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.serving.spec import (DraftModelProposer, NgramProposer,
+                                        Proposer)
 
 __all__ = [
     "RequestState", "SamplingParams", "ServeRequest",
     "AdmissionError", "QueueFullError", "RequestTooLongError",
     "BlockManager", "ContinuousBatchingScheduler",
+    "Proposer", "NgramProposer", "DraftModelProposer",
 ]

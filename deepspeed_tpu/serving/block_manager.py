@@ -76,9 +76,59 @@ class BlockManager:
         return self._tables.get(request_id, [])
 
     def free(self, request_id: int):
-        """Return every block of the request to the pool (retire/evict)."""
+        """Return every block of the request to the pool (retire/evict).
+        Idempotent: a second free of the same request is a no-op, never a
+        double-free (the table was popped the first time)."""
         for b in self._tables.pop(request_id, []):
             self._free.append(b)
+
+    def truncate(self, request_id: int, num_tokens: int) -> int:
+        """Speculative-decoding rollback: shrink the request's table to
+        the blocks covering ``num_tokens`` positions, returning every
+        whole now-unused block to the free list.  Positions beyond the
+        kept range may hold stale (rejected-draft) KV vectors — the
+        decode kernel's length masking never reads past the row's fill
+        count, and the next writes overwrite them.  Returns the number
+        of blocks freed; unknown requests are a no-op (the request may
+        have retired/evicted — its table is already gone)."""
+        table = self._tables.get(request_id)
+        if not table:
+            return 0
+        keep = self.blocks_for_tokens(num_tokens)
+        if keep >= len(table):
+            return 0
+        freed = table[keep:]
+        del table[keep:]
+        self._free.extend(freed)
+        return len(freed)
+
+    def check_invariant(self):
+        """Allocation-accounting invariant (ISSUE 5 satellite): every
+        non-trash block is on the free list XOR on exactly one table —
+        ``free + live == num_blocks - 1`` with no duplicates.  Raises
+        AssertionError with the discrepancy; the scheduler asserts this
+        per step in debug runs so a shrink-then-regrow cycle that
+        double-frees or leaks fails loudly at the step that broke it."""
+        live = [b for t in self._tables.values() for b in t]
+        free = self._free
+        if len(set(live)) != len(live):
+            raise AssertionError(
+                f"block accounting: duplicate block in tables ({live})")
+        if len(set(free)) != len(free):
+            raise AssertionError(
+                f"block accounting: duplicate block on free list ({free})")
+        overlap = set(live) & set(free)
+        if overlap:
+            raise AssertionError(
+                f"block accounting: blocks both live and free: {overlap}")
+        if self.TRASH_BLOCK in live or self.TRASH_BLOCK in free:
+            raise AssertionError("block accounting: trash block 0 leaked "
+                                 "into the allocatable set")
+        if len(free) + len(live) != self.num_blocks - 1:
+            raise AssertionError(
+                f"block accounting: free({len(free)}) + live({len(live)}) "
+                f"!= {self.num_blocks - 1} (leak or double-free)")
+        return True
 
     # ---------------------------------------------------------- addressing
     def position_index(self, request_id: int, pos: int) -> int:

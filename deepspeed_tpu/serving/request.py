@@ -83,6 +83,13 @@ class ServeRequest:
     done: threading.Event = field(default_factory=threading.Event,
                                   repr=False)
 
+    # -- speculative-decoding state (serving/spec; survives eviction —
+    # a request's speculatability is a property of its content) --------
+    spec_k: int = 0                 #: adaptive draft length (0 = unset)
+    spec_passes: int = 0            #: verify passes that carried a draft
+    spec_accept_ema: float = -1.0   #: rolling acceptance rate (-1 = none)
+    spec_disabled: bool = False     #: min_accept_rate tripped
+
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
         if self.prompt_ids.size == 0:

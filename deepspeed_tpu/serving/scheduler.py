@@ -30,6 +30,7 @@ position)`` — preemption-stable, but deliberately NOT the static engine's
 batch-coupled rng chain.
 """
 import collections
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -38,7 +39,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.sampling import NEG_INF
 from deepspeed_tpu.serving.block_manager import BlockManager
 from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
                                            RequestState, RequestTooLongError,
@@ -50,29 +50,25 @@ def _round_up(n: int, q: int) -> int:
     return -(-n // q) * q
 
 
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def _sample_rows(logits, seeds, positions, temps, top_ks, top_ps, do_flags,
                  any_sampling: bool):
     """Per-row sampling with traced per-request params.  ``positions``
     keys the rng per (seed, absolute token index) so an evicted-and-
-    resumed request reproduces its stream exactly."""
+    resumed request reproduces its stream exactly.  The temperature /
+    top-k / top-p pipeline lives in ``spec/verifier.py`` so speculative
+    rejection sampling draws from the SAME distribution."""
+    from deepspeed_tpu.serving.spec.verifier import process_sampling_logits
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if not any_sampling:                    # static: all-greedy steps skip
         return greedy                       # the sort entirely
-    V = logits.shape[-1]
-    x = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
-    # top-k with per-row k (0 = off): threshold at the kth largest
-    sorted_desc = -jnp.sort(-x, axis=-1)
-    kth = jnp.take_along_axis(
-        sorted_desc, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
-    x = jnp.where((top_ks[:, None] > 0) & (x < kth), NEG_INF, x)
-    # top-p with per-row p (>=1 = off), on the top-k-masked logits
-    sorted_desc = -jnp.sort(-x, axis=-1)
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_ps[:, None]
-    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
-                     keepdims=True)
-    x = jnp.where(x < thresh, NEG_INF, x)
+    x = process_sampling_logits(logits, temps, top_ks, top_ps)
     keys = jax.vmap(lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
                     )(seeds, positions)
     sampled = jax.vmap(jax.random.categorical)(keys, x).astype(jnp.int32)
@@ -95,7 +91,7 @@ class ServingMetrics:
                       ("serving/latency_s", "latency"),
                       ("serving/queue_wait_s", "queue_wait"))
 
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, max_accept_len: int = 17):
         from deepspeed_tpu.telemetry import (COUNT_BUCKETS, MetricsRegistry,
                                              OCCUPANCY_BUCKETS)
         #: isolated per scheduler by default; ds_serve passes the
@@ -113,6 +109,13 @@ class ServingMetrics:
                                               buckets=OCCUPANCY_BUCKETS)
         self.prefill_batch_tokens = reg.histogram(
             "serving/prefill_batch_tokens", buckets=COUNT_BUCKETS)
+        # tokens emitted per verify pass per speculating request
+        # (accepted drafts + the bonus token) — ISSUE 5; unit-granular
+        # buckets sized to the configured cap (max_draft_tokens + 1) so
+        # high-k workloads never collapse into +Inf
+        self.spec_accept_len = reg.histogram(
+            "serve/spec_accept_len",
+            buckets=tuple(range(1, max(max_accept_len, 2) + 1)))
 
     def observe_finished(self, req: ServeRequest):
         self.counters["completed"] += 1
@@ -130,6 +133,20 @@ class ServingMetrics:
     def _hist(self, name: str):
         return self.registry.histogram(name)
 
+    def _spec_accept_gauges(self) -> Dict[str, float]:
+        """serve/spec_accept_len quantiles + mean, in raw token units
+        (ISSUE 5: the /metrics surface the adaptive-k dashboards read)."""
+        h = self.spec_accept_len
+        out: Dict[str, float] = {}
+        vals = h.quantiles(tuple(q for q, _tag in self._QUANTILES))
+        if vals is None:
+            return out
+        for (_q, tag), v in zip(self._QUANTILES, vals):
+            out[f"serve/spec_accept_len_{tag}"] = round(v, 3)
+        if h.count:
+            out["serve/spec_accept_len_mean"] = round(h.sum / h.count, 3)
+        return out
+
     def snapshot(self) -> Dict[str, float]:
         out = {f"serving/{k}": float(v) for k, v in self.counters.items()}
         out.update({f"serving/{k}": float(v)
@@ -141,6 +158,7 @@ class ServingMetrics:
                 continue
             for (_q, tag), v in zip(self._QUANTILES, vals):
                 out[f"serving/{stem}_{tag}_ms"] = round(v * 1e3, 3)
+        out.update(self._spec_accept_gauges())
         return out
 
     def to_events(self, step: int):
@@ -164,6 +182,8 @@ class ServingMetrics:
             for (_q, tag), v in zip(self._QUANTILES, vals):
                 self.registry.set_gauge(
                     f"serving/{stem}_{tag}_ms", round(v * 1e3, 3))
+        for name, value in self._spec_accept_gauges().items():
+            self.registry.set_gauge(name, value)
         return self.registry.render_prometheus()
 
 
@@ -180,7 +200,8 @@ class ContinuousBatchingScheduler:
     PROMPT_BUCKET = 16          # prefill compile count = distinct buckets
 
     def __init__(self, model, params, config, kv_cache_dtype=None,
-                 monitor=None, injector=None, registry=None):
+                 monitor=None, injector=None, registry=None,
+                 proposer=None):
         if (model.init_cache_fn is None or model.prefill_fn is None
                 or model.decode_fn is None):
             raise ValueError("model does not expose the KV-cache serving "
@@ -219,19 +240,50 @@ class ContinuousBatchingScheduler:
         self.s_pad = _round_up(self.max_model_len, 64)
         self.blocks_per_table = -(-self.s_pad // bs)
 
+        #: per-step block-accounting invariant check (O(num_blocks) under
+        #: the scheduler lock — a debug aid, not a production default);
+        #: the spec test suite arms it for every scheduler it builds
+        self._debug_invariant = bool(int(
+            os.environ.get("DS_SERVE_DEBUG", "0") or 0))
         self._lock = threading.RLock()
         self._queue: List[ServeRequest] = []
         self._slots: List[Optional[ServeRequest]] = \
             [None] * config.max_num_seqs
         self._next_id = 0
         self._step_count = 0
-        self.metrics = ServingMetrics(registry=self._telemetry_registry)
+        self.metrics = ServingMetrics(
+            registry=self._telemetry_registry,
+            max_accept_len=getattr(getattr(config, "spec", None),
+                                   "max_draft_tokens", 16) + 1)
         self._serve_t0 = time.monotonic()   # tokens/s accounting window
         self._prefill_fns = {}
         self._decode_fns = {}
         self._sample1_fns = {}
+        self._verify_fns = {}
         self._finished_this_step: List[ServeRequest] = []
+        # --- speculative decoding (ISSUE 5): resolve the proposer from
+        # serving.spec.mode; an explicit proposer wins (and implies spec
+        # on even when the config section says off — test/bench intent)
+        self.proposer = self._resolve_proposer(proposer)
         self.pool = self._init_pool()
+
+    def _resolve_proposer(self, proposer):
+        spec = getattr(self.cfg, "spec", None)
+        mode = getattr(spec, "mode", "off") if spec is not None else "off"
+        if proposer is not None:
+            return proposer
+        if mode == "off":
+            return None
+        if mode == "ngram":
+            from deepspeed_tpu.serving.spec import NgramProposer
+            return NgramProposer(ngram_max=spec.ngram_max,
+                                 ngram_min=spec.ngram_min)
+        # draft mode needs a model+params pair the scheduler cannot
+        # conjure — bin/ds_serve builds the DraftModelProposer from
+        # serving.spec.draft_model
+        raise ValueError(
+            "serving.spec.mode='draft' needs a DraftModelProposer passed "
+            "as ContinuousBatchingScheduler(..., proposer=...)")
 
     # ------------------------------------------------------------- pool
     def _init_pool(self):
@@ -310,6 +362,52 @@ class ContinuousBatchingScheduler:
             self._decode_fns[key] = jax.jit(fn)
         return self._decode_fns[key]
 
+    def _verify_fn(self, W: int, any_sampling: bool):
+        """Speculative verify program (ISSUE 5): gather the pool dense,
+        score a ``W``-token window per row in ONE call to the model's
+        ``verify_fn`` (one weight pass per layer when the family wires
+        the native window scorer; a scan of ``decode_fn`` otherwise /
+        under DS_SPEC_VERIFY=scan), scatter the window's KV vectors back
+        (pad positions land in the trash block), and run the
+        accept/emit math on device.
+
+        Packing: ints [4 + 2W, B] — rows 0..W-1 window tokens (col 0 =
+        last committed token, then padded drafts), W: lengths, W+1:
+        draft_len, W+2: seeds, W+3: top_ks, W+4..: per-window-position
+        pool destinations; floats [2, B]: temps, top_ps."""
+        key = (W, any_sampling)
+        if key not in self._verify_fns:
+            from deepspeed_tpu.serving.spec.verifier import (accept_tokens,
+                                                             scan_verify_fn)
+            model = self.model
+            vf = model.verify_fn
+            if vf is None or os.environ.get("DS_SPEC_VERIFY") == "scan":
+                vf = scan_verify_fn(model.decode_fn)
+
+            def fn(params, pool, ints, floats, do_flags, pos_idx):
+                tokens = ints[:W].T                     # [B, W]
+                lengths = ints[W]
+                draft_len = ints[W + 1]
+                seeds, top_ks = ints[W + 2], ints[W + 3]
+                dests = ints[W + 4:]
+                temps, top_ps = floats[0], floats[1]
+                rows = jnp.arange(tokens.shape[0])
+                dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
+                logits, new_cache = vf(params, tokens, dense, lengths)
+                for j in range(W):
+                    vecs = jax.tree.map(
+                        lambda c: c[:, rows, lengths + j], new_cache)
+                    pool = jax.tree.map(
+                        lambda p, nv: p.at[:, dests[j]].set(nv),
+                        pool, vecs)
+                acc, out = accept_tokens(
+                    logits, tokens, draft_len, seeds, lengths + 1,
+                    temps, top_ks, top_ps, do_flags, any_sampling)
+                return acc, out, pool
+
+            self._verify_fns[key] = jax.jit(fn)
+        return self._verify_fns[key]
+
     # ----------------------------------------------------------- submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
                timeout_s: float = 0.0) -> ServeRequest:
@@ -387,6 +485,8 @@ class ContinuousBatchingScheduler:
     # -------------------------------------------------------- lifecycle
     def _retire(self, req: ServeRequest, state: RequestState,
                 reason: Optional[str] = None):
+        if self.proposer is not None:
+            self.proposer.release(req.request_id)
         self.block_mgr.free(req.request_id)
         if req.slot >= 0:
             self._slots[req.slot] = None
@@ -402,6 +502,8 @@ class ContinuousBatchingScheduler:
 
     def _evict(self, victim: ServeRequest):
         """Preempt: free blocks+slot, requeue for recompute-on-resume."""
+        if self.proposer is not None:
+            self.proposer.release(victim.request_id)
         self.block_mgr.free(victim.request_id)
         if victim.slot >= 0:
             self._slots[victim.slot] = None
@@ -583,6 +685,8 @@ class ContinuousBatchingScheduler:
                   and r.state == RequestState.DECODE]
         if not active:
             return
+        if self.proposer is not None and self._spec_decode(active):
+            return
         B = self.cfg.max_num_seqs
         bm = self.block_mgr
         k = self._choose_window(active)
@@ -627,6 +731,186 @@ class ContinuousBatchingScheduler:
                     self._retire(req, RequestState.FINISHED)
                     break
 
+    # --------------------------------------------- speculative decoding
+    #: verify passes with a draft before min_accept_rate can trip
+    SPEC_MIN_PASSES = 4
+
+    def _spec_budget(self, req: ServeRequest) -> int:
+        """Adaptive per-request draft length for this round (0 = don't
+        speculate: disabled, or too close to max_new for a draft plus
+        the bonus token to fit)."""
+        spec = self.cfg.spec
+        if req.spec_disabled or req.remaining_new_tokens <= 1:
+            return 0
+        if req.spec_k <= 0:
+            req.spec_k = spec.max_draft_tokens      # start optimistic
+        return min(req.spec_k, spec.max_draft_tokens,
+                   req.remaining_new_tokens - 1)
+
+    def _propose_drafts(self, active) -> Dict[int, np.ndarray]:
+        from deepspeed_tpu.telemetry import get_tracer
+        tracer = get_tracer()
+        bm = self.block_mgr
+        drafts: Dict[int, np.ndarray] = {}
+        for req in active:
+            k = self._spec_budget(req)
+            if k <= 0:
+                continue
+            with tracer.span("serve/draft", cat="serving",
+                             corr=f"req-{req.request_id}",
+                             args={"request_id": req.request_id, "k": k}):
+                d = np.asarray(self.proposer.propose(req, k),
+                               np.int32).reshape(-1)[:k]
+            if d.size == 0:
+                continue
+            # window writes reach position (seq-1)+len(d): all-or-nothing
+            # block growth, never preempting — a denied/exhausted pool
+            # just drops the draft and the row decodes plain in-window
+            last = int(req.all_token_ids.size) - 1 + int(d.size)
+            need = last // bm.block_size + 1 \
+                - len(bm.block_table(req.request_id))
+            if need > 0 and bm.allocate(req.request_id, need) is None:
+                continue
+            drafts[req.request_id] = d
+        return drafts
+
+    def _spec_decode(self, active) -> bool:
+        """One drafted-verify iteration: propose per row, score the whole
+        window in one verify pass, accept the longest valid prefix plus
+        the bonus token, roll rejected suffixes back through the block
+        tables.  Rows without a draft ride the same window as plain
+        single-step decode.  Returns False to fall back to the plain
+        (fused) decode path — nothing drafted this round, or a
+        ``serve.spec`` fault (raise/deny) fired BEFORE any KV write, so
+        degradation is always to a correct plain step."""
+        from deepspeed_tpu.resilience.faults import FaultInjected
+        drafts = self._propose_drafts(active)
+        if not drafts:
+            return False
+        bm = self.block_mgr
+        try:
+            denied = self.injector.deny("serve.spec")
+        except FaultInjected:
+            denied = True
+        if denied:
+            # degrade to plain decode for this step; hand back the
+            # window blocks the dropped drafts had reserved
+            self.metrics.counters["spec_faults"] += 1
+            for rid in drafts:
+                req = self._request_in_slot(rid)
+                if req is not None:
+                    bm.truncate(rid, int(req.all_token_ids.size))
+            return False
+        B = self.cfg.max_num_seqs
+        maxd = max(int(d.size) for d in drafts.values())
+        W = 1 + _pow2ceil(maxd)        # one compiled program per bucket
+        ints = np.zeros((4 + 2 * W, B), np.int32)
+        ints[W + 4:] = (np.arange(W) % bm.block_size)[:, None]  # trash
+        floats = np.ones((2, B), np.float32)
+        do_flags = np.zeros((B,), bool)
+        pos_idx = np.zeros((B, self.s_pad), np.int32)
+        offs = np.arange(self.s_pad) % bm.block_size
+        blk_of = np.arange(self.s_pad) // bm.block_size
+        for req in active:
+            b = req.slot
+            seq = req.all_token_ids
+            d = drafts.get(req.request_id)
+            nd = 0 if d is None else int(d.size)
+            table = np.zeros((self.blocks_per_table,), np.int64)
+            t = bm.block_table(req.request_id)
+            table[:len(t)] = t
+            pos_idx[b] = table[blk_of] * bm.block_size + offs
+            s = req.sampling
+            ints[0, b] = seq[-1]
+            if nd:
+                ints[1:1 + nd, b] = d
+            ints[W, b] = seq.size - 1
+            ints[W + 1, b] = nd
+            ints[W + 2, b], ints[W + 3, b] = s.seed & 0x7FFFFFFF, s.top_k
+            # real pool destinations for the last token + draft writes;
+            # pad window positions keep the trash pattern
+            for j in range(nd + 1):
+                ints[W + 4 + j, b] = bm.position_index(
+                    req.request_id, seq.size - 1 + j)
+            floats[0, b], floats[1, b] = s.temperature, s.top_p
+            do_flags[b] = s.do_sample
+        any_sampling = bool(do_flags.any())
+        acc, out, self.pool = self._verify_fn(W, any_sampling)(
+            self.params, self.pool, ints, floats, do_flags, pos_idx)
+        self.metrics.counters["spec_verify_steps"] += 1
+        self._apply_spec_result(active, drafts, np.asarray(acc),
+                                np.asarray(out))
+        return True
+
+    def _request_in_slot(self, request_id: int) -> Optional[ServeRequest]:
+        for r in self._slots:
+            if r is not None and r.request_id == request_id:
+                return r
+        return None
+
+    def _apply_spec_result(self, active, drafts, acc: np.ndarray,
+                           out: np.ndarray):
+        """Host-side acceptance walk per row: commit the longest accepted
+        draft prefix plus the token the verify logits emit at the stop
+        position (rejection resample / bonus), then truncate the block
+        table back to the committed length — whole now-unused blocks
+        return to the pool."""
+        from deepspeed_tpu.telemetry import get_tracer
+        tracer = get_tracer()
+        bm = self.block_mgr
+        c = self.metrics.counters
+        for req in active:
+            b, rid = req.slot, req.request_id
+            d = drafts.get(rid)
+            nd = 0 if d is None else int(d.size)
+            a = 0
+            while a < nd and acc[b, a]:
+                a += 1
+            emitted = [int(t) for t in d[:a]] if nd else []
+            emitted.append(int(out[b, a]))
+            with tracer.span("serve/verify", cat="serving",
+                             corr=f"req-{rid}",
+                             args={"request_id": rid, "drafted": nd,
+                                   "accepted": a}):
+                for tok in emitted:
+                    req.record_token(tok)
+                    c["generated_tokens"] += 1
+                    if req.finished_by(tok):
+                        # EOS inside the accepted prefix discards the
+                        # rest of the window for this row only
+                        self._retire(req, RequestState.FINISHED)
+                        break
+            if nd:
+                c["spec_drafted_tokens"] += nd
+                c["spec_accepted_tokens"] += a
+                c["spec_rolled_back_tokens"] += nd - a
+                self.metrics.spec_accept_len.observe(a + 1)
+                self._spec_adapt(req, nd, a)
+            if req.slot >= 0:       # still live: paged-KV rollback
+                bm.truncate(rid, int(req.all_token_ids.size))
+
+    def _spec_adapt(self, req: ServeRequest, drafted: int, accepted: int):
+        """Per-request adaptive draft length: double on full acceptance,
+        halve on full rejection; a rolling acceptance-rate EMA below
+        ``serving.spec.min_accept_rate`` (after a few passes) disables
+        speculation for the request — mixed workloads stop paying verify
+        cost for unspeculatable streams."""
+        spec = self.cfg.spec
+        req.spec_passes += 1
+        rate = accepted / drafted
+        req.spec_accept_ema = (rate if req.spec_accept_ema < 0
+                               else 0.5 * req.spec_accept_ema + 0.5 * rate)
+        if accepted == drafted:
+            req.spec_k = min(max(req.spec_k, 1) * 2,
+                             spec.max_draft_tokens)
+        elif accepted == 0:
+            req.spec_k = max(1, req.spec_k // 2)
+        if (spec.min_accept_rate > 0
+                and req.spec_passes >= self.SPEC_MIN_PASSES
+                and req.spec_accept_ema < spec.min_accept_rate):
+            req.spec_disabled = True
+            self.metrics.counters["spec_auto_disabled"] += 1
+
     # ------------------------------------------------------------- step
     def step(self) -> List[ServeRequest]:
         """One engine iteration; returns requests finished this step.
@@ -657,6 +941,13 @@ class ContinuousBatchingScheduler:
                                  args={"active": active}):
                     self._decode()
                 self._step_count += 1
+                if self._debug_invariant:
+                    # allocation-accounting invariant (ISSUE 5): spec
+                    # rollback shrinks tables mid-flight — catch any
+                    # double-free/leak at the step that caused it
+                    # (DS_SERVE_DEBUG=1; off by default — the scan is
+                    # O(num_blocks) inside the scheduler lock)
+                    self.block_mgr.check_invariant()
                 if active:
                     self.metrics.decode_occupancy.observe(
                         active / self.cfg.max_num_seqs)
@@ -685,6 +976,9 @@ class ContinuousBatchingScheduler:
         if elapsed > 0 and c["generated_tokens"]:
             self.metrics.gauges["tokens_per_s"] = round(
                 c["generated_tokens"] / elapsed, 3)
+        if c["spec_drafted_tokens"]:
+            self.metrics.gauges["spec_accept_rate"] = round(
+                c["spec_accepted_tokens"] / c["spec_drafted_tokens"], 4)
 
     def run_until_idle(self, max_steps: int = 100_000):
         """Drive step() until queue and slots drain (bench/test helper)."""

@@ -1,0 +1,31 @@
+"""Speculative-decoding subsystem (ISSUE 5 tentpole).
+
+Per-request latency past the int8 weight-stream floor means amortizing
+each weight pass over more than one token (Leviathan et al., 2023;
+DeepSpeed-FastGen's lineage).  The pieces:
+
+- `proposer.py` — the Proposer interface + NgramProposer (prompt-lookup
+  self-drafting: no second model, wins on echo-heavy workloads)
+- `draft.py`    — DraftModelProposer: a smaller checkpoint drafting
+  greedily over its own small paged KV pool, with self-healing
+  prefix-sync and paged-KV rollback
+- `verifier.py` — acceptance math: greedy longest-prefix matching (spec
+  output == plain greedy output token-for-token) and rejection sampling
+  against deterministic drafts (sampled output distribution provably
+  unchanged), plus the scan-of-decode_fn verify fallback for model
+  families without a native one-weight-pass ``verify_fn``
+
+The scheduler (`serving/scheduler.py`) owns the orchestration: draft →
+one windowed verify pass over the packed batch → accept/rollback via
+``BlockManager.truncate`` → per-request adaptive draft length.
+"""
+from deepspeed_tpu.serving.spec.proposer import NgramProposer, Proposer
+from deepspeed_tpu.serving.spec.draft import DraftModelProposer
+from deepspeed_tpu.serving.spec.verifier import (accept_tokens,
+                                                 process_sampling_logits,
+                                                 scan_verify_fn)
+
+__all__ = [
+    "Proposer", "NgramProposer", "DraftModelProposer",
+    "accept_tokens", "process_sampling_logits", "scan_verify_fn",
+]

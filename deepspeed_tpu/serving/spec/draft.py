@@ -1,0 +1,198 @@
+"""Draft-model proposer: a smaller checkpoint (same tokenizer family)
+greedily drafts ``k`` tokens per request over its OWN small paged KV
+pool (ISSUE 5 tentpole).
+
+The draft pool mirrors the scheduler's physical layout — a position-flat
+``[L, num_blocks*block_size, ...]`` pytree addressed through per-request
+``BlockManager`` tables — but at draft-model scale and batch 1 (drafting
+is a sequential, latency-cheap side computation; batching draft decodes
+across requests is future work and noted in the docs).  The proposer is
+self-healing: each ``propose`` diffs the tokens backing its cached KVs
+against the request's current history, rolls the draft cache back to the
+common prefix via ``BlockManager.truncate`` (the same paged-KV rollback
+the target pool uses for rejected suffixes), and catches up by prefill
+(far behind — first call, post-eviction resume) or incremental decode
+(the usual one-token bonus gap).  Skipped verifies, rollbacks, and
+preemptions all reduce to "prefix mismatch" here.
+
+Drafting is GREEDY by construction: the verifier's rejection sampling
+treats the proposal as deterministic (a point mass), which keeps
+temperature-sampled outputs provably distributed as the target model
+alone would produce them.
+"""
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.serving.block_manager import BlockManager
+from deepspeed_tpu.serving.spec.proposer import Proposer
+
+
+def _round_up(n: int, q: int) -> int:
+    return -(-n // q) * q
+
+
+class DraftModelProposer(Proposer):
+    """``model``/``params``: the DRAFT checkpoint (must expose the
+    KV-cache serving surface); vocabularies must match the target's.
+    ``num_blocks``/``block_size`` size the draft pool (serving.spec.
+    draft_num_blocks / draft_block_size)."""
+
+    name = "draft"
+    PROMPT_BUCKET = 16
+    #: gap (tokens) beyond which catch-up re-prefills instead of
+    #: decoding token by token
+    REPREFILL_GAP = 8
+
+    def __init__(self, model, params, num_blocks: int = 64,
+                 block_size: int = 16, kv_cache_dtype=None):
+        if (model.init_cache_fn is None or model.prefill_fn is None
+                or model.decode_fn is None):
+            raise ValueError("draft model does not expose the KV-cache "
+                             "serving surface")
+        self.model = model
+        self.params = params
+        self.kv_cache_dtype = kv_cache_dtype
+        self.bm = BlockManager(num_blocks, block_size)
+        model_ctx = int(getattr(model.config, "max_seq_len", 1 << 30))
+        self.max_len = min(model_ctx,
+                           self.bm.num_usable_blocks * block_size)
+        self.s_pad = _round_up(self.max_len, 64)
+        #: request_id -> the token ids whose KVs the pool holds (token i
+        #: backs pool position i of this request's table)
+        self._cached: Dict[int, np.ndarray] = {}
+        self._prefill_fns = {}
+        self._decode_jit = None
+        n_pos = num_blocks * block_size
+        cache = model.init_cache_fn(1, n_pos, kv_cache_dtype)
+        self.pool = jax.tree.map(lambda a: a[:, 0], cache)
+
+    # ------------------------------------------------------- jitted fns
+    def _prefill_fn(self, sp: int):
+        if sp not in self._prefill_fns:
+            model, kv_dtype = self.model, self.kv_cache_dtype
+            cache_len = _round_up(sp, 64)
+
+            def fn(params, pool, tokens, dest_idx):
+                cache = model.init_cache_fn(1, cache_len, kv_dtype)
+                _, cache = model.prefill_fn(
+                    params, {"input_ids": tokens}, cache)
+                return jax.tree.map(
+                    lambda p, c: p.at[:, dest_idx].set(c[:, 0, :sp]),
+                    pool, cache)
+
+            self._prefill_fns[sp] = jax.jit(fn)
+        return self._prefill_fns[sp]
+
+    def _decode_fn(self):
+        if self._decode_jit is None:
+            model = self.model
+
+            def fn(params, pool, token, length, dest, pos_idx):
+                dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
+                logits, new_cache = model.decode_fn(
+                    params, token, dense, length)
+                vecs = jax.tree.map(
+                    lambda c: c[:, jnp.arange(1), length], new_cache)
+                pool = jax.tree.map(
+                    lambda p, v: p.at[:, dest].set(v), pool, vecs)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+            self._decode_jit = jax.jit(fn)
+        return self._decode_jit
+
+    # ---------------------------------------------------------- helpers
+    def _pos_idx(self, rid: int) -> np.ndarray:
+        bm = self.bm
+        table = np.zeros((-(-self.s_pad // bm.block_size),), np.int64)
+        t = bm.block_table(rid)
+        table[:len(t)] = t
+        offs = np.arange(self.s_pad) % bm.block_size
+        return (table[np.arange(self.s_pad) // bm.block_size]
+                * bm.block_size + offs)[None, :].astype(np.int32)
+
+    def _ensure_blocks(self, rid: int, num_tokens: int) -> bool:
+        """All-or-nothing growth to cover ``num_tokens`` positions."""
+        need = self.bm.blocks_for_tokens(num_tokens) \
+            - len(self.bm.block_table(rid))
+        if need <= 0:
+            return True
+        return self.bm.allocate(rid, need) is not None
+
+    def _decode1(self, rid: int, token: int, position: int) -> int:
+        dest = np.asarray([self.bm.position_index(rid, position)], np.int32)
+        nxt, self.pool = self._decode_fn()(
+            self.params, self.pool, jnp.asarray([token], np.int32),
+            jnp.asarray([position], np.int32), jnp.asarray(dest),
+            jnp.asarray(self._pos_idx(rid)))
+        return int(np.asarray(nxt)[0])
+
+    # ------------------------------------------------------------ public
+    def propose(self, req, k: int) -> np.ndarray:
+        rid = req.request_id
+        tokens = np.asarray(req.all_token_ids, np.int32)
+        n = tokens.size
+        # drafting writes draft-pool positions through n-2+k
+        k = min(k, self.max_len - n + 1)
+        if k <= 0:
+            return np.zeros((0,), np.int32)
+        prefix = tokens[:n - 1]          # positions that must be cached
+        cached = self._cached.get(rid, np.zeros((0,), np.int32))
+        m = min(cached.size, prefix.size)
+        neq = np.nonzero(cached[:m] != prefix[:m])[0]
+        cp = int(neq[0]) if neq.size else m
+        # paged-KV rollback to the common prefix (mirrors the target
+        # pool's rejected-suffix rollback)
+        if cp == 0 and cached.size:
+            self.bm.free(rid)
+        elif cp < cached.size:
+            self.bm.truncate(rid, cp)
+        cached = cached[:cp]
+        if prefix.size - cp > self.REPREFILL_GAP:
+            # far behind (fresh request / post-eviction resume): one
+            # prefill pass instead of a token-by-token crawl
+            self.bm.free(rid)
+            if not self._ensure_blocks(rid, n - 1 + k):
+                return np.zeros((0,), np.int32)
+            self._prefill(rid, prefix)
+            cp = prefix.size
+        elif not self._ensure_blocks(rid, n - 1 + k):
+            # draft pool exhausted: skip proposing (the target decodes
+            # plain); the cache stays for when pressure eases
+            return np.zeros((0,), np.int32)
+        # feed the uncached tail (catch-up + the last committed token),
+        # then greedy-draft forward
+        drafts = []
+        pos = cp
+        feed = list(tokens[cp:])
+        for t in feed:
+            nxt = self._decode1(rid, int(t), pos)
+            pos += 1
+        drafts.append(nxt)
+        for _ in range(k - 1):
+            nxt = self._decode1(rid, drafts[-1], pos)
+            pos += 1
+            drafts.append(nxt)
+        self._cached[rid] = np.concatenate(
+            [tokens, np.asarray(drafts[:-1], np.int32)])
+        return np.asarray(drafts, np.int32)
+
+    def _prefill(self, rid: int, prefix: np.ndarray):
+        if prefix.size == 0:
+            return
+        sp = min(max(_round_up(prefix.size, self.PROMPT_BUCKET),
+                     self.PROMPT_BUCKET), self.s_pad)
+        padded = np.zeros((1, sp), np.int32)
+        padded[0, :prefix.size] = prefix
+        bm = self.bm
+        dest = np.arange(sp) % bm.block_size        # pads -> trash block
+        dest[:prefix.size] = [bm.position_index(rid, int(p))
+                              for p in range(prefix.size)]
+        self.pool = self._prefill_fn(sp)(
+            self.params, self.pool, jnp.asarray(padded), jnp.asarray(dest))
+
+    def release(self, request_id: int):
+        self.bm.free(request_id)
+        self._cached.pop(request_id, None)

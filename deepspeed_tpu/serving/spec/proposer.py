@@ -1,0 +1,83 @@
+"""Draft proposers for speculative decoding (ISSUE 5).
+
+A :class:`Proposer` supplies up to ``k`` draft tokens for a request's
+next positions; the scheduler verifies the whole draft against the
+target model in one weight pass and rolls rejected suffixes back through
+the paged block tables.  Proposals must be DETERMINISTIC functions of
+the request's token history: the verifier's rejection sampling treats
+the proposal distribution as a point mass, which is exact only for
+deterministic drafts (greedy draft-model decoding, n-gram lookup).
+
+:class:`NgramProposer` is prompt-lookup decoding (Saxena, 2023): match
+the last n-gram of the request's own prompt+output history against an
+earlier occurrence and draft its continuation.  No second model, no
+extra memory, pure host numpy — it wins on workloads whose outputs echo
+their inputs (extraction, code edits, long-document QA) and on the
+repetition loops greedy decoding falls into.  The draft-model proposer
+lives in ``serving/spec/draft.py`` (it carries its own paged KV pool).
+"""
+from typing import Optional
+
+import numpy as np
+
+
+class Proposer:
+    """Interface: the scheduler calls ``propose`` each iteration for
+    each spec-eligible request and ``release`` when a request leaves the
+    engine (finished, rejected, or evicted — eviction frees any
+    per-request proposer state; the request may resume later and the
+    proposer rebuilds from its token history)."""
+
+    name = "base"
+
+    def propose(self, req, k: int) -> np.ndarray:
+        """Up to ``k`` drafted token ids (int32 [<=k]; empty = no
+        proposal this round) continuing ``req.all_token_ids``."""
+        raise NotImplementedError
+
+    def release(self, request_id: int):
+        """Drop any per-request state (no-op by default)."""
+
+
+class NgramProposer(Proposer):
+    """Prompt-lookup drafting: longest-suffix n-gram match over the
+    request's own token history, most recent occurrence wins (recency
+    tracks the repetition loops and local echo structure that make
+    self-lookup profitable)."""
+
+    name = "ngram"
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(f"ngram sizes min={ngram_min} "
+                             f"max={ngram_max}: need 1 <= min <= max")
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+
+    def _find(self, ctx: np.ndarray, n: int, k: int) -> Optional[np.ndarray]:
+        L = ctx.size
+        if L < n + 1:
+            return None
+        pat = ctx[-n:]
+        # candidate starts 0..L-n-1: every length-n window EXCEPT the
+        # suffix itself; a hit at i drafts the continuation ctx[i+n:]
+        view = np.lib.stride_tricks.sliding_window_view(ctx, n)[:L - n]
+        hits = np.nonzero((view == pat[None, :]).all(axis=1))[0]
+        if hits.size == 0:
+            return None
+        # prefer the most RECENT hit that still has k continuation
+        # tokens before the suffix; otherwise the EARLIEST hit (longest
+        # continuation) — in a period-p repetition the latest hit sits
+        # one period back and would draft only the run's tail otherwise
+        full = hits[hits + n + k <= L]
+        i = int(full[-1]) if full.size else int(hits[0])
+        cont = ctx[i + n:i + n + k]
+        return cont if cont.size else None
+
+    def propose(self, req, k: int) -> np.ndarray:
+        ctx = np.asarray(req.all_token_ids, np.int32)
+        for n in range(self.ngram_max, self.ngram_min - 1, -1):
+            cont = self._find(ctx, n, k)
+            if cont is not None:
+                return cont.astype(np.int32)
+        return np.zeros((0,), np.int32)

@@ -1,0 +1,137 @@
+"""Target-model verification math for speculative decoding (ISSUE 5).
+
+Three pieces, all pure jax (traced inside the scheduler's jitted verify
+program):
+
+- :func:`process_sampling_logits` — the temperature / top-k / top-p
+  pipeline factored out of the scheduler's ``_sample_rows`` so rejection
+  sampling draws from EXACTLY the distribution plain sampling uses;
+- :func:`accept_tokens` — vectorized accept/emit over one verify window:
+  greedy rows accept the longest draft prefix matching the argmax chain
+  (so greedy spec output is token-for-token the plain greedy output);
+  sampled rows run Leviathan et al. (2023) rejection sampling against a
+  *deterministic* proposal (q = a point mass at the drafted token —
+  exact for greedy-drafting proposers like prompt-lookup and a greedy
+  draft model), which provably leaves the output distribution unchanged:
+  accept d with probability p(d); on rejection resample from the
+  renormalized residual p(x)/(1-p(d)), x != d;
+- :func:`scan_verify_fn` — a model-agnostic verify built from W
+  sequential ``decode_fn`` steps inside one program.  Bitwise-identical
+  logits to plain decode but W weight passes — the correctness fallback
+  for families without a native ``verify_fn`` (and the DS_SPEC_VERIFY=
+  ``scan`` triage escape hatch).
+
+RNG discipline: every random draw keys off ``fold_in(PRNGKey(seed),
+position)`` — the same (seed, absolute token index) scheme plain
+sampling uses — so spec sampling stays preemption-stable; accept-test
+and residual-resample draws fold in a further 1/2 so they are
+independent of each other and of the bonus-position categorical.
+"""
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.sampling import NEG_INF
+
+
+def process_sampling_logits(x, temps, top_ks, top_ps):
+    """Per-row temperature scaling + top-k + top-p masking (the exact
+    ``_sample_rows`` pipeline): ``x`` [B, V] raw logits -> fp32 processed
+    logits whose softmax is the distribution plain sampling draws from.
+    top_k=0 and top_p>=1 are no-ops per row."""
+    V = x.shape[-1]
+    x = x.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    # top-k with per-row k (0 = off): threshold at the kth largest
+    sorted_desc = -jnp.sort(-x, axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_ks - 1, 0, V - 1)[:, None], axis=-1)
+    x = jnp.where((top_ks[:, None] > 0) & (x < kth), NEG_INF, x)
+    # top-p with per-row p (>=1 = off), on the top-k-masked logits
+    sorted_desc = -jnp.sort(-x, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(x < thresh, NEG_INF, x)
+
+
+def _position_keys(seeds, positions):
+    """[B] keys: fold_in(PRNGKey(seed), position) — the plain-sampling
+    key family, so spec emission at a position is keyed exactly like
+    plain emission at that position."""
+    return jax.vmap(lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s),
+                                                    p))(seeds, positions)
+
+
+def accept_tokens(logits, window_tokens, draft_len, seeds, first_pos,
+                  temps, top_ks, top_ps, do_flags, any_sampling: bool):
+    """Accept/emit decision for one verify window.
+
+    ``logits`` [B, W, V]: target scores; ``logits[:, j]`` decides the
+    token at sequence index ``first_pos + j``.
+    ``window_tokens`` [B, W]: column 0 is the last committed token,
+    columns 1..W-1 the (padded) drafts.
+    ``draft_len`` [B]: real drafts per row (<= W-1).
+    Returns ``(acc [B, W-1] bool, out [B, W] int32)``: ``acc[:, j]`` is
+    whether draft j survives at its position; ``out[:, j]`` is the token
+    emitted AT window position j when the host's acceptance walk stops
+    there — the rejection resample for j < draft_len, the bonus sample
+    (or greedy argmax) at j == draft_len.  Columns past a row's own
+    draft never get consumed by the walk."""
+    B, W, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, W]
+    drafts = window_tokens[:, 1:]                               # [B, W-1]
+    acc_greedy = drafts == greedy[:, :-1]
+    if not any_sampling:
+        return acc_greedy, greedy
+
+    acc_cols, out_cols = [], []
+    for j in range(W):
+        pos = first_pos + j
+        x = process_sampling_logits(logits[:, j], temps, top_ks, top_ps)
+        probs = jax.nn.softmax(x, axis=-1)                      # [B, V]
+        keys = _position_keys(seeds, pos)
+        if j < W - 1:
+            d = drafts[:, j]
+            p_d = jnp.take_along_axis(probs, d[:, None], axis=-1)[:, 0]
+            u = jax.vmap(jax.random.uniform)(
+                jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys))
+            acc_cols.append(u < p_d)
+            # residual: p with the drafted token zeroed, renormalized —
+            # categorical on the masked logits does both at once.  Only
+            # consumed on rejection (prob 1 - p(d)), so the all-masked
+            # degenerate case (p(d) == 1) is never read.
+            residual = jnp.where(
+                jax.nn.one_hot(d, V, dtype=bool), NEG_INF, x)
+            resampled = jax.vmap(jax.random.categorical)(
+                jax.vmap(lambda k: jax.random.fold_in(k, 2))(keys),
+                residual).astype(jnp.int32)
+        else:
+            resampled = jnp.zeros((B,), jnp.int32)   # no draft col here
+        # bonus position (j == draft_len): a full categorical with the
+        # position's own key — for an all-accepted window this is the
+        # very draw plain decode would have made at that index
+        bonus = jax.vmap(jax.random.categorical)(keys, x).astype(jnp.int32)
+        sampled_out = jnp.where(j < draft_len, resampled, bonus)
+        out_cols.append(jnp.where(do_flags, sampled_out, greedy[:, j]))
+    acc = jnp.stack(acc_cols, axis=1) if acc_cols \
+        else jnp.zeros((B, 0), bool)
+    acc = jnp.where(do_flags[:, None], acc, acc_greedy)
+    return acc, jnp.stack(out_cols, axis=1)
+
+
+def scan_verify_fn(decode_fn):
+    """Model-agnostic ``verify_fn`` built from ``decode_fn``: W
+    sequential decode steps inside one program.  Logits are bitwise what
+    plain decode computes (it IS plain decode, with forced tokens) at
+    the cost of W weight passes — the fallback for model families
+    without a native windowed ``verify_fn``."""
+    def vf(params, tokens, cache, lengths):
+        def body(carry, tok_col):
+            cache, lens = carry
+            logits, cache = decode_fn(params, tok_col, cache, lens)
+            return (cache, lens + 1), logits
+        (cache, _), logits = jax.lax.scan(
+            body, (cache, lengths), tokens.T)
+        return jnp.moveaxis(logits, 0, 1), cache        # [B, W, V]
+    return vf

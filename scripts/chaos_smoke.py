@@ -189,6 +189,38 @@ def case_kv_deny_preempts():
     assert all(r.state == RequestState.FINISHED for r in reqs)
 
 
+def case_spec_fault_degrades():
+    """serve.spec raise during speculative verify: the scheduler must
+    degrade to plain decode (exact greedy output, no wedge, pool fully
+    drained) — ISSUE 5."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        spec={"mode": "ngram", "max_draft_tokens": 4})
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("serve.spec:raise@*"))
+    prompt = np.tile(np.asarray([9, 23, 4], np.int32), 5)
+    req = sched.submit(prompt, SamplingParams(max_new_tokens=8))
+    sched.run_until_idle()
+    ref = np.asarray(eng.generate(prompt[None], max_new_tokens=8,
+                                  do_sample=False))[0, prompt.size:]
+    assert req.state == RequestState.FINISHED
+    assert np.array_equal(np.asarray(req.output_ids), ref)
+    assert sched.metrics.counters["spec_faults"] >= 1
+    assert sched.block_mgr.num_allocated_blocks == 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="resilience chaos smoke")
     p.add_argument("--fast", action="store_true",
@@ -216,6 +248,8 @@ def main(argv=None):
     cases.append(("torn latest pointer", case_torn_latest))
     cases.append(("serving loop degrades", case_serving_loop_degrades))
     cases.append(("kv.alloc deny preempts", case_kv_deny_preempts))
+    cases.append(("serve.spec fault degrades to plain decode",
+                  case_spec_fault_degrades))
 
     results = []
     for name, fn in cases:

@@ -11,10 +11,14 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODEL=mixtral:1b-moe SERVE_KV=int8 python scripts/serve_bench.py
     SERVE_MODEL=bloom:560m SERVE_B=8 python scripts/serve_bench.py
     SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
+    SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
 
 Static mode prints one JSON line: prefill ms + steady decode tokens/s.
 CB mode prints one JSON line: continuous-batching vs static-batch tok/s
 on the same mixed-length workload + p50/p99 TTFT.
+Spec mode (ISSUE 5) runs the ngram-proposer speculative path vs plain cb
+on a mixed-length repetitive-suffix workload and reports tokens per
+weight pass + acceptance rate (the ISSUE 5 acceptance columns).
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import json
@@ -74,7 +78,7 @@ def main():
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"
         kwargs = {}
-    elif os.environ.get("SERVE_MODE") == "cb":
+    elif os.environ.get("SERVE_MODE") in ("cb", "spec"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -83,9 +87,11 @@ def main():
     else:
         kwargs = dict(vocab_size=256, num_layers=2, num_heads=4,
                       d_model=32)
-    # cb mode sizes its own heavy-tailed workload (bench_continuous_batching)
-    cb_ctx = (0 if os.environ.get("SERVE_MODE") != "cb"
-              else (768 + 384 if on_tpu else 96))
+    # cb/spec modes size their own workloads (spec's motif-tiled prompts
+    # run a little longer than cb's heavy tail off-TPU)
+    _mode = os.environ.get("SERVE_MODE")
+    cb_ctx = (0 if _mode not in ("cb", "spec")
+              else (768 + 384 if on_tpu else (96 if _mode == "cb" else 128)))
     model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
                            else "float32",
                            max_seq_len=max(2048 if on_tpu else 64,
@@ -111,6 +117,8 @@ def main():
 
     if os.environ.get("SERVE_MODE") == "cb":
         return bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu)
+    if os.environ.get("SERVE_MODE") == "spec":
+        return bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -256,6 +264,100 @@ def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu):
             "static_ttft_p99_ms": pct(st_ttft, 99),
             "decode_steps_total": int(
                 sched.metrics.counters["decode_steps"]),
+        },
+    }))
+
+
+def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu):
+    """Speculative (ngram-proposer) vs plain continuous batching on a
+    mixed-length REPETITIVE-SUFFIX workload — prompts built by tiling a
+    short motif, the regime prompt-lookup exists for (long prompts the
+    output echoes; greedy decoding's own repetition loops).  Columns:
+    tokens per weight pass (generated tokens over decode+verify passes —
+    the quantity speculation raises above 1.0) and draft acceptance
+    rate, plus the mean accepted length per verify pass (ISSUE 5
+    acceptance: > 1.3 on this workload)."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 24 if on_tpu else 12))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    max_draft = int(os.environ.get("SERVE_SPEC_K", 8))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    # motif-tiled prompts with a small random head: the suffix n-gram
+    # always has an earlier occurrence, mixed lengths keep the batch
+    # ragged like the cb bench
+    m_lo, m_hi = (4, 9)
+    reps_lo, reps_hi = ((8, 24) if on_tpu else (3, 8))
+    n_lo, n_hi = ((32, 256) if on_tpu else (12, 48))
+    workload = []
+    for i in range(n_reqs):
+        motif = rng.integers(1, V, (int(rng.integers(m_lo, m_hi)),))
+        head = rng.integers(1, V, (int(rng.integers(0, 4)),))
+        prompt = np.concatenate(
+            [head, np.tile(motif, int(rng.integers(reps_lo, reps_hi)))])
+        workload.append((prompt.astype(np.int32),
+                         int(rng.integers(n_lo, n_hi))))
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 4
+    need = -(-max_len // bs) + 2
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * max_seqs,
+                max_num_batched_tokens=1 << 30)
+
+    def run(spec_mode):
+        cfg = ServingConfig(**base, spec=(
+            {"mode": "ngram", "max_draft_tokens": max_draft}
+            if spec_mode else {"mode": "off"}))
+        sched = ContinuousBatchingScheduler(
+            model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+        # warm compiles out of the measurement, then measure once (the
+        # workload is long enough to swamp dispatch jitter off-TPU too)
+        for _ in range(2):
+            reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                    for p, nn in workload]
+            t0 = _time.time()
+            sched.run_until_idle()
+            dt = _time.time() - t0
+            assert all(len(r.output_ids) == nn
+                       for r, (_, nn) in zip(reqs, workload))
+        return dt, sched.metrics
+
+    spec_s, spec_m = run(True)
+    cb_s, cb_m = run(False)
+    c = spec_m.counters
+    # weight passes that generated tokens: plain decode scan iterations
+    # plus one per spec verify window
+    spec_passes = c["decode_steps"] + c["spec_verify_steps"]
+    cb_passes = cb_m.counters["decode_steps"]
+    h = spec_m.spec_accept_len
+    print(json.dumps({
+        "metric": f"{spec}_serve_spec"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / spec_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "requests": n_reqs, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "max_draft_tokens": max_draft,
+            "spec_tok_s": round(useful / spec_s, 1),
+            "cb_tok_s": round(useful / cb_s, 1),
+            "speedup_vs_cb": round(cb_s / spec_s, 3),
+            "spec_tokens_per_weight_pass": round(
+                c["generated_tokens"] / max(spec_passes, 1), 3),
+            "cb_tokens_per_weight_pass": round(
+                cb_m.counters["generated_tokens"] / max(cb_passes, 1), 3),
+            "accept_rate": round(
+                c["spec_accepted_tokens"] / max(c["spec_drafted_tokens"],
+                                                1), 3),
+            "mean_accept_len": round(h.sum / max(h.count, 1), 3),
+            "drafted": int(c["spec_drafted_tokens"]),
+            "accepted": int(c["spec_accepted_tokens"]),
+            "rolled_back": int(c["spec_rolled_back_tokens"]),
+            "verify_passes": int(c["spec_verify_steps"]),
         },
     }))
 

@@ -114,6 +114,23 @@ def validate(path: str, require_corr: bool = False) -> List[str]:
     return errors
 
 
+def correlated_spans(events: List[Dict], names) -> Dict[str, set]:
+    """corr id -> the subset of ``names`` whose B-spans carry it (CI
+    helper, ISSUE 5: assert a spec-mode serve session emits serve/draft
+    AND serve/verify spans sharing each request's correlation id)."""
+    names = set(names)
+    out: Dict[str, set] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "B":
+            continue
+        if ev.get("name") not in names:
+            continue
+        corr = (ev.get("args") or {}).get("corr")
+        if corr is not None:
+            out.setdefault(corr, set()).add(ev["name"])
+    return out
+
+
 def summarize(events: List[Dict]) -> str:
     spans = sum(1 for e in events if e.get("ph") == "B")
     instants = sum(1 for e in events if e.get("ph") in ("i", "I"))

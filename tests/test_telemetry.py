@@ -414,15 +414,21 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     engine.save_checkpoint(str(tmp_path / "ckpt"))
     engine.load_checkpoint(str(tmp_path / "ckpt"))
 
-    # ---- serve: 3 requests with a fault on the second iteration ------
+    # ---- serve: 3 requests with a fault on the second iteration,
+    # speculative (ngram) mode so the trace carries draft/verify spans
+    # (ISSUE 5 acceptance) ---------------------------------------------
     m, eng = served
     sched = ContinuousBatchingScheduler(
         m, eng.params,
-        ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2),
+        ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                      spec={"mode": "ngram", "max_draft_tokens": 4}),
         registry=MetricsRegistry(),
         injector=FaultInjector("serve.step:raise@1"))
-    for p in _prompts(3, seed=7):
+    for p in _prompts(2, seed=7):
         sched.submit(p, SamplingParams(max_new_tokens=3))
+    # a repetitive prompt so the ngram proposer actually drafts
+    sched.submit(np.tile(np.asarray([9, 23, 4], np.int32), 5),
+                 SamplingParams(max_new_tokens=8))
     faults_seen = 0
     while sched.has_work():
         try:
@@ -455,6 +461,14 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     # those spans' correlation ids — the timeline reads as one story
     assert fault_corrs & train_corrs
     assert fault_corrs & serve_corrs
+    # ISSUE 5: the spec-mode session's draft and verify spans share the
+    # request correlation id (one request's speculation reads as one
+    # story too)
+    from scripts.trace_validate import correlated_spans
+    spec_corrs = correlated_spans(evs, ("serve/draft", "serve/verify"))
+    assert any(names == {"serve/draft", "serve/verify"}
+               for names in spec_corrs.values())
+    assert all(c.startswith("req-") for c in spec_corrs)
 
     # ---- both metrics surfaces ---------------------------------------
     reg = get_registry()
@@ -472,4 +486,9 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     serve_text = sched.render_metrics()
     assert "serving_ttft_s_bucket" in serve_text
     assert "serving_goodput" in serve_text
+    # ISSUE 5: /metrics exposes the spec accept-length histogram with
+    # quantile gauges
+    assert "# TYPE serve_spec_accept_len histogram" in serve_text
+    assert "serve_spec_accept_len_p50" in serve_text
+    assert "serve_spec_accept_len_p99" in serve_text
     engine.metrics_server.stop()
