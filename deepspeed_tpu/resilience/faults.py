@@ -16,7 +16,7 @@ key; specs separated by ``;`` or whitespace)::
 
     site    dotted hook name: ckpt.save ckpt.aux ckpt.manifest
             ckpt.publish ckpt.latest train.step serve.step serve.spec
-            kv.alloc ...
+            kv.alloc kv.cache ...
     action  raise      raise FaultInjected at the site
             kill       os._exit(param or 1) — a hard crash, no cleanup
             sigterm    deliver SIGTERM to this process (preemption)
@@ -38,6 +38,11 @@ Examples::
     DS_FAULTS="kv.alloc:deny@*"               # pool always exhausted
     DS_FAULTS="serve.spec:deny@*"             # spec verify degrades to
                                               # plain decode every step
+    DS_FAULTS="kv.cache:deny@*"               # prefix cache blind: every
+                                              # admission full-prefills
+                                              # (fires at match AND at
+                                              # attach — deny@1 models an
+                                              # eviction under the fork)
 """
 import hashlib
 import os

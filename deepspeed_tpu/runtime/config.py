@@ -415,6 +415,36 @@ class SpecDecodeConfig(DeepSpeedConfigModel):
                              f"{self.draft_block_size}: must be >= 1")
 
 
+class PrefixCacheConfig(DeepSpeedConfigModel):
+    """``serving.prefix_cache`` — cross-request prefix caching (ISSUE 6):
+    full KV blocks become hash-addressed immutable entries shared between
+    requests; a new request's prompt is matched block-by-block against
+    the cache and prefill starts at the first uncached token."""
+    #: off by default: with it on, greedy output is token-identical but
+    #: not bitwise in the logits (suffix prefill rides the verify-window
+    #: path, ~1-ulp from the one-shot causal prefill)
+    enabled: bool = False
+    #: minimum matched blocks worth attaching — below this the request
+    #: full-prefills (tiny matches don't pay for the suffix-program
+    #: dispatch + ref bookkeeping)
+    min_prefix_blocks: int = 1
+    #: cap on RETAINED refcount-0 cached blocks (0 = bounded only by the
+    #: pool); cap it when serving wildly heterogeneous traffic so stale
+    #: prefixes can't crowd the free list into constant LRU churn
+    max_cached_blocks: int = 0
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.min_prefix_blocks < 1:
+            raise ValueError(
+                "serving.prefix_cache.min_prefix_blocks="
+                f"{self.min_prefix_blocks}: must be >= 1")
+        if self.max_cached_blocks < 0:
+            raise ValueError(
+                "serving.prefix_cache.max_cached_blocks="
+                f"{self.max_cached_blocks}: must be >= 0 (0 = pool-bounded)")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -462,11 +492,17 @@ class ServingConfig(DeepSpeedConfigModel):
     #: SpecDecodeConfig below — nested pydantic construction would skip
     #: the sub-config's __init__ validation)
     spec: Any = None
+    #: cross-request prefix-cache sub-section (same dict-in-JSON
+    #: validation pattern as ``spec``)
+    prefix_cache: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
         if not isinstance(self.spec, SpecDecodeConfig):
             self.spec = SpecDecodeConfig(**(self.spec or {}))
+        if not isinstance(self.prefix_cache, PrefixCacheConfig):
+            self.prefix_cache = PrefixCacheConfig(
+                **(self.prefix_cache or {}))
         if self.block_size < 1:
             raise ValueError(f"serving.block_size={self.block_size}: "
                              "must be >= 1")

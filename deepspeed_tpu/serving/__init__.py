@@ -8,11 +8,15 @@ kernel, `InferenceEngine` prefill/decode fns):
 - `request.py`   — typed request/response lifecycle
   (QUEUED → PREFILL → DECODE → FINISHED, with EVICTED and REJECTED arcs)
 - `block_manager.py` — free-list allocator over a pool of fixed-size
-  token blocks; per-request block tables
+  token blocks; per-request block tables; cross-request prefix cache
+  (ISSUE 6): hash-addressed immutable full blocks with ref counts,
+  copy-on-write forks, and ref-count-aware LRU eviction
 - `scheduler.py` — iteration-level engine loop: admits prefills up to a
-  token budget, packs the active decode set through the jitted decode
-  step via block-table gathers, retires finished rows mid-batch,
-  preempts (recompute-on-resume) under pool pressure
+  token budget (matching each prompt against the prefix cache and
+  prefilling only the uncached suffix), packs the active decode set
+  through the jitted decode step via block-table gathers, retires
+  finished rows mid-batch (releasing full blocks into the cache),
+  preempts (recompute-on-resume, cache-accelerated) under pool pressure
 - `server.py`    — stdlib HTTP front-end (/generate, /healthz, /metrics)
   driving the scheduler on a background thread (bin/ds_serve)
 - `spec/`        — speculative decoding (ISSUE 5): ngram/draft-model

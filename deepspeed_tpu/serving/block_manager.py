@@ -1,15 +1,42 @@
 """Block-granular KV-cache accounting: a free-list allocator over a pool
-of fixed-size token blocks (vLLM PagedAttention's physical layer, minus
-swap — preempted requests recompute on resume).
+of fixed-size token blocks (vLLM PagedAttention's physical layer) with a
+cross-request **prefix cache** (ISSUE 6): full blocks become
+hash-addressed immutable entries shared between requests via per-block
+ref counts, released blocks are retained on a ref-count-aware LRU
+instead of the free list, and a request that must write into a shared
+block forks it copy-on-write.
 
 The physical cache itself lives in the scheduler as a position-flat
 pytree ``[L, num_blocks * block_size, ...]`` (the `models/serving.py`
 `init_cache` layout with the batch dim collapsed into the pool); this
-class owns only the integer bookkeeping.  Block 0 is reserved as the
-trash block: padding rows in the packed decode batch point their tables
-at it, so their (ignored) cache writes can never land in a live block.
+class owns only the integer bookkeeping — the scheduler executes the
+actual KV copy for a COW fork.  Block 0 is reserved as the trash block:
+padding rows in the packed decode batch point their tables at it, so
+their (ignored) cache writes can never land in a live block.
+
+Prefix-cache semantics:
+
+- **Content hash**: each FULL block's hash chains on its parent block's
+  hash plus the token ids the block covers (``blake2b(parent_hash ||
+  int32 tokens)``), so a block's identity pins the *entire token prefix*
+  — and, decoding being causal, the KV vectors it holds.
+- **Immutability**: a hashed block is never written in place.  The only
+  writer-into-shared-state case (re-verifying the last token of a fully
+  cached prompt) goes through :meth:`acquire_prefix`'s copy-on-write
+  fork; a request writing into its OWN hashed block (cannot happen with
+  block-granular matching, but defended) must unregister it first.
+- **Ref counts** count table references.  A released block with
+  refcount 0 parks on the LRU when hashed (cache retention) and returns
+  to the free list otherwise.  Allocation prefers the free list and
+  evicts oldest-released cached blocks only when it runs dry — the
+  cache never steals from live requests, live requests always reclaim
+  the cache.
 """
-from typing import Dict, List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from deepspeed_tpu.resilience.faults import FaultInjector, NULL_INJECTOR
 
@@ -18,7 +45,8 @@ class BlockManager:
     TRASH_BLOCK = 0
 
     def __init__(self, num_blocks: int, block_size: int,
-                 injector: FaultInjector = NULL_INJECTOR):
+                 injector: FaultInjector = NULL_INJECTOR,
+                 cache_enabled: bool = False, max_cached_blocks: int = 0):
         if num_blocks < 2:
             raise ValueError(f"num_blocks={num_blocks}: need >= 2 "
                              "(block 0 is the reserved trash block)")
@@ -27,10 +55,25 @@ class BlockManager:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.injector = injector
+        self.cache_enabled = cache_enabled
+        #: cap on RETAINED (refcount-0) cached blocks; 0 = bounded only
+        #: by the pool itself
+        self.max_cached_blocks = max_cached_blocks
         # LIFO free list: recently-freed blocks are re-handed first, so a
         # drained-and-refilled pool stays compact
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._tables: Dict[int, List[int]] = {}     # request_id -> blocks
+        self._ref: Dict[int, int] = {}              # block -> #table refs
+        self._hash_of: Dict[int, str] = {}          # block -> content hash
+        self._by_hash: Dict[str, int] = {}          # content hash -> block
+        #: refcount-0 cached blocks, oldest-released first (eviction order)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        #: request -> hash chain of its committed full-block prefix (a
+        #: pure function of the committed token ids, so it only ever
+        #: extends — rebuilt from scratch after an eviction/resume)
+        self._chains: Dict[int, List[str]] = {}
+        #: cached blocks evicted to satisfy allocations (telemetry)
+        self.cache_evictions = 0
 
     # -------------------------------------------------------------- sizes
     @property
@@ -42,8 +85,19 @@ class BlockManager:
         return len(self._free)
 
     @property
+    def num_cached_blocks(self) -> int:
+        """Refcount-0 blocks retained for prefix reuse (reclaimable)."""
+        return len(self._lru)
+
+    @property
+    def num_reclaimable_blocks(self) -> int:
+        """Blocks an allocation can draw on: free + evictable cached."""
+        return len(self._free) + len(self._lru)
+
+    @property
     def num_allocated_blocks(self) -> int:
-        return self.num_usable_blocks - self.num_free_blocks
+        return self.num_usable_blocks - self.num_free_blocks \
+            - self.num_cached_blocks
 
     def utilization(self) -> float:
         return self.num_allocated_blocks / max(self.num_usable_blocks, 1)
@@ -55,20 +109,67 @@ class BlockManager:
         """Could a request of this total length run on an EMPTY pool?"""
         return self.blocks_for_tokens(num_tokens) <= self.num_usable_blocks
 
+    # ------------------------------------------------------------ hashing
+    @staticmethod
+    def _chain_hash(parent: Optional[str], tokens) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        h.update((parent or "\x00root").encode())
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.hexdigest()
+
     # ---------------------------------------------------------- allocate
+    def _pop_block(self) -> Optional[int]:
+        """One block off the free list, evicting the oldest refcount-0
+        cached block when the list runs dry — the cache yields to live
+        demand, never the other way around."""
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            b, _ = self._lru.popitem(last=False)
+            self._unregister(b)
+            self.cache_evictions += 1
+            return b
+        return None
+
+    def _unregister(self, b: int):
+        h = self._hash_of.pop(b, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+
+    def _release_block(self, b: int):
+        """Drop one table reference; a block reaching refcount 0 parks on
+        the LRU when it carries cached content, else frees."""
+        r = self._ref.get(b, 0) - 1
+        if r > 0:
+            self._ref[b] = r
+            return
+        self._ref.pop(b, None)
+        if b in self._hash_of:
+            self._lru[b] = None                 # newest-released last
+            while self.max_cached_blocks \
+                    and len(self._lru) > self.max_cached_blocks:
+                old, _ = self._lru.popitem(last=False)
+                self._unregister(old)
+                self.cache_evictions += 1
+                self._free.append(old)
+        else:
+            self._free.append(b)
+
     def can_allocate(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.num_reclaimable_blocks
 
     def allocate(self, request_id: int, n: int) -> Optional[List[int]]:
-        """Append ``n`` fresh blocks to the request's table; None (and no
-        state change) when the pool can't supply them — or when a
-        ``kv.alloc`` deny fault fires (exercises the preemption /
-        recompute-on-resume path deterministically)."""
+        """Append ``n`` fresh exclusively-owned blocks to the request's
+        table; None (and no state change) when the pool can't supply them
+        — or when a ``kv.alloc`` deny fault fires (exercises the
+        preemption / recompute-on-resume path deterministically)."""
         if self.injector.deny("kv.alloc"):
             return None
-        if n > len(self._free):
+        if n > self.num_reclaimable_blocks:
             return None
-        got = [self._free.pop() for _ in range(n)]
+        got = [self._pop_block() for _ in range(n)]
+        for b in got:
+            self._ref[b] = 1
         self._tables.setdefault(request_id, []).extend(got)
         return got
 
@@ -76,58 +177,222 @@ class BlockManager:
         return self._tables.get(request_id, [])
 
     def free(self, request_id: int):
-        """Return every block of the request to the pool (retire/evict).
-        Idempotent: a second free of the same request is a no-op, never a
-        double-free (the table was popped the first time)."""
+        """Release every block of the request (retire/evict): shared
+        blocks lose one reference, exclusively-owned hashed blocks join
+        the cache LRU, the rest return to the free list.  Idempotent: a
+        second free of the same request is a no-op, never a double-free
+        (the table was popped the first time)."""
         for b in self._tables.pop(request_id, []):
-            self._free.append(b)
+            self._release_block(b)
+        self._chains.pop(request_id, None)
 
     def truncate(self, request_id: int, num_tokens: int) -> int:
         """Speculative-decoding rollback: shrink the request's table to
-        the blocks covering ``num_tokens`` positions, returning every
-        whole now-unused block to the free list.  Positions beyond the
-        kept range may hold stale (rejected-draft) KV vectors — the
-        decode kernel's length masking never reads past the row's fill
-        count, and the next writes overwrite them.  Returns the number
-        of blocks freed; unknown requests are a no-op (the request may
-        have retired/evicted — its table is already gone)."""
+        the blocks covering ``num_tokens`` positions, releasing every
+        whole now-unused block (to the free list, the cache LRU, or just
+        a ref drop when still shared).  Positions beyond the kept range
+        may hold stale (rejected-draft) KV vectors — the decode kernel's
+        length masking never reads past the row's fill count, and the
+        next writes overwrite them.  Committed tokens never roll back,
+        so a request's hashed full-block prefix is never truncated away.
+        Returns the number of blocks released from this table; unknown
+        requests are a no-op (the request may have retired/evicted — its
+        table is already gone)."""
         table = self._tables.get(request_id)
         if not table:
             return 0
         keep = self.blocks_for_tokens(num_tokens)
         if keep >= len(table):
             return 0
-        freed = table[keep:]
+        released = table[keep:]
         del table[keep:]
-        self._free.extend(freed)
-        return len(freed)
+        for b in released:
+            self._release_block(b)
+        return len(released)
+
+    # ------------------------------------------------------- prefix cache
+    def match_prefix(self, token_ids) -> List[int]:
+        """Block-granular cache lookup: walk the prompt's full blocks,
+        chaining hashes, and return the longest run of consecutively
+        cached blocks from token 0.  Read-only — attachment happens in
+        :meth:`acquire_prefix`.  A ``kv.cache`` deny fault models a
+        lookup outage: no match, full prefill (chaos satellite)."""
+        if not self.cache_enabled or not self._by_hash:
+            return []
+        if self.injector.deny("kv.cache"):
+            return []
+        out: List[int] = []
+        h: Optional[str] = None
+        bs = self.block_size
+        for i in range(len(token_ids) // bs):
+            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs])
+            b = self._by_hash.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def acquire_prefix(self, request_id: int, matched: List[int],
+                       n_fresh: int, fork_last: bool) \
+            -> Optional[Tuple[List[int], Optional[Tuple[int, int]]]]:
+        """Attach ``matched`` cached blocks (ref bump; refcount-0 blocks
+        leave the LRU) as the request's table prefix and extend it with
+        ``n_fresh`` pool blocks — all or nothing; None means the pool
+        could not cover the fresh demand (or a ``kv.cache`` fault fired
+        mid-admission) and NO state changed: the caller degrades to a
+        plain full-prefill admission.
+
+        ``fork_last``: the request will re-write the last matched
+        block's final position (the fully-cached-prompt case, where the
+        last prompt token must be re-scored for logits) — that block is
+        shared/immutable, so it is forked copy-on-write: a fresh block
+        replaces it in the table and the (src, dst) pair is returned for
+        the scheduler to copy the KV payload.  ``n_fresh`` includes the
+        fork destination."""
+        if not matched:
+            return None
+        if self.injector.deny("kv.cache"):
+            return None
+        avail = self.num_reclaimable_blocks \
+            - sum(1 for b in matched if self._ref.get(b, 0) == 0)
+        if n_fresh > avail:
+            return None
+        assert request_id not in self._tables, \
+            f"acquire_prefix: request {request_id} already has a table"
+        table = list(matched)
+        for b in matched:
+            r = self._ref.get(b, 0)
+            if r == 0:
+                self._lru.pop(b)                # cache hit: back to live
+            self._ref[b] = r + 1
+        fork_pair = None
+        n_rest = n_fresh
+        if fork_last:
+            dst = self._pop_block()
+            src = table[-1]
+            table[-1] = dst
+            self._ref[dst] = 1
+            self._release_block(src)    # drop this request's ref; the
+            fork_pair = (src, dst)      # cached original stays shared
+            n_rest -= 1
+        fresh = [self._pop_block() for _ in range(n_rest)]
+        for b in fresh:
+            self._ref[b] = 1
+        table.extend(fresh)
+        self._tables[request_id] = table
+        if fork_pair is not None:
+            fresh = [fork_pair[1]] + fresh
+        return fresh, fork_pair
+
+    def register_committed(self, request_id: int, token_ids,
+                           materialized: Optional[int] = None):
+        """Register the request's committed-and-KV-materialized full
+        blocks as cache entries.  ``materialized`` is the number of
+        leading tokens whose KV vectors are actually in the pool; by
+        default that is ``len(token_ids) - 1`` — the newest sampled
+        token's KV is only written by the decode step that consumes it,
+        so the final block must not be published one position early
+        (prefill callers pass the exact prefilled count).
+
+        Idempotent and incremental: the per-request hash chain is a pure
+        function of the committed prefix (which only grows), so each
+        call hashes only newly-filled blocks.  A hash already mapping to
+        another block keeps the existing entry (first content wins —
+        ``_by_hash`` stays a bijection)."""
+        if not self.cache_enabled:
+            return
+        table = self._tables.get(request_id)
+        if not table:
+            return
+        if materialized is None:
+            materialized = max(0, len(token_ids) - 1)
+        n_full = min(materialized // self.block_size, len(table))
+        chain = self._chains.setdefault(request_id, [])
+        bs = self.block_size
+        for i in range(len(chain), n_full):
+            h = self._chain_hash(chain[-1] if chain else None,
+                                 token_ids[i * bs:(i + 1) * bs])
+            chain.append(h)
+            b = table[i]
+            if b in self._hash_of or h in self._by_hash:
+                continue
+            self._hash_of[b] = h
+            self._by_hash[h] = b
 
     def check_invariant(self):
-        """Allocation-accounting invariant (ISSUE 5 satellite): every
-        non-trash block is on the free list XOR on exactly one table —
-        ``free + live == num_blocks - 1`` with no duplicates.  Raises
-        AssertionError with the discrepancy; the scheduler asserts this
-        per step in debug runs so a shrink-then-regrow cycle that
-        double-frees or leaks fails loudly at the step that broke it."""
-        live = [b for t in self._tables.values() for b in t]
+        """Allocation-accounting invariant, extended to the ref-counted
+        prefix-cache world (ISSUE 6 satellite)::
+
+            free + |unique(live ∪ cached)| == num_blocks - 1
+
+        plus: per-block refcounts equal the number of tables referencing
+        the block; no cached (LRU) block appears in any table or on the
+        free list; every LRU block is hashed with refcount 0; the
+        hash↔block maps are a bijection; the trash block never leaks
+        into any set.  Raises AssertionError with the discrepancy; the
+        scheduler asserts this per step under DS_SERVE_DEBUG=1 so a
+        shrink/regrow/share/fork cycle that double-frees or leaks fails
+        loudly at the step that broke it."""
+        live_counts: Dict[int, int] = {}
+        for rid, t in self._tables.items():
+            if len(set(t)) != len(t):
+                raise AssertionError(
+                    f"block accounting: duplicate block in table of "
+                    f"request {rid} ({t})")
+            for b in t:
+                live_counts[b] = live_counts.get(b, 0) + 1
+        live = set(live_counts)
         free = self._free
-        if len(set(live)) != len(live):
-            raise AssertionError(
-                f"block accounting: duplicate block in tables ({live})")
+        cached = set(self._lru)
         if len(set(free)) != len(free):
             raise AssertionError(
                 f"block accounting: duplicate block on free list ({free})")
-        overlap = set(live) & set(free)
+        overlap = live & set(free)
         if overlap:
             raise AssertionError(
                 f"block accounting: blocks both live and free: {overlap}")
-        if self.TRASH_BLOCK in live or self.TRASH_BLOCK in free:
+        if cached & live:
+            raise AssertionError(
+                f"block accounting: cached blocks still referenced by a "
+                f"table: {cached & live}")
+        if cached & set(free):
+            raise AssertionError(
+                f"block accounting: cached blocks on the free list: "
+                f"{cached & set(free)}")
+        for b, n in live_counts.items():
+            if self._ref.get(b) != n:
+                raise AssertionError(
+                    f"block accounting: block {b} refcount "
+                    f"{self._ref.get(b)} != {n} table references")
+        stray = set(self._ref) - live
+        if stray:
+            raise AssertionError(
+                f"block accounting: refcounts for non-live blocks {stray}")
+        for b in cached:
+            if b not in self._hash_of:
+                raise AssertionError(
+                    f"block accounting: LRU block {b} has no hash entry")
+        for b, h in self._hash_of.items():
+            if self._by_hash.get(h) != b:
+                raise AssertionError(
+                    f"block accounting: hash maps broken for block {b}")
+            if b not in live and b not in cached:
+                raise AssertionError(
+                    f"block accounting: hashed block {b} neither live "
+                    "nor cached")
+        if len(self._by_hash) != len(self._hash_of):
+            raise AssertionError(
+                "block accounting: by_hash/hash_of size mismatch "
+                f"({len(self._by_hash)} != {len(self._hash_of)})")
+        everywhere = live | set(free) | cached
+        if self.TRASH_BLOCK in everywhere:
             raise AssertionError("block accounting: trash block 0 leaked "
                                  "into the allocatable set")
-        if len(free) + len(live) != self.num_blocks - 1:
+        if len(free) + len(live) + len(cached) != self.num_blocks - 1:
             raise AssertionError(
                 f"block accounting: free({len(free)}) + live({len(live)}) "
-                f"!= {self.num_blocks - 1} (leak or double-free)")
+                f"+ cached({len(cached)}) != {self.num_blocks - 1} "
+                "(leak or double-free)")
         return True
 
     # ---------------------------------------------------------- addressing

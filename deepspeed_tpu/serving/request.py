@@ -69,6 +69,10 @@ class ServeRequest:
 
     # -- scheduler-owned runtime state ----------------------------------
     state: RequestState = RequestState.QUEUED
+    #: prompt tokens served from the prefix cache at the LAST admission
+    #: (ISSUE 6) — prefill skipped these; a resumed request re-hitting
+    #: its own prefix counts prompt AND regenerated tokens here
+    num_cached_tokens: int = 0
     #: when the request last ENTERED the queue (submit or eviction);
     #: timeout_s bounds queue wait, not total lifetime — an admitted
     #: request that decodes slowly is being served, not stalled
@@ -145,6 +149,7 @@ class ServeRequest:
             "state": self.state.value,
             "output_ids": list(self.output_ids),
             "num_preemptions": self.num_preemptions,
+            "num_cached_tokens": self.num_cached_tokens,
         }
         if self.reject_reason is not None:
             out["reject_reason"] = self.reject_reason

@@ -6,6 +6,9 @@ Each ``step()`` is one engine iteration:
 1. expire queued requests past their timeout (graceful 429, never a crash);
 2. admit queued prefills — highest priority first — up to the
    ``max_num_batched_tokens`` budget and the free-slot/free-block supply;
+   with ``serving.prefix_cache`` on, each prompt is first matched
+   block-by-block against the cross-request prefix cache and only the
+   uncached suffix prefills (ISSUE 6);
 3. grow each active row's block table for the token it is about to write
    (allocate-on-decode); under pool exhaustion the lowest-priority active
    request is preempted (blocks freed, request requeued; it resumes later
@@ -215,8 +218,16 @@ class ContinuousBatchingScheduler:
         self.injector = (injector if injector is not None
                          else resolve_injector())
         self._telemetry_registry = registry
+        # cross-request prefix cache (ISSUE 6): released full blocks are
+        # hash-addressed and retained; _admit matches prompts against
+        # them and prefills only the uncached suffix
+        pc = config.prefix_cache
+        self._prefix_cache_on = bool(pc.enabled)
+        self._prefix_min_blocks = pc.min_prefix_blocks
         self.block_mgr = BlockManager(config.num_blocks, config.block_size,
-                                      injector=self.injector)
+                                      injector=self.injector,
+                                      cache_enabled=pc.enabled,
+                                      max_cached_blocks=pc.max_cached_blocks)
         # int8-weights decode dispatch: install this config's threshold so
         # the model-side use_scan_decode sees it (env override still wins
         # inside get_quant_scan_threshold).  Only an EXPLICITLY supplied
@@ -239,6 +250,11 @@ class ContinuousBatchingScheduler:
         # S-block alignment (engine.py cache_size does the same)
         self.s_pad = _round_up(self.max_model_len, 64)
         self.blocks_per_table = -(-self.s_pad // bs)
+        # table→flat-pool expansion, shared by every dense gather
+        # (_pos_idx_row): logical position p lives at
+        # table[p // bs] * bs + p % bs
+        self._pos_offs = np.arange(self.s_pad) % bs
+        self._pos_blk = np.arange(self.s_pad) // bs
 
         #: per-step block-accounting invariant check (O(num_blocks) under
         #: the scheduler lock — a debug aid, not a production default);
@@ -260,6 +276,8 @@ class ContinuousBatchingScheduler:
         self._decode_fns = {}
         self._sample1_fns = {}
         self._verify_fns = {}
+        self._suffix_prefill_fns = {}
+        self._copy_fn = None            # COW-fork block copy (lazy jit)
         self._finished_this_step: List[ServeRequest] = []
         # --- speculative decoding (ISSUE 5): resolve the proposer from
         # serving.spec.mode; an explicit proposer wins (and implies spec
@@ -408,6 +426,72 @@ class ContinuousBatchingScheduler:
             self._verify_fns[key] = jax.jit(fn)
         return self._verify_fns[key]
 
+    #: suffix-prefill chunk width (ISSUE 6): cached-prefix admissions
+    #: prefill only the uncached tail, riding the verify-window path in
+    #: chunks of at most this many tokens — one weight pass per chunk,
+    #: and a bounded compiled-program set (W ∈ SUFFIX_BUCKET-multiples up
+    #: to 64) instead of one W-unrolled program per suffix length
+    SUFFIX_CHUNK = 64
+    #: finer than PROMPT_BUCKET: the window unrolls per-position
+    #: attention, so rounding a 5-token tail up to 16 doubles its cost
+    SUFFIX_BUCKET = 8
+
+    def _suffix_prefill_fn(self, W: int):
+        """Prefix-cache suffix prefill (ISSUE 6): score ``W`` prompt-tail
+        tokens at positions ``length..length+W-1`` against the request's
+        pool-gathered cache — the cached prefix supplies positions below
+        ``length`` — and scatter the window's KV vectors back (pad
+        positions land in the trash block).  This IS the speculative
+        verify surface (`models/serving.py verify_window`, or the
+        scan-of-decode fallback for families without it): one weight
+        pass scores the whole window with per-position causal attention,
+        exactly what a resume-style re-prefill of the suffix needs."""
+        if W not in self._suffix_prefill_fns:
+            from deepspeed_tpu.serving.spec.verifier import scan_verify_fn
+            model = self.model
+            vf = model.verify_fn
+            if vf is None or os.environ.get("DS_SPEC_VERIFY") == "scan":
+                vf = scan_verify_fn(model.decode_fn)
+
+            def fn(params, pool, tokens, length, dests, pos_idx):
+                # tokens [1, W]; length [1] = first suffix position;
+                # dests [W] flat pool destinations; pos_idx [1, S_pad]
+                dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
+                logits, new_cache = vf(params, tokens, dense, length)
+                # ONE gather+scatter for the whole window (a per-position
+                # .set loop would copy the full pool W times on backends
+                # that don't fuse the chain).  Clamped GATHER, not a
+                # dynamic_slice: when the padded window overruns the
+                # dense width (a prompt ending within W of s_pad) a
+                # dynamic_slice would clamp its START and silently
+                # misalign every row against dests — the clamp here only
+                # affects pad rows, whose dests point at the trash block
+                win = jax.tree.map(
+                    lambda c: c[:, 0][:, jnp.minimum(
+                        length[0] + jnp.arange(W), c.shape[2] - 1)],
+                    new_cache)
+                pool = jax.tree.map(
+                    lambda p, w: p.at[:, dests].set(w), pool, win)
+                return logits, pool             # logits [1, W, V]
+
+            self._suffix_prefill_fns[W] = jax.jit(fn)
+        return self._suffix_prefill_fns[W]
+
+    def _cow_copy(self, pair):
+        """Execute a copy-on-write fork's physical KV move: duplicate the
+        shared source block's pool positions into the request's private
+        destination block (the BlockManager already swapped the table
+        entry).  One jitted gather/scatter, reused for every fork."""
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(lambda pool, src, dst: jax.tree.map(
+                lambda p: p.at[:, dst].set(p[:, src]), pool))
+        src, dst = pair
+        bs = self.block_mgr.block_size
+        self.pool = self._copy_fn(
+            self.pool,
+            jnp.arange(src * bs, (src + 1) * bs, dtype=jnp.int32),
+            jnp.arange(dst * bs, (dst + 1) * bs, dtype=jnp.int32))
+
     # ----------------------------------------------------------- submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
                timeout_s: float = 0.0) -> ServeRequest:
@@ -487,6 +571,10 @@ class ContinuousBatchingScheduler:
                 reason: Optional[str] = None):
         if self.proposer is not None:
             self.proposer.release(req.request_id)
+        # release INTO the cache (ISSUE 6): hash any last full blocks,
+        # then free — hashed blocks park on the LRU for the next request
+        self.block_mgr.register_committed(req.request_id,
+                                          req.all_token_ids)
         self.block_mgr.free(req.request_id)
         if req.slot >= 0:
             self._slots[req.slot] = None
@@ -501,9 +589,14 @@ class ContinuousBatchingScheduler:
         req.done.set()
 
     def _evict(self, victim: ServeRequest):
-        """Preempt: free blocks+slot, requeue for recompute-on-resume."""
+        """Preempt: free blocks+slot, requeue for recompute-on-resume.
+        With the prefix cache on, the victim's full blocks are hashed
+        first — resume re-matches them and re-prefills (close to)
+        nothing instead of the whole prompt+generated tail."""
         if self.proposer is not None:
             self.proposer.release(victim.request_id)
+        self.block_mgr.register_committed(victim.request_id,
+                                          victim.all_token_ids)
         self.block_mgr.free(victim.request_id)
         if victim.slot >= 0:
             self._slots[victim.slot] = None
@@ -530,8 +623,18 @@ class ContinuousBatchingScheduler:
     # -------------------------------------------------------- admission
     def _admit(self):
         """Admit queued prefills (highest priority, then oldest, first)
-        into free slots, bounded by the step token budget and the pool."""
+        into free slots, bounded by the step token budget and the pool.
+
+        With the prefix cache on (ISSUE 6), each prompt is first matched
+        block-by-block against the cache: matched blocks attach to the
+        request's table with a ref bump and prefill starts at the first
+        uncached token — a fully cached prompt re-scores only its last
+        token, into a copy-on-write fork of the final shared block.  A
+        failed attach (pool pressure mid-admission, or an injected
+        ``kv.cache`` fault) degrades to a plain full prefill, never to a
+        corrupted table."""
         budget = self.cfg.max_num_batched_tokens
+        bm = self.block_mgr
         spent = 0
         while self._queue:
             free_slots = [i for i, r in enumerate(self._slots) if r is None]
@@ -545,63 +648,142 @@ class ContinuousBatchingScheduler:
             # decode recomputes that one's KV as it proceeds
             inputs = tokens[:-1] if resumed else tokens
             n_in = int(inputs.size)
-            if spent and spent + n_in > budget:
+            matched, start = ([], 0)
+            if self._prefix_cache_on:
+                matched, start = self._match_prefix(req, inputs, resumed)
+            # the budget meters PREFILL COMPUTE: cached tokens are free
+            if spent and spent + (n_in - start) > budget:
                 break
             # blocks covering positions [0, n_in] — prefill fill plus the
             # first decode write — so admission never instantly preempts
-            need = self.block_mgr.blocks_for_tokens(n_in + 1)
-            if not self.block_mgr.can_allocate(need):
-                break
-            # allocate BEFORE dequeueing: a denied allocation (injected
-            # fault or free-list race) must leave the request queued, not
-            # admit it blockless
-            if self.block_mgr.allocate(req.request_id, need) is None:
-                break
+            total = bm.blocks_for_tokens(n_in + 1)
+            n_full = n_in // bm.block_size
+            fork_pair = None
+            c = self.metrics.counters
+            if matched:
+                # prefill writing INTO the matched region (the fully
+                # cached prompt's last token) forks that block COW
+                fork = start < len(matched) * bm.block_size
+                n_fresh = total - len(matched) + (1 if fork else 0)
+                got = bm.acquire_prefix(req.request_id, matched,
+                                        n_fresh, fork)
+                if got is None:
+                    # degrade: full prefill — the whole prompt is now
+                    # prefill compute, so the budget check re-runs
+                    matched, start = ([], 0)
+                    if spent and spent + n_in > budget:
+                        break
+                else:
+                    fork_pair = got[1]
+            if not matched:
+                if not bm.can_allocate(total):
+                    break
+                # allocate BEFORE dequeueing: a denied allocation
+                # (injected fault or free-list race) must leave the
+                # request queued, not admit it blockless
+                if bm.allocate(req.request_id, total) is None:
+                    break
             self._queue.remove(req)
+            if self._prefix_cache_on:
+                # hits count at ATTACH on the admission that sticks, not
+                # at lookup: a discarded match (below min_prefix_blocks,
+                # attach denied) served nothing and must not inflate the
+                # hit-rate gauge, and a request left queued by pool
+                # pressure must not re-count its misses every retry
+                c["prefix_cache_hit"] += len(matched)
+                c["prefix_cache_miss"] += n_full - len(matched)
             req.state = RequestState.PREFILL
             req.slot = free_slots[0]
             self._slots[req.slot] = req
-            spent += n_in
+            req.num_cached_tokens = start
+            spent += n_in - start
             self.metrics.observe_queue_wait(
                 time.monotonic() - req.queued_at)
             if resumed:
                 # goodput accounting: the generated tail re-prefilled
-                # here is work the pool preemption threw away
+                # here is work the pool preemption threw away — a
+                # cache re-hit of the request's own blocks shrinks it
                 self.metrics.counters["recomputed_tokens"] += max(
-                    0, n_in - req.prompt_len)
-            self._run_prefill(req, inputs, resumed)
+                    0, n_in - max(start, req.prompt_len))
+            if fork_pair is not None:
+                self._cow_copy(fork_pair)
+                self.metrics.counters["prefix_cache_cow_forks"] += 1
+            if start >= n_in:
+                # resumed request fully served from cache: nothing to
+                # prefill, the generated tail is already sampled — straight
+                # to decode (recomputed_tokens rides at 0)
+                req.state = RequestState.DECODE
+            else:
+                self._run_prefill(req, inputs, resumed, start)
             if resumed:
                 self.metrics.counters["resumed"] += 1
         if spent:
             self.metrics.prefill_batch_tokens.observe(spent)
 
+    def _match_prefix(self, req: ServeRequest, inputs: np.ndarray,
+                      resumed: bool):
+        """Cache lookup for one admission: returns (matched blocks,
+        prefill-start token).  Fresh requests cap the start at the last
+        prompt token — its logits seed sampling, so it must be re-scored
+        even when its block is cached (the COW-fork case); resumed
+        requests may skip prefill entirely."""
+        from deepspeed_tpu.telemetry import get_tracer
+        bm = self.block_mgr
+        n_in = int(inputs.size)
+        with get_tracer().span("serve/prefix_match", cat="serving",
+                               corr=f"req-{req.request_id}",
+                               args={"request_id": req.request_id,
+                                     "prompt_tokens": n_in,
+                                     "resumed": bool(resumed)}):
+            blocks = bm.match_prefix(inputs)
+        # hit/miss accounting happens in _admit once the admission
+        # sticks — lookups that don't end in an attach count as misses
+        if len(blocks) < self._prefix_min_blocks:
+            return [], 0
+        start = len(blocks) * bm.block_size
+        if not resumed and start >= n_in:
+            start = n_in - 1
+        return blocks, start
+
     def _run_prefill(self, req: ServeRequest, inputs: np.ndarray,
-                     resumed: bool):
+                     resumed: bool, start: int = 0):
         from deepspeed_tpu.telemetry import get_tracer
         with get_tracer().span("serve/prefill", cat="serving",
                                corr=f"req-{req.request_id}",
                                args={"request_id": req.request_id,
-                                     "tokens": int(inputs.size),
+                                     "tokens": int(inputs.size) - start,
+                                     "cached": int(start),
                                      "resumed": bool(resumed)}):
-            self._run_prefill_traced(req, inputs, resumed)
+            self._run_prefill_traced(req, inputs, resumed, start)
 
     def _run_prefill_traced(self, req: ServeRequest, inputs: np.ndarray,
-                            resumed: bool):
-        sp = min(max(_round_up(inputs.size, self.PROMPT_BUCKET),
-                     self.PROMPT_BUCKET), self.s_pad)
-        padded = np.zeros((1, sp), np.int32)
-        padded[0, :inputs.size] = inputs
-        # flat pool destination per prompt position; pads write into the
-        # trash block (positions 0..block_size-1), never a live block
+                            resumed: bool, start: int = 0):
         bm = self.block_mgr
-        dest = np.arange(sp) % bm.block_size
-        pos = np.arange(inputs.size)
-        dest[:inputs.size] = [bm.position_index(req.request_id, int(p))
-                              for p in pos]
-        last_logits, self.pool = self._prefill_fn(sp)(
-            self.params, self.pool, jnp.asarray(padded),
-            jnp.asarray([inputs.size], np.int32), jnp.asarray(dest))
-        self.metrics.counters["prefill_tokens"] += int(inputs.size)
+        if start > 0:
+            # cached-prefix admission: only the uncached suffix runs
+            last_logits = self._suffix_prefill(req, inputs, start)
+        else:
+            sp = min(max(_round_up(inputs.size, self.PROMPT_BUCKET),
+                         self.PROMPT_BUCKET), self.s_pad)
+            padded = np.zeros((1, sp), np.int32)
+            padded[0, :inputs.size] = inputs
+            # flat pool destination per prompt position; pads write into
+            # the trash block (positions 0..block_size-1), never a live
+            # block
+            dest = np.arange(sp) % bm.block_size
+            pos = np.arange(inputs.size)
+            dest[:inputs.size] = [bm.position_index(req.request_id, int(p))
+                                  for p in pos]
+            last_logits, self.pool = self._prefill_fn(sp)(
+                self.params, self.pool, jnp.asarray(padded),
+                jnp.asarray([inputs.size], np.int32), jnp.asarray(dest))
+        self.metrics.counters["prefill_tokens"] += int(inputs.size) - start
+        # the prompt's full blocks are cache content from here on —
+        # registering BEFORE the first sample lets the next admission in
+        # this very step hit them (materialized = exactly the prefilled
+        # prefix; the token sampled below has no KV yet)
+        bm.register_committed(req.request_id, inputs,
+                              materialized=int(inputs.size))
         req.state = RequestState.DECODE
         if resumed:
             return                  # generated tail already sampled
@@ -620,6 +802,35 @@ class ContinuousBatchingScheduler:
         self.metrics.counters["generated_tokens"] += 1
         if req.finished_by(tok):
             self._retire(req, RequestState.FINISHED)
+
+    def _suffix_prefill(self, req: ServeRequest, inputs: np.ndarray,
+                        start: int):
+        """Prefill tokens ``start..n_in-1`` against the cached prefix,
+        in SUFFIX_CHUNK-sized verify windows (see _suffix_prefill_fn);
+        returns the last real position's logits ``[1, V]`` for first-
+        token sampling."""
+        bm = self.block_mgr
+        n_in = int(inputs.size)
+        # dense gather indices over the request's (fully allocated,
+        # possibly shared) table — fixed across chunks
+        pos_idx = self._pos_idx_row(req.request_id)[None]
+        pos, last = start, None
+        while pos < n_in:
+            take = min(self.SUFFIX_CHUNK, n_in - pos)
+            W = min(_round_up(take, self.SUFFIX_BUCKET), self.SUFFIX_CHUNK)
+            toks = np.zeros((1, W), np.int32)
+            toks[0, :take] = inputs[pos:pos + take]
+            # pad window positions keep the trash pattern
+            dests = (np.arange(W) % bm.block_size).astype(np.int32)
+            for j in range(take):
+                dests[j] = bm.position_index(req.request_id, pos + j)
+            logits, self.pool = self._suffix_prefill_fn(W)(
+                self.params, self.pool, jnp.asarray(toks),
+                jnp.asarray([pos], np.int32), jnp.asarray(dests),
+                jnp.asarray(pos_idx))
+            last = logits[0, take - 1][None]
+            pos += take
+        return last
 
     # ------------------------------------------------- decode iteration
     def _grow_tables(self):
@@ -657,7 +868,7 @@ class ContinuousBatchingScheduler:
             if n > 0:
                 plan.append((req, n))
                 total += n
-        if total > bm.num_free_blocks:
+        if total > bm.num_reclaimable_blocks:
             return False
         for req, n in plan:
             if bm.allocate(req.request_id, n) is None:
@@ -696,15 +907,10 @@ class ContinuousBatchingScheduler:
         floats = np.ones((2, B), np.float32)
         do_flags = np.zeros((B,), bool)
         pos_idx = np.zeros((B, self.s_pad), np.int32)
-        offs = np.arange(self.s_pad) % bm.block_size
-        blk_of = np.arange(self.s_pad) // bm.block_size
         for req in active:
             b = req.slot
             seq = req.all_token_ids
-            table = np.zeros((self.blocks_per_table,), np.int64)
-            t = bm.block_table(req.request_id)
-            table[:len(t)] = t
-            pos_idx[b] = table[blk_of] * bm.block_size + offs
+            pos_idx[b] = self._pos_idx_row(req.request_id)
             s = req.sampling
             ints[0, b], ints[1, b] = seq[-1], seq.size - 1
             ints[2, b], ints[3, b] = s.seed & 0x7FFFFFFF, s.top_k
@@ -809,17 +1015,12 @@ class ContinuousBatchingScheduler:
         floats = np.ones((2, B), np.float32)
         do_flags = np.zeros((B,), bool)
         pos_idx = np.zeros((B, self.s_pad), np.int32)
-        offs = np.arange(self.s_pad) % bm.block_size
-        blk_of = np.arange(self.s_pad) // bm.block_size
         for req in active:
             b = req.slot
             seq = req.all_token_ids
             d = drafts.get(req.request_id)
             nd = 0 if d is None else int(d.size)
-            table = np.zeros((self.blocks_per_table,), np.int64)
-            t = bm.block_table(req.request_id)
-            table[:len(t)] = t
-            pos_idx[b] = table[blk_of] * bm.block_size + offs
+            pos_idx[b] = self._pos_idx_row(req.request_id)
             s = req.sampling
             ints[0, b] = seq[-1]
             if nd:
@@ -841,6 +1042,17 @@ class ContinuousBatchingScheduler:
         self._apply_spec_result(active, drafts, np.asarray(acc),
                                 np.asarray(out))
         return True
+
+    def _pos_idx_row(self, request_id: int) -> np.ndarray:
+        """One row of dense-gather indices: the flat pool position of
+        every logical position 0..s_pad-1 for this request.  Positions
+        past the allocated table ride block 0 (the trash block), like
+        padding rows — the length masking never reads them."""
+        table = np.zeros((self.blocks_per_table,), np.int64)
+        t = self.block_mgr.block_table(request_id)
+        table[:len(t)] = t
+        return (table[self._pos_blk] * self.block_mgr.block_size
+                + self._pos_offs).astype(np.int32)
 
     def _request_in_slot(self, request_id: int) -> Optional[ServeRequest]:
         for r in self._slots:
@@ -940,6 +1152,14 @@ class ContinuousBatchingScheduler:
                 with tracer.span("serve/decode", cat="serving",
                                  args={"active": active}):
                     self._decode()
+                if self._prefix_cache_on:
+                    # newly filled full blocks become cache entries while
+                    # their owners still decode — concurrent same-prefix
+                    # admissions share them immediately
+                    for r in self._slots:
+                        if r is not None and r.state == RequestState.DECODE:
+                            self.block_mgr.register_committed(
+                                r.request_id, r.all_token_ids)
                 self._step_count += 1
                 if self._debug_invariant:
                     # allocation-accounting invariant (ISSUE 5): spec
@@ -973,6 +1193,14 @@ class ContinuousBatchingScheduler:
             free_blocks=self.block_mgr.num_free_blocks,
             goodput=round(serving_goodput(
                 c["generated_tokens"], c["recomputed_tokens"]), 4))
+        if self._prefix_cache_on:
+            c["prefix_cache_evict"] = self.block_mgr.cache_evictions
+            self.metrics.gauges["cached_blocks"] = \
+                self.block_mgr.num_cached_blocks
+            lookups = c["prefix_cache_hit"] + c["prefix_cache_miss"]
+            if lookups:
+                self.metrics.gauges["prefix_cache_hit_rate"] = round(
+                    c["prefix_cache_hit"] / lookups, 4)
         if elapsed > 0 and c["generated_tokens"]:
             self.metrics.gauges["tokens_per_s"] = round(
                 c["generated_tokens"] / elapsed, 3)

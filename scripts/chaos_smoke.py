@@ -221,6 +221,47 @@ def case_spec_fault_degrades():
     assert sched.block_mgr.num_allocated_blocks == 0
 
 
+def case_prefix_cache_fault_degrades():
+    """kv.cache deny during prefix-cache admission (ISSUE 6): lookups
+    and attaches are refused, so every request degrades to a full
+    prefill — exact greedy output, no live-block-table corruption, pool
+    fully drained with the ref-counted invariant intact."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        prefix_cache={"enabled": True})
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("kv.cache:deny@*"))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 128, (16,)).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(1, 128, (3 + i,)).astype(
+                                   np.int32)]) for i in range(3)]
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=6))
+            for p in prompts]
+    sched.run_until_idle()
+    for p, req in zip(prompts, reqs):
+        ref = np.asarray(eng.generate(p[None], max_new_tokens=6,
+                                      do_sample=False))[0, p.size:]
+        assert req.state == RequestState.FINISHED
+        assert np.array_equal(np.asarray(req.output_ids), ref)
+    assert sched.metrics.counters["prefix_cache_hit"] == 0, \
+        "a denied cache lookup still reported hits"
+    assert sched.block_mgr.num_allocated_blocks == 0
+    sched.block_mgr.check_invariant()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="resilience chaos smoke")
     p.add_argument("--fast", action="store_true",
@@ -250,6 +291,8 @@ def main(argv=None):
     cases.append(("kv.alloc deny preempts", case_kv_deny_preempts))
     cases.append(("serve.spec fault degrades to plain decode",
                   case_spec_fault_degrades))
+    cases.append(("kv.cache fault degrades to full prefill",
+                  case_prefix_cache_fault_degrades))
 
     results = []
     for name, fn in cases:

@@ -12,6 +12,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODEL=bloom:560m SERVE_B=8 python scripts/serve_bench.py
     SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
+    SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
 
 Static mode prints one JSON line: prefill ms + steady decode tokens/s.
 CB mode prints one JSON line: continuous-batching vs static-batch tok/s
@@ -19,6 +20,11 @@ on the same mixed-length workload + p50/p99 TTFT.
 Spec mode (ISSUE 5) runs the ngram-proposer speculative path vs plain cb
 on a mixed-length repetitive-suffix workload and reports tokens per
 weight pass + acceptance rate (the ISSUE 5 acceptance columns).
+Prefix mode (ISSUE 6) runs the cb scheduler on a SHARED-PREFIX workload
+(N requests over M shared system prompts + distinct tails) with the
+prefix cache on vs off and reports TTFT p50/p99, cache hit rate,
+prefill tokens computed, and serving_goodput — the ISSUE 6 acceptance
+columns (identical outputs asserted between the two runs).
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import json
@@ -78,7 +84,7 @@ def main():
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"
         kwargs = {}
-    elif os.environ.get("SERVE_MODE") in ("cb", "spec"):
+    elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -90,8 +96,16 @@ def main():
     # cb/spec modes size their own workloads (spec's motif-tiled prompts
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
-    cb_ctx = (0 if _mode not in ("cb", "spec")
-              else (768 + 384 if on_tpu else (96 if _mode == "cb" else 128)))
+    if _mode not in ("cb", "spec", "prefix"):
+        cb_ctx = 0
+    elif on_tpu:
+        cb_ctx = 768 + 384
+    elif _mode == "prefix":
+        # headroom for the shared system prompts — the long-shared-head
+        # short-tail regime is the whole point of this mode
+        cb_ctx = int(os.environ.get("SERVE_SYS_LEN", 512)) + 128
+    else:
+        cb_ctx = 96 if _mode == "cb" else 128
     model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
                            else "float32",
                            max_seq_len=max(2048 if on_tpu else 64,
@@ -119,6 +133,8 @@ def main():
         return bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu)
     if os.environ.get("SERVE_MODE") == "spec":
         return bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu)
+    if os.environ.get("SERVE_MODE") == "prefix":
+        return bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -358,6 +374,104 @@ def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu):
             "accepted": int(c["spec_accepted_tokens"]),
             "rolled_back": int(c["spec_rolled_back_tokens"]),
             "verify_passes": int(c["spec_verify_steps"]),
+        },
+    }))
+
+
+def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu):
+    """Shared-prefix workload (ISSUE 6): N requests drawn over M shared
+    system prompts, each with a distinct random tail — the chat-fleet
+    regime where most prefill is redundant.  Runs the cb scheduler with
+    the prefix cache ON vs OFF (fresh scheduler each, identical
+    workload), asserts token-identical outputs, and reports TTFT
+    p50/p99, block-granular hit rate, prefill tokens computed (the >=2x
+    acceptance column), and serving_goodput."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 32 if on_tpu else 12))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    n_sys = int(os.environ.get("SERVE_SYS_PROMPTS", 4 if on_tpu else 2))
+    sys_len = int(os.environ.get("SERVE_SYS_LEN", 512))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    t_lo, t_hi = ((16, 96) if on_tpu else (4, 16))
+    n_lo, n_hi = ((32, 128) if on_tpu else (6, 20))
+    systems = [rng.integers(1, V, (sys_len,)).astype(np.int32)
+               for _ in range(n_sys)]
+    workload = []
+    for i in range(n_reqs):
+        tail = rng.integers(1, V, (int(rng.integers(t_lo, t_hi)),))
+        prompt = np.concatenate([systems[i % n_sys], tail])
+        workload.append((prompt.astype(np.int32),
+                         int(rng.integers(n_lo, n_hi))))
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 8
+    need = -(-max_len // bs) + 1
+    # pool sized so the batch fits AND released prefixes can be retained
+    # (the steady-state regime the cache serves)
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * (max_seqs + n_sys + 1),
+                max_num_batched_tokens=1 << 30)
+
+    def run(enabled):
+        cfg = ServingConfig(**base,
+                            prefix_cache={"enabled": enabled})
+        sched = ContinuousBatchingScheduler(
+            model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+        outs = None
+        # warm compiles out of the measurement, then measure (fresh
+        # submission wave; the cache persists across waves, as in a
+        # long-lived server)
+        for _ in range(2):
+            reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                    for p, nn in workload]
+            t0 = _time.time()
+            sched.run_until_idle()
+            dt = _time.time() - t0
+            assert all(len(r.output_ids) == nn
+                       for r, (_, nn) in zip(reqs, workload))
+            outs = [list(r.output_ids) for r in reqs]
+        ttfts = sorted(r.ttft_s for r in reqs)
+        return dt, ttfts, sched.metrics, outs
+
+    on_s, on_ttft, on_m, on_out = run(True)
+    off_s, off_ttft, off_m, off_out = run(False)
+    assert on_out == off_out, \
+        "prefix cache changed greedy output (parity violation)"
+    pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)
+    c = on_m.counters
+    lookups = c["prefix_cache_hit"] + c["prefix_cache_miss"]
+    print(json.dumps({
+        "metric": f"{spec}_serve_prefix"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / on_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "requests": n_reqs, "system_prompts": n_sys,
+            "system_len": sys_len, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "block_size": bs,
+            "cache_on_tok_s": round(useful / on_s, 1),
+            "cache_off_tok_s": round(useful / off_s, 1),
+            "speedup_vs_off": round(off_s / on_s, 3),
+            "prefill_tokens_on": int(c["prefill_tokens"]),
+            "prefill_tokens_off": int(
+                off_m.counters["prefill_tokens"]),
+            "prefill_reduction": round(
+                off_m.counters["prefill_tokens"]
+                / max(c["prefill_tokens"], 1), 2),
+            "hit_rate": round(c["prefix_cache_hit"] / max(lookups, 1), 3),
+            "cow_forks": int(c["prefix_cache_cow_forks"]),
+            "evictions": int(c["prefix_cache_evict"]),
+            "ttft_on_p50_ms": pct(on_ttft, 50),
+            "ttft_on_p99_ms": pct(on_ttft, 99),
+            "ttft_off_p50_ms": pct(off_ttft, 50),
+            "ttft_off_p99_ms": pct(off_ttft, 99),
+            "goodput_on": on_m.gauges.get("goodput"),
+            "goodput_off": off_m.gauges.get("goodput"),
         },
     }))
 

@@ -3,8 +3,30 @@ device=cpu/nvme; tests/unit/runtime/zero compare offload vs plain paths)."""
 import numpy as np
 import pytest
 
+import jax
+
 import deepspeed_tpu
 from tests.util import tiny_gpt2, base_config, random_batches
+
+
+def _has_pinned_host() -> bool:
+    return any(m.kind == "pinned_host"
+               for m in jax.local_devices()[0].addressable_memories())
+
+
+#: environment-blocked (ROADMAP hygiene item 6): offload_param places
+#: block params with memory_kind="pinned_host", which this container's
+#: jaxlib CPU backend does not implement (its CPU devices address only
+#: unpinned_host — engine init dies in jax sharding_impls with
+#: "Could not find memory addressable by device cpu ... Got memory
+#: kind: pinned_host").  Repro: any jax.device_put to
+#: jax.local_devices()[0].memory("pinned_host") raises the same error;
+#: the tests pass wherever the backend advertises pinned_host (newer
+#: jaxlib CPU, any TPU).
+requires_pinned_host = pytest.mark.skipif(
+    not _has_pinned_host(),
+    reason="jaxlib CPU backend lacks the pinned_host memory kind "
+           "offload_param shards into (env-blocked; see module note)")
 
 
 def _train(engine, steps=3, seed=0):
@@ -167,6 +189,7 @@ def test_offload_param_multidevice_requires_stage3(devices8):
                     "offload_param": {"device": "cpu"}}))
 
 
+@requires_pinned_host
 def test_offload_param_multidevice_trains_to_parity(devices8):
     """offload_param on an 8-device mesh (full ZeRO-Infinity: per-device
     pinned-host shards of the layer stack, per-layer stream doubling as
@@ -199,6 +222,7 @@ def test_offload_param_multidevice_trains_to_parity(devices8):
     np.testing.assert_allclose(off, ref, rtol=2e-4, atol=2e-4)
 
 
+@requires_pinned_host
 def test_offload_param_params_live_on_host(mesh1):
     """offload_param stores block params in pinned host memory —
     HBM holds O(1 layer), the ZeRO-Infinity memory shape (reference
@@ -226,6 +250,7 @@ def test_offload_param_params_live_on_host(mesh1):
     assert engine.state["params"]["wte"].sharding.memory_kind == "device"
 
 
+@requires_pinned_host
 def test_offload_param_matches_no_offload(mesh1):
     """Training with the param-offload streaming path must match the plain
     host-offload path step for step (same optimizer, same grads)."""
@@ -243,6 +268,7 @@ def test_offload_param_matches_no_offload(mesh1):
     np.testing.assert_allclose(l_inf, l_ref, rtol=1e-5, atol=1e-5)
 
 
+@requires_pinned_host
 def test_offload_param_with_gas(mesh1):
     """gas>1 exercises the python-level host grad accumulation."""
     engine, *_ = deepspeed_tpu.initialize(
@@ -258,6 +284,7 @@ def test_offload_param_with_gas(mesh1):
         assert np.isfinite(loss)
 
 
+@requires_pinned_host
 def test_offload_param_nvme_masters(mesh1, tmp_path):
     """device=nvme: fp32 masters AND moments stream through the aio op;
     only the compute-dtype working copy stays in host DRAM."""
@@ -279,6 +306,7 @@ def test_offload_param_nvme_masters(mesh1, tmp_path):
     assert any(".m0" in n for n in names), names             # moments on disk
 
 
+@requires_pinned_host
 def test_offload_param_checkpoint_roundtrip(mesh1, tmp_path):
     cfg = base_config(
         zero_optimization={"stage": 0,

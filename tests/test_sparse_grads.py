@@ -5,7 +5,8 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from deepspeed_tpu.utils.jax_compat import shard_map
+from deepspeed_tpu.utils.jax_compat import (HAS_PARTIAL_AUTO_SHARD_MAP,
+                                            shard_map)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import deepspeed_tpu
@@ -69,6 +70,12 @@ def test_sparse_gradients_training_matches_dense(devices8):
     np.testing.assert_allclose(sparse_wte, dense_wte, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.skipif(
+    not HAS_PARTIAL_AUTO_SHARD_MAP,
+    reason="sparse-tier-on-wide-mesh needs partially-auto shard_map; "
+           "this jax's lowering CHECK-aborts the process so the engine "
+           "gates the tier off (env-blocked — same class as the qgZ "
+           "skips, see tests/test_zeropp.py module note)")
 def test_sparse_gradients_on_hybrid_tp_mesh(devices8):
     """sparse_gradients engages on a TP×DP mesh (round-2 VERDICT weak 1:
     no more single-axis pure-DP restriction) — the touched-rows exchange

@@ -408,6 +408,37 @@ def test_serve_spec_fault_degrades_to_plain_decode(served):
         assert sched.block_mgr.num_allocated_blocks == 0
 
 
+# ------------------------------------------------- prefix cache (ISSUE 6)
+def test_spec_with_prefix_cache_parity(served):
+    """Speculative decoding over a cache-enabled pool: drafted windows
+    roll back through truncate on tables whose prefix blocks are SHARED,
+    and greedy output stays exactly plain-cb's — committed tokens never
+    roll back, so the cached prefix is never corrupted (the invariant
+    fixture checks the ref-counted accounting every step)."""
+    m, eng = served
+    rng = np.random.default_rng(17)
+    shared = np.tile(np.asarray([9, 23, 4], np.int32), 8)   # 24 tokens
+    prompts = [np.concatenate(
+        [shared, rng.integers(1, 128, (int(t),)).astype(np.int32)])
+        for t in (3, 5, 7)]
+    prompts.append(shared.copy())     # block-aligned: COW-fork admission
+    cfg = _spec_cfg(prefix_cache={"enabled": True})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=14))
+            for p in prompts]
+    sched.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.state == RequestState.FINISHED
+        np.testing.assert_array_equal(
+            np.asarray(r.output_ids), _static_reference(eng, p, 14))
+    c = sched.metrics.counters
+    assert c["spec_verify_steps"] > 0         # speculation really ran
+    assert c["prefix_cache_hit"] >= 3         # the cache really hit
+    assert c["prefix_cache_cow_forks"] >= 1   # ...including the fork path
+    assert sched.block_mgr.num_allocated_blocks == 0
+    sched.block_mgr.check_invariant()
+
+
 # ------------------------------------------------------------- telemetry
 def test_spec_metrics_and_correlated_spans(served, tmp_path, monkeypatch):
     """serve/draft + serve/verify spans share each request's correlation

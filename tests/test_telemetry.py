@@ -421,14 +421,18 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     sched = ContinuousBatchingScheduler(
         m, eng.params,
         ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
-                      spec={"mode": "ngram", "max_draft_tokens": 4}),
+                      spec={"mode": "ngram", "max_draft_tokens": 4},
+                      prefix_cache={"enabled": True}),
         registry=MetricsRegistry(),
         injector=FaultInjector("serve.step:raise@1"))
     for p in _prompts(2, seed=7):
         sched.submit(p, SamplingParams(max_new_tokens=3))
-    # a repetitive prompt so the ngram proposer actually drafts
-    sched.submit(np.tile(np.asarray([9, 23, 4], np.int32), 5),
-                 SamplingParams(max_new_tokens=8))
+    # a repetitive prompt so the ngram proposer actually drafts —
+    # submitted twice so the second admission hits the prefix cache
+    # (ISSUE 6: its serve/prefix_match span joins the timeline)
+    for _ in range(2):
+        sched.submit(np.tile(np.asarray([9, 23, 4], np.int32), 5),
+                     SamplingParams(max_new_tokens=8))
     faults_seen = 0
     while sched.has_work():
         try:
@@ -469,6 +473,10 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     assert any(names == {"serve/draft", "serve/verify"}
                for names in spec_corrs.values())
     assert all(c.startswith("req-") for c in spec_corrs)
+    # ISSUE 6: every cache lookup runs inside a serve/prefix_match span
+    # under its request's correlation id
+    match_corrs = corrs(spans, "serve/prefix_match")
+    assert match_corrs and all(c.startswith("req-") for c in match_corrs)
 
     # ---- both metrics surfaces ---------------------------------------
     reg = get_registry()
@@ -491,4 +499,11 @@ def test_chaos_session_trace_and_metrics(tmp_path, monkeypatch, served):
     assert "# TYPE serve_spec_accept_len histogram" in serve_text
     assert "serve_spec_accept_len_p50" in serve_text
     assert "serve_spec_accept_len_p99" in serve_text
+    # ISSUE 6: prefix-cache counters + hit-rate/cached-blocks gauges ride
+    # the same exposition (the duplicated prompt above guarantees a hit)
+    assert "serving_prefix_cache_hit" in serve_text
+    assert "serving_prefix_cache_miss" in serve_text
+    assert "serving_prefix_cache_hit_rate" in serve_text
+    assert "serving_cached_blocks" in serve_text
+    assert sched.metrics.counters["prefix_cache_hit"] > 0
     engine.metrics_server.stop()
