@@ -14,6 +14,11 @@ preemptible TPU pods:
   draining/degraded) + the scheduler watchdog
 - `preemption.py` — SIGTERM drain for training: emergency checkpoint +
   the distinct exit code the elastic agent resumes from
+- `postmortem.py` — crash/stall forensic bundles (ISSUE 7):
+  ``postmortem-<step|ts>/`` directories with the flight-recorder
+  drain, metrics snapshot, thread stacks, scheduler state, and the
+  flushed trace, written on watchdog stalls, DEGRADED transitions,
+  unhandled crashes, and preemption drains
 
 See docs/tutorials/resilience.md for the durability contract and the
 fault-spec syntax.
@@ -33,8 +38,10 @@ from deepspeed_tpu.resilience.preemption import (PREEMPTED_EXIT_CODE,
                                                  emergency_save,
                                                  resume_tag_from_env,
                                                  run_resilient_training)
+from deepspeed_tpu.resilience.postmortem import write_postmortem
 
 __all__ = [
+    "write_postmortem",
     "FaultInjected", "FaultInjector", "FaultSpec", "NULL_INJECTOR",
     "parse_spec", "resolve_injector",
     "RetryDeadlineExceeded", "retry_call",

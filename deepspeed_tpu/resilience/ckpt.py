@@ -206,13 +206,17 @@ def tag_step(load_dir: str, tag: str) -> int:
 
 
 def list_tags(load_dir: str) -> List[str]:
-    """Published (non-``.tmp``) tag directories under the root."""
+    """Published (non-``.tmp``) tag directories under the root.
+    ``postmortem-*`` forensic bundles (ISSUE 7) share the checkpoint
+    root but are never checkpoint tags — a root holding only a bundle
+    must resolve to "no tags" (fresh start), not corruption."""
     if not os.path.isdir(load_dir):
         return []
     return sorted(
         name for name in os.listdir(load_dir)
         if os.path.isdir(os.path.join(load_dir, name))
-        and not name.endswith(TMP_SUFFIX))
+        and not name.endswith(TMP_SUFFIX)
+        and not name.startswith("postmortem-"))
 
 
 def read_latest(load_dir: str) -> Optional[str]:

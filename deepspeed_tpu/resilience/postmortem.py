@@ -1,0 +1,200 @@
+"""Crash/stall post-mortem bundles (ISSUE 7 tentpole).
+
+When something goes wrong that a metrics scrape can't explain — the
+watchdog flags a stall, health flips DEGRADED, the serving loop takes
+an unhandled exception, a preemption signal lands — the process writes
+a ``postmortem-<step|ts>/`` directory capturing the black-box state at
+that moment:
+
+- ``manifest.json``  — reason, timestamps, step, pid, file inventory
+- ``flightrec.jsonl``— flight-recorder snapshot (per-request/per-step
+  lifecycle events; the stalled request's timeline reconstructs from
+  its ``req-<id>`` lines)
+- ``stacks.txt``     — all-thread Python stack dump (lock-free)
+- ``metrics.prom`` / ``metrics.json`` — registry exposition + snapshot
+- ``scheduler.json`` — live scheduler/request/block-pool/SLO state
+  (serving bundles)
+- ``config.json``    — the scheduler's ServingConfig (or whatever the
+  caller passes)
+- ``health.json``    — health state machine snapshot
+- ``trace.json``     — the flushed Perfetto trace, when a tracer is
+  armed
+
+Writing a bundle must never make the incident worse: every artifact is
+written best-effort under its own try/except, and the writer itself
+never raises.  Bundles are rate-limited per process (default one per
+:data:`MIN_INTERVAL_S`) so a flapping watchdog cannot fill a disk.
+"""
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+#: minimum seconds between bundles from one process (0 = unlimited);
+#: a DEGRADED->READY->DEGRADED flap every poll interval must not turn
+#: the post-mortem dir into a disk-filler
+MIN_INTERVAL_S = 30.0
+
+_LAST_LOCK = threading.Lock()
+_LAST_BUNDLE_TS = 0.0
+
+
+def _unique_dir(base: str) -> str:
+    path = base
+    n = 1
+    while os.path.exists(path):
+        n += 1
+        path = f"{base}-{n}"
+    return path
+
+
+def _write_json(path: str, payload) -> bool:
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+    return True
+
+
+def write_postmortem(out_dir: str, reason: str, *,
+                     step: Optional[int] = None,
+                     scheduler=None, health=None, registry=None,
+                     config=None, flightrec=None,
+                     extra: Optional[Dict[str, Any]] = None,
+                     min_interval_s: Optional[float] = None
+                     ) -> Optional[str]:
+    """Write one bundle under ``out_dir``; returns its path, or None
+    when disabled (falsy ``out_dir``), rate-limited, or the directory
+    itself could not be created.  Never raises."""
+    global _LAST_BUNDLE_TS
+    if not out_dir:
+        return None
+    interval = MIN_INTERVAL_S if min_interval_s is None else min_interval_s
+    now = time.time()
+    with _LAST_LOCK:
+        if interval and now - _LAST_BUNDLE_TS < interval:
+            logger.warning(
+                f"postmortem: suppressed ({reason!r}) — last bundle "
+                f"{now - _LAST_BUNDLE_TS:.1f}s ago, interval {interval}s")
+            return None
+        prev_ts = _LAST_BUNDLE_TS
+        _LAST_BUNDLE_TS = now
+    tag = (f"step{int(step)}" if step is not None
+           else time.strftime("%Y%m%d-%H%M%S", time.gmtime(now)))
+    try:
+        path = _unique_dir(os.path.join(out_dir, f"postmortem-{tag}"))
+        os.makedirs(path)
+    except OSError as e:
+        logger.error(f"postmortem: cannot create bundle dir: {e}")
+        with _LAST_LOCK:
+            # nothing was written: give the rate limit back so the next
+            # trigger (maybe seconds away, with a writable disk) isn't
+            # suppressed on the strength of THIS failure
+            _LAST_BUNDLE_TS = prev_ts
+        return None
+
+    files = {}
+
+    def artifact(name: str, write):
+        try:
+            if write(os.path.join(path, name)):
+                files[name] = True
+        except Exception as e:          # noqa: BLE001 — forensics must
+            files[name] = f"failed: {e}"        # not crash the patient
+            logger.warning(f"postmortem: {name} failed: {e}")
+
+    from deepspeed_tpu.telemetry.debug import format_thread_stacks
+    from deepspeed_tpu.telemetry.flight_recorder import get_flight_recorder
+    from deepspeed_tpu.telemetry.tracing import get_tracer
+
+    def _write_text(p, text):
+        with open(p, "w") as f:
+            f.write(text)
+        return True
+
+    # stacks FIRST: if later artifacts hang or die, the one thing that
+    # explains a wedge is already on disk
+    artifact("stacks.txt", lambda p: _write_text(p, format_thread_stacks()))
+    rec = flightrec
+    if rec is None and scheduler is not None:
+        rec = getattr(scheduler, "flightrec", None)
+    if rec is None:
+        rec = get_flight_recorder()
+    artifact("flightrec.jsonl", lambda p: bool(rec.dump_jsonl(p)))
+
+    reg = registry
+    if reg is None and scheduler is not None:
+        reg = scheduler.metrics.registry
+    if reg is None:
+        from deepspeed_tpu.telemetry.registry import get_registry
+        reg = get_registry()
+    artifact("metrics.prom",
+             lambda p: _write_text(p, reg.render_prometheus()))
+
+    def _metrics_payload(p):
+        payload = reg.snapshot()
+        if scheduler is not None:
+            # the scheduler's counters (completed/preemptions/...) live
+            # beside the registry, not in it — merge both views
+            payload.update(scheduler.metrics.snapshot())
+        return _write_json(p, payload)
+    artifact("metrics.json", _metrics_payload)
+
+    if scheduler is not None:
+        artifact("scheduler.json", lambda p: _write_json(p, {
+            "scheduler": scheduler.debug_scheduler(),
+            "requests": scheduler.debug_requests(),
+        }))
+        if config is None:
+            config = getattr(scheduler, "cfg", None)
+    if config is not None:
+        def _cfg_payload(p):
+            dump = getattr(config, "model_dump", None) or getattr(
+                config, "dict", None)
+            return _write_json(p, dump() if callable(dump) else config)
+        artifact("config.json", _cfg_payload)
+    if health is not None:
+        artifact("health.json", lambda p: _write_json(p, health.snapshot()))
+
+    tracer = get_tracer()
+    if getattr(tracer, "enabled", False):
+        def _trace(p):
+            src = tracer.flush()
+            if not src or not os.path.exists(src):
+                return False
+            shutil.copyfile(src, p)
+            return True
+        artifact("trace.json", _trace)
+
+    manifest = {
+        "reason": reason,
+        "tag": tag,
+        "step": step,
+        "created_unix": round(now, 3),
+        "pid": os.getpid(),
+        "files": files,
+    }
+    if extra:
+        manifest["extra"] = extra
+    try:
+        _write_json(os.path.join(path, "manifest.json"), manifest)
+    except OSError as e:
+        logger.error(f"postmortem: manifest write failed: {e}")
+    try:
+        reg.inc("postmortem/bundles")
+    except Exception:                   # noqa: BLE001
+        pass
+    rec.record("postmortem", reason=reason, path=path)
+    get_tracer().instant("postmortem", cat="resilience",
+                         args={"reason": reason, "path": path})
+    logger.warning(f"postmortem: bundle written to {path} ({reason})")
+    return path
+
+
+def reset_rate_limit():
+    """Tests: allow the next bundle immediately."""
+    global _LAST_BUNDLE_TS
+    with _LAST_LOCK:
+        _LAST_BUNDLE_TS = 0.0

@@ -111,11 +111,41 @@ def emergency_save(engine, save_dir: str) -> str:
     return tag
 
 
+def _train_postmortem_dir(engine, save_dir: str,
+                          override: Optional[str] = None) -> str:
+    """Training-side bundle placement honoring
+    ``resilience.postmortem_dir``: an explicit ``override`` wins, else
+    the engine's configured value; ``None`` means "next to the
+    checkpoints" and ``""`` disables (write_postmortem no-ops on a
+    falsy dir)."""
+    if override is not None:
+        return override
+    cfg = getattr(getattr(engine, "_config", None),
+                  "resilience_config", None)
+    configured = getattr(cfg, "postmortem_dir", None)
+    return save_dir if configured is None else configured
+
+
 def drain_and_exit(engine, save_dir: str,
-                   _exit: Callable[[int], None] = sys.exit):
+                   _exit: Callable[[int], None] = sys.exit,
+                   postmortem_dir: Optional[str] = None):
     """Emergency-save then exit with the preemption code (the elastic
-    agent turns that code into a resume-from-latest restart)."""
+    agent turns that code into a resume-from-latest restart).  Before
+    exiting, a post-mortem bundle (ISSUE 7) lands next to the
+    checkpoints (or in ``resilience.postmortem_dir``) — the
+    fatal-signal forensic record: flight-recorder tail, metrics
+    snapshot, thread stacks, flushed trace."""
     emergency_save(engine, save_dir)
+    from deepspeed_tpu.resilience.postmortem import write_postmortem
+    write_postmortem(
+        _train_postmortem_dir(engine, save_dir, postmortem_dir),
+        "preemption drain (fatal signal)",
+        step=int(engine.global_steps),
+        registry=getattr(engine, "telemetry_registry", None),
+        # terminal, one-shot: the process exits right after, so the
+        # flap rate limit (built for DEGRADED<->READY oscillation) must
+        # not suppress the only bundle this incident will ever get
+        min_interval_s=0.0)
     _exit(PREEMPTED_EXIT_CODE)
 
 
@@ -161,6 +191,18 @@ def run_resilient_training(engine, batches: Iterable, save_dir: str,
                 engine.save_checkpoint(save_dir)
         engine.wait_pending_checkpoint()
         return loss
+    except Exception as e:
+        # unhandled training crash: leave a forensic bundle (ISSUE 7)
+        # next to the checkpoints, then propagate — the elastic agent
+        # sees the crash exit code, the operator sees the bundle
+        from deepspeed_tpu.resilience.postmortem import write_postmortem
+        write_postmortem(_train_postmortem_dir(engine, save_dir),
+                         f"unhandled training exception: {e!r}",
+                         step=int(engine.global_steps),
+                         registry=getattr(engine, "telemetry_registry",
+                                          None),
+                         min_interval_s=0.0)  # terminal: see drain_and_exit
+        raise
     finally:
         if own_handler:
             handler.uninstall()

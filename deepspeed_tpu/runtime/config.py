@@ -304,6 +304,13 @@ class ResilienceConfig(DeepSpeedConfigModel):
     #: record per-leaf crc32s in the checkpoint manifest (costs one host
     #: fetch of the state at save time; shapes/dtypes are always recorded)
     checkpoint_checksums: bool = True
+    #: where crash/stall post-mortem bundles land (ISSUE 7):
+    #: ``postmortem-<step|ts>/`` directories with the flight-recorder
+    #: drain, metrics snapshot, thread stacks, scheduler state, and the
+    #: flushed trace.  None = subsystem default placement (serving:
+    #: ``./postmortems``; training: next to the checkpoints in
+    #: ``save_dir``).  "" disables bundle writing entirely.
+    postmortem_dir: Optional[str] = None
     #: load-time verification: "off", "manifest" (structural: the
     #: manifest parses and its file inventory matches on disk), or
     #: "full" (also re-checksums every restored leaf)
@@ -349,9 +356,31 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #: per-device peak FLOPs for the MFU gauge; 0 = auto-detect from the
     #: device kind (DS_PEAK_FLOPS env overrides either)
     peak_flops: float = 0.0
+    #: flight-recorder ring capacity in events (ISSUE 7): the bounded
+    #: black-box buffer of per-request/per-step lifecycle events behind
+    #: /debug/flightrec and post-mortem bundles.  0 disables recording.
+    flightrec_events: int = 8192
+    #: rolling median+MAD step-latency anomaly detector (ISSUE 7):
+    #: MAD-score threshold above which a step is flagged (counter +
+    #: trace instant + flight-recorder event).  0 disables detection.
+    anomaly_threshold: float = 5.0
+    #: detector window (recent step latencies the median/MAD run over)
+    anomaly_window: int = 64
 
     def __init__(self, **data):
         super().__init__(**data)
+        if self.flightrec_events < 0:
+            raise ValueError(
+                f"telemetry.flightrec_events={self.flightrec_events}: "
+                "must be >= 0 (0 disables the flight recorder)")
+        if self.anomaly_threshold < 0:
+            raise ValueError(
+                f"telemetry.anomaly_threshold={self.anomaly_threshold}: "
+                "must be >= 0 (0 disables anomaly detection)")
+        if self.anomaly_window < 4:
+            raise ValueError(
+                f"telemetry.anomaly_window={self.anomaly_window}: "
+                "must be >= 4")
         if self.metrics_port is not None and self.metrics_port < 0:
             raise ValueError(
                 f"telemetry.metrics_port={self.metrics_port}: must be "
@@ -445,6 +474,51 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
                 f"{self.max_cached_blocks}: must be >= 0 (0 = pool-bounded)")
 
 
+class SLOClassConfig(DeepSpeedConfigModel):
+    """One request class's latency targets (``serving.slo.classes``).
+    0 = no target for that dimension (requests still counted)."""
+    #: time-to-first-token target, milliseconds
+    ttft_ms: float = 0.0
+    #: time-per-output-token target, milliseconds (mean inter-token)
+    tpot_ms: float = 0.0
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.ttft_ms < 0 or self.tpot_ms < 0:
+            raise ValueError(
+                f"serving.slo class targets ttft_ms={self.ttft_ms} "
+                f"tpot_ms={self.tpot_ms}: must be >= 0 (0 = no target)")
+
+
+class SLOConfig(DeepSpeedConfigModel):
+    """``serving.slo`` — per-class latency-target accounting (ISSUE 7):
+    each finished request is scored against its class's TTFT/TPOT
+    targets, feeding violation counters and rolling burn-rate gauges.
+    This is the substrate ROADMAP item 5's admission control will
+    consume; this section only *accounts* — it never sheds."""
+    enabled: bool = False
+    #: class name -> SLOClassConfig (dict-in-JSON, validated below);
+    #: unknown request classes fall back to "default"
+    classes: Any = None
+    #: rolling burn-rate window, in requests per class
+    window: int = 256
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        raw = self.classes or {}
+        if not isinstance(raw, dict):
+            raise ValueError("serving.slo.classes must be an object of "
+                             "class-name -> {ttft_ms, tpot_ms}")
+        self.classes = {
+            str(name): (c if isinstance(c, SLOClassConfig)
+                        else SLOClassConfig(**(c or {})))
+            for name, c in raw.items()}
+        self.classes.setdefault("default", SLOClassConfig())
+        if self.window < 1:
+            raise ValueError(f"serving.slo.window={self.window}: must "
+                             "be >= 1")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -495,6 +569,8 @@ class ServingConfig(DeepSpeedConfigModel):
     #: cross-request prefix-cache sub-section (same dict-in-JSON
     #: validation pattern as ``spec``)
     prefix_cache: Any = None
+    #: per-class SLO accounting sub-section (same pattern; ISSUE 7)
+    slo: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
@@ -503,6 +579,8 @@ class ServingConfig(DeepSpeedConfigModel):
         if not isinstance(self.prefix_cache, PrefixCacheConfig):
             self.prefix_cache = PrefixCacheConfig(
                 **(self.prefix_cache or {}))
+        if not isinstance(self.slo, SLOConfig):
+            self.slo = SLOConfig(**(self.slo or {}))
         if self.block_size < 1:
             raise ValueError(f"serving.block_size={self.block_size}: "
                              "must be >= 1")

@@ -640,6 +640,21 @@ class DeepSpeedEngine:
         #: aggregate peak over this process's local devices (per-host MFU)
         self._peak_flops = (peak * len(jax.local_devices())
                             if peak else None)
+        # black-box layer (ISSUE 7): flight recorder (train-step events
+        # + the substrate post-mortem bundles drain) and the rolling
+        # step-latency anomaly detector
+        from deepspeed_tpu.telemetry import (AnomalyMonitor,
+                                             configure_flight_recorder)
+        from deepspeed_tpu.telemetry.flight_recorder import DEFAULT_CAPACITY
+        # a default-valued config must not replace (and empty) a ring
+        # another subsystem in this process already sized explicitly —
+        # only an explicit non-default capacity rebuilds the global
+        self.flightrec = configure_flight_recorder(
+            None if tcfg.flightrec_events == DEFAULT_CAPACITY
+            else tcfg.flightrec_events)
+        self.anomaly = AnomalyMonitor(
+            registry=self.telemetry_registry, flightrec=self.flightrec,
+            window=tcfg.anomaly_window, threshold=tcfg.anomaly_threshold)
         self.metrics_server = None
         if tcfg.metrics_port is not None and jax.process_index() == 0:
             from deepspeed_tpu.telemetry import MetricsServer
@@ -2015,7 +2030,10 @@ class DeepSpeedEngine:
                               corr=f"train-step-{step}",
                               args={"step": step}):
             loss = self._train_batch_impl(data_iter=data_iter, batch=batch)
-        self._record_step_telemetry(time.perf_counter() - t0)
+            # still inside the train/step span so an anomaly instant
+            # lands between this step's B/E pair (the serve side keeps
+            # the same invariant)
+            self._record_step_telemetry(time.perf_counter() - t0)
         return loss
 
     def _train_batch_impl(self, data_iter=None, batch=None):
@@ -2331,6 +2349,14 @@ class DeepSpeedEngine:
         reg = self.telemetry_registry
         reg.inc("train/steps")
         reg.histogram("train/step_latency_s").observe(duration_s)
+        # flight-recorder step event + rolling anomaly check (ISSUE 7);
+        # corr matches the train/step span id so the black-box record,
+        # the trace, and any anomaly instant cross-reference
+        corr = f"train-step-{self.global_steps}"
+        self.flightrec.record("train/step", corr=corr,
+                              step=self.global_steps,
+                              dur_ms=round(duration_s * 1e3, 3))
+        self.anomaly.observe("train.step", duration_s, corr=corr)
         tokens = self.train_batch_size() * max(self._last_seq_len, 0)
         if tokens and duration_s > 0:
             reg.set_gauge("train/tokens_per_s", tokens / duration_s)

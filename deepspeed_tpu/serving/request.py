@@ -65,6 +65,9 @@ class ServeRequest:
     sampling: SamplingParams = field(default_factory=SamplingParams)
     priority: int = 0                        # higher = evicted later
     timeout_s: float = 0.0                   # 0 = never times out in queue
+    #: ``serving.slo`` class for burn accounting (ISSUE 7); unknown
+    #: names fall back to "default" at scoring time
+    slo_class: str = "default"
     arrival_time: float = field(default_factory=time.monotonic)
 
     # -- scheduler-owned runtime state ----------------------------------

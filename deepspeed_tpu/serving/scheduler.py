@@ -204,7 +204,7 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, model, params, config, kv_cache_dtype=None,
                  monitor=None, injector=None, registry=None,
-                 proposer=None):
+                 proposer=None, flightrec=None, anomaly=None):
         if (model.init_cache_fn is None or model.prefill_fn is None
                 or model.decode_fn is None):
             raise ValueError("model does not expose the KV-cache serving "
@@ -271,6 +271,21 @@ class ContinuousBatchingScheduler:
             registry=self._telemetry_registry,
             max_accept_len=getattr(getattr(config, "spec", None),
                                    "max_draft_tokens", 16) + 1)
+        # black-box layer (ISSUE 7): flight recorder for per-request
+        # lifecycle events, rolling step-latency anomaly detection, and
+        # per-class SLO burn accounting — all writing into the SAME
+        # registry/trace/correlation-id space as the PR 4 telemetry
+        from deepspeed_tpu.telemetry.anomaly import (AnomalyMonitor,
+                                                     SLOTracker)
+        from deepspeed_tpu.telemetry.flight_recorder import \
+            get_flight_recorder
+        self.flightrec = (flightrec if flightrec is not None
+                          else get_flight_recorder())
+        self.anomaly = (anomaly if anomaly is not None
+                        else AnomalyMonitor(registry=self.metrics.registry,
+                                            flightrec=self.flightrec))
+        self.slo = SLOTracker(getattr(config, "slo", None),
+                              self.metrics.registry)
         self._serve_t0 = time.monotonic()   # tokens/s accounting window
         self._prefill_fns = {}
         self._decode_fns = {}
@@ -494,16 +509,24 @@ class ContinuousBatchingScheduler:
 
     # ----------------------------------------------------------- submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
-               timeout_s: float = 0.0) -> ServeRequest:
+               timeout_s: float = 0.0,
+               slo_class: str = "default") -> ServeRequest:
         """Enqueue a request; raises AdmissionError (429-style) instead of
-        crashing or wedging the loop."""
+        crashing or wedging the loop.  ``slo_class`` names the request's
+        ``serving.slo`` class for burn accounting (unknown classes fall
+        back to ``default``)."""
         from deepspeed_tpu.serving.request import SamplingParams
         with self._lock:
             req = ServeRequest(
                 request_id=self._next_id,
                 prompt_ids=prompt_ids,
                 sampling=sampling or SamplingParams(),
-                priority=priority, timeout_s=timeout_s)
+                priority=priority, timeout_s=timeout_s,
+                slo_class=slo_class)
+            # consume the id for REJECTED requests too: a reject's
+            # flight-recorder event must never share its req-<id> corr
+            # with a later accepted request's timeline
+            self._next_id += 1
             total = req.prompt_len + req.sampling.max_new_tokens
             if total > self.max_model_len \
                     or not self.block_mgr.fits_ever(total):
@@ -512,6 +535,9 @@ class ContinuousBatchingScheduler:
                     f"prompt+max_new_tokens={total} exceeds serving "
                     f"capacity {self.max_model_len}")
                 self.metrics.counters["rejected_too_long"] += 1
+                self.flightrec.record("req/reject",
+                                      corr=f"req-{req.request_id}",
+                                      reason="too_long", tokens=total)
                 req.done.set()
                 raise RequestTooLongError(req.reject_reason)
             if len(self._queue) >= self.cfg.max_queued:
@@ -519,11 +545,17 @@ class ContinuousBatchingScheduler:
                 req.reject_reason = (
                     f"queue full ({self.cfg.max_queued} waiting)")
                 self.metrics.counters["rejected_queue_full"] += 1
+                self.flightrec.record("req/reject",
+                                      corr=f"req-{req.request_id}",
+                                      reason="queue_full")
                 req.done.set()
                 raise QueueFullError(req.reject_reason)
-            self._next_id += 1
             self.metrics.counters["received"] += 1
             self._queue.append(req)
+            self.flightrec.record("req/queue", corr=f"req-{req.request_id}",
+                                  prompt_tokens=req.prompt_len,
+                                  max_new=req.sampling.max_new_tokens,
+                                  priority=priority, slo_class=slo_class)
             return req
 
     # ------------------------------------------------------------ state
@@ -566,6 +598,93 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return self.metrics.render_prometheus()
 
+    # ------------------------------------------------- debug introspection
+    # Both views below are deliberately LOCK-FREE (ISSUE 7): they exist
+    # to answer "what is the scheduler doing" while a wedged step()
+    # holds the scheduler lock — the same reasoning as the watchdog's
+    # has_work_unlocked.  Reads are GIL-atomic snapshots of plain
+    # attributes; a view racing a live step may be internally slightly
+    # inconsistent (a request mid-retire, say), which is acceptable for
+    # forensics and unacceptable to deadlock on.
+
+    @staticmethod
+    def _debug_request(req: ServeRequest, now: float) -> Dict:
+        return {
+            "request_id": req.request_id,
+            "state": req.state.value,
+            "slot": req.slot,
+            "priority": req.priority,
+            "slo_class": req.slo_class,
+            "prompt_tokens": req.prompt_len,
+            "generated": req.num_generated,
+            "max_new_tokens": req.sampling.max_new_tokens,
+            "cached_tokens": req.num_cached_tokens,
+            "preemptions": req.num_preemptions,
+            "age_s": round(now - req.arrival_time, 3),
+            "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                        if req.ttft_s is not None else None),
+            "spec_k": req.spec_k,
+            "spec_disabled": req.spec_disabled,
+        }
+
+    def debug_requests(self) -> Dict:
+        """The ``/debug/requests`` body: every queued + active request's
+        live state (lock-free snapshot)."""
+        now = time.monotonic()
+        active = [self._debug_request(r, now)
+                  for r in list(self._slots) if r is not None]
+        queued = [self._debug_request(r, now) for r in list(self._queue)]
+        return {"step_count": self._step_count,
+                "active": active, "queued": queued}
+
+    def debug_scheduler(self) -> Dict:
+        """The ``/debug/scheduler`` body: scheduler + block-pool +
+        prefix-cache + spec + SLO state (lock-free snapshot)."""
+        bm = self.block_mgr
+        slots = [r.request_id if r is not None else None
+                 for r in list(self._slots)]
+        out = {
+            "step_count": self._step_count,
+            "queue_depth": len(self._queue),
+            "max_num_seqs": self.cfg.max_num_seqs,
+            "max_model_len": self.max_model_len,
+            "slots": slots,
+            "block_pool": {
+                "num_blocks": self.cfg.num_blocks,
+                "block_size": bm.block_size,
+                "free": bm.num_free_blocks,
+                "cached": bm.num_cached_blocks,
+                "allocated": bm.num_allocated_blocks,
+                "utilization": round(bm.utilization(), 4),
+                "cache_evictions": bm.cache_evictions,
+            },
+            "prefix_cache": {
+                "enabled": self._prefix_cache_on,
+                "min_prefix_blocks": self._prefix_min_blocks,
+                "hits": int(self.metrics.counters["prefix_cache_hit"]),
+                "misses": int(self.metrics.counters["prefix_cache_miss"]),
+                "cow_forks": int(
+                    self.metrics.counters["prefix_cache_cow_forks"]),
+            },
+            "spec": {
+                "proposer": (type(self.proposer).__name__
+                             if self.proposer is not None else None),
+                "verify_steps": int(
+                    self.metrics.counters["spec_verify_steps"]),
+                "drafted": int(
+                    self.metrics.counters["spec_drafted_tokens"]),
+                "accepted": int(
+                    self.metrics.counters["spec_accepted_tokens"]),
+            },
+            "slo": {
+                "enabled": self.slo.enabled,
+                "classes": sorted(self.slo.classes),
+                "burn_rates": self.slo.burn_rates(),
+                "violations": int(self.metrics.counters["slo_violations"]),
+            },
+        }
+        return out
+
     # -------------------------------------------------------- lifecycle
     def _retire(self, req: ServeRequest, state: RequestState,
                 reason: Optional[str] = None):
@@ -586,6 +705,24 @@ class ContinuousBatchingScheduler:
             req.t_finish = time.monotonic()
             self.metrics.observe_finished(req)
             self._finished_this_step.append(req)
+            # SLO burn accounting (ISSUE 7): score the finished request
+            # against its class targets; TPOT = mean inter-token gap
+            times = req.token_times
+            tpot = ((times[-1] - times[0]) / (len(times) - 1)
+                    if len(times) > 1 else None)
+            viol = self.slo.observe(req.slo_class, req.ttft_s, tpot)
+            if viol:
+                self.metrics.counters["slo_violations"] += 1
+                self.flightrec.record(
+                    "req/slo_violation", corr=f"req-{req.request_id}",
+                    slo_class=self.slo.resolve_class(req.slo_class),
+                    **{k: True for k in viol})
+        self.flightrec.record(
+            "req/retire", corr=f"req-{req.request_id}",
+            state=state.value, generated=req.num_generated,
+            ttft_ms=(round(req.ttft_s * 1e3, 3)
+                     if req.ttft_s is not None else None),
+            reason=reason)
         req.done.set()
 
     def _evict(self, victim: ServeRequest):
@@ -605,6 +742,10 @@ class ContinuousBatchingScheduler:
         victim.num_preemptions += 1
         victim.queued_at = time.monotonic()    # timeout clock restarts
         self.metrics.counters["preemptions"] += 1
+        self.flightrec.record("req/preempt",
+                              corr=f"req-{victim.request_id}",
+                              generated=victim.num_generated,
+                              priority=victim.priority)
         self._queue.append(victim)
         logger.info(f"serving: preempted request {victim.request_id} "
                     f"(priority {victim.priority}, "
@@ -618,6 +759,12 @@ class ContinuousBatchingScheduler:
                 self.metrics.counters["rejected_timeout"] += 1
                 req.state = RequestState.REJECTED
                 req.reject_reason = f"timed out after {req.timeout_s}s queued"
+                # terminal flight event: without it a timed-out request's
+                # timeline ends at req/queue and reads as still in flight
+                self.flightrec.record("req/reject",
+                                      corr=f"req-{req.request_id}",
+                                      reason="timeout",
+                                      queued_s=round(now - req.queued_at, 3))
                 req.done.set()
 
     # -------------------------------------------------------- admission
@@ -696,6 +843,16 @@ class ContinuousBatchingScheduler:
             req.slot = free_slots[0]
             self._slots[req.slot] = req
             req.num_cached_tokens = start
+            self.flightrec.record(
+                "req/resume" if resumed else "req/admit",
+                corr=f"req-{req.request_id}", slot=req.slot,
+                step=self._step_count, cached_tokens=start,
+                prompt_tokens=n_in)
+            if matched:
+                self.flightrec.record(
+                    "req/prefix_hit", corr=f"req-{req.request_id}",
+                    blocks=len(matched), cached_tokens=start,
+                    cow_fork=fork_pair is not None)
             spent += n_in - start
             self.metrics.observe_queue_wait(
                 time.monotonic() - req.queued_at)
@@ -778,6 +935,12 @@ class ContinuousBatchingScheduler:
                 self.params, self.pool, jnp.asarray(padded),
                 jnp.asarray([inputs.size], np.int32), jnp.asarray(dest))
         self.metrics.counters["prefill_tokens"] += int(inputs.size) - start
+        if start == 0:
+            # the cached-suffix path records per chunk; this is the
+            # one-shot full-prompt program
+            self.flightrec.record("req/prefill_chunk",
+                                  corr=f"req-{req.request_id}",
+                                  tokens=int(inputs.size), offset=0)
         # the prompt's full blocks are cache content from here on —
         # registering BEFORE the first sample lets the next admission in
         # this very step hit them (materialized = exactly the prefilled
@@ -829,6 +992,9 @@ class ContinuousBatchingScheduler:
                 jnp.asarray([pos], np.int32), jnp.asarray(dests),
                 jnp.asarray(pos_idx))
             last = logits[0, take - 1][None]
+            self.flightrec.record("req/prefill_chunk",
+                                  corr=f"req-{req.request_id}",
+                                  tokens=take, offset=pos)
             pos += take
         return last
 
@@ -1097,6 +1263,8 @@ class ContinuousBatchingScheduler:
                 c["spec_accepted_tokens"] += a
                 c["spec_rolled_back_tokens"] += nd - a
                 self.metrics.spec_accept_len.observe(a + 1)
+                self.flightrec.record("req/spec_accept", corr=f"req-{rid}",
+                                      drafted=nd, accepted=a)
                 self._spec_adapt(req, nd, a)
             if req.slot >= 0:       # still live: paged-KV rollback
                 bm.truncate(rid, int(req.all_token_ids.size))
@@ -1133,11 +1301,13 @@ class ContinuousBatchingScheduler:
         decode windows, and any faults line up in the trace."""
         from deepspeed_tpu.telemetry import get_tracer
         tracer = get_tracer()
+        step_id = self._step_count
+        t0 = time.perf_counter()
         # fault site OUTSIDE the lock: an injected stall models a wedged
         # engine without also wedging the /metrics + submit paths
         with tracer.span("serve/step", cat="serving",
-                         corr=f"serve-step-{self._step_count}",
-                         args={"step": self._step_count}):
+                         corr=f"serve-step-{step_id}",
+                         args={"step": step_id}):
             self.injector.check("serve.step")
             with self._lock:
                 self._finished_this_step = []
@@ -1176,7 +1346,18 @@ class ContinuousBatchingScheduler:
                         self._step_count % self.cfg.monitor_interval == 0):
                     self.monitor.write_events(
                         self.metrics.to_events(self._step_count))
-                return list(self._finished_this_step)
+                finished = list(self._finished_this_step)
+            # black-box step record + rolling anomaly check (ISSUE 7);
+            # still inside the serve/step span, so the anomaly instant
+            # lands between this step's B/E pair with its corr id
+            dur_s = time.perf_counter() - t0
+            self.flightrec.record(
+                "serve/step", corr=f"serve-step-{step_id}",
+                dur_ms=round(dur_s * 1e3, 3), active=active,
+                queued=len(self._queue), finished=len(finished))
+            self.anomaly.observe("serve.step", dur_s,
+                                 corr=f"serve-step-{step_id}")
+            return finished
 
     def _update_gauges(self):
         """Occupancy + goodput gauges (ISSUE 4).  Goodput = generated

@@ -5,7 +5,7 @@ Endpoints:
   POST /generate  {"input_ids": [...], "max_new_tokens": 16,
                    "temperature": .., "top_k": .., "top_p": ..,
                    "do_sample": false, "eos_token_id": .., "seed": ..,
-                   "priority": 0}
+                   "priority": 0, "slo_class": "default"}
                   -> 200 {"request_id", "output_ids", "ttft_ms", ...}
                   -> 429 when the queue is full / the request times out
                   -> 400 for malformed bodies or impossible lengths
@@ -13,6 +13,15 @@ Endpoints:
   GET  /metrics   -> Prometheus text exposition (TYPE lines, counters/
                      gauges, latency histogram buckets + p50/p90/p99
                      quantile gauges — telemetry registry rendering)
+  GET  /debug/requests   per-request live state (queued + active)
+  GET  /debug/scheduler  scheduler/block-pool/prefix-cache/spec/SLO
+                         state + health snapshot
+  GET  /debug/stacks     all-thread Python stack dump (lock-free; works
+                         while the scheduler is wedged)
+  GET  /debug/flightrec  flight-recorder snapshot (?n=, ?corr=, ?kind=)
+
+The ``/debug/*`` surface (ISSUE 7) is read-only and never takes the
+scheduler lock — it exists precisely for the moments the lock is stuck.
 
 The scheduler loop runs on ONE background thread (the engine step is the
 unit of concurrency — iteration-level scheduling happens inside it);
@@ -168,7 +177,43 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path.startswith("/debug/"):
+            self._do_debug()
+            return
         self._send_json(404, {"error": f"no route {self.path}"})
+
+    def _do_debug(self):
+        """Live introspection (ISSUE 7).  Lock-free by construction:
+        these handlers must answer while a wedged step() holds the
+        scheduler lock (the watchdog can say DEGRADED; /debug/stacks
+        says where, /debug/requests and /debug/scheduler say what was
+        in flight)."""
+        from deepspeed_tpu.telemetry.debug import (flightrec_payload,
+                                                   format_thread_stacks,
+                                                   parse_debug_query)
+        route, query = parse_debug_query(self.path)
+        if route == "/debug/stacks":
+            body = format_thread_stacks().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if route == "/debug/requests":
+            self._send_json(200, self.scheduler.debug_requests())
+            return
+        if route == "/debug/scheduler":
+            payload = self.scheduler.debug_scheduler()
+            if self.health is not None:
+                payload["health"] = self.health.snapshot()
+            self._send_json(200, payload)
+            return
+        if route == "/debug/flightrec":
+            self._send_json(200, flightrec_payload(
+                self.scheduler.flightrec, query))
+            return
+        self._send_json(404, {"error": f"no route {route}"})
 
     def do_POST(self):
         if self.path != "/generate":
@@ -197,13 +242,15 @@ class _Handler(BaseHTTPRequestHandler):
             priority = int(body.get("priority", 0))
             timeout_s = float(body.get("timeout_s",
                                        self.default_timeout_s))
+            slo_class = str(body.get("slo_class", "default"))
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         try:
             req = self.scheduler.submit(input_ids, sampling,
                                         priority=priority,
-                                        timeout_s=timeout_s)
+                                        timeout_s=timeout_s,
+                                        slo_class=slo_class)
         except QueueFullError as e:
             self._send_json(429, {"error": str(e)})
             return
@@ -233,10 +280,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, resp)
 
 
-def _wire_health(scheduler) -> HealthMonitor:
+def _wire_health(scheduler, postmortem_dir=None) -> HealthMonitor:
     """HealthMonitor whose transitions surface through the scheduler's
     metrics (``serving/health_state`` gauge + per-state counters) and,
-    when configured, the monitor sinks."""
+    when configured, the monitor sinks.  With ``postmortem_dir`` set,
+    any DEGRADED transition (watchdog stall verdict, consecutive step
+    failures — every degradation funnels through health) writes a
+    post-mortem bundle capturing the flight recorder, metrics,
+    scheduler state, and thread stacks at the moment of degradation
+    (ISSUE 7; resilience/postmortem.py)."""
+    health_ref = []
+
     def on_transition(state, reason):
         scheduler.metrics.gauges["health_state"] = STATE_CODE[state]
         scheduler.metrics.counters[f"health_to_{state.value}"] += 1
@@ -244,21 +298,31 @@ def _wire_health(scheduler) -> HealthMonitor:
             scheduler.monitor.write_events([(
                 "serving/health_state", float(STATE_CODE[state]),
                 scheduler.step_count)])
+        if state is HealthState.DEGRADED and postmortem_dir:
+            from deepspeed_tpu.resilience.postmortem import write_postmortem
+            write_postmortem(
+                postmortem_dir, f"serving degraded: {reason}",
+                step=scheduler.step_count, scheduler=scheduler,
+                health=health_ref[0] if health_ref else None)
 
     health = HealthMonitor(on_transition=on_transition)
+    health_ref.append(health)
     scheduler.metrics.gauges["health_state"] = STATE_CODE[health.state]
     return health
 
 
 def make_server(scheduler, host: str = "127.0.0.1", port: int = 8000,
                 default_timeout_s: float = 0.0, health=None,
-                max_loop_failures=None, stall_timeout_s=None):
+                max_loop_failures=None, stall_timeout_s=None,
+                postmortem_dir=None):
     """(ThreadingHTTPServer, ServingLoop) — caller starts/joins both.
     ``port=0`` binds an ephemeral port (tests).  The loop carries the
     health state machine (``loop.health``); watchdog/failure-cap knobs
-    default from the scheduler's ServingConfig."""
+    default from the scheduler's ServingConfig.  ``postmortem_dir``
+    arms crash/stall bundle writing on DEGRADED transitions (None =
+    off; bin/ds_serve passes ``resilience.postmortem_dir``)."""
     if health is None:
-        health = _wire_health(scheduler)
+        health = _wire_health(scheduler, postmortem_dir=postmortem_dir)
     loop = ServingLoop(scheduler, health=health,
                        max_loop_failures=max_loop_failures,
                        stall_timeout_s=stall_timeout_s)
@@ -289,8 +353,10 @@ def install_drain_handlers(health: HealthMonitor, httpd,
 
 def serve_forever(scheduler, host: str = "127.0.0.1", port: int = 8000,
                   default_timeout_s: float = 0.0,
-                  install_signal_handlers: bool = True):  # pragma: no cover
-    httpd, loop = make_server(scheduler, host, port, default_timeout_s)
+                  install_signal_handlers: bool = True,
+                  postmortem_dir=None):  # pragma: no cover
+    httpd, loop = make_server(scheduler, host, port, default_timeout_s,
+                              postmortem_dir=postmortem_dir)
     health = loop.health
     loop.start()
     if install_signal_handlers:

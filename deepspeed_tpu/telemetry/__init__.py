@@ -2,6 +2,9 @@
 exposition, Chrome-trace span tracer with correlation ids, and
 MFU/goodput accounting — the cross-cutting observability layer train
 and serve both report through (docs/tutorials/monitoring-profiling.md).
+ISSUE 7 adds the black-box layer: a structured flight recorder,
+rolling anomaly detection + SLO burn accounting, and the live
+``/debug/*`` introspection surface.
 """
 from deepspeed_tpu.telemetry.registry import (      # noqa: F401
     COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
@@ -12,4 +15,11 @@ from deepspeed_tpu.telemetry.tracing import (       # noqa: F401
 from deepspeed_tpu.telemetry.mfu import (           # noqa: F401
     PEAK_FLOPS_ENV, mfu, peak_flops_per_device, serving_goodput,
     tokens_per_second, total_peak_flops)
+from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder, NULL_FLIGHT_RECORDER, configure_flight_recorder,
+    get_flight_recorder, reset_flight_recorder)
+from deepspeed_tpu.telemetry.anomaly import (       # noqa: F401
+    AnomalyMonitor, RollingMadDetector, SLOTracker)
+from deepspeed_tpu.telemetry.debug import (         # noqa: F401
+    flightrec_payload, format_thread_stacks, parse_debug_query)
 from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

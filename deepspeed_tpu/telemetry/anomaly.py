@@ -1,0 +1,216 @@
+"""Rolling anomaly detection + SLO burn accounting (ISSUE 7 tentpole).
+
+Two consumers of the per-step latencies the registry already observes:
+
+- :class:`RollingMadDetector` / :class:`AnomalyMonitor` — a rolling
+  median + MAD outlier detector over recent step latencies (train and
+  serve).  Median/MAD rather than mean/stddev because step latencies
+  are heavy-tailed by construction (compiles, checkpoint stalls): one
+  legitimate 30 s compile must not blind the detector to a 2 s stall
+  ten steps later.  An anomaly increments the ``anomaly/<kind>``
+  counter, lands an ``anomaly/<kind>`` instant on the Perfetto timeline
+  carrying the enclosing step's correlation id, and records a
+  flight-recorder event — so "why did this step spike" has a metrics,
+  trace, AND black-box answer.
+
+- :class:`SLOTracker` — per-class TTFT/TPOT target accounting
+  (``serving.slo`` config): violation counters, request counters, and
+  rolling burn-rate gauges per class.  This is the substrate ROADMAP
+  item 5's admission control consumes: "shed the lowest class first"
+  needs per-class burn rates to exist before it can act on them.
+"""
+import collections
+import statistics
+import threading
+from typing import Dict, Optional
+
+#: MAD -> sigma for a normal distribution; keeps thresholds comparable
+#: to z-scores people already have intuition for
+MAD_SIGMA = 1.4826
+
+
+class RollingMadDetector:
+    """Flags values implausibly far above the rolling median.
+
+    One-sided on purpose: a step that runs *fast* is never an incident.
+    The score is ``(v - median) / (MAD_SIGMA * mad_floor)`` over the
+    last ``window`` samples; the floor (a fraction of the median) stops
+    a perfectly flat window from flagging microsecond jitter.  The
+    anomalous value still enters the window, so a genuine regime change
+    (bigger batches land) stops alerting once it becomes the norm."""
+
+    def __init__(self, window: int = 64, threshold: float = 5.0,
+                 min_samples: int = 16, rel_floor: float = 0.05):
+        if window < 4:
+            raise ValueError(f"anomaly window {window}: need >= 4")
+        self.window = int(window)
+        self.threshold = float(threshold)
+        # clamp to the window: the ring can never hold more than
+        # ``window`` samples, so a larger min_samples would silently
+        # disable detection for small configured windows
+        self.min_samples = min(max(int(min_samples), 4), self.window)
+        self.rel_floor = float(rel_floor)
+        self._ring = collections.deque(maxlen=self.window)
+
+    def observe(self, value: float) -> Optional[Dict[str, float]]:
+        """Feed one sample; returns an anomaly record (value/median/
+        mad/score) or None.  Not thread-safe — one detector per
+        observing loop (the monitor holds one per kind)."""
+        v = float(value)
+        out = None
+        if len(self._ring) >= self.min_samples:
+            data = list(self._ring)
+            med = statistics.median(data)
+            mad = statistics.median(abs(x - med) for x in data)
+            floor = max(mad, abs(med) * self.rel_floor, 1e-9)
+            score = (v - med) / (MAD_SIGMA * floor)
+            if score > self.threshold:
+                out = {"value": v, "median": med, "mad": mad,
+                       "score": round(score, 3)}
+        self._ring.append(v)
+        return out
+
+
+class AnomalyMonitor:
+    """Per-kind detectors fanned out to the three observability
+    surfaces.  ``observe("serve.step", dur_s, corr="serve-step-12")``
+    on an outlier:
+
+    - counter ``anomaly/<kind>`` in the registry (plus the
+      ``anomaly/last_score{kind}`` gauge);
+    - instant ``anomaly/<kind>`` on the trace timeline, carrying the
+      enclosing step's correlation id (``scripts/trace_validate.py
+      --check-anomalies`` asserts the pairing);
+    - flight-recorder event ``anomaly/<kind>`` with the score fields.
+    """
+
+    def __init__(self, registry=None, flightrec=None, window: int = 64,
+                 threshold: float = 5.0, min_samples: int = 16):
+        self.registry = registry
+        self.flightrec = flightrec
+        self.window = window
+        self.threshold = threshold
+        self.min_samples = min_samples
+        self.enabled = threshold > 0
+        self._detectors: Dict[str, RollingMadDetector] = {}
+        self._lock = threading.Lock()
+
+    def _detector(self, kind: str) -> RollingMadDetector:
+        with self._lock:
+            det = self._detectors.get(kind)
+            if det is None:
+                det = self._detectors[kind] = RollingMadDetector(
+                    window=self.window, threshold=self.threshold,
+                    min_samples=self.min_samples)
+            return det
+
+    def observe(self, kind: str, value: float,
+                corr: Optional[str] = None) -> Optional[Dict[str, float]]:
+        if not self.enabled:
+            return None
+        anomaly = self._detector(kind).observe(value)
+        if anomaly is None:
+            return None
+        if self.registry is not None:
+            self.registry.inc(f"anomaly/{kind}")
+            self.registry.set_gauge("anomaly/last_score", anomaly["score"],
+                                    kind=kind)
+        from deepspeed_tpu.telemetry.tracing import get_tracer
+        get_tracer().instant(f"anomaly/{kind}", cat="anomaly", corr=corr,
+                             args={k: v for k, v in anomaly.items()})
+        if self.flightrec is not None:
+            self.flightrec.record(f"anomaly/{kind}", corr=corr, **anomaly)
+        return anomaly
+
+
+class SLOTracker:
+    """Per-class latency-target accounting (``serving.slo``).
+
+    ``observe(cls, ttft_s, tpot_s)`` per finished request updates, in
+    the shared registry (all labeled ``slo_class=<cls>``):
+
+    - counters ``serving/slo_requests``, ``serving/slo_ttft_violations``,
+      ``serving/slo_tpot_violations``;
+    - gauges ``serving/slo_ttft_burn_rate`` / ``slo_tpot_burn_rate`` —
+      the violating fraction over the last ``window`` requests of that
+      class (1.0 = every recent request missed its target).
+
+    A request class without configured targets still counts requests
+    (fleet accounting) but can never violate.  Unknown classes fall
+    back to ``default`` so a typo'd client degrades to the default SLO
+    rather than escaping accounting."""
+
+    def __init__(self, config, registry):
+        self.cfg = config
+        self.registry = registry
+        self.enabled = bool(getattr(config, "enabled", False))
+        self.window = int(getattr(config, "window", 256))
+        self.classes = dict(getattr(config, "classes", {}) or {})
+        #: class -> deque of (ttft_ok, tpot_ok) over recent requests
+        self._recent: Dict[str, collections.deque] = {}
+        self._lock = threading.Lock()
+
+    def resolve_class(self, name: Optional[str]) -> str:
+        if name and name in self.classes:
+            return name
+        return "default"
+
+    def observe(self, slo_class: Optional[str], ttft_s: Optional[float],
+                tpot_s: Optional[float]) -> Dict[str, bool]:
+        """Record one finished request; returns the violation flags
+        (empty dict when disabled) for the caller's flight-recorder
+        event."""
+        if not self.enabled:
+            return {}
+        cls = self.resolve_class(slo_class)
+        targets = self.classes.get(cls)
+        ttft_target = float(getattr(targets, "ttft_ms", 0.0) or 0.0) / 1e3
+        tpot_target = float(getattr(targets, "tpot_ms", 0.0) or 0.0) / 1e3
+        ttft_bad = bool(ttft_target and ttft_s is not None
+                        and ttft_s > ttft_target)
+        tpot_bad = bool(tpot_target and tpot_s is not None
+                        and tpot_s > tpot_target)
+        reg = self.registry
+        reg.inc("serving/slo_requests", slo_class=cls)
+        if ttft_bad:
+            reg.inc("serving/slo_ttft_violations", slo_class=cls)
+        if tpot_bad:
+            reg.inc("serving/slo_tpot_violations", slo_class=cls)
+        with self._lock:
+            ring = self._recent.get(cls)
+            if ring is None:
+                ring = self._recent[cls] = collections.deque(
+                    maxlen=self.window)
+            ring.append((ttft_bad, tpot_bad))
+            n = len(ring)
+            ttft_burn = sum(1 for t, _ in ring if t) / n
+            tpot_burn = sum(1 for _, t in ring if t) / n
+        reg.set_gauge("serving/slo_ttft_burn_rate", round(ttft_burn, 4),
+                      slo_class=cls)
+        reg.set_gauge("serving/slo_tpot_burn_rate", round(tpot_burn, 4),
+                      slo_class=cls)
+        out = {}
+        if ttft_bad:
+            out["ttft"] = True
+        if tpot_bad:
+            out["tpot"] = True
+        return out
+
+    def burn_rates(self) -> Dict[str, Dict[str, float]]:
+        """class -> {ttft_burn_rate, tpot_burn_rate, window_requests}
+        (the ``/debug/scheduler`` view; admission control will read the
+        same numbers)."""
+        out = {}
+        with self._lock:
+            items = [(cls, list(ring)) for cls, ring in
+                     self._recent.items()]
+        for cls, ring in items:
+            n = len(ring)
+            out[cls] = {
+                "window_requests": n,
+                "ttft_burn_rate": round(
+                    sum(1 for t, _ in ring if t) / n, 4) if n else 0.0,
+                "tpot_burn_rate": round(
+                    sum(1 for _, t in ring if t) / n, 4) if n else 0.0,
+            }
+        return out

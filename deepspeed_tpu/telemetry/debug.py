@@ -1,0 +1,74 @@
+"""Live debug introspection helpers (ISSUE 7 tentpole).
+
+The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
+:class:`~deepspeed_tpu.telemetry.http_endpoint.MetricsServer`:
+
+- ``format_thread_stacks()`` — an all-thread Python stack dump.  This
+  is THE tool for a wedged scheduler: the lock-free watchdog can flag
+  DEGRADED but cannot say *where* the step is stuck; ``/debug/stacks``
+  can, because it never takes any scheduler lock (it walks
+  ``sys._current_frames()``, which the interpreter hands over without
+  cooperation from the stuck thread).
+- ``flightrec_payload()`` — the ``/debug/flightrec`` JSON body with
+  ``?n=``/``?corr=``/``?kind=`` filtering.
+- ``parse_debug_query()`` — tiny query-string parsing shared by both
+  HTTP front doors.
+
+Everything here is read-only and lock-free with respect to the
+subsystems it inspects — safe to hit while the process is wedged,
+which is the whole point.
+"""
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+def format_thread_stacks() -> str:
+    """Dump every thread's Python stack (the ``py-spy dump`` you can
+    curl).  Thread names come from ``threading.enumerate()`` — daemon
+    loops in this codebase are named (ds-serve-loop, ds-serve-watchdog,
+    ds-metrics), so a wedged step reads as "ds-serve-loop is inside
+    ``model.decode_fn``" at a glance."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = [f"# thread stack dump pid={__import__('os').getpid()} "
+             f"unix={time.time():.3f} threads={len(names)}"]
+    for ident, frame in sorted(sys._current_frames().items()):
+        name = names.get(ident, "?")
+        lines.append(f"\n--- thread {ident} ({name}) ---")
+        lines.extend(line.rstrip()
+                     for line in traceback.format_stack(frame))
+    return "\n".join(lines) + "\n"
+
+
+def parse_debug_query(path: str) -> Tuple[str, Dict[str, str]]:
+    """``/debug/flightrec?n=100&corr=req-3`` -> ("/debug/flightrec",
+    {"n": "100", "corr": "req-3"})."""
+    parsed = urlparse(path)
+    query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+    return parsed.path, query
+
+
+def flightrec_payload(recorder, query: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, Any]:
+    """The ``/debug/flightrec`` body: recorder stats + a filtered event
+    snapshot.  Query keys: ``n`` (last N after filtering, default 256),
+    ``corr`` (exact correlation id), ``kind`` (prefix match)."""
+    query = query or {}
+    try:
+        last_n = int(query.get("n", 256))
+    except ValueError:
+        last_n = 256
+    events = recorder.events(last_n=last_n,
+                             corr=query.get("corr"),
+                             kind_prefix=query.get("kind"))
+    return {
+        "capacity": recorder.capacity,
+        "enabled": recorder.enabled,
+        "total_recorded": recorder.total_recorded,
+        "dropped": recorder.dropped,
+        "returned": len(events),
+        "events": events,
+    }

@@ -1,0 +1,175 @@
+"""Structured flight recorder (ISSUE 7 tentpole).
+
+Aggregate telemetry (the registry, PR 4) answers "how is the fleet
+doing"; the flight recorder answers "what happened to *this* request"
+and "what was the scheduler doing right before it wedged".  It is a
+bounded, lock-cheap ring buffer of structured lifecycle events:
+
+- per-request: ``req/queue`` ``req/admit`` ``req/prefix_hit``
+  ``req/prefill_chunk`` ``req/spec_accept`` ``req/preempt``
+  ``req/resume`` ``req/retire`` ``req/reject`` ``req/slo_violation`` —
+  every event carries the request's ``req-<id>`` correlation id, the
+  SAME id the PR 4 trace spans use, so a flight-recorder timeline and a
+  Perfetto timeline cross-reference directly;
+- per-step: ``serve/step`` and ``train/step`` with durations (the
+  anomaly detector's raw material);
+- ``anomaly/<kind>`` and ``postmortem`` markers.
+
+Cost model: one ``record()`` is a lock acquire, a ``time.time()``, and
+a deque append — no string formatting, no I/O.  The ring bounds memory
+(old events fall off); the recorder never touches disk until someone
+drains it (``/debug/flightrec``, a post-mortem bundle, or
+``dump_jsonl``).  The tier-1 micro-bench asserts total recording cost
+stays under 5% of a 100-step CPU smoke.
+"""
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: default ring capacity (events); ``telemetry.flightrec_events``
+#: overrides, 0 disables recording entirely
+DEFAULT_CAPACITY = 8192
+
+
+class FlightRecorder:
+    """Bounded ring of structured events.  Thread-safe: one plain lock
+    guards the deque; the hot path holds it for an append only."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self.enabled = self.capacity > 0
+        self._ring = collections.deque(maxlen=max(self.capacity, 1))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.total_recorded = 0
+
+    # ------------------------------------------------------------ record
+    def record(self, kind: str, corr: Optional[str] = None, **fields):
+        """Append one event.  ``corr`` is the correlation id shared with
+        the span tracer (``req-<id>``, ``serve-step-N``,
+        ``train-step-N``); ``fields`` must be JSON-serializable."""
+        if not self.enabled:
+            return
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self.total_recorded += 1
+            self._ring.append((seq, time.time(), kind, corr,
+                               fields or None))
+
+    # ------------------------------------------------------------ views
+    @property
+    def dropped(self) -> int:
+        """Events that aged off the ring (recorded - retained)."""
+        with self._lock:
+            return self.total_recorded - len(self._ring)
+
+    @staticmethod
+    def _as_dict(ev) -> Dict[str, Any]:
+        seq, ts, kind, corr, fields = ev
+        out = {"seq": seq, "ts": round(ts, 6), "kind": kind}
+        if corr is not None:
+            out["corr"] = corr
+        if fields:
+            out.update(fields)
+        return out
+
+    def events(self, last_n: Optional[int] = None,
+               corr: Optional[str] = None,
+               kind_prefix: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Snapshot (oldest first), optionally filtered by correlation
+        id and/or kind prefix, optionally only the last ``last_n`` after
+        filtering.  Does NOT clear the ring."""
+        with self._lock:
+            raw = list(self._ring)
+        if corr is not None:
+            raw = [e for e in raw if e[3] == corr]
+        if kind_prefix is not None:
+            raw = [e for e in raw if e[2].startswith(kind_prefix)]
+        if last_n is not None and last_n >= 0:
+            raw = raw[-last_n:] if last_n else []
+        return [self._as_dict(e) for e in raw]
+
+    def timeline(self, request_id: int) -> List[Dict[str, Any]]:
+        """One request's lifecycle, oldest first — the on-demand
+        per-request assembly ``/debug/requests`` and post-mortem
+        bundles use."""
+        return self.events(corr=f"req-{int(request_id)}")
+
+    # ------------------------------------------------------------ drain
+    def drain(self) -> List[Dict[str, Any]]:
+        """Snapshot AND clear (oldest first)."""
+        with self._lock:
+            raw = list(self._ring)
+            self._ring.clear()
+        return [self._as_dict(e) for e in raw]
+
+    def to_jsonl(self, events: Optional[List[Dict[str, Any]]] = None) -> str:
+        """JSONL rendering of a snapshot (default: current ring, not
+        cleared)."""
+        evs = self.events() if events is None else events
+        return "".join(json.dumps(e, default=str) + "\n" for e in evs)
+
+    def dump_jsonl(self, path: str) -> str:
+        """Write the current ring (not cleared) as JSONL; returns path."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_jsonl())
+        return path
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+
+class _NullFlightRecorder(FlightRecorder):
+    """Disabled recorder (capacity 0): record() early-outs."""
+
+    def __init__(self):
+        super().__init__(capacity=0)
+
+
+NULL_FLIGHT_RECORDER = _NullFlightRecorder()
+
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[FlightRecorder] = None
+
+
+def configure_flight_recorder(capacity: Optional[int] = None
+                              ) -> FlightRecorder:
+    """(Re)build the process-wide recorder.  ``capacity=0`` installs the
+    null recorder; ``None`` keeps an existing one (or creates the
+    default)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if capacity is None:
+            if _GLOBAL is None:
+                _GLOBAL = FlightRecorder()
+            return _GLOBAL
+        if capacity <= 0:
+            _GLOBAL = NULL_FLIGHT_RECORDER
+        elif _GLOBAL is None or _GLOBAL.capacity != capacity:
+            _GLOBAL = FlightRecorder(capacity)
+        return _GLOBAL
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide recorder (created on first use).  Subsystems
+    wanting isolation construct their own FlightRecorder and pass it
+    down (the scheduler/engine accept one)."""
+    if _GLOBAL is None:
+        return configure_flight_recorder()
+    return _GLOBAL
+
+
+def reset_flight_recorder():
+    """Tests: drop the process-wide recorder so the next get() is
+    fresh."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

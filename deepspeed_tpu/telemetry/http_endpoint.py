@@ -1,4 +1,5 @@
-"""Opt-in training-side metrics HTTP endpoint (ISSUE 4 tentpole).
+"""Opt-in training-side metrics HTTP endpoint (ISSUE 4 tentpole;
+ISSUE 7 debug surface).
 
 ``telemetry.metrics_port`` (or a direct :class:`MetricsServer`) exposes
 the process-wide :class:`~deepspeed_tpu.telemetry.registry.
@@ -6,7 +7,17 @@ MetricsRegistry` over ``GET /metrics`` in the same Prometheus text
 format ``ds_serve`` renders — one exposition function, two front doors.
 Stdlib-only, one daemon thread; ``port=0`` binds an ephemeral port
 (tests read :attr:`MetricsServer.port` after ``start()``).
+
+Routes:
+  ``/metrics``         Prometheus text exposition
+  ``/healthz``         200 ``{"status": "ok"}`` when the process is
+                       alive (matching the ds_serve surface shape)
+  ``/debug/stacks``    all-thread Python stack dump (lock-free — works
+                       while the training loop is wedged)
+  ``/debug/flightrec`` flight-recorder snapshot (``?n=``, ``?corr=``,
+                       ``?kind=`` filters)
 """
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -34,13 +45,27 @@ class MetricsServer:
                 logger.debug("metrics endpoint: " + fmt % args)
 
             def do_GET(self):
-                if self.path == "/metrics":
+                from deepspeed_tpu.telemetry.debug import (
+                    flightrec_payload, format_thread_stacks,
+                    parse_debug_query)
+                from deepspeed_tpu.telemetry.flight_recorder import \
+                    get_flight_recorder
+                route, query = parse_debug_query(self.path)
+                if route == "/metrics":
                     body = registry.render_prometheus().encode()
                     code, ctype = 200, "text/plain; charset=utf-8"
-                elif self.path == "/healthz":
-                    body, code, ctype = b"ok\n", 200, "text/plain"
+                elif route == "/healthz":
+                    body = json.dumps({"status": "ok"}).encode() + b"\n"
+                    code, ctype = 200, "application/json"
+                elif route == "/debug/stacks":
+                    body = format_thread_stacks().encode()
+                    code, ctype = 200, "text/plain; charset=utf-8"
+                elif route == "/debug/flightrec":
+                    body = json.dumps(flightrec_payload(
+                        get_flight_recorder(), query)).encode()
+                    code, ctype = 200, "application/json"
                 else:
-                    body = f"no route {self.path}\n".encode()
+                    body = f"no route {route}\n".encode()
                     code, ctype = 404, "text/plain"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -54,7 +79,8 @@ class MetricsServer:
                                         daemon=True, name="ds-metrics")
         self._thread.start()
         logger.info(f"telemetry: metrics endpoint on "
-                    f"http://{self.host}:{self.port}/metrics")
+                    f"http://{self.host}:{self.port}/metrics "
+                    f"(+ /healthz, /debug/stacks, /debug/flightrec)")
         return self
 
     def stop(self):

@@ -6,12 +6,36 @@ The container bakes a jax where ``shard_map`` still lives in
 The codebase is written against the current API — every ``shard_map``
 import routes through here so both toolchains drive the same call sites.
 """
+import jax
+
 try:                                    # current jax
     from jax import shard_map as _shard_map
     _CURRENT = True
 except ImportError:                     # older jax: experimental namespace
     from jax.experimental.shard_map import shard_map as _shard_map
     _CURRENT = False
+
+# Sharding-invariant RNG.  This jax still defaults
+# ``jax_threefry_partitionable`` to False, under which a jitted
+# ``jax.random.*`` draw with a SHARDED out_sharding produces DIFFERENT
+# bits than the same draw replicated — so an engine that births params
+# sharded (out_shardings=param_shardings at init) silently initializes
+# e.g. the vocab-parallel embedding differently under TP than under
+# plain DP, breaking TP↔DP train parity at step 0 (the frozen tier-1
+# TP-parity failures traced back to exactly this).  The partitionable
+# formulation computes the same counters per element regardless of
+# partitioning, making generation sharding-invariant; current jax
+# defaults it to True.  Values differ from the legacy stream, which is
+# fine — nothing persists RNG-derived expectations across processes.
+try:
+    import os as _os
+    if "JAX_THREEFRY_PARTITIONABLE" not in _os.environ:
+        # respect an explicit user choice (env var); otherwise flip —
+        # bystander code importing this package does see a different
+        # (but valid) random stream than it would without the import
+        jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:                  # future jax: flag removed (on
+    pass                                # by default, no-op)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
@@ -37,6 +61,14 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
 #: set contains a >1-sized axis.  Callers gate their partial-auto tiers on
 #: this and fall back to fully-automatic GSPMD.
 HAS_PARTIAL_AUTO_SHARD_MAP = _CURRENT
+
+#: this jaxlib's CPU backend has no cross-process collective
+#: implementation AT ALL — any multi-process computation (even
+#: multihost_utils.sync_global_devices' psum) dies with
+#: "INVALID_ARGUMENT: Multiprocess computations aren't implemented on
+#: the CPU backend".  Current jax runs CPU cross-host collectives over
+#: gloo.  The multiprocess parity tests gate on this.
+HAS_MULTIPROCESS_CPU_COLLECTIVES = _CURRENT
 
 
 def get_abstract_mesh():

@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Diff two structured bench outputs and flag regressions (ISSUE 7
+satellite).
+
+Inputs are the machine-readable records the benches emit — a
+``serve_bench --json`` file, a BENCH_*.json record, a JSONL stream of
+records, a list of records, or a flat ``{name: value}`` dict.  Each
+record's ``value`` plus every numeric ``detail`` field becomes a
+comparable metric named ``<metric>`` / ``<metric>.<detail_key>``.
+
+A metric regresses when it moves more than ``--threshold`` (default
+10%) in its BAD direction.  Direction is inferred from the name —
+latencies/durations/counts-of-waste (``*_ms``, ``*_s``, ``latency``,
+``wait``, ``prefill_tokens``, ``rolled_back``, ``evictions``,
+``misses``) are lower-better; rates/throughputs are higher-better —
+and can be forced per-name with ``--lower-better``/``--higher-better``.
+
+Usage::
+
+    python scripts/bench_compare.py baseline.json current.json
+    python scripts/bench_compare.py old.json new.json --threshold 0.05
+    python scripts/bench_compare.py a.json b.json --metrics ttft,tok_s
+
+Exit 0 = no regression; 1 = at least one flagged regression; 2 = bad
+input.  Improvements and within-threshold drift are reported but never
+fail the run.
+"""
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+#: name fragments implying "smaller is better" (substring match)
+LOWER_BETTER_HINTS = ("latency", "wait", "duration", "prefill_tokens",
+                      "rolled_back", "evict", "miss", "violation",
+                      "recomputed", "preemption")
+#: time-unit suffixes (suffix-only: "_s" mid-name would misfire on
+#: every "..._serve..." metric)
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_sec", "_us")
+#: fragments that override a lower-better hint back to higher-better
+#: (rates and counts of good work)
+HIGHER_BETTER_HINTS = ("per_sec", "per_s", "tok_s", "rate", "speedup",
+                       "goodput", "hit", "accept", "useful", "mfu",
+                       "requests")
+
+
+def lower_is_better(name: str) -> bool:
+    n = name.lower()
+    if any(h in n for h in HIGHER_BETTER_HINTS):
+        return False
+    return n.endswith(LOWER_BETTER_SUFFIXES) \
+        or any(h in n for h in LOWER_BETTER_HINTS)
+
+
+def _records(doc) -> List[dict]:
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    if isinstance(doc, dict):
+        if "metric" in doc:
+            return [doc]
+        # flat {name: value} map
+        return [{"metric": str(k), "value": v} for k, v in doc.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    return []
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Flatten a bench file into {metric_name: numeric_value}."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        docs = [json.loads(text)]
+    except json.JSONDecodeError:
+        # JSONL: one record per non-empty line
+        docs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                docs.append(json.loads(line))
+    out: Dict[str, float] = {}
+    for doc in docs:
+        for rec in _records(doc):
+            name = str(rec.get("metric", "metric"))
+            val = rec.get("value")
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                out[name] = float(val)
+            for k, v in (rec.get("detail") or {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{name}.{k}"] = float(v)
+    return out
+
+
+def compare(old: Dict[str, float], new: Dict[str, float],
+            threshold: float = 0.10, metrics=None,
+            force_lower=(), force_higher=()) -> List[dict]:
+    """Rows for every metric present in BOTH files; ``regressed`` set
+    when the bad-direction relative change exceeds the threshold."""
+    rows = []
+    for name in sorted(set(old) & set(new)):
+        if metrics and not any(m in name for m in metrics):
+            continue
+        a, b = old[name], new[name]
+        if any(m in name for m in force_lower):
+            lower = True
+        elif any(m in name for m in force_higher):
+            lower = False
+        else:
+            lower = lower_is_better(name)
+        if a == 0:
+            # no baseline to be relative to: a counter that was 0 last
+            # round (rollbacks, evictions, preemptions) going nonzero is
+            # ordinary run-to-run jitter, not an unbounded regression —
+            # report the move but never flag it
+            change = 0.0 if b == 0 else float("inf") * (1 if b > 0 else -1)
+            regressed = False
+        else:
+            change = (b - a) / abs(a)
+            regressed = (change if lower else -change) > threshold
+        rows.append({
+            "metric": name, "old": a, "new": b,
+            "change_pct": round(change * 100, 2),
+            "direction": "lower_better" if lower else "higher_better",
+            "regressed": regressed,
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="diff two bench JSON outputs, flag >threshold "
+                    "regressions on named metrics")
+    p.add_argument("baseline")
+    p.add_argument("current")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="bad-direction relative change that counts as a "
+                        "regression (default 0.10 = 10%%)")
+    p.add_argument("--metrics", default=None,
+                   help="comma-separated substrings; only matching "
+                        "metric names are compared")
+    p.add_argument("--lower-better", default="",
+                   help="comma-separated substrings forced lower-better")
+    p.add_argument("--higher-better", default="",
+                   help="comma-separated substrings forced higher-better")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="print only regressions")
+    args = p.parse_args(argv)
+    try:
+        old = load_metrics(args.baseline)
+        new = load_metrics(args.current)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"bench_compare: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    if not old or not new:
+        print("bench_compare: no numeric metrics found", file=sys.stderr)
+        return 2
+    metrics = [m for m in (args.metrics or "").split(",") if m] or None
+    rows = compare(old, new, threshold=args.threshold, metrics=metrics,
+                   force_lower=[m for m in args.lower_better.split(",")
+                                if m],
+                   force_higher=[m for m in args.higher_better.split(",")
+                                 if m])
+    if not rows:
+        print("bench_compare: no common metrics to compare",
+              file=sys.stderr)
+        return 2
+    regressions = [r for r in rows if r["regressed"]]
+    width = max(len(r["metric"]) for r in rows)
+    for r in rows:
+        if args.quiet and not r["regressed"]:
+            continue
+        flag = "REGRESSED" if r["regressed"] else "ok"
+        arrow = "↓ better" if r["direction"] == "lower_better" \
+            else "↑ better"
+        print(f"{r['metric']:<{width}}  {r['old']:>12.4g} -> "
+              f"{r['new']:>12.4g}  {r['change_pct']:>+8.2f}%  "
+              f"[{arrow}]  {flag}")
+    print(f"\n{len(rows)} metrics compared, {len(regressions)} "
+          f"regression(s) past {args.threshold:.0%}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

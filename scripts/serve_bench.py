@@ -13,6 +13,12 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
+    SERVE_MODE=cb python scripts/serve_bench.py --json out.json
+
+``--json out.json`` (ISSUE 7 satellite) additionally writes the result
+record to a file — the machine-readable form ``scripts/
+bench_compare.py`` diffs across rounds, so the bench trajectory stops
+being prose-only in PERF.md.
 
 Static mode prints one JSON line: prefill ms + steady decode tokens/s.
 CB mode prints one JSON line: continuous-batching vs static-batch tok/s
@@ -27,6 +33,7 @@ prefill tokens computed, and serving_goodput — the ISSUE 6 acceptance
 columns (identical outputs asserted between the two runs).
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
+import argparse
 import json
 import os
 import sys
@@ -38,7 +45,27 @@ import numpy as np
 import jax
 
 
-def main():
+def emit(result: dict, json_path=None) -> dict:
+    """Print the one-line JSON record (the existing convention) and,
+    with --json, persist it for bench_compare.py."""
+    print(json.dumps(result))
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"# wrote {json_path}", file=sys.stderr)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="serve_bench",
+        description="serving benchmark (workload shape via SERVE_* env "
+                    "vars — see module docstring)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the result record to PATH "
+                        "(bench_compare.py input)")
+    args = p.parse_args(argv)
+    json_path = args.json
     on_tpu = "tpu" in str(jax.devices()[0]).lower()
     spec = os.environ.get("SERVE_MODEL",
                           "gpt2:125m" if on_tpu else "gpt2:custom")
@@ -130,11 +157,14 @@ def main():
     eng = InferenceEngine(model, cfg, model_parameters=params)
 
     if os.environ.get("SERVE_MODE") == "cb":
-        return bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu)
+        return bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu,
+                                         json_path)
     if os.environ.get("SERVE_MODE") == "spec":
-        return bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu)
+        return bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu,
+                                   json_path)
     if os.environ.get("SERVE_MODE") == "prefix":
-        return bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu)
+        return bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
+                                  json_path)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -172,7 +202,7 @@ def main():
     else:
         rate = round(toks / decode_s, 1)
     from deepspeed_tpu.models.serving import qgemm_enabled
-    print(json.dumps({
+    emit({
         "metric": f"{spec}_serve"
                   + ("_int8kv" if kv_dtype == "int8" else "")
                   + (("_int8w_qgemm" if qgemm_enabled() else "_int8w_dq")
@@ -183,10 +213,11 @@ def main():
                    "new_tokens": new_tokens,
                    "prefill_ms": round(t_prefill * 1e3, 2),
                    "total_s": round(t_full, 3)},
-    }))
+    }, json_path)
 
 
-def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu):
+def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu,
+                              json_path=None):
     """Mixed-length workload through the iteration-level scheduler vs the
     static-batch baseline (rectangular pad, batch drains as a unit).
 
@@ -263,7 +294,7 @@ def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu):
     st_s, st_ttft = min((run_static() for _ in range(3)),
                         key=lambda r: r[0])
     pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)
-    print(json.dumps({
+    emit({
         "metric": f"{spec}_serve_cb"
                   + ("_int8kv" if kv_dtype == "int8" else ""),
         "value": round(useful / cb_s, 1),
@@ -281,10 +312,11 @@ def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu):
             "decode_steps_total": int(
                 sched.metrics.counters["decode_steps"]),
         },
-    }))
+    }, json_path)
 
 
-def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu):
+def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu,
+                        json_path=None):
     """Speculative (ngram-proposer) vs plain continuous batching on a
     mixed-length REPETITIVE-SUFFIX workload — prompts built by tiling a
     short motif, the regime prompt-lookup exists for (long prompts the
@@ -351,7 +383,7 @@ def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu):
     spec_passes = c["decode_steps"] + c["spec_verify_steps"]
     cb_passes = cb_m.counters["decode_steps"]
     h = spec_m.spec_accept_len
-    print(json.dumps({
+    emit({
         "metric": f"{spec}_serve_spec"
                   + ("_int8kv" if kv_dtype == "int8" else ""),
         "value": round(useful / spec_s, 1),
@@ -375,10 +407,11 @@ def bench_spec_decoding(model, eng, spec, kv_dtype, on_tpu):
             "rolled_back": int(c["spec_rolled_back_tokens"]),
             "verify_passes": int(c["spec_verify_steps"]),
         },
-    }))
+    }, json_path)
 
 
-def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu):
+def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
+                       json_path=None):
     """Shared-prefix workload (ISSUE 6): N requests drawn over M shared
     system prompts, each with a distinct random tail — the chat-fleet
     regime where most prefill is redundant.  Runs the cb scheduler with
@@ -445,7 +478,7 @@ def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu):
     pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)
     c = on_m.counters
     lookups = c["prefix_cache_hit"] + c["prefix_cache_miss"]
-    print(json.dumps({
+    emit({
         "metric": f"{spec}_serve_prefix"
                   + ("_int8kv" if kv_dtype == "int8" else ""),
         "value": round(useful / on_s, 1),
@@ -473,7 +506,7 @@ def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu):
             "goodput_on": on_m.gauges.get("goodput"),
             "goodput_off": off_m.gauges.get("goodput"),
         },
-    }))
+    }, json_path)
 
 
 if __name__ == "__main__":

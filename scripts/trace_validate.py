@@ -13,10 +13,18 @@ all:
 - ``B``/``E`` pairs balance LIFO per (pid, tid), with matching names;
 - ``args``, when present, is an object.
 
+Anomaly instants (ISSUE 7): every ``anomaly/<kind>`` instant must carry
+the ENCLOSING step's correlation id (``train-step-N`` /
+``serve-step-N``) and its detector fields — an anomaly that can't be
+tied back to the step that spiked is forensic noise.  The check always
+runs when anomaly events are present; ``--check-anomalies`` also fails
+when the trace contains none at all (chaos-session acceptance).
+
 Usage::
 
     python scripts/trace_validate.py /tmp/ds_trace.json
     python scripts/trace_validate.py --require-corr trace.json
+    python scripts/trace_validate.py --check-anomalies chaos_trace.json
 
 Exit 0 = valid; 1 = schema violations (printed one per line).  The
 tier-1 telemetry test runs ``validate()`` against a trace produced by a
@@ -24,11 +32,16 @@ toy train + serve session.
 """
 import argparse
 import json
+import re
 import sys
 from typing import Dict, List
 
 REQUIRED_FIELDS = ("name", "ph", "ts", "pid", "tid")
 ALLOWED_PH = {"B", "E", "X", "i", "I", "C", "M"}
+
+#: the correlation ids an anomaly instant may legally carry — the
+#: enclosing train/serve step's span id
+_STEP_CORR = re.compile(r"^(train|serve)-step-\d+$")
 
 
 def load_events(path: str) -> List[Dict]:
@@ -97,12 +110,50 @@ def validate_events(events: List[Dict]) -> List[str]:
     return errors
 
 
-def validate(path: str, require_corr: bool = False) -> List[str]:
+def validate_anomalies(events: List[Dict],
+                       require_present: bool = False) -> List[str]:
+    """ISSUE 7: ``anomaly/<kind>`` instants must be instants, carry the
+    enclosing step's correlation id, and carry the detector fields
+    (value/median/score).  ``require_present`` additionally fails an
+    anomaly-free trace (the chaos acceptance mode)."""
+    errors: List[str] = []
+    seen = 0
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or \
+                not str(ev.get("name", "")).startswith("anomaly/"):
+            continue
+        seen += 1
+        name = ev["name"]
+        if ev.get("ph") not in ("i", "I"):
+            errors.append(f"event {i} ({name!r}): anomaly events must be "
+                          f"instants, got ph={ev.get('ph')!r}")
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        corr = args.get("corr")
+        if not (isinstance(corr, str) and _STEP_CORR.match(corr)):
+            errors.append(
+                f"event {i} ({name!r}): anomaly instant must carry the "
+                f"enclosing step's corr id (train-step-N / serve-step-N), "
+                f"got {corr!r}")
+        missing = [k for k in ("value", "median", "score")
+                   if k not in args]
+        if missing:
+            errors.append(f"event {i} ({name!r}): anomaly instant missing "
+                          f"detector fields {missing}")
+    if require_present and not seen:
+        errors.append("--check-anomalies: trace contains no anomaly/* "
+                      "instants")
+    return errors
+
+
+def validate(path: str, require_corr: bool = False,
+             check_anomalies: bool = False) -> List[str]:
     try:
         events = load_events(path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         return [f"cannot load {path}: {e}"]
     errors = validate_events(events)
+    errors.extend(validate_anomalies(events,
+                                     require_present=check_anomalies))
     if require_corr and not errors:
         corrs = {ev.get("args", {}).get("corr") for ev in events
                  if isinstance(ev, dict) and isinstance(ev.get("args"),
@@ -149,9 +200,13 @@ def main(argv=None) -> int:
     p.add_argument("path")
     p.add_argument("--require-corr", action="store_true",
                    help="also fail when no event carries args.corr")
+    p.add_argument("--check-anomalies", action="store_true",
+                   help="fail when the trace has no anomaly/* instants "
+                        "(their corr/field schema is always checked)")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args(argv)
-    errors = validate(args.path, require_corr=args.require_corr)
+    errors = validate(args.path, require_corr=args.require_corr,
+                      check_anomalies=args.check_anomalies)
     if errors:
         for e in errors:
             print(f"INVALID: {e}", file=sys.stderr)

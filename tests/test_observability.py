@@ -1,0 +1,819 @@
+"""Black-box observability layer (ISSUE 7 tentpole): flight recorder,
+rolling anomaly detection, SLO burn accounting, live ``/debug/*``
+introspection, and crash/stall post-mortem bundles — plus the
+satellites (bench_compare, serve_bench --json, trace_validate anomaly
+checks, MetricsServer debug surface).
+
+The acceptance test at the bottom runs one chaos session — a serving
+loop with an injected ``serve.step`` stall under DS_TRACE — and asserts
+the watchdog-triggered post-mortem bundle exists, parses, and its
+flight-recorder tail reconstructs the stalled request's timeline; that
+the trace is validator-clean INCLUDING anomaly instants carrying step
+correlation ids; and that ``/debug/requests`` / ``/debug/scheduler``
+answer consistently over live HTTP.  A micro-bench asserts flight-
+recorder overhead stays under 5% of a 100-step CPU smoke.
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import (ServingConfig, SLOConfig,
+                                          TelemetryConfig)
+from deepspeed_tpu.serving import ContinuousBatchingScheduler, SamplingParams
+from deepspeed_tpu.serving.server import make_server
+from deepspeed_tpu.telemetry import (AnomalyMonitor, FlightRecorder,
+                                     MetricsRegistry, MetricsServer,
+                                     RollingMadDetector, SLOTracker,
+                                     configure_tracer, flightrec_payload,
+                                     format_thread_stacks, get_tracer,
+                                     parse_debug_query, reset_tracer)
+from scripts.trace_validate import load_events, validate, validate_anomalies
+from tests.util import tiny_gpt2
+
+
+@pytest.fixture(autouse=True)
+def _tracer_isolation():
+    reset_tracer()
+    yield
+    reset_tracer()
+
+
+@pytest.fixture(autouse=True)
+def _postmortem_rate_limit():
+    """Every test may write a bundle immediately."""
+    from deepspeed_tpu.resilience.postmortem import reset_rate_limit
+    reset_rate_limit()
+    yield
+    reset_rate_limit()
+
+
+@pytest.fixture(scope="module")
+def served():
+    m = tiny_gpt2()
+    eng = deepspeed_tpu.init_inference(model=m, config={"dtype": "float32"})
+    return m, eng
+
+
+def _prompts(n, seed=0, lo=3, hi=10):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 128, (int(L),)).astype(np.int32)
+            for L in rng.integers(lo, hi, n)]
+
+
+# ------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_filters_and_drain():
+    fr = FlightRecorder(capacity=8)
+    fr.record("req/queue", corr="req-1", prompt_tokens=5)
+    fr.record("req/admit", corr="req-1", slot=0)
+    fr.record("req/queue", corr="req-2", prompt_tokens=3)
+    for i in range(4):
+        fr.record("serve/step", corr=f"serve-step-{i}", dur_ms=1.0)
+    evs = fr.events()
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    assert fr.timeline(1) == fr.events(corr="req-1")
+    assert [e["kind"] for e in fr.timeline(1)] == ["req/queue",
+                                                   "req/admit"]
+    assert len(fr.events(kind_prefix="serve/")) == 4
+    assert len(fr.events(last_n=2)) == 2
+    # ring bound: 8-cap, push it over
+    for i in range(10):
+        fr.record("x")
+    assert len(fr.events()) == 8
+    assert fr.dropped == fr.total_recorded - 8 > 0
+    # jsonl round-trips
+    lines = fr.to_jsonl().splitlines()
+    assert len(lines) == 8
+    assert all(json.loads(ln)["kind"] for ln in lines)
+    drained = fr.drain()
+    assert len(drained) == 8 and fr.events() == []
+
+
+def test_flight_recorder_disabled_and_dump(tmp_path):
+    off = FlightRecorder(capacity=0)
+    off.record("req/queue", corr="req-1")
+    assert not off.enabled and off.events() == []
+    fr = FlightRecorder(capacity=4)
+    fr.record("a", x=1)
+    path = fr.dump_jsonl(str(tmp_path / "sub" / "fr.jsonl"))
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["kind"] == "a" and rec["x"] == 1
+
+
+def test_flight_recorder_configure_global():
+    from deepspeed_tpu.telemetry import (configure_flight_recorder,
+                                         get_flight_recorder,
+                                         reset_flight_recorder)
+    reset_flight_recorder()
+    try:
+        fr = configure_flight_recorder(16)
+        assert get_flight_recorder() is fr and fr.capacity == 16
+        off = configure_flight_recorder(0)
+        assert not off.enabled and get_flight_recorder() is off
+    finally:
+        reset_flight_recorder()
+
+
+# ------------------------------------------------------ anomaly detector
+def test_rolling_mad_detector_flags_and_adapts():
+    det = RollingMadDetector(window=16, threshold=5.0, min_samples=8)
+    rng = np.random.default_rng(0)
+    for _ in range(12):
+        assert det.observe(0.01 + rng.uniform(0, 1e-4)) is None
+    a = det.observe(0.5)
+    assert a is not None and a["score"] > 5.0
+    assert a["median"] == pytest.approx(0.01, rel=0.1)
+    # below min_samples: never flags
+    young = RollingMadDetector(window=16, threshold=5.0, min_samples=8)
+    for _ in range(7):
+        assert young.observe(0.01) is None
+    assert young.observe(99.0) is None      # 8th sample, window too young
+    # regime change stops alerting once the window adapts
+    shifted = RollingMadDetector(window=8, threshold=5.0, min_samples=4)
+    for _ in range(8):
+        shifted.observe(0.01)
+    assert shifted.observe(1.0) is not None
+    for _ in range(8):
+        shifted.observe(1.0)
+    assert shifted.observe(1.0) is None
+
+
+def test_anomaly_monitor_three_surfaces(tmp_path):
+    trace = str(tmp_path / "t.json")
+    os.environ.pop("DS_TRACE", None)
+    reset_tracer()
+    tracer = configure_tracer(trace)
+    reg = MetricsRegistry()
+    fr = FlightRecorder(capacity=64)
+    mon = AnomalyMonitor(registry=reg, flightrec=fr, window=16,
+                         threshold=5.0, min_samples=4)
+    for i in range(8):
+        assert mon.observe("serve.step", 0.01,
+                           corr=f"serve-step-{i}") is None
+    a = mon.observe("serve.step", 2.0, corr="serve-step-8")
+    assert a is not None
+    assert reg.get_counter("anomaly/serve.step") == 1
+    assert reg.get_gauge("anomaly/last_score", kind="serve.step") > 5
+    evs = fr.events(kind_prefix="anomaly/")
+    assert len(evs) == 1 and evs[0]["corr"] == "serve-step-8"
+    tracer.flush()
+    events = load_events(trace)
+    assert validate_anomalies(events, require_present=True) == []
+    inst = [e for e in events if e["name"] == "anomaly/serve.step"]
+    assert inst and inst[0]["args"]["corr"] == "serve-step-8"
+    # disabled monitor (threshold 0) never observes
+    off = AnomalyMonitor(registry=reg, threshold=0)
+    assert off.observe("k", 1e9) is None
+
+
+def test_trace_validate_anomaly_checks(tmp_path):
+    from scripts.trace_validate import main
+    ok = [{"name": "anomaly/serve.step", "ph": "i", "ts": 1, "pid": 1,
+           "tid": 1, "s": "p",
+           "args": {"corr": "serve-step-3", "value": 2.0, "median": 0.01,
+                    "mad": 0.001, "score": 9.0}}]
+    assert validate_anomalies(ok) == []
+    assert validate_anomalies([], require_present=True) != []
+    bad_corr = [dict(ok[0], args={**ok[0]["args"], "corr": "req-3"})]
+    assert any("corr" in e for e in validate_anomalies(bad_corr))
+    no_fields = [dict(ok[0], args={"corr": "train-step-1"})]
+    assert any("detector fields" in e
+               for e in validate_anomalies(no_fields))
+    bad_ph = [dict(ok[0], ph="B"), dict(ok[0], ph="E", ts=2)]
+    assert any("instants" in e for e in validate_anomalies(bad_ph))
+    # CLI flag: a trace without anomalies fails --check-anomalies
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "a", "ph": "B", "ts": 0, "pid": 1, "tid": 1},
+            {"name": "a", "ph": "E", "ts": 1, "pid": 1, "tid": 1}]}, f)
+    assert main([path, "-q"]) == 0
+    assert main([path, "--check-anomalies", "-q"]) == 1
+
+
+# ----------------------------------------------------------------- SLO
+def test_slo_config_roundtrip_and_validation():
+    cfg = ServingConfig(slo={
+        "enabled": True, "window": 32,
+        "classes": {"interactive": {"ttft_ms": 200, "tpot_ms": 40},
+                    "batch": {}}})
+    assert cfg.slo.enabled and cfg.slo.window == 32
+    # "default" always exists as the fallback class
+    assert set(cfg.slo.classes) == {"interactive", "batch", "default"}
+    assert cfg.slo.classes["interactive"].ttft_ms == 200
+    assert ServingConfig().slo.enabled is False
+    with pytest.raises(ValueError, match="window"):
+        SLOConfig(window=0)
+    with pytest.raises(ValueError, match="ttft_ms"):
+        SLOConfig(classes={"x": {"ttft_ms": -1}})
+    with pytest.raises(ValueError, match="classes"):
+        SLOConfig(classes=[1, 2])
+
+
+def test_slo_tracker_burn_accounting():
+    cfg = SLOConfig(enabled=True, window=4,
+                    classes={"fast": {"ttft_ms": 100, "tpot_ms": 10}})
+    reg = MetricsRegistry()
+    t = SLOTracker(cfg, reg)
+    # violation on ttft only
+    assert t.observe("fast", ttft_s=0.5, tpot_s=0.005) == {"ttft": True}
+    # both within target
+    assert t.observe("fast", ttft_s=0.05, tpot_s=0.005) == {}
+    # unknown class falls back to default (no targets -> no violation)
+    assert t.observe("typo", ttft_s=99.0, tpot_s=99.0) == {}
+    assert t.resolve_class("typo") == "default"
+    assert reg.get_counter("serving/slo_requests", slo_class="fast") == 2
+    assert reg.get_counter("serving/slo_ttft_violations",
+                           slo_class="fast") == 1
+    assert reg.get_gauge("serving/slo_ttft_burn_rate",
+                         slo_class="fast") == 0.5
+    rates = t.burn_rates()
+    assert rates["fast"]["window_requests"] == 2
+    assert rates["fast"]["ttft_burn_rate"] == 0.5
+    # rolling window: push violations out
+    for _ in range(4):
+        t.observe("fast", ttft_s=0.01, tpot_s=0.001)
+    assert t.burn_rates()["fast"]["ttft_burn_rate"] == 0.0
+    # disabled tracker is inert
+    off = SLOTracker(SLOConfig(), MetricsRegistry())
+    assert off.observe("fast", 99, 99) == {}
+
+
+# ---------------------------------------------- scheduler integration
+def test_scheduler_flight_recorder_lifecycle_and_slo(served):
+    m, eng = served
+    fr = FlightRecorder(capacity=2048)
+    reg = MetricsRegistry()
+    cfg = ServingConfig(
+        block_size=8, num_blocks=32, max_num_seqs=2,
+        slo={"enabled": True,
+             "classes": {"strict": {"ttft_ms": 1e-4},
+                         "loose": {"ttft_ms": 60000}}})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg, registry=reg,
+                                        flightrec=fr)
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=4),
+                         slo_class="strict")
+            for p in _prompts(2, seed=1)]
+    sched.submit(_prompts(1, seed=2)[0], SamplingParams(max_new_tokens=4),
+                 slo_class="loose")
+    sched.run_until_idle()
+    # every request's timeline reconstructs end-to-end
+    for r in reqs:
+        kinds = [e["kind"] for e in fr.timeline(r.request_id)]
+        assert kinds[0] == "req/queue" and kinds[-1] == "req/retire"
+        assert "req/admit" in kinds and "req/prefill_chunk" in kinds
+        # the strict 0.1 us TTFT target is unmeetable: violation recorded
+        assert "req/slo_violation" in kinds
+    # step events carry durations and queue/active occupancy
+    steps = fr.events(kind_prefix="serve/step")
+    assert steps and all("dur_ms" in e and "active" in e for e in steps)
+    # SLO surfaces on /metrics through the shared exposition
+    text = sched.render_metrics()
+    assert 'serving_slo_requests{slo_class="strict"} 2' in text
+    assert 'serving_slo_ttft_violations{slo_class="strict"} 2' in text
+    assert 'serving_slo_ttft_burn_rate{slo_class="strict"} 1' in text
+    assert 'serving_slo_requests{slo_class="loose"} 1' in text
+    assert sched.metrics.counters["slo_violations"] == 2
+    # debug views agree with final state
+    dbg = sched.debug_scheduler()
+    assert dbg["slo"]["enabled"] and dbg["slo"]["violations"] == 2
+    assert dbg["queue_depth"] == 0
+    assert all(s is None for s in dbg["slots"])
+    assert dbg["block_pool"]["allocated"] == 0
+    assert sched.debug_requests()["active"] == []
+
+
+def test_scheduler_preempt_resume_flight_events(served):
+    """Eviction under pool pressure leaves req/preempt + req/resume on
+    the victim's timeline."""
+    m, eng = served
+    fr = FlightRecorder(capacity=2048)
+    # 8 blocks = 7 usable (one trash); each request needs 4 blocks for
+    # its 16 tokens, so the pair cannot coexist at full length
+    cfg = ServingConfig(block_size=4, num_blocks=8, max_num_seqs=2)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    rng = np.random.default_rng(3)
+    reqs = [sched.submit(rng.integers(1, 128, (6,)).astype(np.int32),
+                         SamplingParams(max_new_tokens=10), priority=pr)
+            for pr in (0, 1)]
+    sched.run_until_idle()
+    assert sched.metrics.counters["preemptions"] > 0
+    victims = [r for r in reqs
+               if any(e["kind"] == "req/preempt"
+                      for e in fr.timeline(r.request_id))]
+    assert victims
+    for v in victims:
+        kinds = [e["kind"] for e in fr.timeline(v.request_id)]
+        assert "req/resume" in kinds[kinds.index("req/preempt"):]
+        assert kinds[-1] == "req/retire"
+
+
+def test_rejected_requests_never_share_ids_or_timelines(served):
+    """A rejected submit must consume its request id: its req/reject
+    flight event may not share a req-<id> corr with the next accepted
+    request's timeline (review fix)."""
+    from deepspeed_tpu.serving.scheduler import RequestTooLongError
+    m, eng = served
+    fr = FlightRecorder(capacity=256)
+    cfg = ServingConfig(block_size=8, num_blocks=16, max_num_seqs=1)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    huge = np.arange(1, 60, dtype=np.int32)
+    with pytest.raises(RequestTooLongError):
+        sched.submit(huge, SamplingParams(max_new_tokens=200))
+    ok = sched.submit(_prompts(1, seed=21)[0],
+                      SamplingParams(max_new_tokens=2))
+    sched.run_until_idle()
+    kinds = [e["kind"] for e in fr.timeline(ok.request_id)]
+    assert "req/reject" not in kinds and kinds[-1] == "req/retire"
+    rejects = fr.events(kind_prefix="req/reject")
+    assert len(rejects) == 1
+    assert rejects[0]["corr"] != f"req-{ok.request_id}"
+
+
+def test_queue_timeout_records_terminal_flight_event(served):
+    """A queued request that times out must close its timeline with a
+    req/reject (reason=timeout) — not dangle at req/queue (review
+    fix)."""
+    m, eng = served
+    fr = FlightRecorder(capacity=256)
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=1)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    blocker = sched.submit(_prompts(1, seed=22)[0],
+                           SamplingParams(max_new_tokens=8))
+    doomed = sched.submit(_prompts(1, seed=23)[0],
+                          SamplingParams(max_new_tokens=2),
+                          timeout_s=1e-6)
+    time.sleep(0.01)
+    sched.run_until_idle()
+    assert doomed.state.value == "rejected"
+    kinds = [e["kind"] for e in fr.timeline(doomed.request_id)]
+    assert kinds[0] == "req/queue" and kinds[-1] == "req/reject"
+    assert blocker.state.value == "finished"
+
+
+# ------------------------------------------------------ debug endpoints
+def test_serve_debug_endpoints_http(served):
+    m, eng = served
+    fr = FlightRecorder(capacity=256)
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    for p in _prompts(2, seed=4):
+        sched.submit(p, SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+    httpd, _loop = make_server(sched, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        with urllib.request.urlopen(base + "/debug/requests",
+                                    timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["active"] == [] and body["queued"] == []
+        assert body["step_count"] == sched.step_count
+        with urllib.request.urlopen(base + "/debug/scheduler",
+                                    timeout=10) as r:
+            dbg = json.loads(r.read())
+        assert dbg["step_count"] == sched.step_count
+        assert dbg["block_pool"]["num_blocks"] == 32
+        assert len(dbg["slots"]) == cfg.max_num_seqs
+        assert dbg["health"]["status"] == "starting"   # loop never started
+        with urllib.request.urlopen(base + "/debug/stacks",
+                                    timeout=10) as r:
+            stacks = r.read().decode()
+        assert "thread stack dump" in stacks and "MainThread" in stacks
+        url = base + "/debug/flightrec?kind=req/&n=4"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["enabled"] and payload["returned"] == 4
+        assert all(e["kind"].startswith("req/")
+                   for e in payload["events"])
+        corr = payload["events"][0]["corr"]
+        with urllib.request.urlopen(
+                base + f"/debug/flightrec?corr={corr}", timeout=10) as r:
+            scoped = json.loads(r.read())
+        assert scoped["events"] and all(e["corr"] == corr
+                                        for e in scoped["events"])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/debug/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_metrics_server_healthz_and_debug(monkeypatch):
+    """Satellite: the training MetricsServer answers /healthz with the
+    ds_serve-shaped JSON body and carries the /debug surface."""
+    from deepspeed_tpu.telemetry import (configure_flight_recorder,
+                                         reset_flight_recorder)
+    reset_flight_recorder()
+    fr = configure_flight_recorder(64)
+    fr.record("train/step", corr="train-step-1", dur_ms=5.0)
+    reg = MetricsRegistry()
+    reg.set_gauge("train/mfu", 0.5)
+    srv = MetricsServer(reg, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            assert json.loads(r.read()) == {"status": "ok"}
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert "train_mfu 0.5" in r.read().decode()
+        with urllib.request.urlopen(base + "/debug/stacks",
+                                    timeout=10) as r:
+            assert "thread stack dump" in r.read().decode()
+        with urllib.request.urlopen(base + "/debug/flightrec?corr="
+                                    "train-step-1", timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["returned"] == 1
+        assert payload["events"][0]["kind"] == "train/step"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        reset_flight_recorder()
+
+
+def test_debug_helpers_unit():
+    route, q = parse_debug_query("/debug/flightrec?n=7&corr=req-2&kind=a")
+    assert route == "/debug/flightrec"
+    assert q == {"n": "7", "corr": "req-2", "kind": "a"}
+    fr = FlightRecorder(capacity=8)
+    fr.record("a", corr="c-1")
+    payload = flightrec_payload(fr, {"n": "bogus"})
+    assert payload["returned"] == 1        # bad n falls back to default
+    dump = format_thread_stacks()
+    assert "MainThread" in dump and "format_thread_stacks" in dump
+
+
+# -------------------------------------------------- post-mortem bundles
+def _read_bundle(path):
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    fr_lines = [json.loads(ln) for ln in
+                open(os.path.join(path, "flightrec.jsonl"))
+                if ln.strip()]
+    return man, fr_lines
+
+
+def test_write_postmortem_contents(tmp_path, served):
+    from deepspeed_tpu.resilience.postmortem import write_postmortem
+    m, eng = served
+    fr = FlightRecorder(capacity=256)
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    req = sched.submit(_prompts(1, seed=5)[0],
+                       SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+    path = write_postmortem(str(tmp_path), "test incident",
+                            step=sched.step_count, scheduler=sched)
+    assert path and os.path.basename(path).startswith("postmortem-step")
+    man, fr_lines = _read_bundle(path)
+    assert man["reason"] == "test incident"
+    for name in ("stacks.txt", "flightrec.jsonl", "metrics.prom",
+                 "metrics.json", "scheduler.json", "config.json"):
+        assert man["files"][name] is True, (name, man["files"])
+    # the request timeline reconstructs from the bundle alone
+    tl = [e for e in fr_lines if e.get("corr") == f"req-{req.request_id}"]
+    kinds = [e["kind"] for e in tl]
+    assert kinds[0] == "req/queue" and kinds[-1] == "req/retire"
+    sj = json.load(open(os.path.join(path, "scheduler.json")))
+    assert sj["scheduler"]["block_pool"]["num_blocks"] == 32
+    metrics = json.load(open(os.path.join(path, "metrics.json")))
+    assert metrics.get("serving/completed") == 1
+    assert "serving_ttft_s_bucket" in \
+        open(os.path.join(path, "metrics.prom")).read()
+    cfg_dump = json.load(open(os.path.join(path, "config.json")))
+    assert cfg_dump["num_blocks"] == 32
+
+
+def test_postmortem_rate_limit_and_disable(tmp_path):
+    from deepspeed_tpu.resilience.postmortem import (reset_rate_limit,
+                                                     write_postmortem)
+    assert write_postmortem("", "disabled") is None
+    p1 = write_postmortem(str(tmp_path), "first")
+    assert p1 is not None
+    # immediately after: suppressed by the rate limit
+    assert write_postmortem(str(tmp_path), "second") is None
+    reset_rate_limit()
+    p2 = write_postmortem(str(tmp_path), "third")
+    assert p2 is not None and p2 != p1
+
+
+def test_postmortem_failed_write_returns_rate_limit(tmp_path):
+    """A bundle attempt that cannot even create its directory must not
+    consume the rate limit — the next trigger (writable again) still
+    gets its bundle (review fix)."""
+    from deepspeed_tpu.resilience.postmortem import write_postmortem
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a dir")     # makedirs will fail
+    assert write_postmortem(str(blocked), "doomed") is None
+    # immediately after: a healthy dir must succeed, not be suppressed
+    assert write_postmortem(str(tmp_path / "ok"), "real incident") \
+        is not None
+
+
+def test_train_postmortem_dir_resolution(tmp_path):
+    """resilience.postmortem_dir semantics on the training path:
+    None = next to checkpoints, "" = disabled, path = that path
+    (review fix)."""
+    from deepspeed_tpu.resilience.preemption import _train_postmortem_dir
+
+    class _Cfg:
+        postmortem_dir = None
+
+    class _RC:
+        resilience_config = _Cfg()
+
+    class _Eng:
+        _config = _RC()
+
+    eng = _Eng()
+    assert _train_postmortem_dir(eng, "/ckpts") == "/ckpts"
+    _Cfg.postmortem_dir = ""
+    assert _train_postmortem_dir(eng, "/ckpts") == ""      # disabled
+    _Cfg.postmortem_dir = "/custom"
+    assert _train_postmortem_dir(eng, "/ckpts") == "/custom"
+    assert _train_postmortem_dir(eng, "/ckpts",
+                                 override="/x") == "/x"
+
+
+def test_list_tags_ignores_postmortem_bundles(tmp_path):
+    """A checkpoint root holding only a forensic bundle must resolve to
+    'no tags' (fresh start), not CheckpointCorruptError."""
+    from deepspeed_tpu.resilience.ckpt import find_valid_tag, list_tags
+    from deepspeed_tpu.resilience.postmortem import write_postmortem
+    root = str(tmp_path / "ckpts")
+    os.makedirs(root)
+    assert write_postmortem(root, "crash before first save") is not None
+    assert list_tags(root) == []
+    assert find_valid_tag(root) is None
+
+
+def test_drain_and_exit_writes_bundle(tmp_path, served):
+    """The fatal-signal path: drain_and_exit leaves a bundle next to the
+    emergency checkpoint."""
+    from deepspeed_tpu.resilience.preemption import drain_and_exit
+    from tests.util import base_config, random_batches
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=base_config())
+    engine.train_batch(iter(random_batches(1, seed=0)))
+    codes = []
+    drain_and_exit(engine, str(tmp_path), _exit=codes.append)
+    assert codes == [86]
+    bundles = [d for d in os.listdir(tmp_path)
+               if d.startswith("postmortem-step")]
+    assert len(bundles) == 1
+    man, fr_lines = _read_bundle(os.path.join(tmp_path, bundles[0]))
+    assert "preemption drain" in man["reason"]
+    # the engine's train-step flight events rode into the bundle
+    assert any(e["kind"] == "train/step" for e in fr_lines)
+    # and the emergency checkpoint is still discoverable next to it
+    from deepspeed_tpu.resilience.ckpt import find_valid_tag
+    assert find_valid_tag(str(tmp_path)).startswith("emergency_step")
+
+
+# ------------------------------------------------------- bench tooling
+def test_bench_compare_direction_and_exit_codes(tmp_path):
+    from scripts.bench_compare import (compare, load_metrics,
+                                       lower_is_better, main)
+    assert lower_is_better("x.cb_ttft_p99_ms")
+    assert lower_is_better("x.prefill_tokens")
+    assert lower_is_better("ckpt_save_duration_s")
+    assert not lower_is_better("gpt2_serve_cb")          # tokens/s value
+    assert not lower_is_better("x.hit_rate")
+    assert not lower_is_better("x.spec_tokens_per_weight_pass")
+    old = str(tmp_path / "old.json")
+    new = str(tmp_path / "new.json")
+    json.dump({"metric": "m_serve", "value": 100.0,
+               "detail": {"ttft_p99_ms": 50.0, "requests": 8}},
+              open(old, "w"))
+    json.dump({"metric": "m_serve", "value": 80.0,
+               "detail": {"ttft_p99_ms": 40.0, "requests": 8}},
+              open(new, "w"))
+    assert main([old, old, "-q"]) == 0         # self-compare: clean
+    assert main([old, new, "-q"]) == 1         # 20% tok/s drop flagged
+    rows = compare(load_metrics(old), load_metrics(new), threshold=0.10)
+    by = {r["metric"]: r for r in rows}
+    assert by["m_serve"]["regressed"]                    # value down 20%
+    assert not by["m_serve.ttft_p99_ms"]["regressed"]    # ttft improved
+    assert not by["m_serve.requests"]["regressed"]
+    # threshold is respected
+    rows = compare(load_metrics(old), load_metrics(new), threshold=0.25)
+    assert not any(r["regressed"] for r in rows)
+    # metric filter + direction override
+    rows = compare(load_metrics(old), load_metrics(new),
+                   metrics=["ttft"], force_higher=["ttft"])
+    assert len(rows) == 1 and rows[0]["regressed"]
+    # malformed input -> exit 2
+    bad = str(tmp_path / "bad.json")
+    open(bad, "w").write("not json {")
+    assert main([bad, new, "-q"]) == 2
+
+
+def test_bench_compare_jsonl_and_flat_inputs(tmp_path):
+    from scripts.bench_compare import load_metrics, main
+    jl = str(tmp_path / "a.jsonl")
+    with open(jl, "w") as f:
+        f.write('{"metric": "a", "value": 1.0}\n')
+        f.write('{"metric": "b", "value": 2.0, "detail": {"x_ms": 3}}\n')
+    assert load_metrics(jl) == {"a": 1.0, "b": 2.0, "b.x_ms": 3.0}
+    flat = str(tmp_path / "flat.json")
+    json.dump({"tok_s": 10.0, "note": "text ignored"}, open(flat, "w"))
+    assert load_metrics(flat) == {"tok_s": 10.0}
+    # disjoint metric sets -> exit 2 (nothing comparable)
+    assert main([jl, flat, "-q"]) == 2
+
+
+def test_serve_bench_emit_writes_json(tmp_path, capsys):
+    from scripts.serve_bench import emit
+    out = str(tmp_path / "r.json")
+    rec = {"metric": "m", "value": 1.5, "detail": {"x": 2}}
+    emit(rec, out)
+    assert json.load(open(out)) == rec
+    assert json.loads(capsys.readouterr().out.strip()) == rec
+
+
+# ------------------------------------------- acceptance: chaos session
+def test_chaos_stall_postmortem_and_debug_acceptance(tmp_path,
+                                                     monkeypatch, served):
+    """ISSUE 7 acceptance: an injected serve.step stall under DS_TRACE
+    drives the watchdog to DEGRADED, which writes a post-mortem bundle
+    whose flight-recorder tail contains the stalled request's timeline;
+    the trace validates clean WITH anomaly instants carrying step corr
+    ids; /debug/requests and /debug/scheduler answer over live HTTP
+    consistently with scheduler state."""
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    m, eng = served
+    trace_path = str(tmp_path / "chaos_trace.json")
+    monkeypatch.setenv("DS_TRACE", trace_path)
+    reset_tracer()
+    tracer = configure_tracer()
+    fr = FlightRecorder(capacity=4096)
+    reg = MetricsRegistry()
+    cfg = ServingConfig(block_size=8, num_blocks=64, max_num_seqs=2,
+                        max_fused_steps=1,
+                        slo={"enabled": True,
+                             "classes": {"default": {"ttft_ms": 1e-4}}})
+    sched = ContinuousBatchingScheduler(
+        m, eng.params, cfg, registry=reg,
+        injector=FaultInjector("serve.step:stall=1.5@20"),
+        flightrec=fr,
+        anomaly=AnomalyMonitor(registry=reg, flightrec=fr,
+                               min_samples=6, threshold=5.0))
+    # warm every compile path (prefill buckets + decode) BEFORE arming
+    # the 0.25 s watchdog: first-step compilation reads as a stall,
+    # and the false-positive bundle would rate-limit the real one.
+    # These warmup steps consume serve.step injector invocations too,
+    # but step_count and the fault site tick in lockstep, so the stall
+    # still lands at step_count 20 — just fewer steps into the live run.
+    for p in _prompts(3, seed=9, lo=4, hi=9):
+        sched.submit(p, SamplingParams(max_new_tokens=3))
+    sched.run_until_idle()
+    pm_dir = str(tmp_path / "pm")
+    httpd, loop = make_server(sched, port=0, stall_timeout_s=0.25,
+                              postmortem_dir=pm_dir)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=40))
+            for p in _prompts(3, seed=9, lo=4, hi=9)]
+    loop.start()
+    try:
+        # wait for the stall-triggered bundle (stall at step 20 lasts
+        # 1.5 s; the watchdog flags after 0.25 s of frozen step_count)
+        deadline = time.monotonic() + 60
+        bundles = []
+        while time.monotonic() < deadline:
+            if os.path.isdir(pm_dir):
+                bundles = [d for d in os.listdir(pm_dir)
+                           if d.startswith("postmortem-")]
+                if bundles:
+                    break
+            time.sleep(0.02)
+        assert bundles, "watchdog stall produced no post-mortem bundle"
+        # scrape /debug/* over live HTTP while the incident is fresh
+        with urllib.request.urlopen(base + "/debug/requests",
+                                    timeout=10) as r:
+            dbg_reqs = json.loads(r.read())
+        with urllib.request.urlopen(base + "/debug/scheduler",
+                                    timeout=10) as r:
+            dbg_sched = json.loads(r.read())
+        with urllib.request.urlopen(base + "/debug/stacks",
+                                    timeout=10) as r:
+            assert "ds-serve-loop" in r.read().decode()
+        # consistency with live scheduler state (racy by design; the
+        # structural facts below are stable)
+        assert dbg_sched["block_pool"]["num_blocks"] == cfg.num_blocks
+        assert len(dbg_sched["slots"]) == cfg.max_num_seqs
+        assert dbg_sched["slo"]["enabled"] is True
+        known = {r.request_id for r in reqs}
+        seen = {q["request_id"]
+                for q in dbg_reqs["active"] + dbg_reqs["queued"]}
+        assert seen <= known
+        live_slots = {s for s in dbg_sched["slots"] if s is not None}
+        assert live_slots <= known
+        # every request still finishes once the stall clears (the
+        # watchdog un-bricks the replica when step_count advances)
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+            assert len(r.output_ids) == 40
+    finally:
+        loop.shutdown()
+        httpd.shutdown()
+        httpd.server_close()
+
+    # ---- the bundle reconstructs the faulted request end-to-end ------
+    man, fr_lines = _read_bundle(os.path.join(pm_dir, bundles[0]))
+    assert "degraded" in man["reason"] and "stalled" in man["reason"]
+    assert man["files"]["flightrec.jsonl"] is True
+    assert man["files"]["scheduler.json"] is True
+    # the stall hit at step 20, well into decode: at least one request
+    # was admitted before it — its timeline must reconstruct from the
+    # bundle's flight-recorder tail alone
+    stalled = [rid for rid in (r.request_id for r in reqs)
+               if any(e.get("corr") == f"req-{rid}"
+                      and e["kind"] == "req/admit" for e in fr_lines)]
+    assert stalled, "no admitted request in the bundle's flight tail"
+    for rid in stalled:
+        kinds = [e["kind"] for e in fr_lines
+                 if e.get("corr") == f"req-{rid}"]
+        assert kinds[0] == "req/queue"
+        assert "req/prefill_chunk" in kinds
+    # serve/step events up to the stall are in the tail too
+    assert any(e["kind"] == "serve/step" for e in fr_lines)
+    bundle_sched = json.load(open(
+        os.path.join(pm_dir, bundles[0], "scheduler.json")))
+    assert bundle_sched["scheduler"]["block_pool"]["num_blocks"] == 64
+    assert bundle_sched["requests"]["step_count"] <= sched.step_count
+
+    # ---- validator-clean trace WITH anomaly instants -----------------
+    tracer.flush()
+    assert validate(trace_path, require_corr=True,
+                    check_anomalies=True) == []
+    evs = load_events(trace_path)
+    anomalies = [e for e in evs
+                 if e["name"].startswith("anomaly/serve.step")]
+    assert anomalies
+    # the stalled step's anomaly carries ITS corr id (the 1.5 s outlier
+    # lands on step 20's timeline entry)
+    corrs = {e["args"]["corr"] for e in anomalies}
+    assert "serve-step-20" in corrs
+    # health transition instants joined the same timeline
+    assert any(e["name"] == "health/degraded" for e in evs)
+    assert any(e["name"] == "postmortem" for e in evs)
+
+
+def test_flight_recorder_overhead_under_5pct(served):
+    """ISSUE 7 acceptance micro-bench: the cost of recording every
+    flight event a 100-step CPU smoke generates must stay under 5% of
+    that smoke's wall time.  Measured by isolation (re-recording the
+    same event mix into a fresh ring) rather than A/B wall clock —
+    jitted-step jitter off-TPU dwarfs a sub-5% effect."""
+    m, eng = served
+    fr = FlightRecorder(capacity=1 << 16)
+    # max_num_seqs=1 runs the two 50-token requests back-to-back: a
+    # genuine 100-step smoke (side-by-side they'd share ~50 steps)
+    cfg = ServingConfig(block_size=8, num_blocks=128, max_num_seqs=1,
+                        max_fused_steps=1)
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        registry=MetricsRegistry(),
+                                        flightrec=fr)
+    # warm the compile caches out of the measurement
+    sched.submit(_prompts(1, seed=11)[0], SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    fr.clear()
+    before = fr.total_recorded
+    t0 = time.perf_counter()
+    for p in _prompts(2, seed=12):
+        sched.submit(p, SamplingParams(max_new_tokens=52))
+    steps = sched.run_until_idle()
+    smoke_s = time.perf_counter() - t0
+    assert steps >= 100
+    n_events = fr.total_recorded - before
+    assert n_events >= steps              # at least one event per step
+    # replay the same volume of records into a fresh ring, timed alone
+    replay = FlightRecorder(capacity=1 << 16)
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        replay.record("serve/step", corr=f"serve-step-{i}",
+                      dur_ms=1.234, active=2, queued=0, finished=0)
+    record_s = time.perf_counter() - t0
+    overhead = record_s / smoke_s
+    assert overhead < 0.05, (
+        f"flight recorder overhead {overhead:.2%} "
+        f"({n_events} events, smoke {smoke_s:.3f}s)")
