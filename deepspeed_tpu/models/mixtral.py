@@ -36,6 +36,13 @@ class MixtralConfig:
     #: router skew).  Set a number to cap eval capacity (cheaper dispatch
     #: for long prefills, at the cost of potential drops).
     eval_capacity_factor: "float | None" = None
+    #: expert dispatch formulation (moe/layer.py dispatch_mode): "auto"
+    #: (default — einsum when training, megablocks-style grouped GEMM at
+    #: eval/serving), "einsum", or "grouped".  Grouped serving consumes
+    #: int8 expert stacks in place through the fused-dequant grouped
+    #: kernel (ops/pallas/grouped_gemm.py) instead of the per-expert
+    #: residual-dequant fallback (ISSUE 8).
+    moe_dispatch: str = "auto"
     aux_loss_coef: float = 0.01
     rope_theta: float = 1e6
     rms_norm_eps: float = 1e-5
@@ -58,7 +65,8 @@ class MixtralConfig:
                          capacity_factor=self.capacity_factor,
                          eval_capacity_factor=eval_cf,
                          aux_loss_coef=self.aux_loss_coef,
-                         activation="silu_glu")
+                         activation="silu_glu",
+                         dispatch_mode=self.moe_dispatch)
 
 
 MIXTRAL_SIZES = {
@@ -220,13 +228,15 @@ def _serving_fns(config: MixtralConfig):
         return serving.decode_step(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            moe_grouped=serving.moe_dispatch_grouped(config.moe))
 
     def verify_fn(p, t, c, l):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            moe_grouped=serving.moe_dispatch_grouped(config.moe))
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 

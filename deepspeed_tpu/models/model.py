@@ -90,12 +90,14 @@ def qdot(x, w):
     return x @ w.astype(x.dtype)
 
 
-def _maybe_dequant(tree, keep_gemm_weights: bool = False):
+def _maybe_dequant(tree, keep_gemm_weights: bool = False,
+                   keep_moe_weights: bool = False):
     """Reconstruct ``QuantizedTensor`` leaves in compute dtype.  With
     ``keep_gemm_weights`` the 2-D (already layer-sliced) weights that the
-    qgemm path consumes directly stay quantized — only leaves qdot cannot
-    take as-is (e.g. stacked MoE expert tensors fed to einsums) dequantize.
-    """
+    qgemm path consumes directly stay quantized; with
+    ``keep_moe_weights`` the 3-D stacked expert tensors that the grouped
+    expert kernel (ops/pallas/grouped_gemm.py) consumes stay quantized
+    too — only leaves no kernel can take as-is dequantize."""
     is_q = lambda x: isinstance(x, QuantizedTensor)
     if not any(map(is_q, jax.tree_util.tree_leaves(tree, is_leaf=is_q))):
         return tree
@@ -105,6 +107,8 @@ def _maybe_dequant(tree, keep_gemm_weights: bool = False):
         if is_q(x):
             if keep_gemm_weights and x.q.ndim == 2:
                 return x
+            if keep_moe_weights and x.q.ndim == 3:
+                return x
             import jax.numpy as jnp
             return block_dequantize_int8(x.q, x.s).astype(
                 jnp.dtype(x.dtype))
@@ -113,7 +117,8 @@ def _maybe_dequant(tree, keep_gemm_weights: bool = False):
     return jax.tree_util.tree_map(dq, tree, is_leaf=is_q)
 
 
-def maybe_stream(layer_tree, keep_quantized: bool = False):
+def maybe_stream(layer_tree, keep_quantized: bool = False,
+                 keep_moe_quantized: bool = False):
     """Inside a layer-scan body: move this layer's (possibly host-resident)
     params to device memory, and/or reconstruct int8-quantized weights
     (``QuantizedTensor`` leaves) in compute dtype.  No-op otherwise.
@@ -123,9 +128,12 @@ def maybe_stream(layer_tree, keep_quantized: bool = False):
     ``keep_quantized`` (serving decode paths): leave the layer's 2-D
     quantized projection weights as ``QuantizedTensor`` — the model's
     ``qdot`` call sites feed them to the fused-dequant qgemm kernel, so
-    no compute-dtype copy of the layer's weights is ever materialized."""
+    no compute-dtype copy of the layer's weights is ever materialized.
+    ``keep_moe_quantized`` extends the same contract to the layer's 3-D
+    stacked expert weights, consumed by the grouped expert kernel."""
     layer_tree = _maybe_dequant(layer_tree,
-                                keep_gemm_weights=keep_quantized)
+                                keep_gemm_weights=keep_quantized,
+                                keep_moe_weights=keep_moe_quantized)
     cfg = _PARAM_STREAM.get()
     if not cfg:
         return layer_tree

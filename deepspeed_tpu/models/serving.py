@@ -26,7 +26,46 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def quantized_layer_bytes(blocks, residual_only: bool = False) -> int:
+def moe_dispatch_grouped(config_moe=None, train: bool = False) -> bool:
+    """True when the serving MoE dispatch resolves to the grouped kernel
+    AND the kernel is real (single TPU device or interpret mode) — the
+    condition under which stacked int8 expert weights stay quantized
+    into the fused-dequant grouped GEMM instead of the per-expert
+    residual-dequant fallback (ISSUE 8).  ``config_moe`` is the layer's
+    MoEConfig when the caller has one (the model serving fns); None
+    resolves from env/override with the serving default."""
+    from deepspeed_tpu.moe.layer import (MoEConfig, gg_kernel_real,
+                                         resolve_dispatch_mode)
+    if not gg_kernel_real():
+        return False
+    cfg = config_moe if config_moe is not None else MoEConfig(
+        d_model=1, d_ff=1, dispatch_mode="auto")
+    return resolve_dispatch_mode(cfg, train=train) == "grouped"
+
+
+def split_quantized_bytes(blocks) -> "tuple[int, int]":
+    """(dense_bytes, expert_bytes) of the STORED int8 form — q bytes +
+    fp32 scale bytes — split at the stacked-expert rank (q.ndim >= 4 =
+    the [L, E, in, out] expert stacks; everything else is dense).  The
+    weights_floor_moe accounting (scripts/serve_bench.py,
+    scripts/decode_profile.py) prices decode steps from this one walk
+    so the two tools can never drift apart."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    is_q = lambda x: isinstance(x, QuantizedTensor)
+    dense = expert = 0
+    for leaf in jax.tree_util.tree_leaves(blocks, is_leaf=is_q):
+        if not is_q(leaf):
+            continue
+        b = int(leaf.q.size) + 4 * int(leaf.s.size)
+        if leaf.q.ndim >= 4:
+            expert += b
+        else:
+            dense += b
+    return dense, expert
+
+
+def quantized_layer_bytes(blocks, residual_only: bool = False,
+                          moe_grouped: bool = False) -> int:
     """Total compute-dtype bytes a full dequantization of ``blocks``
     would materialize (0 when nothing is quantized).  The decode
     dispatchers use this to pick the loop form: the python-unrolled
@@ -39,14 +78,19 @@ def quantized_layer_bytes(blocks, residual_only: bool = False) -> int:
 
     ``residual_only``: count only the leaves the fused-dequant qgemm
     path will NOT consume in place (stacked-2-D weights — q.ndim == 3 —
-    go straight to ``ds_qgemm`` and never dequantize; higher-rank leaves
-    like MoE expert stacks still do)."""
+    go straight to ``ds_qgemm`` and never dequantize).  ``moe_grouped``:
+    the grouped expert kernel additionally consumes stacked MoE expert
+    tensors (q.ndim == 4) in place, removing them from the residual
+    too (ISSUE 8 — with both kernels active a quantized MoE model has
+    NO residual dequant left)."""
     from deepspeed_tpu.models.model import QuantizedTensor
     total = 0
     for leaf in jax.tree_util.tree_leaves(
             blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
         if isinstance(leaf, QuantizedTensor):
             if residual_only and leaf.q.ndim == 3:
+                continue
+            if moe_grouped and leaf.q.ndim == 4:
                 continue
             total += jnp.dtype(leaf.dtype).itemsize * int(leaf.q.size)
     return total
@@ -151,7 +195,7 @@ def qgemm_active(blocks) -> bool:
                    blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
 
 
-def use_scan_decode(blocks) -> bool:
+def use_scan_decode(blocks, moe_grouped: bool = False) -> bool:
     """The ONE dispatch rule for the decode loop form (both the shared
     scaffold and gpt2's own decode call this): scan when a full dequant
     of the quantized blocks that the qgemm KERNEL does not absorb would
@@ -159,12 +203,17 @@ def use_scan_decode(blocks) -> bool:
     projections never dequantize, so the threshold guards only the
     residual (e.g. MoE expert stacks) — the scan form is the FALLBACK
     defense, not the default, and large dense int8 models keep the
-    faster unrolled loop.  When qgemm is merely FORCED onto the jnp
-    reference (DS_QGEMM=1 off-chip / multi-device), every projection
-    still dequantizes per matmul, so all bytes count and the scan
-    defense re-engages."""
+    faster unrolled loop.  ``moe_grouped`` (the model's serving fns
+    resolve it): the grouped expert kernel consumes the 4-D expert
+    stacks in place too, so they stop counting against the threshold —
+    int8 Mixtral keeps the unrolled loop at any scale.  When qgemm is
+    merely FORCED onto the jnp reference (DS_QGEMM=1 off-chip /
+    multi-device), every projection still dequantizes per matmul, so
+    all bytes count and the scan defense re-engages."""
     residual_only = qgemm_active(blocks) and qgemm_kernel_real()
-    residual = quantized_layer_bytes(blocks, residual_only=residual_only)
+    residual = quantized_layer_bytes(
+        blocks, residual_only=residual_only,
+        moe_grouped=moe_grouped and residual_only)
     return residual > get_quant_scan_threshold()
 
 
@@ -246,7 +295,8 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
 
 
 def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
-                finish_fn, head_fn, num_heads, alibi_slopes=None):
+                finish_fn, head_fn, num_heads, alibi_slopes=None,
+                moe_grouped: bool = False):
     """One decode step: tokens [B], lengths [B] current fill counts.
     Rotary positions are per-row; the GQA cache stays compact (KV heads) —
     the decode kernel handles the query-group mapping.  ``alibi_slopes``
@@ -265,22 +315,25 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
-    if use_scan_decode(params["blocks"]):
+    if use_scan_decode(params["blocks"], moe_grouped=moe_grouped):
         return decode_step_scan(
             params, x, cache, lengths, qkv_fn=qkv_fn, finish_fn=finish_fn,
-            head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes)
+            head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes,
+            moe_grouped=moe_grouped)
 
     # int8 weights: the 2-D projection weights stay QuantizedTensor and
     # the hooks' qdot sites feed them to ds_qgemm — no layer-sized
     # compute-dtype dequant exists for XLA to hoist, so the unrolled
-    # loop is safe at any model scale
+    # loop is safe at any model scale.  moe_grouped: the 3-D expert
+    # stacks likewise stay quantized into the grouped kernel.
     keep_q = qgemm_active(params["blocks"])
     kc, vc = cache["k"], cache["v"]
     ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
     L = kc.shape[0]
     for l in range(L):
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
-                             keep_quantized=keep_q)
+                             keep_quantized=keep_q,
+                             keep_moe_quantized=moe_grouped)
         q, kk, v = qkv_fn(x[:, None, :], layer, lengths[:, None])
         hd = q.shape[-1]
         if quantized:
@@ -308,7 +361,8 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
 
 
 def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
-                  finish_fn, head_fn, num_heads, alibi_slopes=None):
+                  finish_fn, head_fn, num_heads, alibi_slopes=None,
+                  moe_grouped: bool = False):
     """Speculative-decoding verification: score a ``W``-token window in
     ONE weight pass per layer (the whole point of speculation — k+1
     drafted positions amortize a single stream of the layer weights
@@ -339,7 +393,8 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     L = kc.shape[0]
     for l in range(L):
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
-                             keep_quantized=keep_q)
+                             keep_quantized=keep_q,
+                             keep_moe_quantized=moe_grouped)
         q, kk, v = qkv_fn(x, layer, positions)
         hd = q.shape[-1]
         attn_cols = []
@@ -368,7 +423,8 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
 
 
 def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
-                     head_fn, num_heads, alibi_slopes=None):
+                     head_fn, num_heads, alibi_slopes=None,
+                     moe_grouped: bool = False):
     """lax.scan decode body for LARGE int8-quantized models: scan
     semantics serialize the per-layer dequant, so at most one layer's
     bf16 weights exist at a time (see ``quantized_layer_bytes``)."""
@@ -389,7 +445,8 @@ def decode_step_scan(params, x, cache, lengths, *, qkv_fn, finish_fn,
         else:
             layer, kc, vc = layer_kv
             ksc = vsc = None
-        layer = maybe_stream(layer, keep_quantized=keep_q)
+        layer = maybe_stream(layer, keep_quantized=keep_q,
+                             keep_moe_quantized=moe_grouped)
         q, kk, v = qkv_fn(carry[:, None, :], layer, lengths[:, None])
         hd = q.shape[-1]
         if q_cache:

@@ -1,4 +1,9 @@
-from deepspeed_tpu.moe.layer import (MoE, MoEConfig, moe_layer,
-                                     init_moe_params, moe_logical_specs)
+from deepspeed_tpu.moe.layer import (MoE, MoEConfig, dispatch_scope,
+                                     moe_layer, init_moe_params,
+                                     moe_logical_specs,
+                                     resolve_dispatch_mode,
+                                     set_dispatch_override,
+                                     set_moe_metrics_registry)
 from deepspeed_tpu.moe.sharded_moe import (top1gating, top2gating, topkgating,
-                                           GateOutput)
+                                           topk_routing, GateOutput,
+                                           TopKRouting)

@@ -2,12 +2,30 @@
 ``MoE`` and sharded_moe.py:425 ``MOELayer``: gate → dispatch → all-to-all →
 local experts → all-to-all → combine).
 
-TPU-native formulation: expert weights are stacked [E, ...] and sharded over the
-``expert`` mesh axis; dispatch/combine are einsums against the [T, E, C] gating
-tensors.  XLA lowers the resharding between token-sharded and expert-sharded
-operands to the same pair of all-to-alls the reference issues by hand, and
-overlaps them with the expert matmuls.
+Two dispatch formulations (``MoEConfig.dispatch_mode``, ISSUE 8):
+
+- ``einsum`` — the GShard capacity formulation: expert weights stacked
+  [E, ...] and sharded over the ``expert`` mesh axis; dispatch/combine
+  are einsums against dense [T, E, C] gating tensors whose resharding
+  XLA lowers to the reference's pair of all-to-alls.  Deterministic and
+  multi-axis-shardable, but the two einsums are O(T·E·C·D) and every
+  expert pads to capacity C (tokens past C DROP).
+- ``grouped`` — megablocks-style ragged dispatch
+  (ops/pallas/grouped_gemm.py): tokens argsort by expert, the expert
+  FFN runs as ONE grouped GEMM over the sorted rows against the stacked
+  weights (zero capacity padding), and outputs combine by gather.
+  **Drop-free**: every routed token computes, regardless of
+  ``capacity_factor``.  On a multi-device ``expert`` mesh axis this
+  mode currently falls back to the einsum formulation (the pallas
+  custom call has no GSPMD rule — the qgemm precedent; a shard_map
+  tier is queued on a jax with working partial-auto shard_map).
+- ``auto`` — einsum when training; grouped at eval/serving when the
+  kernel is real (single TPU device / interpret) or the host is
+  single-device — a multi-device host where only the unsharded
+  ragged_dot reference would run keeps the sharded einsum formulation.
 """
+import contextlib
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable, Optional
@@ -17,7 +35,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import get_topology, EXPERT_AXIS
-from deepspeed_tpu.moe.sharded_moe import topkgating, GateOutput
+from deepspeed_tpu.moe.sharded_moe import (topkgating, topk_routing,
+                                           GateOutput)
 
 
 @dataclass(frozen=True)
@@ -37,6 +56,13 @@ class MoEConfig:
     #: building block, arXiv:2201.05596): a dense FFN runs beside the
     #: routed experts and a learned 2-way softmax coefficient mixes them
     use_residual: bool = False
+    #: expert dispatch formulation — "einsum" (GShard capacity tensors,
+    #: the bitwise-back-compat default), "grouped" (megablocks-style
+    #: ragged grouped GEMM, drop-free), or "auto" (einsum when training,
+    #: grouped at eval/serving).  DS_MOE_DISPATCH env and the serving
+    #: config's ``serving.moe_dispatch`` key override (see
+    #: :func:`resolve_dispatch_mode`).
+    dispatch_mode: str = "einsum"
 
 
 def init_moe_params(config: MoEConfig, rng) -> dict:
@@ -82,27 +108,222 @@ def moe_logical_specs(config: MoEConfig) -> dict:
     return specs
 
 
+# ------------------------------------------------------ dispatch resolution
+#: serving-config override slot (``serving.moe_dispatch``); None = defer
+_dispatch_override: Optional[str] = None
+
+DISPATCH_MODES = ("auto", "einsum", "grouped")
+
+
+def set_dispatch_override(mode: Optional[str]):
+    """Install the serving config's dispatch choice (None resets).  The
+    resolution order is DS_MOE_DISPATCH env > this override > the layer
+    config's ``dispatch_mode`` (scheduler installs it at construction,
+    mirroring ``serving.quant_scan_threshold_mb``)."""
+    global _dispatch_override
+    if mode is not None and mode not in DISPATCH_MODES:
+        raise ValueError(f"moe dispatch mode {mode!r}: choose one of "
+                         f"{DISPATCH_MODES}")
+    _dispatch_override = mode
+
+
+@contextlib.contextmanager
+def dispatch_scope(mode: Optional[str]):
+    """Force a dispatch mode for code TRACED inside this scope (A/B
+    benches and parity tests; same trace-time caveat as qgemm_scope)."""
+    global _dispatch_override
+    prev = _dispatch_override
+    set_dispatch_override(mode)
+    try:
+        yield
+    finally:
+        _dispatch_override = prev
+
+
+def resolve_dispatch_mode(config: MoEConfig, train: bool) -> str:
+    """-> "einsum" | "grouped" for this call (see set_dispatch_override).
+    A grouped request on a multi-device ``expert`` mesh axis falls back
+    to einsum (no GSPMD rule for the pallas call — qgemm precedent)."""
+    env = os.environ.get("DS_MOE_DISPATCH")
+    mode = env or _dispatch_override or config.dispatch_mode or "auto"
+    if mode not in DISPATCH_MODES:
+        raise ValueError(f"moe dispatch mode {mode!r}: choose one of "
+                         f"{DISPATCH_MODES}")
+    if mode == "auto":
+        if train:
+            mode = "einsum"
+        elif gg_kernel_real() or jax.device_count() == 1:
+            mode = "grouped"
+        else:
+            # multi-device host where only the ragged_dot REFERENCE
+            # would run (e.g. eval inside a TP/DP training mesh): the
+            # reference's argsort/gather carries none of the einsum
+            # path's sharding pins, so auto keeps the sharded einsum
+            # formulation; an EXPLICIT grouped request still wins
+            # (single-device serving programs on a multi-device host —
+            # the test/bench surface)
+            mode = "einsum"
+    if mode == "grouped":
+        ep = dict(get_topology().mesh.shape).get(EXPERT_AXIS, 1)
+        if ep > 1:
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once(
+                f"moe grouped dispatch: expert mesh axis is {ep}-way — "
+                "falling back to the einsum formulation (drop-free at "
+                "eval; configured capacity when training).  The "
+                "shard_map grouped tier is queued (ROADMAP item 4).")
+            mode = "einsum"
+    return mode
+
+
+# ------------------------------------------------------------- telemetry
+#: metrics registry tap (ISSUE 8 satellite): when installed at TRACE
+#: time, moe_layer emits ``moe/dispatch_tokens`` / ``moe/dropped_tokens``
+#: counters and a ``moe_drop_fraction`` gauge through a host callback
+#: (einsum mode reports real capacity drops; grouped mode pins drops to
+#: 0).  Off by default — the per-step host callback is observability
+#: overhead serving opts into (ds_serve wires its /metrics registry).
+_metrics_registry = None
+
+
+def set_moe_metrics_registry(registry):
+    global _metrics_registry
+    _metrics_registry = registry
+
+
+def _report_routing(dispatched, dropped):
+    reg = _metrics_registry
+    if reg is None:
+        return
+    d, p = float(dispatched), float(dropped)
+    reg.inc("moe/dispatch_tokens", d)
+    reg.inc("moe/dropped_tokens", p)
+    total = d + p
+    reg.set_gauge("moe_drop_fraction", (p / total) if total else 0.0)
+
+
+def _emit_routing_stats(dispatched, dropped):
+    """Host-callback bridge (trace-time gated on the installed tap)."""
+    if _metrics_registry is None:
+        return
+    jax.debug.callback(_report_routing, dispatched, dropped)
+
+
+def _dq(w, dt):
+    """Expert weight -> compute dtype.  QuantizedTensor leaves reach the
+    einsum path only when a grouped-mode keep-quantized decision was
+    later overridden (mode mix-ups, EP fallback) — dequantize in place
+    rather than crash; the grouped path consumes them natively."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    if isinstance(w, QuantizedTensor):
+        from deepspeed_tpu.ops.pallas.quantization import \
+            block_dequantize_int8
+        return block_dequantize_int8(w.q, w.s).astype(dt)
+    return w.astype(dt)
+
+
 def _expert_ffn(params, x, config: MoEConfig):
-    """x: [E, C', D] — per-expert token slots; one vmapped FFN per expert."""
+    """x: [E, C', D] — per-expert token slots; one vmapped FFN per expert.
+
+    The gate operand is passed explicitly as ``None`` for non-GLU
+    activations (ISSUE 8 satellite): the old ``params.get("w_gate",
+    params["w_in"])`` default vmapped an unused [E, D, F] operand
+    through gelu-mode experts — wasted HBM reads under remat."""
     dt = x.dtype
 
-    def one(w_in, w_out, w_gate, xe):
-        if config.activation == "silu_glu":
-            h = jax.nn.silu(xe @ w_gate.astype(dt)) * (xe @ w_in.astype(dt))
-        else:
-            h = jax.nn.gelu(xe @ w_in.astype(dt), approximate=True)
-        return h @ w_out.astype(dt)
+    if config.activation == "silu_glu":
+        def one(w_in, w_out, w_gate, xe):
+            h = jax.nn.silu(xe @ w_gate) * (xe @ w_in)
+            return h @ w_out
+        return jax.vmap(one)(_dq(params["w_in"], dt),
+                             _dq(params["w_out"], dt),
+                             _dq(params["w_gate"], dt), x)
 
-    w_gate = params.get("w_gate", params["w_in"])
-    return jax.vmap(one)(params["w_in"], params["w_out"], w_gate, x)
+    def one(w_in, w_out, xe):
+        h = jax.nn.gelu(xe @ w_in, approximate=True)
+        return h @ w_out
+
+    return jax.vmap(one)(_dq(params["w_in"], dt),
+                         _dq(params["w_out"], dt), x)
+
+
+def _grouped_moe(params, xt, config: MoEConfig, train: bool, rng):
+    """Megablocks-style drop-free dispatch (ISSUE 8 tentpole): argsort
+    the [T·k] routed (token, choice) pairs by expert, run the expert FFN
+    as grouped GEMMs over the sorted rows (ops/pallas/grouped_gemm.py —
+    zero capacity padding, no [T, E, C] tensors), and combine each
+    token's k outputs by gather + normalized-gate weighting.  Returns
+    (combined [T, D], aux scalar, (dispatched, dropped))."""
+    from deepspeed_tpu.ops.pallas import grouped_gemm as gg
+    T, D = xt.shape
+    E, k = config.num_experts, config.top_k
+    dt = xt.dtype
+    routing = topk_routing(
+        _routing_logits(params, xt, config), config.top_k,
+        rng if (train and config.noisy_gate_policy) else None,
+        config.z_loss_coef)
+    eids = routing.expert_idx.reshape(-1)               # [T*k]
+    gates = routing.gate_weights.reshape(-1)            # [T*k] fp32
+    tids = jnp.arange(T * k, dtype=jnp.int32) // k
+    rows = jnp.take(xt, tids, axis=0)                   # [T*k, D]
+
+    w_gate = params.get("w_gate")
+    w_in, w_out = params["w_in"], params["w_out"]
+
+    R = T * k
+    kernel_real = gg_kernel_real()
+    if kernel_real and not train and R <= gg.SLOT_MAX_ROWS:
+        # decode/verify-sized: the slot kernels stream each DISTINCT
+        # routed expert's weights exactly once — the top-k-distinct
+        # expert floor — with no scatter/gather at all
+        plan = gg.make_slot_plan(eids, E)
+        mm = partial(gg.ds_ggemm_slots, plan=plan, out_dtype=dt)
+        y = _glu(mm, rows, w_gate, w_in, config)
+        y = mm(y, w_out)
+        out_rows = y
+    else:
+        plan = gg.make_group_plan(eids, E)
+        x_pad = gg.scatter_to_groups(rows, plan)
+        mm = partial(gg.ds_ggemm, plan=plan, out_dtype=dt)
+        h = _glu(mm, x_pad, w_gate, w_in, config)
+        y = mm(h, w_out)                                # [Mp, D]
+        out_rows = gg.gather_from_groups(y, plan)       # [T*k, D]
+    combined = jnp.sum(
+        (gates.astype(dt)[:, None] * out_rows).reshape(T, k, D), axis=1)
+    aux = routing.l_aux * config.aux_loss_coef + routing.router_z_loss
+    return combined, aux, (jnp.int32(R), jnp.int32(0))
+
+
+def _glu(mm, x, w_gate, w_in, config: MoEConfig):
+    if config.activation == "silu_glu":
+        return jax.nn.silu(mm(x, w_gate)) * mm(x, w_in)
+    return jax.nn.gelu(mm(x, w_in), approximate=True)
+
+
+def gg_kernel_real() -> bool:
+    """Whether ds_ggemm will run the actual Pallas kernels (single TPU
+    device, or interpret mode forced) rather than the jnp reference —
+    the scan-threshold and keep-quantized decisions key on this (the
+    qgemm_kernel_real contract)."""
+    from deepspeed_tpu.ops.pallas.grouped_gemm import _use_reference
+    use_ref, _ = _use_reference(None)
+    return not use_ref
+
+
+def _routing_logits(params, xt, config: MoEConfig):
+    """Router matmul shared by both dispatch modes (qdot: int8 serving
+    keeps the 2-D router quantized for the fused-dequant qgemm)."""
+    from deepspeed_tpu.models.model import qdot
+    return qdot(xt.astype(jnp.float32), params["router"])
 
 
 def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
               train: bool = True, rng=None):
     """x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
 
-    The reference's MOELayer.forward (sharded_moe.py:477) step-for-step, with
-    einsum dispatch in place of explicit all_to_all_single calls.
+    einsum mode: the reference's MOELayer.forward (sharded_moe.py:477)
+    step-for-step, with einsum dispatch in place of explicit
+    all_to_all_single calls.  grouped mode: see :func:`_grouped_moe`.
     """
     B, S, D = x.shape
     T = B * S
@@ -113,11 +334,17 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     # falls back to replicate-then-repartition on the backward transposes
     tok = P(tuple(get_topology().zero_shard_axes))
     tok_sh = jax.sharding.NamedSharding(mesh, tok)
-    from deepspeed_tpu.models.model import qdot
     xt = wsc(x.reshape(T, D), tok_sh)
+    mode = resolve_dispatch_mode(config, train)
+    if mode == "grouped":
+        combined, aux, (n_disp, n_drop) = _grouped_moe(
+            params, xt, config, train, rng)
+        _emit_routing_stats(n_disp, n_drop)
+        moe_out = wsc(combined, tok_sh).reshape(B, S, D)
+        return _finish_residual(params, x, moe_out, aux, config)
     # qdot: int8 serving keeps the (stacked-2-D) router quantized — the
     # fused-dequant qgemm consumes it; plain arrays take the same matmul
-    logits = wsc(qdot(xt.astype(jnp.float32), params["router"]), tok_sh)
+    logits = wsc(_routing_logits(params, xt, config), tok_sh)
     cf = config.capacity_factor if train else config.eval_capacity_factor
     noise = rng if (train and config.noisy_gate_policy) else None
     gate: GateOutput = topkgating(logits, config.top_k, cf,
@@ -125,6 +352,8 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
                                   config.z_loss_coef)
     combine_w = wsc(gate.combine_weights, tok_sh)
     dispatch_m = wsc(gate.dispatch_mask, tok_sh)
+    kept = jnp.sum(dispatch_m.astype(jnp.int32))
+    _emit_routing_stats(kept, jnp.int32(T * config.top_k) - kept)
     # dispatch: [T,E,C] x [T,D] -> [E,C,D]  (token->expert all-to-all)
     dispatched = jnp.einsum("tec,td->ecd",
                             dispatch_m.astype(x.dtype), xt)
@@ -137,6 +366,11 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
                               combine_w.astype(x.dtype), out), tok_sh)
     aux = gate.l_aux * config.aux_loss_coef + gate.router_z_loss
     moe_out = combined.reshape(B, S, D)
+    return _finish_residual(params, x, moe_out, aux, config)
+
+
+def _finish_residual(params, x, moe_out, aux, config: MoEConfig):
+    from deepspeed_tpu.models.model import qdot
     if config.use_residual:
         # Residual MoE (reference moe/layer.py:116-123): dense FFN beside
         # the experts, mixed by a learned per-token softmax coefficient

@@ -21,6 +21,60 @@ class GateOutput(NamedTuple):
     router_z_loss: jnp.ndarray    # scalar (0 when disabled)
 
 
+class TopKRouting(NamedTuple):
+    """Capacity-free routing decision (ISSUE 8): the top-k selection and
+    normalized gate values WITHOUT the dense [T, E, C] tensors — the
+    grouped (megablocks-style) dispatch consumes this directly, and
+    :func:`topkgating` builds its capacity tensors from the same values
+    so the two dispatch modes share bitwise-identical router math."""
+    l_aux: jnp.ndarray            # load-balancing loss (scalar)
+    router_z_loss: jnp.ndarray    # scalar (0 when disabled)
+    expert_idx: jnp.ndarray       # [T, k] int32 chosen expert per choice
+    gate_weights: jnp.ndarray     # [T, k] fp32 normalized gate values
+
+
+def topk_routing(logits: jnp.ndarray, k: int,
+                 noise_rng: Optional[jax.Array] = None,
+                 z_loss_coef: float = 0.0) -> TopKRouting:
+    """The selection/aux half of :func:`topkgating`, verbatim (iterative
+    argmax with -1e9 suppression, top-1 aux loss, per-token gate
+    normalization) — extracted so capacity enforcement is a property of
+    the DISPATCH, not of the routing decision."""
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    select_logits = logits.astype(jnp.float32)
+    if noise_rng is not None:
+        select_logits = select_logits + jax.random.gumbel(
+            noise_rng, select_logits.shape)
+
+    top1 = jnp.argmax(select_logits, axis=-1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    z_loss = jnp.float32(0.0)
+    if z_loss_coef > 0:
+        z = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+        z_loss = z_loss_coef * jnp.mean(z ** 2)
+
+    remaining = select_logits
+    chosen_gates = []
+    chosen_idx = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        chosen_idx.append(idx)
+        chosen_gates.append(jnp.take_along_axis(
+            gates, idx[:, None], axis=1)[:, 0])
+        remaining = remaining - jax.nn.one_hot(idx, E) * 1e9
+
+    denom = sum(chosen_gates)
+    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    expert_idx = jnp.stack(chosen_idx, axis=1).astype(jnp.int32)
+    gate_weights = jnp.stack([g / denom for g in chosen_gates], axis=1)
+    return TopKRouting(l_aux, z_loss, expert_idx, gate_weights)
+
+
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int, top_k: int = 1) -> int:
     cap = int(num_tokens * top_k / num_experts * capacity_factor)
@@ -62,48 +116,20 @@ def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float = 1.0,
     fraction_dispatched(ce), computed on the top-1 assignment.
     """
     T, E = logits.shape
-    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     capacity = _capacity(T, E, capacity_factor, min_capacity, top_k=k)
-
-    select_logits = logits.astype(jnp.float32)
-    if noise_rng is not None:
-        # gumbel jitter — the reference's noisy_gate_policy='Jitter'/'RSample'
-        select_logits = select_logits + jax.random.gumbel(
-            noise_rng, select_logits.shape)
-
-    # aux loss on the top-1 assignment (reference top1gating l_aux)
-    top1 = jnp.argmax(select_logits, axis=-1)
-    me = jnp.mean(gates, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
-    l_aux = jnp.sum(me * ce) * E
-
-    z_loss = jnp.float32(0.0)
-    if z_loss_coef > 0:
-        z = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
-        z_loss = z_loss_coef * jnp.mean(z ** 2)
+    routing = topk_routing(logits, k, noise_rng, z_loss_coef)
 
     combine_total = jnp.zeros((T, E, capacity), jnp.float32)
-    remaining = select_logits
-    chosen_gates = []
-    chosen_idx = []
-    for _ in range(k):
-        idx = jnp.argmax(remaining, axis=-1)
-        chosen_idx.append(idx)
-        chosen_gates.append(jnp.take_along_axis(
-            gates, idx[:, None], axis=1)[:, 0])
-        remaining = remaining - jax.nn.one_hot(idx, E) * 1e9
-
-    # normalise the k gate values per token (reference top2gating denominator)
-    denom = sum(chosen_gates)
-    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
     occupancy = jnp.zeros((E,), jnp.int32)
-    for idx, g in zip(chosen_idx, chosen_gates):
-        combine, _, counts = _one_hot_dispatch(idx, g / denom, E, capacity,
-                                               occupancy=occupancy)
+    for i in range(k):
+        combine, _, counts = _one_hot_dispatch(
+            routing.expert_idx[:, i], routing.gate_weights[:, i], E,
+            capacity, occupancy=occupancy)
         combine_total = combine_total + combine
         occupancy = occupancy + counts
 
-    return GateOutput(l_aux, combine_total, combine_total > 0, z_loss)
+    return GateOutput(routing.l_aux, combine_total, combine_total > 0,
+                      routing.router_z_loss)
 
 
 def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,

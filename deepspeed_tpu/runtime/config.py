@@ -552,6 +552,13 @@ class ServingConfig(DeepSpeedConfigModel):
     #: decode dispatches to the lax.scan form — models/serving.py
     #: use_scan_decode).  DS_QUANT_SCAN_THRESHOLD_MB overrides.
     quant_scan_threshold_mb: int = 512
+    #: MoE expert dispatch formulation override (moe/layer.py): None
+    #: leaves the model config's ``dispatch_mode`` in force; "auto" /
+    #: "einsum" / "grouped" installs a serving-wide override at
+    #: scheduler construction (DS_MOE_DISPATCH env still wins at trace
+    #: time).  "grouped" is the megablocks-style drop-free ragged GEMM
+    #: (ops/pallas/grouped_gemm.py — ISSUE 8).
+    moe_dispatch: Optional[str] = None
     #: scheduler watchdog: seconds of pending work with step_count frozen
     #: before the server goes DEGRADED (waiting /generate handlers then
     #: 503 instead of hanging).  Generous default = the old handler-local
@@ -616,6 +623,13 @@ class ServingConfig(DeepSpeedConfigModel):
             raise ValueError(
                 "serving.quant_scan_threshold_mb="
                 f"{self.quant_scan_threshold_mb}: must be >= 0")
+        if self.moe_dispatch is not None:
+            from deepspeed_tpu.moe.layer import DISPATCH_MODES
+            if self.moe_dispatch not in DISPATCH_MODES:
+                raise ValueError(
+                    f"serving.moe_dispatch={self.moe_dispatch!r}: choose "
+                    f"one of {DISPATCH_MODES} (or omit to keep the model "
+                    "config's dispatch_mode)")
         if self.stall_timeout_s < 0:
             raise ValueError(
                 f"serving.stall_timeout_s={self.stall_timeout_s}: must be "

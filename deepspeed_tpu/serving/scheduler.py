@@ -237,6 +237,13 @@ class ContinuousBatchingScheduler:
             from deepspeed_tpu.models import serving as _serving
             _serving.set_quant_scan_threshold(
                 int(config.quant_scan_threshold_mb) << 20)
+        # MoE expert dispatch (ISSUE 8): an explicit serving.moe_dispatch
+        # installs the process override so every model-side
+        # resolve_dispatch_mode — decode, verify, suffix prefill — sees
+        # it (DS_MOE_DISPATCH env still wins at trace time)
+        if config.moe_dispatch is not None:
+            from deepspeed_tpu.moe.layer import set_dispatch_override
+            set_dispatch_override(config.moe_dispatch)
 
         bs = config.block_size
         model_ctx = int(getattr(model.config, "max_seq_len", 1 << 30))
@@ -271,6 +278,14 @@ class ContinuousBatchingScheduler:
             registry=self._telemetry_registry,
             max_accept_len=getattr(getattr(config, "spec", None),
                                    "max_draft_tokens", 16) + 1)
+        # MoE routing-health telemetry (ISSUE 8 satellite): an
+        # explicitly-passed registry (the ds_serve /metrics path) arms
+        # the moe_layer host-callback tap; a registry-less scheduler
+        # DISARMS it (last-constructed wins — a retired server's dead
+        # registry must not keep receiving per-step callbacks from
+        # programs a later scheduler traces)
+        from deepspeed_tpu.moe.layer import set_moe_metrics_registry
+        set_moe_metrics_registry(self._telemetry_registry)
         # black-box layer (ISSUE 7): flight recorder for per-request
         # lifecycle events, rolling step-latency anomaly detection, and
         # per-class SLO burn accounting — all writing into the SAME

@@ -20,6 +20,13 @@ between step counts are trustworthy).
 
     python scripts/decode_profile.py            # gpt2 125m, B=4, S=384
     DEC_B=8 DEC_S=512 python scripts/decode_profile.py
+    DEC_MOE=1 python scripts/decode_profile.py  # mixtral expert floors
+
+DEC_MOE=1 (ISSUE 8) switches to the Mixtral expert-floor accounting:
+``weights_floor_moe`` streams the dense int8 bytes plus only the top-k-
+DISTINCT-expert bytes per step (what the grouped int8 kernel's slot
+plan fetches), vs ``weights_floor_moe_all`` streaming all E experts
+(what einsum dispatch — or any capacity-padded formulation — pays).
 """
 import json
 import os
@@ -54,7 +61,109 @@ def timed_chain(step_fn, state0, n, warmup=3):
     return (t_big - t_small) / (4 * n) * 1e3
 
 
+def moe_floor_main():
+    """Mixtral expert-floor accounting + dummy-stream timing (ISSUE 8):
+    how much of the decode step's weight traffic is experts, and what
+    the grouped int8 path's distinct-expert floor buys over streaming
+    every expert.  Per layer a decode step with A active rows and top-k
+    routing touches at most min(A*k, E) distinct experts — the grouped
+    slot kernel fetches exactly the distinct set once; the einsum
+    formulation's dense [T,E,C] dispatch computes (and streams) all E."""
+    on_tpu = "tpu" in str(jax.devices()[0]).lower()
+    B = int(os.environ.get("DEC_B", 4))
+    size = os.environ.get("DEC_MODEL", "1b-moe" if on_tpu else "tiny")
+    steps = int(os.environ.get("DEC_STEPS", 20 if on_tpu else 2))
+
+    from deepspeed_tpu.models.mixtral import mixtral_model
+    from deepspeed_tpu.models.model import QuantizedTensor
+    from deepspeed_tpu.ops.pallas.quantization import block_quantize_int8
+    model = mixtral_model(size, dtype="bfloat16" if on_tpu else "float32",
+                          attention_impl="xla")
+    cfg = model.config
+    dtype = jnp.dtype(cfg.dtype)
+    params = jax.jit(model.init_fn)(jax.random.PRNGKey(0))
+
+    def _pack(x):
+        if x.ndim >= 3 and jnp.issubdtype(x.dtype, jnp.floating):
+            qq, ss = block_quantize_int8(x.astype(dtype))
+            return QuantizedTensor(qq, ss, str(dtype))
+        return x
+
+    qblocks = jax.tree.map(_pack, params["blocks"])
+    is_q = lambda x: isinstance(x, QuantizedTensor)
+    expert_mats, dense_mats = [], []
+    for leaf in jax.tree_util.tree_leaves(qblocks, is_leaf=is_q):
+        if not is_q(leaf):
+            continue
+        if leaf.q.ndim >= 4:        # [L, E, in, out] stacked experts
+            expert_mats.append(leaf)
+        else:
+            dense_mats.append(leaf)
+    E, k, L = cfg.num_experts, cfg.top_k, cfg.num_layers
+    # byte accounting shared with serve_bench's weights_floor_moe record
+    from deepspeed_tpu.models.serving import split_quantized_bytes
+    dense_b, expert_b = split_quantized_bytes(qblocks)
+    per_expert = expert_b // E          # all layers, one expert
+    distinct = min(B * k, E)
+    floor_moe = dense_b + distinct * per_expert
+    floor_all = dense_b + expert_b
+    print(json.dumps({
+        "model": f"mixtral:{size}", "batch": B, "num_experts": E,
+        "top_k": k, "layers": L,
+        "dense_int8_bytes_mb": round(dense_b / 1e6, 2),
+        "expert_int8_bytes_mb": round(expert_b / 1e6, 2),
+        "distinct_experts_per_step_bound": distinct,
+        "weights_floor_moe_mb": round(floor_moe / 1e6, 2),
+        "weights_floor_moe_all_mb": round(floor_all / 1e6, 2),
+        "floor_ratio_all_over_distinct": round(floor_all / floor_moe, 3),
+        "floor_moe_ms_at_819GBs": round(floor_moe / 819e9 * 1e3, 3),
+        "floor_moe_all_ms_at_819GBs": round(floor_all / 819e9 * 1e3, 3),
+    }))
+
+    # dummy-stream variants: one int8 matvec chain per streamed matrix —
+    # the same idiom as weights_floor_int8, restricted to the bytes each
+    # formulation actually touches per step
+    def chain(mats_2d):
+        def step(state):
+            tok, a, b = state
+            acc = jnp.zeros((B, 1), jnp.int32)
+            for m in mats_2d:
+                r, _ = m.shape
+                y = jnp.broadcast_to(tok[:, None].astype(jnp.int8), (B, r))
+                acc = acc + jnp.sum(lax.dot(
+                    y, m, preferred_element_type=jnp.int32),
+                    axis=-1, keepdims=True)
+            return ((tok + jnp.sum(acc) * 0) % 127, a, b)
+        return step
+
+    def flat_dense(leaves):
+        return [m.q.reshape(-1, m.q.shape[-1]) for m in leaves]
+
+    def flat_experts(n):
+        # first n experts of every layer stand in for the distinct set —
+        # same byte count, same access pattern class
+        return [m.q[:, :n].reshape(-1, m.q.shape[-1])
+                for m in expert_mats]
+
+    tok0 = jnp.zeros((B,), jnp.int32)
+    state0 = (tok0, tok0, tok0)
+    for name, mats_2d in (
+            ("weights_floor_moe", flat_dense(dense_mats)
+             + flat_experts(distinct)),
+            ("weights_floor_moe_all", flat_dense(dense_mats)
+             + flat_experts(E))):
+        try:
+            ms = timed_chain(chain(mats_2d), state0, steps)
+            print(json.dumps({"variant": name, "step_ms": round(ms, 4),
+                              "tok_per_s_B": (round(B / (ms * 1e-3))
+                                              if ms > 0 else None)}))
+        except Exception as e:
+            print(json.dumps({"variant": name, "error": str(e)[:300]}))
+
+
 def main():
+    if os.environ.get("DEC_MOE"):
+        return moe_floor_main()
     on_tpu = "tpu" in str(jax.devices()[0]).lower()
     B = int(os.environ.get("DEC_B", 4))
     S = int(os.environ.get("DEC_S", 384))

@@ -13,6 +13,8 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
+    SERVE_MODE=moe python scripts/serve_bench.py            # mixtral A/B
+    SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
     SERVE_MODE=cb python scripts/serve_bench.py --json out.json
 
 ``--json out.json`` (ISSUE 7 satellite) additionally writes the result
@@ -31,6 +33,12 @@ Prefix mode (ISSUE 6) runs the cb scheduler on a SHARED-PREFIX workload
 prefix cache on vs off and reports TTFT p50/p99, cache hit rate,
 prefill tokens computed, and serving_goodput — the ISSUE 6 acceptance
 columns (identical outputs asserted between the two runs).
+MoE mode (ISSUE 8) runs a Mixtral cb workload with grouped (megablocks
+ragged-GEMM) vs einsum (GShard capacity) expert dispatch — token-
+identical greedy outputs asserted — and, with SERVE_INT8_WEIGHTS=1,
+reports the ``weights_floor_moe`` accounting (dense int8 bytes + top-k-
+distinct-expert bytes per decode step — the floor the grouped int8
+path streams at; the einsum path streams ALL E experts).
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import argparse
@@ -67,8 +75,12 @@ def main(argv=None):
     args = p.parse_args(argv)
     json_path = args.json
     on_tpu = "tpu" in str(jax.devices()[0]).lower()
-    spec = os.environ.get("SERVE_MODEL",
-                          "gpt2:125m" if on_tpu else "gpt2:custom")
+    if os.environ.get("SERVE_MODE") == "moe":
+        # the dispatch A/B needs a routed-expert model
+        default_model = "mixtral:1b-moe" if on_tpu else "mixtral:tiny"
+    else:
+        default_model = "gpt2:125m" if on_tpu else "gpt2:custom"
+    spec = os.environ.get("SERVE_MODEL", default_model)
     B = int(os.environ.get("SERVE_B", 4))
     prompt_len = int(os.environ.get("SERVE_PROMPT", 128 if on_tpu else 8))
     new_tokens = int(os.environ.get("SERVE_TOKENS", 256 if on_tpu else 8))
@@ -111,7 +123,7 @@ def main(argv=None):
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"
         kwargs = {}
-    elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix"):
+    elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -123,7 +135,7 @@ def main(argv=None):
     # cb/spec modes size their own workloads (spec's motif-tiled prompts
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
-    if _mode not in ("cb", "spec", "prefix"):
+    if _mode not in ("cb", "spec", "prefix", "moe"):
         cb_ctx = 0
     elif on_tpu:
         cb_ctx = 768 + 384
@@ -132,7 +144,7 @@ def main(argv=None):
         # short-tail regime is the whole point of this mode
         cb_ctx = int(os.environ.get("SERVE_SYS_LEN", 512)) + 128
     else:
-        cb_ctx = 96 if _mode == "cb" else 128
+        cb_ctx = 96 if _mode in ("cb", "moe") else 128
     model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
                            else "float32",
                            max_seq_len=max(2048 if on_tpu else 64,
@@ -165,6 +177,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "prefix":
         return bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
                                   json_path)
+    if os.environ.get("SERVE_MODE") == "moe":
+        return bench_moe_dispatch(model, eng, spec, kv_dtype, quant,
+                                  on_tpu, json_path)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -506,6 +521,110 @@ def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
             "goodput_on": on_m.gauges.get("goodput"),
             "goodput_off": off_m.gauges.get("goodput"),
         },
+    }, json_path)
+
+
+def bench_moe_dispatch(model, eng, spec, kv_dtype, quant, on_tpu,
+                       json_path=None):
+    """Mixtral expert-dispatch A/B (ISSUE 8): the same mixed-length cb
+    workload through the scheduler with grouped (megablocks-style ragged
+    grouped GEMM, ops/pallas/grouped_gemm.py) vs einsum (GShard [T,E,C]
+    capacity tensors) dispatch — greedy outputs asserted token-identical
+    (eval einsum capacity is drop-free by MixtralConfig default, so the
+    two formulations compute the same math).  With SERVE_INT8_WEIGHTS=1
+    the grouped path consumes the int8 expert stacks in place through
+    the fused-dequant grouped kernel and the record carries the
+    ``weights_floor_moe`` accounting: dense int8 bytes + top-k-DISTINCT-
+    expert bytes per decode step — the floor the grouped path streams
+    at, vs all-E-experts for einsum's dense dispatch."""
+    import time as _time
+    from deepspeed_tpu.moe.layer import dispatch_scope, gg_kernel_real
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    moe_cfg = getattr(model.config, "moe", None)
+    if moe_cfg is None:
+        raise SystemExit(f"SERVE_MODE=moe needs a routed-expert model "
+                         f"(got {spec}) — e.g. SERVE_MODEL=mixtral:1b-moe")
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 24 if on_tpu else 8))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    p_lo, p_hi = ((32, 768) if on_tpu else (4, 24))
+    n_lo, n_hi = ((8, 384) if on_tpu else (4, 16))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    workload = [
+        (rng.integers(1, V, (int(pl),)).astype(np.int32), int(nn))
+        for pl, nn in zip(rng.integers(p_lo, p_hi, n_reqs),
+                          rng.integers(n_lo, n_hi, n_reqs))]
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 4
+    need = -(-max_len // bs) + 1
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * max_seqs,
+                max_num_batched_tokens=1 << 30)
+
+    def run(mode):
+        # fresh scheduler per mode: per-instance jit caches, and the
+        # dispatch choice is resolved at trace time inside the scope
+        with dispatch_scope(mode):
+            cfg = ServingConfig(**base)
+            sched = ContinuousBatchingScheduler(
+                model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+            outs = None
+            for _ in range(2):      # warm compiles, then measure
+                reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                        for p, nn in workload]
+                t0 = _time.time()
+                sched.run_until_idle()
+                dt = _time.time() - t0
+                assert all(len(r.output_ids) == nn
+                           for r, (_, nn) in zip(reqs, workload))
+                outs = [list(r.output_ids) for r in reqs]
+        return dt, outs
+
+    g_s, g_out = run("grouped")
+    e_s, e_out = run("einsum")
+    assert g_out == e_out, \
+        "grouped dispatch changed greedy output (parity violation)"
+
+    detail = {
+        "requests": n_reqs, "useful_tokens": useful,
+        "max_num_seqs": max_seqs, "block_size": bs,
+        "num_experts": moe_cfg.num_experts, "top_k": moe_cfg.top_k,
+        "grouped_tok_s": round(useful / g_s, 1),
+        "einsum_tok_s": round(useful / e_s, 1),
+        "speedup_vs_einsum": round(e_s / g_s, 3),
+        "grouped_kernel_real": gg_kernel_real(),
+        "int8_weights": bool(quant),
+    }
+    if quant:
+        # weights_floor_moe: per decode step the grouped int8 path
+        # streams every DENSE int8 byte once plus, per layer, only the
+        # distinct routed experts' bytes (<= min(active_rows * top_k, E)
+        # — the slot plan fetches each distinct expert's weight block
+        # exactly once); einsum dispatch streams all E experts' bytes
+        from deepspeed_tpu.models.serving import split_quantized_bytes
+        dense_b, expert_b = split_quantized_bytes(eng.params["blocks"])
+        E, k = moe_cfg.num_experts, moe_cfg.top_k
+        per_expert = expert_b // max(E, 1)      # all layers, one expert
+        distinct = min(max_seqs * k, E)
+        detail.update({
+            "dense_int8_bytes": dense_b,
+            "expert_int8_bytes_total": expert_b,
+            "weights_floor_moe_bytes": dense_b + distinct * per_expert,
+            "einsum_stream_bytes": dense_b + expert_b,
+            "distinct_experts_bound": distinct,
+        })
+    emit({
+        "metric": f"{spec}_serve_moe"
+                  + ("_int8kv" if kv_dtype == "int8" else "")
+                  + ("_int8w" if quant else ""),
+        "value": round(useful / g_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": detail,
     }, json_path)
 
 
