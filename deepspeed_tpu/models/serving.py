@@ -376,6 +376,13 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     ``decode_step`` chain would have produced (greedy spec parity rides
     on this).  Returns (logits [B, W, V], cache).
 
+    This window program is also the serving scheduler's CHUNK surface:
+    prefix-cache suffix prefill (ISSUE 6) and chunked prefill (ISSUE 9)
+    both score prompt windows at a traced offset through it — a chunked
+    prefill is this program run repeatedly from a progress cursor, so
+    spec verify, suffix prefill, and prefill chunks share one compiled
+    program set per window width.
+
     No lax.scan variant: verification is one projection matmul over W
     positions per layer, and spec mode is a latency lever for serving —
     the big-int8 scan defense stays a plain-decode concern."""

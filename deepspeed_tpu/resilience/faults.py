@@ -16,7 +16,7 @@ key; specs separated by ``;`` or whitespace)::
 
     site    dotted hook name: ckpt.save ckpt.aux ckpt.manifest
             ckpt.publish ckpt.latest train.step serve.step serve.spec
-            kv.alloc kv.cache ...
+            serve.chunk kv.alloc kv.cache ...
     action  raise      raise FaultInjected at the site
             kill       os._exit(param or 1) — a hard crash, no cleanup
             sigterm    deliver SIGTERM to this process (preemption)
@@ -43,6 +43,11 @@ Examples::
                                               # (fires at match AND at
                                               # attach — deny@1 models an
                                               # eviction under the fork)
+    DS_FAULTS="serve.chunk:raise@2"           # crash mid-chunked-prefill:
+                                              # the request resumes from
+                                              # its last committed chunk
+                                              # cursor (deny = defer the
+                                              # row's chunk this step)
 """
 import hashlib
 import os

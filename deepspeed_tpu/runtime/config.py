@@ -481,6 +481,11 @@ class SLOClassConfig(DeepSpeedConfigModel):
     ttft_ms: float = 0.0
     #: time-per-output-token target, milliseconds (mean inter-token)
     tpot_ms: float = 0.0
+    #: QoS rank (ISSUE 9): higher = more important.  Admission and
+    #: chunked-prefill service order by it, preemption victimizes the
+    #: lowest first, and overload shedding drops classes strictly BELOW
+    #: a burning class's priority (shed-lowest-first)
+    priority: int = 0
 
     def __init__(self, **data):
         super().__init__(**data)
@@ -491,24 +496,43 @@ class SLOClassConfig(DeepSpeedConfigModel):
 
 
 class SLOConfig(DeepSpeedConfigModel):
-    """``serving.slo`` — per-class latency-target accounting (ISSUE 7):
-    each finished request is scored against its class's TTFT/TPOT
-    targets, feeding violation counters and rolling burn-rate gauges.
-    This is the substrate ROADMAP item 5's admission control will
-    consume; this section only *accounts* — it never sheds."""
+    """``serving.slo`` — per-class latency-target accounting (ISSUE 7)
+    plus burn-driven admission control (ISSUE 9): each finished request
+    is scored against its class's TTFT/TPOT targets, feeding violation
+    counters and rolling burn-rate gauges; with ``shed_enabled`` the
+    scheduler consumes those burn rates at submit time and sheds the
+    lowest-priority classes 429-style (with Retry-After) instead of
+    letting the queue grow without bound."""
     enabled: bool = False
     #: class name -> SLOClassConfig (dict-in-JSON, validated below);
     #: unknown request classes fall back to "default"
     classes: Any = None
     #: rolling burn-rate window, in requests per class
     window: int = 256
+    #: overload shedding (ISSUE 9): at saturation, reject submissions of
+    #: the lowest-priority classes with a 429 + Retry-After instead of
+    #: queueing them (requires ``enabled``)
+    shed_enabled: bool = False
+    #: a class whose rolling TTFT/TPOT burn rate exceeds this sheds
+    #: every class with strictly lower priority (the burning class
+    #: itself keeps queueing — queue pressure handles the bottom class)
+    shed_burn_threshold: float = 0.5
+    #: queue depth, as a fraction of ``serving.max_queued``, beyond
+    #: which the lowest-priority class sheds outright
+    shed_queue_fraction: float = 0.75
+    #: minimum requests in a class's burn window before its burn rate
+    #: can trigger shedding (one unlucky first request must not drop a
+    #: whole class)
+    shed_min_requests: int = 4
+    #: Retry-After seconds returned with shed 429s
+    retry_after_s: float = 1.0
 
     def __init__(self, **data):
         super().__init__(**data)
         raw = self.classes or {}
         if not isinstance(raw, dict):
             raise ValueError("serving.slo.classes must be an object of "
-                             "class-name -> {ttft_ms, tpot_ms}")
+                             "class-name -> {ttft_ms, tpot_ms, priority}")
         self.classes = {
             str(name): (c if isinstance(c, SLOClassConfig)
                         else SLOClassConfig(**(c or {})))
@@ -517,6 +541,45 @@ class SLOConfig(DeepSpeedConfigModel):
         if self.window < 1:
             raise ValueError(f"serving.slo.window={self.window}: must "
                              "be >= 1")
+        if not 0.0 < self.shed_burn_threshold <= 1.0:
+            raise ValueError(
+                "serving.slo.shed_burn_threshold="
+                f"{self.shed_burn_threshold}: must be in (0, 1]")
+        if not 0.0 < self.shed_queue_fraction <= 1.0:
+            raise ValueError(
+                "serving.slo.shed_queue_fraction="
+                f"{self.shed_queue_fraction}: must be in (0, 1]")
+        if self.shed_min_requests < 1:
+            raise ValueError(
+                "serving.slo.shed_min_requests="
+                f"{self.shed_min_requests}: must be >= 1")
+        if self.retry_after_s < 0:
+            raise ValueError(f"serving.slo.retry_after_s="
+                             f"{self.retry_after_s}: must be >= 0")
+
+
+class ChunkedPrefillConfig(DeepSpeedConfigModel):
+    """``serving.chunked_prefill`` — Sarathi-style chunked prefill
+    (ISSUE 9): prompts whose prefill exceeds the per-iteration chunk
+    allowance are admitted into a persistent PREFILLING state and their
+    prefill runs as budget-sized chunks (the PR 6 suffix-prefill
+    verify-window programs, driven from a progress cursor) interleaved
+    with decode across scheduler iterations — one 32k-token prompt can
+    no longer monopolize an iteration and spike every active stream's
+    TPOT."""
+    enabled: bool = False
+    #: max prefill tokens executed per scheduler iteration, shared by
+    #: every admission + PREFILLING row (decode rows consume the rest of
+    #: ``max_num_batched_tokens``); the scheduler floors effective
+    #: progress at one suffix bucket so prefill can never stall outright
+    chunk_tokens: int = 256
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.chunk_tokens < 1:
+            raise ValueError(
+                "serving.chunked_prefill.chunk_tokens="
+                f"{self.chunk_tokens}: must be >= 1")
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -576,8 +639,11 @@ class ServingConfig(DeepSpeedConfigModel):
     #: cross-request prefix-cache sub-section (same dict-in-JSON
     #: validation pattern as ``spec``)
     prefix_cache: Any = None
-    #: per-class SLO accounting sub-section (same pattern; ISSUE 7)
+    #: per-class SLO accounting + admission-control sub-section (same
+    #: pattern; ISSUE 7 accounting, ISSUE 9 shedding)
     slo: Any = None
+    #: chunked-prefill sub-section (same pattern; ISSUE 9)
+    chunked_prefill: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
@@ -588,6 +654,9 @@ class ServingConfig(DeepSpeedConfigModel):
                 **(self.prefix_cache or {}))
         if not isinstance(self.slo, SLOConfig):
             self.slo = SLOConfig(**(self.slo or {}))
+        if not isinstance(self.chunked_prefill, ChunkedPrefillConfig):
+            self.chunked_prefill = ChunkedPrefillConfig(
+                **(self.chunked_prefill or {}))
         if self.block_size < 1:
             raise ValueError(f"serving.block_size={self.block_size}: "
                              "must be >= 1")

@@ -16,7 +16,11 @@ kernel, `InferenceEngine` prefill/decode fns):
   prefilling only the uncached suffix), packs the active decode set
   through the jitted decode step via block-table gathers, retires
   finished rows mid-batch (releasing full blocks into the cache),
-  preempts (recompute-on-resume, cache-accelerated) under pool pressure
+  preempts (recompute-on-resume, cache-accelerated) under pool pressure;
+  with ``serving.chunked_prefill`` (ISSUE 9) long prompts prefill as
+  budget-sized chunks interleaved with decode (PREFILLING state +
+  cursor) and ``serving.slo`` classes drive admission order, chunk
+  service order, and burn-rate overload shedding (429 + Retry-After)
 - `server.py`    — stdlib HTTP front-end (/generate, /healthz, /metrics)
   driving the scheduler on a background thread (bin/ds_serve)
 - `spec/`        — speculative decoding (ISSUE 5): ngram/draft-model
@@ -24,7 +28,9 @@ kernel, `InferenceEngine` prefill/decode fns):
 """
 from deepspeed_tpu.serving.request import (RequestState, SamplingParams,
                                            ServeRequest, AdmissionError,
-                                           QueueFullError, RequestTooLongError)
+                                           QueueFullError,
+                                           RequestShedError,
+                                           RequestTooLongError)
 from deepspeed_tpu.serving.block_manager import BlockManager
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 from deepspeed_tpu.serving.spec import (DraftModelProposer, NgramProposer,
@@ -32,7 +38,8 @@ from deepspeed_tpu.serving.spec import (DraftModelProposer, NgramProposer,
 
 __all__ = [
     "RequestState", "SamplingParams", "ServeRequest",
-    "AdmissionError", "QueueFullError", "RequestTooLongError",
+    "AdmissionError", "QueueFullError", "RequestShedError",
+    "RequestTooLongError",
     "BlockManager", "ContinuousBatchingScheduler",
     "Proposer", "NgramProposer", "DraftModelProposer",
 ]

@@ -3,16 +3,26 @@
 State machine::
 
     QUEUED --admit--> PREFILL --first token--> DECODE --eos/len--> FINISHED
-      ^                                          |
+      ^        \\                                 |
+      |         +--> PREFILLING --last chunk--> DECODE
+      |                   |  (chunked prefill, ISSUE 9)
       |            (pool pressure, recompute-on-resume)
       +---------------- EVICTED <----------------+
-    QUEUED --timeout / queue full / too long--> REJECTED
+    QUEUED --timeout / queue full / too long / shed--> REJECTED
 
 An evicted request returns to the queue carrying everything generated so
 far; re-admission re-prefills prompt+generated (recompute-on-resume — no
 swap tier in v1) and decoding continues token-for-token where it left
 off (sampling keys are derived from (seed, absolute position), so the
 resumed stream is bit-identical to the uninterrupted one).
+
+PREFILLING (ISSUE 9, ``serving.chunked_prefill``): a prompt whose
+prefill exceeds the per-iteration chunk allowance persists in its slot
+across iterations with a committed-progress cursor (``prefill_pos``);
+each iteration runs at most the chunk budget of its prefill, interleaved
+with the decode batch.  A PREFILLING request evicted under pool pressure
+resumes from its last committed chunk (the committed prefix re-attaches
+through the prefix cache when enabled, and is recomputed otherwise).
 """
 import enum
 import threading
@@ -26,6 +36,10 @@ import numpy as np
 class RequestState(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
+    #: chunked prefill in flight (ISSUE 9): admitted, blocks allocated,
+    #: prefill partially committed up to ``prefill_pos`` — persists in
+    #: its slot across scheduler iterations
+    PREFILLING = "prefilling"
     DECODE = "decode"
     FINISHED = "finished"
     EVICTED = "evicted"
@@ -42,6 +56,16 @@ class QueueFullError(AdmissionError):
 
 class RequestTooLongError(AdmissionError):
     """prompt + max_new_tokens can never fit the block pool / model ctx."""
+
+
+class RequestShedError(AdmissionError):
+    """SLO admission control shed this request (ISSUE 9): the system is
+    saturated and the request's class is below the shed cutoff.  Carries
+    the Retry-After hint the HTTP front-end returns with the 429."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass(frozen=True)
@@ -82,6 +106,15 @@ class ServeRequest:
     queued_at: float = field(default_factory=time.monotonic)
     output_ids: List[int] = field(default_factory=list)
     slot: int = -1                           # decode-batch row while active
+    # -- chunked-prefill cursor (ISSUE 9; PREFILLING state only) --------
+    #: committed prefill progress: tokens of ``prefill_inputs`` whose KV
+    #: vectors are in the pool.  Only ever advances after a chunk
+    #: program completes, so an eviction or injected fault mid-prefill
+    #: resumes from a consistent committed prefix.
+    prefill_pos: int = 0
+    #: the admission's prefill token stream (prompt, or prompt+generated
+    #: tail minus one on resume); None outside PREFILLING
+    prefill_inputs: Optional[np.ndarray] = field(default=None, repr=False)
     num_preemptions: int = 0
     reject_reason: Optional[str] = None
     t_first_token: Optional[float] = None    # monotonic; TTFT = - arrival

@@ -4,11 +4,18 @@ over a vLLM-style paged KV pool).
 Each ``step()`` is one engine iteration:
 
 1. expire queued requests past their timeout (graceful 429, never a crash);
-2. admit queued prefills — highest priority first — up to the
-   ``max_num_batched_tokens`` budget and the free-slot/free-block supply;
-   with ``serving.prefix_cache`` on, each prompt is first matched
+2. admit queued prefills — highest SLO class, then priority, first — up
+   to the ``max_num_batched_tokens`` budget and the free-slot/free-block
+   supply; with ``serving.prefix_cache`` on, each prompt is first matched
    block-by-block against the cross-request prefix cache and only the
-   uncached suffix prefills (ISSUE 6);
+   uncached suffix prefills (ISSUE 6); with ``serving.chunked_prefill``
+   on (ISSUE 9), a prefill larger than the per-iteration chunk allowance
+   admits into a persistent PREFILLING state instead of running whole;
+2b. service PREFILLING rows (``_prefill_chunks``): each iteration runs at
+   most ``chunk_tokens`` of pending prefill — highest class first — as
+   suffix-prefill verify windows from each request's committed cursor,
+   interleaved with the decode batch below, so one 32k-token prompt can
+   never spike every active stream's TPOT;
 3. grow each active row's block table for the token it is about to write
    (allocate-on-decode); under pool exhaustion the lowest-priority active
    request is preempted (blocks freed, request requeued; it resumes later
@@ -301,6 +308,14 @@ class ContinuousBatchingScheduler:
                                             flightrec=self.flightrec))
         self.slo = SLOTracker(getattr(config, "slo", None),
                               self.metrics.registry)
+        # chunked prefill (ISSUE 9): prefill becomes a per-iteration
+        # resource — admissions larger than the chunk allowance persist
+        # in PREFILLING state and the _prefill_chunks phase services
+        # them, highest SLO class first, within the shared token budget
+        cp = getattr(config, "chunked_prefill", None)
+        self._chunked_on = bool(getattr(cp, "enabled", False))
+        self._chunk_tokens = int(getattr(cp, "chunk_tokens", 256) or 256)
+        self._prefill_spent = 0         # prefill tokens executed this step
         self._serve_t0 = time.monotonic()   # tokens/s accounting window
         self._prefill_fns = {}
         self._decode_fns = {}
@@ -528,9 +543,13 @@ class ContinuousBatchingScheduler:
                slo_class: str = "default") -> ServeRequest:
         """Enqueue a request; raises AdmissionError (429-style) instead of
         crashing or wedging the loop.  ``slo_class`` names the request's
-        ``serving.slo`` class for burn accounting (unknown classes fall
-        back to ``default``)."""
-        from deepspeed_tpu.serving.request import SamplingParams
+        ``serving.slo`` class for burn accounting AND admission control
+        (unknown classes fall back to ``default``): with
+        ``serving.slo.shed_enabled``, a saturated system sheds the
+        lowest-priority classes here with a RequestShedError carrying
+        the Retry-After hint (ISSUE 9)."""
+        from deepspeed_tpu.serving.request import (RequestShedError,
+                                                   SamplingParams)
         with self._lock:
             req = ServeRequest(
                 request_id=self._next_id,
@@ -555,6 +574,28 @@ class ContinuousBatchingScheduler:
                                       reason="too_long", tokens=total)
                 req.done.set()
                 raise RequestTooLongError(req.reject_reason)
+            # SLO admission control (ISSUE 9): under saturation (burn
+            # rates over threshold / queue pressure), classes below the
+            # shed cutoff 429 here — BEFORE the queue-full check, so
+            # low-class traffic can't fill the queue against the
+            # classes the system is still meeting targets for
+            cut = self.slo.shed_cutoff(len(self._queue),
+                                       self.cfg.max_queued)
+            if cut is not None and \
+                    self.slo.class_priority(slo_class) < cut["priority"]:
+                req.state = RequestState.REJECTED
+                req.reject_reason = (
+                    f"shed class {self.slo.resolve_class(slo_class)!r} "
+                    f"under overload ({cut['reason']}); retry after "
+                    f"{self.slo.retry_after_s:g}s")
+                self.metrics.counters["rejected_shed"] += 1
+                self.flightrec.record(
+                    "req/reject", corr=f"req-{req.request_id}",
+                    reason="shed",
+                    slo_class=self.slo.resolve_class(slo_class))
+                req.done.set()
+                raise RequestShedError(req.reject_reason,
+                                       self.slo.retry_after_s)
             if len(self._queue) >= self.cfg.max_queued:
                 req.state = RequestState.REJECTED
                 req.reject_reason = (
@@ -640,6 +681,9 @@ class ContinuousBatchingScheduler:
                         if req.ttft_s is not None else None),
             "spec_k": req.spec_k,
             "spec_disabled": req.spec_disabled,
+            "prefill_cursor": req.prefill_pos,
+            "prefill_total": (int(req.prefill_inputs.size)
+                              if req.prefill_inputs is not None else None),
         }
 
     def debug_requests(self) -> Dict:
@@ -694,22 +738,50 @@ class ContinuousBatchingScheduler:
             "slo": {
                 "enabled": self.slo.enabled,
                 "classes": sorted(self.slo.classes),
+                "priorities": dict(self.slo.priorities),
+                "shed_enabled": self.slo.shed_enabled,
                 "burn_rates": self.slo.burn_rates(),
                 "violations": int(self.metrics.counters["slo_violations"]),
+                "shed": int(self.metrics.counters["rejected_shed"]),
+            },
+            "chunked_prefill": {
+                "enabled": self._chunked_on,
+                "chunk_tokens": self._chunk_tokens,
+                "chunks_deferred": int(
+                    self.metrics.counters["chunks_deferred"]),
+                "prefilling": [
+                    {"request_id": r.request_id,
+                     "cursor": r.prefill_pos,
+                     "total": (int(r.prefill_inputs.size)
+                               if r.prefill_inputs is not None else None)}
+                    for r in list(self._slots) if r is not None
+                    and r.state == RequestState.PREFILLING],
             },
         }
         return out
 
     # -------------------------------------------------------- lifecycle
+    def _committed_tokens(self, req: ServeRequest) -> Optional[int]:
+        """KV-materialized token count for cache publication: a
+        PREFILLING request has KV only up to its committed chunk cursor
+        (ISSUE 9); everything else uses register_committed's default
+        (all but the newest sampled token)."""
+        if req.state == RequestState.PREFILLING:
+            return req.prefill_pos
+        return None
+
     def _retire(self, req: ServeRequest, state: RequestState,
                 reason: Optional[str] = None):
         if self.proposer is not None:
             self.proposer.release(req.request_id)
         # release INTO the cache (ISSUE 6): hash any last full blocks,
         # then free — hashed blocks park on the LRU for the next request
-        self.block_mgr.register_committed(req.request_id,
-                                          req.all_token_ids)
+        self.block_mgr.register_committed(
+            req.request_id, req.all_token_ids,
+            materialized=self._committed_tokens(req))
         self.block_mgr.free(req.request_id)
+        req.prefill_inputs = None
+        req.prefill_pos = 0
         if req.slot >= 0:
             self._slots[req.slot] = None
             req.slot = -1
@@ -744,12 +816,18 @@ class ContinuousBatchingScheduler:
         """Preempt: free blocks+slot, requeue for recompute-on-resume.
         With the prefix cache on, the victim's full blocks are hashed
         first — resume re-matches them and re-prefills (close to)
-        nothing instead of the whole prompt+generated tail."""
+        nothing instead of the whole prompt+generated tail.  A victim
+        caught MID-PREFILL (PREFILLING, ISSUE 9) publishes only up to
+        its committed chunk cursor — re-admission resumes from the last
+        committed chunk, never from half-written KV."""
         if self.proposer is not None:
             self.proposer.release(victim.request_id)
-        self.block_mgr.register_committed(victim.request_id,
-                                          victim.all_token_ids)
+        self.block_mgr.register_committed(
+            victim.request_id, victim.all_token_ids,
+            materialized=self._committed_tokens(victim))
         self.block_mgr.free(victim.request_id)
+        victim.prefill_inputs = None
+        victim.prefill_pos = 0
         if victim.slot >= 0:
             self._slots[victim.slot] = None
             victim.slot = -1
@@ -783,9 +861,37 @@ class ContinuousBatchingScheduler:
                 req.done.set()
 
     # -------------------------------------------------------- admission
+    def _qos_key(self, req: ServeRequest):
+        """Scheduling order (ISSUE 9): SLO class priority first, then
+        per-request priority, then eviction count (aging — a request
+        preempted N times stops being the perpetual victim among its
+        peers and re-admits ahead of them), then arrival (oldest wins).
+        ``max`` over this key picks the front of the admission line and
+        the next chunk to service; ``min`` picks the preemption victim —
+        so the lowest class yields pool and compute first.  Without the
+        aging term, equal-priority traffic under recurring pool pressure
+        could re-elect the same PREFILLING row every cycle and (with the
+        prefix cache off, where committed chunks don't persist) restart
+        its prefill from zero forever."""
+        return (self.slo.class_priority(req.slo_class), req.priority,
+                req.num_preemptions, -req.arrival_time)
+
+    def _prefill_allowance(self) -> int:
+        """Per-iteration prefill token allowance under chunked prefill:
+        at most ``chunk_tokens``, shrunk when active decode rows claim
+        their share of ``max_num_batched_tokens`` (one budget, shared),
+        floored at one SUFFIX_BUCKET so prefill always progresses — a
+        saturated decode batch slows chunking down, never starves it."""
+        decode_rows = sum(1 for r in self._slots if r is not None
+                          and r.state == RequestState.DECODE)
+        allow = min(self._chunk_tokens,
+                    self.cfg.max_num_batched_tokens - decode_rows)
+        return max(allow, self.SUFFIX_BUCKET)
+
     def _admit(self):
-        """Admit queued prefills (highest priority, then oldest, first)
-        into free slots, bounded by the step token budget and the pool.
+        """Admit queued prefills (highest SLO class, then priority, then
+        oldest, first) into free slots, bounded by the step token budget
+        and the pool.
 
         With the prefix cache on (ISSUE 6), each prompt is first matched
         block-by-block against the cache: matched blocks attach to the
@@ -794,28 +900,45 @@ class ContinuousBatchingScheduler:
         token, into a copy-on-write fork of the final shared block.  A
         failed attach (pool pressure mid-admission, or an injected
         ``kv.cache`` fault) degrades to a plain full prefill, never to a
-        corrupted table."""
+        corrupted table.
+
+        With chunked prefill on (ISSUE 9) the token budget is a REAL
+        per-iteration cap: an admission whose uncached prefill fits the
+        remaining chunk allowance still runs the one-shot prefill
+        program here; anything larger enters PREFILLING with a progress
+        cursor and is serviced chunk-by-chunk by ``_prefill_chunks`` —
+        the old first-admission escape (one 32k prompt monopolizing an
+        iteration, spiking every active stream's TPOT) is gone."""
         budget = self.cfg.max_num_batched_tokens
+        chunked = self._chunked_on
+        allow = self._prefill_allowance() if chunked else budget
         bm = self.block_mgr
         spent = 0
         while self._queue:
             free_slots = [i for i, r in enumerate(self._slots) if r is None]
             if not free_slots:
                 break
-            req = max(self._queue,
-                      key=lambda r: (r.priority, -r.arrival_time))
+            req = max(self._queue, key=self._qos_key)
             resumed = req.state == RequestState.EVICTED
             tokens = req.all_token_ids
             # resume re-prefills everything but the last generated token —
-            # decode recomputes that one's KV as it proceeds
-            inputs = tokens[:-1] if resumed else tokens
+            # decode recomputes that one's KV as it proceeds.  A request
+            # evicted MID-PREFILL has generated nothing: its whole prompt
+            # is the input and the first token is still owed (ISSUE 9)
+            inputs = tokens[:-1] if resumed and req.num_generated \
+                else tokens
             n_in = int(inputs.size)
             matched, start = ([], 0)
             if self._prefix_cache_on:
                 matched, start = self._match_prefix(req, inputs, resumed)
             # the budget meters PREFILL COMPUTE: cached tokens are free
-            if spent and spent + (n_in - start) > budget:
+            need = n_in - start
+            if not chunked and spent and spent + need > budget:
                 break
+            # chunked: a prefill the remaining allowance can't absorb
+            # defers into PREFILLING — it is still admitted (slot +
+            # blocks) so chunk service can start next phase/iteration
+            defer = chunked and need > allow - spent
             # blocks covering positions [0, n_in] — prefill fill plus the
             # first decode write — so admission never instantly preempts
             total = bm.blocks_for_tokens(n_in + 1)
@@ -833,8 +956,10 @@ class ContinuousBatchingScheduler:
                     # degrade: full prefill — the whole prompt is now
                     # prefill compute, so the budget check re-runs
                     matched, start = ([], 0)
-                    if spent and spent + n_in > budget:
+                    need = n_in
+                    if not chunked and spent and spent + n_in > budget:
                         break
+                    defer = chunked and need > allow - spent
                 else:
                     fork_pair = got[1]
             if not matched:
@@ -862,13 +987,12 @@ class ContinuousBatchingScheduler:
                 "req/resume" if resumed else "req/admit",
                 corr=f"req-{req.request_id}", slot=req.slot,
                 step=self._step_count, cached_tokens=start,
-                prompt_tokens=n_in)
+                prompt_tokens=n_in, deferred=bool(defer and need > 0))
             if matched:
                 self.flightrec.record(
                     "req/prefix_hit", corr=f"req-{req.request_id}",
                     blocks=len(matched), cached_tokens=start,
                     cow_fork=fork_pair is not None)
-            spent += n_in - start
             self.metrics.observe_queue_wait(
                 time.monotonic() - req.queued_at)
             if resumed:
@@ -885,12 +1009,16 @@ class ContinuousBatchingScheduler:
                 # prefill, the generated tail is already sampled — straight
                 # to decode (recomputed_tokens rides at 0)
                 req.state = RequestState.DECODE
+            elif defer:
+                req.state = RequestState.PREFILLING
+                req.prefill_inputs = inputs
+                req.prefill_pos = start
             else:
+                spent += need
                 self._run_prefill(req, inputs, resumed, start)
             if resumed:
                 self.metrics.counters["resumed"] += 1
-        if spent:
-            self.metrics.prefill_batch_tokens.observe(spent)
+        self._prefill_spent += spent
 
     def _match_prefix(self, req: ServeRequest, inputs: np.ndarray,
                       resumed: bool):
@@ -955,15 +1083,28 @@ class ContinuousBatchingScheduler:
             # one-shot full-prompt program
             self.flightrec.record("req/prefill_chunk",
                                   corr=f"req-{req.request_id}",
-                                  tokens=int(inputs.size), offset=0)
+                                  tokens=int(inputs.size), offset=0,
+                                  cursor=int(inputs.size))
+        self._finish_prefill(req, inputs, last_logits)
+
+    def _finish_prefill(self, req: ServeRequest, inputs: np.ndarray,
+                        last_logits):
+        """Shared prefill epilogue (one-shot, cached-suffix, and chunked
+        completion): publish the prefilled blocks to the prefix cache,
+        flip to DECODE, and sample the first token from the last real
+        position's logits — unless the request already carries a
+        generated tail (resumed mid-decode: its next token is already
+        on record, decode continues it)."""
         # the prompt's full blocks are cache content from here on —
         # registering BEFORE the first sample lets the next admission in
         # this very step hit them (materialized = exactly the prefilled
         # prefix; the token sampled below has no KV yet)
-        bm.register_committed(req.request_id, inputs,
-                              materialized=int(inputs.size))
+        self.block_mgr.register_committed(req.request_id, inputs,
+                                          materialized=int(inputs.size))
         req.state = RequestState.DECODE
-        if resumed:
+        req.prefill_inputs = None
+        req.prefill_pos = 0
+        if req.num_generated:
             return                  # generated tail already sampled
         s = req.sampling
         tok = int(np.asarray(self._sample1_fn(bool(s.do_sample))(
@@ -981,13 +1122,35 @@ class ContinuousBatchingScheduler:
         if req.finished_by(tok):
             self._retire(req, RequestState.FINISHED)
 
+    def _prefill_window(self, req: ServeRequest, inputs: np.ndarray,
+                        pos: int, take: int, pos_idx: np.ndarray):
+        """ONE verify-window prefill program execution: score
+        ``inputs[pos:pos+take]`` (take <= SUFFIX_CHUNK) at traced offset
+        ``pos`` against the request's pool-gathered cache and scatter
+        the window's KV back; returns the window's last real position's
+        logits ``[1, V]``.  This is the shared chunk program — the
+        prefix-cache suffix path and the chunked-prefill cursor path
+        reuse the same ``_suffix_prefill_fns`` compiled set."""
+        bm = self.block_mgr
+        W = min(_round_up(take, self.SUFFIX_BUCKET), self.SUFFIX_CHUNK)
+        toks = np.zeros((1, W), np.int32)
+        toks[0, :take] = inputs[pos:pos + take]
+        # pad window positions keep the trash pattern
+        dests = (np.arange(W) % bm.block_size).astype(np.int32)
+        for j in range(take):
+            dests[j] = bm.position_index(req.request_id, pos + j)
+        logits, self.pool = self._suffix_prefill_fn(W)(
+            self.params, self.pool, jnp.asarray(toks),
+            jnp.asarray([pos], np.int32), jnp.asarray(dests),
+            jnp.asarray(pos_idx))
+        return logits[0, take - 1][None]
+
     def _suffix_prefill(self, req: ServeRequest, inputs: np.ndarray,
                         start: int):
         """Prefill tokens ``start..n_in-1`` against the cached prefix,
         in SUFFIX_CHUNK-sized verify windows (see _suffix_prefill_fn);
         returns the last real position's logits ``[1, V]`` for first-
         token sampling."""
-        bm = self.block_mgr
         n_in = int(inputs.size)
         # dense gather indices over the request's (fully allocated,
         # possibly shared) table — fixed across chunks
@@ -995,23 +1158,92 @@ class ContinuousBatchingScheduler:
         pos, last = start, None
         while pos < n_in:
             take = min(self.SUFFIX_CHUNK, n_in - pos)
-            W = min(_round_up(take, self.SUFFIX_BUCKET), self.SUFFIX_CHUNK)
-            toks = np.zeros((1, W), np.int32)
-            toks[0, :take] = inputs[pos:pos + take]
-            # pad window positions keep the trash pattern
-            dests = (np.arange(W) % bm.block_size).astype(np.int32)
-            for j in range(take):
-                dests[j] = bm.position_index(req.request_id, pos + j)
-            logits, self.pool = self._suffix_prefill_fn(W)(
-                self.params, self.pool, jnp.asarray(toks),
-                jnp.asarray([pos], np.int32), jnp.asarray(dests),
-                jnp.asarray(pos_idx))
-            last = logits[0, take - 1][None]
+            last = self._prefill_window(req, inputs, pos, take, pos_idx)
             self.flightrec.record("req/prefill_chunk",
                                   corr=f"req-{req.request_id}",
-                                  tokens=take, offset=pos)
+                                  tokens=take, offset=pos,
+                                  cursor=pos + take)
             pos += take
         return last
+
+    # --------------------------------------------- chunked prefill phase
+    def _chunks_pending(self) -> bool:
+        """Any PREFILLING row still owed chunk service (the spec-decode
+        throttle and the deferral telemetry both key on this)."""
+        return any(r is not None and r.state == RequestState.PREFILLING
+                   for r in self._slots)
+
+    def _prefill_chunks(self):
+        """Chunked-prefill service phase (ISSUE 9 tentpole): give every
+        PREFILLING row — highest SLO class / priority first — its share
+        of this iteration's prefill allowance, at most ``chunk_tokens``
+        total, riding the suffix-prefill verify-window programs from the
+        request's committed cursor.  Rows the allowance can't reach this
+        iteration are deferred (counted) and keep their cursor; the row
+        whose final chunk lands samples its first token exactly like a
+        one-shot prefill."""
+        if not self._chunked_on:
+            return
+        rows = [r for r in self._slots if r is not None
+                and r.state == RequestState.PREFILLING]
+        if not rows:
+            return
+        allow = self._prefill_allowance()
+        rows.sort(key=self._qos_key, reverse=True)
+        for req in rows:
+            left = allow - self._prefill_spent
+            if left < min(self.SUFFIX_BUCKET,
+                          int(req.prefill_inputs.size) - req.prefill_pos):
+                # not even one bucket (or the tiny remainder) left this
+                # iteration — the row keeps its cursor and waits
+                self.metrics.counters["chunks_deferred"] += 1
+                continue
+            self._run_prefill_chunk(req, left)
+
+    def _run_prefill_chunk(self, req: ServeRequest, budget: int):
+        """Run up to ``budget`` prefill tokens for one PREFILLING row.
+        The ``serve.chunk`` fault site fires BEFORE any KV write: a
+        ``raise`` propagates out of step() (the serving loop retries;
+        cursor and block table untouched — the request resumes from its
+        last committed chunk), a ``deny`` defers the row this iteration.
+        The cursor advances only after each window program completes, so
+        a fault between windows is equally consistent."""
+        from deepspeed_tpu.telemetry import get_tracer
+        if self.injector.deny("serve.chunk"):
+            self.metrics.counters["chunks_deferred"] += 1
+            return
+        inputs = req.prefill_inputs
+        n_in = int(inputs.size)
+        take_total = min(budget, n_in - req.prefill_pos)
+        with get_tracer().span(
+                "serve/chunk", cat="serving", corr=f"req-{req.request_id}",
+                args={"request_id": req.request_id,
+                      "offset": int(req.prefill_pos),
+                      "tokens": int(take_total),
+                      "remaining": int(n_in - req.prefill_pos
+                                       - take_total)}):
+            pos_idx = self._pos_idx_row(req.request_id)[None]
+            done, last = 0, None
+            while done < take_total:
+                take = min(self.SUFFIX_CHUNK, take_total - done)
+                last = self._prefill_window(req, inputs,
+                                            req.prefill_pos, take,
+                                            pos_idx)
+                req.prefill_pos += take
+                done += take
+                self.flightrec.record(
+                    "req/prefill_chunk", corr=f"req-{req.request_id}",
+                    tokens=take, offset=req.prefill_pos - take,
+                    cursor=req.prefill_pos, total=n_in)
+        self._prefill_spent += take_total
+        self.metrics.counters["prefill_tokens"] += take_total
+        # committed chunks become prefix-cache content immediately: a
+        # same-prefix admission (or this row's own post-eviction resume)
+        # attaches them instead of recomputing
+        self.block_mgr.register_committed(req.request_id, inputs,
+                                          materialized=req.prefill_pos)
+        if req.prefill_pos >= n_in:
+            self._finish_prefill(req, inputs, last)
 
     # ------------------------------------------------- decode iteration
     def _grow_tables(self):
@@ -1027,10 +1259,13 @@ class ContinuousBatchingScheduler:
                     bm.block_table(req.request_id)):
                 if bm.allocate(req.request_id, 1) is not None:
                     continue
+                # PREFILLING rows are preemptible too (ISSUE 9): a
+                # lowest-class chunking prompt yields its pool to a
+                # higher-class decode before any decode row does
                 active = [r for r in self._slots if r is not None
-                          and r.state == RequestState.DECODE]
-                victim = min(active,
-                             key=lambda r: (r.priority, -r.arrival_time))
+                          and r.state in (RequestState.DECODE,
+                                          RequestState.PREFILLING)]
+                victim = min(active, key=self._qos_key)
                 self._evict(victim)
                 if victim is req:
                     break
@@ -1121,18 +1356,29 @@ class ContinuousBatchingScheduler:
     # --------------------------------------------- speculative decoding
     #: verify passes with a draft before min_accept_rate can trip
     SPEC_MIN_PASSES = 4
+    #: draft-length clamp while prefill chunks are pending (ISSUE 9):
+    #: verify windows and chunk windows contend for the same iteration —
+    #: a wide speculative window would stretch every chunk's wait just
+    #: like an unchunked prefill stretched decode's
+    SPEC_THROTTLE_K = 2
 
     def _spec_budget(self, req: ServeRequest) -> int:
         """Adaptive per-request draft length for this round (0 = don't
         speculate: disabled, or too close to max_new for a draft plus
-        the bonus token to fit)."""
+        the bonus token to fit).  Clamped to SPEC_THROTTLE_K while
+        PREFILLING rows await chunk service (spec auto-throttle,
+        ISSUE 9)."""
         spec = self.cfg.spec
         if req.spec_disabled or req.remaining_new_tokens <= 1:
             return 0
         if req.spec_k <= 0:
             req.spec_k = spec.max_draft_tokens      # start optimistic
-        return min(req.spec_k, spec.max_draft_tokens,
-                   req.remaining_new_tokens - 1)
+        k = min(req.spec_k, spec.max_draft_tokens,
+                req.remaining_new_tokens - 1)
+        if k > self.SPEC_THROTTLE_K and self._chunks_pending():
+            self.metrics.counters["spec_throttled"] += 1
+            k = self.SPEC_THROTTLE_K
+        return k
 
     def _propose_drafts(self, active) -> Dict[int, np.ndarray]:
         from deepspeed_tpu.telemetry import get_tracer
@@ -1326,9 +1572,16 @@ class ContinuousBatchingScheduler:
             self.injector.check("serve.step")
             with self._lock:
                 self._finished_this_step = []
+                self._prefill_spent = 0
+                gen0 = self.metrics.counters["generated_tokens"]
                 self._expire_queued()
                 with tracer.span("serve/admit", cat="serving"):
                     self._admit()
+                # chunked-prefill service (ISSUE 9): PREFILLING rows get
+                # their slice of the iteration's prefill allowance here,
+                # between admission and decode — per-chunk serve/chunk
+                # spans carry each request's req-<id> corr
+                self._prefill_chunks()
                 with tracer.span("serve/grow", cat="serving"):
                     self._grow_tables()
                 active = sum(r is not None and
@@ -1337,6 +1590,16 @@ class ContinuousBatchingScheduler:
                 with tracer.span("serve/decode", cat="serving",
                                  args={"active": active}):
                     self._decode()
+                if self._prefill_spent:
+                    self.metrics.prefill_batch_tokens.observe(
+                        self._prefill_spent)
+                # per-iteration budget split (ISSUE 9 telemetry): how
+                # this step's tokens divided between prefill compute and
+                # decode/sampled emissions
+                self.metrics.gauges["step_prefill_tokens"] = \
+                    self._prefill_spent
+                self.metrics.gauges["step_decode_tokens"] = int(
+                    self.metrics.counters["generated_tokens"] - gen0)
                 if self._prefix_cache_on:
                     # newly filled full blocks become cache entries while
                     # their owners still decode — concurrent same-prefix

@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from deepspeed_tpu.resilience.health import (HealthMonitor, HealthState,
                                              SchedulerWatchdog, STATE_CODE)
 from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
+                                           RequestShedError,
                                            SamplingParams)
 from deepspeed_tpu.utils.logging import logger
 
@@ -142,11 +143,17 @@ class _Handler(BaseHTTPRequestHandler):
         logger.debug("ds_serve: " + fmt % args)
 
     # ------------------------------------------------------------ helpers
-    def _send_json(self, code: int, payload: dict):
+    def _send_json(self, code: int, payload: dict,
+                   retry_after_s: float = None):
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            # Retry-After is integer seconds (RFC 9110); never advertise
+            # 0 — the client would hammer straight back into the shed
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after_s)))))
         self.end_headers()
         self.wfile.write(body)
 
@@ -251,6 +258,13 @@ class _Handler(BaseHTTPRequestHandler):
                                         priority=priority,
                                         timeout_s=timeout_s,
                                         slo_class=slo_class)
+        except RequestShedError as e:
+            # SLO admission control (ISSUE 9): saturated, and this
+            # request's class is below the shed cutoff — bounded
+            # back-pressure with a retry hint, not unbounded queueing
+            self._send_json(429, {"error": str(e), "shed": True},
+                            retry_after_s=e.retry_after_s)
+            return
         except QueueFullError as e:
             self._send_json(429, {"error": str(e)})
             return

@@ -138,7 +138,14 @@ class SLOTracker:
     A request class without configured targets still counts requests
     (fleet accounting) but can never violate.  Unknown classes fall
     back to ``default`` so a typo'd client degrades to the default SLO
-    rather than escaping accounting."""
+    rather than escaping accounting.
+
+    With ``serving.slo.shed_enabled`` (ISSUE 9) the tracker also serves
+    admission control: :meth:`shed_cutoff` turns the burn rates + queue
+    pressure into a priority cutoff, and the scheduler 429-sheds
+    submissions whose class priority sits strictly below it — the
+    lowest class first, with Retry-After, instead of unbounded queue
+    growth."""
 
     def __init__(self, config, registry):
         self.cfg = config
@@ -146,6 +153,21 @@ class SLOTracker:
         self.enabled = bool(getattr(config, "enabled", False))
         self.window = int(getattr(config, "window", 256))
         self.classes = dict(getattr(config, "classes", {}) or {})
+        #: class -> QoS priority (SLOClassConfig.priority; higher = more
+        #: important — admission order, chunk service, shed order)
+        self.priorities: Dict[str, int] = {
+            name: int(getattr(c, "priority", 0) or 0)
+            for name, c in self.classes.items()}
+        self.shed_enabled = self.enabled and bool(
+            getattr(config, "shed_enabled", False))
+        self.shed_burn_threshold = float(
+            getattr(config, "shed_burn_threshold", 0.5) or 0.5)
+        self.shed_queue_fraction = float(
+            getattr(config, "shed_queue_fraction", 0.75) or 0.75)
+        self.shed_min_requests = int(
+            getattr(config, "shed_min_requests", 4) or 4)
+        self.retry_after_s = float(
+            getattr(config, "retry_after_s", 1.0) or 0.0)
         #: class -> deque of (ttft_ok, tpot_ok) over recent requests
         self._recent: Dict[str, collections.deque] = {}
         self._lock = threading.Lock()
@@ -154,6 +176,72 @@ class SLOTracker:
         if name and name in self.classes:
             return name
         return "default"
+
+    def class_priority(self, name: Optional[str]) -> int:
+        """QoS priority of a (possibly unknown) request class; unknown
+        classes inherit ``default``'s priority, an unconfigured tracker
+        ranks everything 0."""
+        return self.priorities.get(self.resolve_class(name), 0)
+
+    def _targeted(self, cls: str) -> bool:
+        c = self.classes.get(cls)
+        return bool(c is not None and (getattr(c, "ttft_ms", 0.0)
+                                       or getattr(c, "tpot_ms", 0.0)))
+
+    def shed_cutoff(self, queue_depth: int,
+                    max_queued: int) -> Optional[Dict]:
+        """Admission-control verdict (ISSUE 9): ``{"priority": P,
+        "reason": ...}`` — submissions whose class priority is strictly
+        below ``P`` should be shed — or None when nothing sheds.
+
+        Two saturation signals, strongest cutoff wins:
+
+        - **burn**: a class with configured targets whose rolling
+          TTFT/TPOT burn rate exceeds ``shed_burn_threshold`` (over at
+          least ``shed_min_requests`` recent requests) sheds every class
+          below it — the system is failing traffic it promised latency
+          to, so the unpromised/lower tiers yield first;
+        - **queue pressure**: queue depth at or beyond
+          ``shed_queue_fraction`` of ``max_queued`` sheds the lowest
+          configured class outright (cutoff = lowest priority + 1) —
+          early, targeted back-pressure before the indiscriminate
+          queue-full 429 hits every class."""
+        if not self.shed_enabled:
+            return None
+        cutoff: Optional[int] = None
+        reason = None
+        with self._lock:
+            rings = [(cls, list(ring))
+                     for cls, ring in self._recent.items()]
+        for cls, ring in rings:
+            if not self._targeted(cls) \
+                    or len(ring) < self.shed_min_requests:
+                continue
+            n = len(ring)
+            burn = max(sum(1 for t, _ in ring if t),
+                       sum(1 for _, t in ring if t)) / n
+            if burn > self.shed_burn_threshold:
+                p = self.priorities.get(cls, 0)
+                if cutoff is None or p > cutoff:
+                    cutoff = p
+                    reason = (f"class {cls!r} burn rate "
+                              f"{round(burn, 3)} > "
+                              f"{self.shed_burn_threshold}")
+        distinct = set(self.priorities.values())
+        if len(distinct) > 1 and max_queued and queue_depth >= max(
+                1, int(self.shed_queue_fraction * max_queued)):
+            # only with a real priority ladder: when every class shares
+            # one priority there IS no "lowest class" to shed first —
+            # a cutoff of min+1 would blanket-429 all traffic at 75%
+            # depth, strictly worse than queueing to the max_queued 429
+            q_cut = min(distinct) + 1
+            if cutoff is None or q_cut > cutoff:
+                cutoff = q_cut
+                reason = (f"queue depth {queue_depth} >= "
+                          f"{self.shed_queue_fraction:g} * {max_queued}")
+        if cutoff is None:
+            return None
+        return {"priority": cutoff, "reason": reason}
 
     def observe(self, slo_class: Optional[str], ttft_s: Optional[float],
                 tpot_s: Optional[float]) -> Dict[str, bool]:

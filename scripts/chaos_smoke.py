@@ -11,7 +11,9 @@ kills the process:
   newest VALID tag;
 - a torn `latest` pointer;
 - serving-loop step failures degrading health instead of spinning;
-- kv.alloc denial driving preemption + recompute-on-resume.
+- kv.alloc denial driving preemption + recompute-on-resume;
+- serve.chunk raise mid-chunked-prefill resuming from the committed
+  cursor (ISSUE 9).
 
 Usage::
 
@@ -262,6 +264,52 @@ def case_prefix_cache_fault_degrades():
     sched.block_mgr.check_invariant()
 
 
+def case_chunk_fault_resumes_from_cursor():
+    """serve.chunk raise mid-chunked-prefill (ISSUE 9): the step fails
+    between committed chunks, the cursor and block table stay
+    consistent, and the retried step resumes from the last committed
+    chunk — exact greedy output, invariant clean, pool fully drained."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.resilience.faults import FaultInjected
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=128,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        chunked_prefill={"enabled": True,
+                                         "chunk_tokens": 8})
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("serve.chunk:raise@2"))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 128, (60,)).astype(np.int32)
+    req = sched.submit(prompt, SamplingParams(max_new_tokens=6))
+    faults = steps = 0
+    while sched.has_work():
+        try:
+            sched.step()
+        except FaultInjected:
+            faults += 1
+            sched.block_mgr.check_invariant()   # consistent AT the fault
+            assert req.prefill_pos > 0, "no committed chunk at the fault"
+        steps += 1
+        assert steps < 500, "chunked scheduler wedged after the fault"
+    ref = np.asarray(eng.generate(prompt[None], max_new_tokens=6,
+                                  do_sample=False))[0, prompt.size:]
+    assert faults == 1
+    assert req.state == RequestState.FINISHED
+    assert np.array_equal(np.asarray(req.output_ids), ref)
+    assert sched.block_mgr.num_allocated_blocks == 0
+    sched.block_mgr.check_invariant()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="resilience chaos smoke")
     p.add_argument("--fast", action="store_true",
@@ -293,6 +341,8 @@ def main(argv=None):
                   case_spec_fault_degrades))
     cases.append(("kv.cache fault degrades to full prefill",
                   case_prefix_cache_fault_degrades))
+    cases.append(("serve.chunk fault resumes from committed cursor",
+                  case_chunk_fault_resumes_from_cursor))
 
     results = []
     for name, fn in cases:

@@ -15,6 +15,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
     SERVE_MODE=moe python scripts/serve_bench.py            # mixtral A/B
     SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
+    SERVE_MODE=slo SERVE_LONG_LEN=8192 python scripts/serve_bench.py
     SERVE_MODE=cb python scripts/serve_bench.py --json out.json
 
 ``--json out.json`` (ISSUE 7 satellite) additionally writes the result
@@ -39,6 +40,14 @@ identical greedy outputs asserted — and, with SERVE_INT8_WEIGHTS=1,
 reports the ``weights_floor_moe`` accounting (dense int8 bytes + top-k-
 distinct-expert bytes per decode step — the floor the grouped int8
 path streams at; the einsum path streams ALL E experts).
+SLO mode (ISSUE 9) runs the ADVERSARIAL heavy-prefill workload: a
+steady pool of short chat streams decoding while a few long prompts
+arrive mid-flight (step-scheduled, identical in both runs), A/B'd with
+chunked prefill ON vs OFF — token-identical greedy outputs asserted —
+reporting p50/p99 TPOT and TTFT per SLO class.  The acceptance shape:
+with chunking OFF the chat class's p99 TPOT spikes at each long-prompt
+arrival (the whole prefill runs in one scheduler iteration); with
+chunking ON it stays bounded near p50.
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import argparse
@@ -123,7 +132,8 @@ def main(argv=None):
         # kv-heads/ffn dims — the generic tiny kwargs would not apply
         size = size or "tiny"
         kwargs = {}
-    elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe"):
+    elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe",
+                                          "slo"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -135,8 +145,13 @@ def main(argv=None):
     # cb/spec modes size their own workloads (spec's motif-tiled prompts
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
-    if _mode not in ("cb", "spec", "prefix", "moe"):
+    if _mode not in ("cb", "spec", "prefix", "moe", "slo"):
         cb_ctx = 0
+    elif _mode == "slo":
+        # headroom for the adversarial long prompts (heavy-prefill
+        # overload is the whole point of this mode)
+        cb_ctx = int(os.environ.get(
+            "SERVE_LONG_LEN", 8192 if on_tpu else 640)) + 256
     elif on_tpu:
         cb_ctx = 768 + 384
     elif _mode == "prefix":
@@ -180,6 +195,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "moe":
         return bench_moe_dispatch(model, eng, spec, kv_dtype, quant,
                                   on_tpu, json_path)
+    if os.environ.get("SERVE_MODE") == "slo":
+        return bench_slo_chunked(model, eng, spec, kv_dtype, on_tpu,
+                                 json_path)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -521,6 +539,160 @@ def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
             "goodput_on": on_m.gauges.get("goodput"),
             "goodput_off": off_m.gauges.get("goodput"),
         },
+    }, json_path)
+
+
+def bench_slo_chunked(model, eng, spec, kv_dtype, on_tpu,
+                      json_path=None):
+    """Adversarial heavy-prefill overload (ISSUE 9): a steady pool of
+    short ``chat``-class streams decodes while a few long ``batch``-class
+    prompts arrive mid-flight (at fixed scheduler step counts, identical
+    in both runs).  A/B: chunked prefill ON vs OFF, token-identical
+    greedy outputs asserted.  The record carries p50/p99 TPOT + TTFT per
+    class for both runs — ``bench_compare.py`` gates regressions on the
+    ``*_ms`` keys (lower-better inferred).  The acceptance column is
+    ``chat_tpot_p99_ms``: bounded with chunking on, spiking with it off
+    (each spike = one long prompt's whole prefill inside one scheduler
+    iteration, stalling every chat stream)."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_chat = int(os.environ.get("SERVE_REQS", 16 if on_tpu else 6))
+    n_long = int(os.environ.get("SERVE_LONG", 2))
+    # off-TPU the long prompts must be long enough that the one-shot
+    # prefill's quadratic attention dwarfs a chunk window's cost — the
+    # verify-window programs are per-position compute off-chip (the PR 6
+    # CPU-crossover caveat); on TPU the regime is the real one
+    long_len = int(os.environ.get("SERVE_LONG_LEN",
+                                  8192 if on_tpu else 640))
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK",
+                                      512 if on_tpu else 64))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    arrival_step = int(os.environ.get("SERVE_ARRIVAL_STEP", 8))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    p_lo, p_hi = ((32, 128) if on_tpu else (6, 24))
+    chat_new = int(os.environ.get("SERVE_TOKENS", 128 if on_tpu else 48))
+    chat = [(rng.integers(1, V, (int(pl),)).astype(np.int32), chat_new)
+            for pl in rng.integers(p_lo, p_hi, n_chat)]
+    longs = [(rng.integers(1, V, (long_len,)).astype(np.int32),
+              8 if on_tpu else 4) for _ in range(n_long)]
+    bs = 16 if on_tpu else 8
+    max_len = max(p.size + nn for p, nn in chat + longs)
+    need = -(-max_len // bs) + 1
+    base = dict(
+        block_size=bs, max_num_seqs=max_seqs,
+        num_blocks=1 + need * (max_seqs + n_long),
+        # a realistic per-iteration budget (not the other modes' 1<<30):
+        # the whole point is that chunking turns it into a REAL cap
+        max_num_batched_tokens=max(2048, chunk_tokens * 2),
+        # unfused decode: every chat token's timestamp is one scheduler
+        # iteration, so the inter-token gap IS the interference signal
+        # (a fused window emits k tokens with one timestamp and buries
+        # the spike in zero-width gaps)
+        max_fused_steps=1,
+        slo={"enabled": True,
+             "classes": {"chat": {"tpot_ms": 200.0, "priority": 1},
+                         "batch": {"priority": 0}}})
+
+    def run(chunked):
+        cfg = ServingConfig(**base, chunked_prefill={
+            "enabled": chunked, "chunk_tokens": chunk_tokens})
+        sched = ContinuousBatchingScheduler(
+            model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+        outs = None
+        max_step_prefill = 0
+        for _ in range(2):          # warm compiles, then measure
+            creqs = [sched.submit(p, SamplingParams(max_new_tokens=nn),
+                                  slo_class="chat") for p, nn in chat]
+            lreqs = []
+            t0 = _time.time()
+            steps = 0
+            max_step_prefill = 0
+            while sched.has_work() or len(lreqs) < n_long:
+                sched.step()
+                steps += 1
+                # the boundedness witness: the largest prefill spend any
+                # single iteration saw — chunked it stays ~chunk_tokens,
+                # unchunked it is the whole long prompt in one iteration
+                max_step_prefill = max(
+                    max_step_prefill,
+                    int(sched.metrics.gauges.get("step_prefill_tokens",
+                                                 0)))
+                # long prompts arrive mid-flight, one per arrival
+                # window, while the chat pool is mid-decode — the
+                # step-keyed schedule is identical across the A/B
+                if steps % arrival_step == 0 and len(lreqs) < n_long:
+                    p, nn = longs[len(lreqs)]
+                    lreqs.append(sched.submit(
+                        p, SamplingParams(max_new_tokens=nn),
+                        slo_class="batch"))
+            dt = _time.time() - t0
+            reqs = creqs + lreqs
+            assert all(len(r.output_ids) == nn for r, (_, nn) in
+                       zip(reqs, chat + longs))
+            outs = [list(r.output_ids) for r in reqs]
+        # per-class latency shape: TPOT = every inter-token gap (the
+        # spike detector — a one-iteration 32k prefill shows up as one
+        # huge gap in EVERY concurrent chat stream), TTFT per request
+        gaps = {"chat": [], "batch": []}
+        ttfts = {"chat": [], "batch": []}
+        for cls, rs in (("chat", creqs), ("batch", lreqs)):
+            for r in rs:
+                ttfts[cls].append(r.ttft_s)
+                ts = r.token_times
+                gaps[cls].extend(b - a for a, b in zip(ts, ts[1:]))
+        return dt, gaps, ttfts, outs, sched.metrics, max_step_prefill
+
+    on_s, on_gaps, on_ttft, on_out, on_m, on_maxpf = run(True)
+    off_s, off_gaps, off_ttft, off_out, off_m, off_maxpf = run(False)
+    assert on_out == off_out, \
+        "chunked prefill changed greedy output (parity violation)"
+    pct = lambda xs, q: (round(float(np.percentile(xs, q)) * 1e3, 2)
+                         if xs else None)
+    useful = sum(nn for _, nn in chat + longs)
+    # the backend-independent boundedness witness: with chunking on, no
+    # single iteration may execute (much) more prefill than the chunk
+    # allowance (window bucket rounding allows a few tokens of slack);
+    # with it off, the long prompt's whole prefill lands in ONE iteration
+    assert on_maxpf <= chunk_tokens + 64, \
+        (f"chunked max per-iteration prefill {on_maxpf} blew the "
+         f"chunk_tokens={chunk_tokens} allowance")
+    assert off_maxpf >= long_len, \
+        "unchunked run never monopolized an iteration — workload too small"
+    detail = {
+        "chat_requests": n_chat, "long_requests": n_long,
+        "long_len": long_len, "chunk_tokens": chunk_tokens,
+        "max_num_seqs": max_seqs, "block_size": bs,
+        "chunked_tok_s": round(useful / on_s, 1),
+        "unchunked_tok_s": round(useful / off_s, 1),
+        "max_step_prefill_tokens_on": on_maxpf,
+        "max_step_prefill_tokens_off": off_maxpf,
+        "chunks_deferred": int(on_m.counters["chunks_deferred"]),
+        "slo_violations_on": int(on_m.counters["slo_violations"]),
+        "slo_violations_off": int(off_m.counters["slo_violations"]),
+    }
+    for cls in ("chat", "batch"):
+        detail.update({
+            f"{cls}_tpot_p50_ms": pct(on_gaps[cls], 50),
+            f"{cls}_tpot_p99_ms": pct(on_gaps[cls], 99),
+            f"{cls}_tpot_max_ms": pct(on_gaps[cls], 100),
+            f"{cls}_ttft_p50_ms": pct(on_ttft[cls], 50),
+            f"{cls}_ttft_p99_ms": pct(on_ttft[cls], 99),
+            f"{cls}_tpot_p50_off_ms": pct(off_gaps[cls], 50),
+            f"{cls}_tpot_p99_off_ms": pct(off_gaps[cls], 99),
+            f"{cls}_tpot_max_off_ms": pct(off_gaps[cls], 100),
+            f"{cls}_ttft_p50_off_ms": pct(off_ttft[cls], 50),
+            f"{cls}_ttft_p99_off_ms": pct(off_ttft[cls], 99),
+        })
+    emit({
+        "metric": f"{spec}_serve_slo"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": detail["chat_tpot_p99_ms"],
+        "unit": "chat_p99_tpot_ms",
+        "detail": detail,
     }, json_path)
 
 

@@ -1,0 +1,448 @@
+"""Chunked prefill + SLO-aware QoS (ISSUE 9 tentpole).
+
+The load-bearing contracts:
+
+- **Parity**: greedy output with chunked prefill on is token-identical
+  to chunked-off / static ``generate`` — including the int8 KV cache,
+  a prefix-cache partial hit landing mid-chunk, preemption/resume
+  mid-prefill, and speculative decoding after a chunked prefill
+  completes.  (NOT bitwise in the logits: chunk windows ride the PR 6
+  suffix-prefill verify surface, ~1 ulp from the one-shot prefill.)
+- **Bounded iterations**: a long prompt admitted into a busy batch
+  DEFERS into PREFILLING and is serviced at most ``chunk_tokens`` per
+  iteration — every active decode stream keeps emitting a token every
+  step (the regression for the old first-admission budget escape).
+- **Consistency**: a ``serve.chunk`` fault (raise/deny) mid-prefill
+  leaves the cursor and block table consistent; the request resumes
+  from its last committed chunk with the block-accounting invariant
+  clean (DS_SERVE_DEBUG is armed for every scheduler in this file).
+- **QoS**: admission/chunk service order by SLO class priority, and
+  burn-rate/queue-pressure saturation sheds the lowest class first
+  (RequestShedError → HTTP 429 + Retry-After).
+"""
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.resilience import FaultInjector
+from deepspeed_tpu.resilience.faults import FaultInjected
+from deepspeed_tpu.runtime.config import ServingConfig
+from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                   RequestShedError, RequestState,
+                                   SamplingParams)
+from tests.util import tiny_gpt2
+
+
+@pytest.fixture(autouse=True)
+def _debug_invariant(monkeypatch):
+    """Block-accounting invariant asserted after every scheduler step
+    (the chunked cursor shares pool blocks with decode/spec/prefix —
+    every test in this file runs with the leak detector armed)."""
+    monkeypatch.setenv("DS_SERVE_DEBUG", "1")
+
+
+@pytest.fixture(scope="module")
+def served():
+    """Tiny model with enough context for genuinely long prompts."""
+    m = tiny_gpt2(max_seq_len=256)
+    eng = deepspeed_tpu.init_inference(model=m, config={"dtype": "float32"})
+    return m, eng
+
+
+def _static_reference(eng, prompt, max_new):
+    return np.asarray(eng.generate(prompt[None], max_new_tokens=max_new,
+                                   do_sample=False))[0, prompt.size:]
+
+
+def _prompts(seed=0):
+    rng = np.random.default_rng(seed)
+    long_p = rng.integers(1, 128, (100,)).astype(np.int32)
+    shorts = [rng.integers(1, 128, (int(n),)).astype(np.int32)
+              for n in rng.integers(4, 12, 3)]
+    return long_p, shorts
+
+
+def _cfg(**over):
+    base = dict(block_size=8, num_blocks=64, max_num_seqs=4,
+                max_num_batched_tokens=1 << 20, max_fused_steps=1,
+                chunked_prefill={"enabled": True, "chunk_tokens": 16})
+    base.update(over)
+    return ServingConfig(**base)
+
+
+def _private_flightrec():
+    """Per-test ring: the process-wide recorder accumulates req-<id>
+    events across every scheduler in the pytest process, and request ids
+    restart at 0 per scheduler — event assertions need isolation."""
+    from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+    return FlightRecorder()
+
+
+# ------------------------------------------------------------------ config
+def test_chunked_prefill_config_roundtrip_and_validation():
+    cfg = ServingConfig(
+        chunked_prefill={"enabled": True, "chunk_tokens": 128},
+        slo={"enabled": True, "shed_enabled": True,
+             "shed_burn_threshold": 0.25, "shed_queue_fraction": 0.5,
+             "shed_min_requests": 2, "retry_after_s": 3.0,
+             "classes": {"premium": {"ttft_ms": 100, "priority": 2},
+                         "bulk": {"priority": 0}}})
+    assert cfg.chunked_prefill.enabled and \
+        cfg.chunked_prefill.chunk_tokens == 128
+    assert cfg.slo.classes["premium"].priority == 2
+    assert cfg.slo.retry_after_s == 3.0
+    # defaults: off, and the default class always exists at priority 0
+    d = ServingConfig()
+    assert not d.chunked_prefill.enabled
+    assert d.slo.classes["default"].priority == 0
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        ServingConfig(chunked_prefill={"chunk_tokens": 0})
+    with pytest.raises(ValueError, match="shed_burn_threshold"):
+        ServingConfig(slo={"shed_burn_threshold": 1.5})
+    with pytest.raises(ValueError, match="shed_queue_fraction"):
+        ServingConfig(slo={"shed_queue_fraction": 0.0})
+    with pytest.raises(ValueError, match="shed_min_requests"):
+        ServingConfig(slo={"shed_min_requests": 0})
+    with pytest.raises(ValueError, match="retry_after_s"):
+        ServingConfig(slo={"retry_after_s": -1})
+
+
+# ------------------------------------------------------------------ parity
+def test_chunked_parity_mixed_lengths(served):
+    """Greedy chunked-on == static generate, long + short prompts mixed
+    (the long one spans many chunk iterations)."""
+    m, eng = served
+    long_p, shorts = _prompts(seed=1)
+    sched = ContinuousBatchingScheduler(m, eng.params, _cfg(),
+                                        flightrec=_private_flightrec())
+    work = [(long_p, 6)] + [(p, 8) for p in shorts]
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=n))
+            for p, n in work]
+    sched.run_until_idle()
+    for (p, n), r in zip(work, reqs):
+        assert r.state == RequestState.FINISHED
+        np.testing.assert_array_equal(
+            np.asarray(r.output_ids), _static_reference(eng, p, n))
+    # the long prompt's chunk trail is on the flight recorder, cursors
+    # monotonically increasing to the prompt length (ISSUE 9 telemetry)
+    evs = sched.flightrec.events(corr="req-0",
+                                 kind_prefix="req/prefill_chunk")
+    cursors = [e["cursor"] for e in evs]
+    assert cursors and cursors[-1] == long_p.size
+    assert cursors == sorted(cursors)
+    assert all(e["tokens"] <= 16 for e in evs if "total" in e)
+
+
+def test_chunked_parity_int8_kv(served):
+    m, _ = served
+    eng8 = deepspeed_tpu.init_inference(
+        model=m, config={"dtype": "float32", "kv_cache_dtype": "int8"})
+    long_p, shorts = _prompts(seed=2)
+    sched = ContinuousBatchingScheduler(m, eng8.params, _cfg(),
+                                        kv_cache_dtype="int8")
+    work = [(long_p, 5), (shorts[0], 6)]
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=n))
+            for p, n in work]
+    sched.run_until_idle()
+    for (p, n), r in zip(work, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(r.output_ids), _static_reference(eng8, p, n))
+
+
+def test_chunked_prefix_partial_hit_lands_mid_chunk(served):
+    """Prefix cache × chunked prefill: a second request sharing a long
+    prefix attaches the cached blocks and chunks only its uncached tail
+    — the cursor starts at the (mid-allowance) cache boundary."""
+    m, eng = served
+    rng = np.random.default_rng(3)
+    shared = rng.integers(1, 128, (40,)).astype(np.int32)
+    tail_a = rng.integers(1, 128, (5,)).astype(np.int32)
+    tail_b = rng.integers(1, 128, (37,)).astype(np.int32)
+    pa = np.concatenate([shared, tail_a])
+    pb = np.concatenate([shared, tail_b])
+    sched = ContinuousBatchingScheduler(
+        m, eng.params, _cfg(prefix_cache={"enabled": True}),
+        flightrec=_private_flightrec())
+    ra = sched.submit(pa, SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    rb = sched.submit(pb, SamplingParams(max_new_tokens=6))
+    sched.run_until_idle()
+    assert rb.num_cached_tokens >= 40 - 40 % 8   # full shared blocks hit
+    np.testing.assert_array_equal(
+        np.asarray(ra.output_ids), _static_reference(eng, pa, 4))
+    np.testing.assert_array_equal(
+        np.asarray(rb.output_ids), _static_reference(eng, pb, 6))
+    # b's chunk trail starts at the cache boundary, not 0
+    evs = sched.flightrec.events(corr=f"req-{rb.request_id}",
+                                 kind_prefix="req/prefill_chunk")
+    assert evs and evs[0]["offset"] == rb.num_cached_tokens
+
+
+def test_chunked_preempt_resume_mid_prefill(served):
+    """Pool exhaustion mid-prefill evicts the PREFILLING (lowest-class)
+    row; it resumes from its committed cursor via the prefix cache and
+    completes token-identically."""
+    m, eng = served
+    long_p, _ = _prompts(seed=4)
+    short_p = np.random.default_rng(5).integers(1, 128, (9,)).astype(
+        np.int32)
+    # pool sized so the chat stream's decode growth lands while the
+    # batch prompt is still PREFILLING and finds the free list empty
+    cfg = _cfg(num_blocks=16, max_num_seqs=2,
+               prefix_cache={"enabled": True},
+               chunked_prefill={"enabled": True, "chunk_tokens": 8},
+               slo={"enabled": True,
+                    "classes": {"chat": {"priority": 1},
+                                "batch": {"priority": 0}}})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    rl = sched.submit(long_p, SamplingParams(max_new_tokens=4),
+                      slo_class="batch")
+    rs = sched.submit(short_p, SamplingParams(max_new_tokens=12),
+                      slo_class="chat")
+    steps = 0
+    while sched.has_work():
+        sched.step()
+        steps += 1
+        assert steps < 500
+    assert rl.num_preemptions >= 1
+    # resume re-attached the committed chunks instead of recomputing
+    assert rl.num_cached_tokens > 0
+    np.testing.assert_array_equal(
+        np.asarray(rs.output_ids), _static_reference(eng, short_p, 12))
+    np.testing.assert_array_equal(
+        np.asarray(rl.output_ids), _static_reference(eng, long_p, 4))
+    assert sched.block_mgr.num_allocated_blocks == 0
+
+
+def test_spec_decode_after_chunked_prefill_and_throttle(served):
+    """Speculative decoding composes: a repetitive prompt chunk-prefills
+    then speculates to parity; while another row's chunks are pending,
+    the draft window is clamped (spec auto-throttle)."""
+    m, eng = served
+    motif = np.asarray([9, 23, 4, 17], np.int32)
+    rep_p = np.tile(motif, 6)
+    long_p, _ = _prompts(seed=6)
+    cfg = _cfg(max_num_seqs=2,
+               spec={"mode": "ngram", "max_draft_tokens": 8},
+               chunked_prefill={"enabled": True, "chunk_tokens": 8})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    r1 = sched.submit(rep_p, SamplingParams(max_new_tokens=16))
+    while r1.state != RequestState.DECODE:
+        sched.step()                 # rep_p itself arrives chunked
+    r2 = sched.submit(long_p, SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    np.testing.assert_array_equal(
+        np.asarray(r1.output_ids), _static_reference(eng, rep_p, 16))
+    np.testing.assert_array_equal(
+        np.asarray(r2.output_ids), _static_reference(eng, long_p, 4))
+    c = sched.metrics.counters
+    assert c["spec_verify_steps"] > 0
+    assert c["spec_throttled"] >= 1   # clamped while r2's chunks pending
+
+
+# ----------------------------------------------- bounded-iteration contract
+def test_long_prompt_defers_not_monopolizes(served):
+    """Regression for the old ``_admit`` first-admission escape: a long
+    prompt admitted into a busy batch must NOT run its whole prefill in
+    one iteration — it defers into PREFILLING, spends at most the chunk
+    allowance per step, and every active decode stream keeps emitting
+    every single iteration."""
+    m, eng = served
+    long_p, shorts = _prompts(seed=7)
+    cfg = _cfg(chunked_prefill={"enabled": True, "chunk_tokens": 16})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    rs = [sched.submit(p, SamplingParams(max_new_tokens=24))
+          for p in shorts[:2]]
+    sched.step()                      # shorts prefill + first token
+    rl = sched.submit(long_p, SamplingParams(max_new_tokens=4))
+    saw_prefilling = 0
+    while rl.state in (RequestState.QUEUED, RequestState.PREFILL,
+                       RequestState.PREFILLING):
+        before = [r.num_generated for r in rs]
+        sched.step()
+        if rl.state == RequestState.PREFILLING:
+            saw_prefilling += 1
+            # budget split honored: prefill spend capped by the chunk
+            # allowance (bucket-rounded), decode still ran for each row
+            assert sched.metrics.gauges["step_prefill_tokens"] <= 16
+            for r, b in zip(rs, before):
+                done = r.state == RequestState.FINISHED
+                assert done or r.num_generated == b + 1, \
+                    "decode stream starved during long-prompt prefill"
+    # 100 tokens / 16 per iteration: genuinely spread over many steps
+    assert saw_prefilling >= 5
+    sched.run_until_idle()
+    np.testing.assert_array_equal(
+        np.asarray(rl.output_ids), _static_reference(eng, long_p, 4))
+    for p, r in zip(shorts[:2], rs):
+        np.testing.assert_array_equal(
+            np.asarray(r.output_ids), _static_reference(eng, p, 24))
+
+
+# ------------------------------------------------------------------ faults
+def test_chunk_fault_raise_resumes_from_committed_cursor(served):
+    """``serve.chunk`` raise mid-prefill: the step fails, cursor and
+    block table stay consistent (invariant clean at the fault step), and
+    the next step resumes from the last committed chunk — output
+    token-identical, no leaked blocks."""
+    m, eng = served
+    long_p, _ = _prompts(seed=8)
+    sched = ContinuousBatchingScheduler(
+        m, eng.params,
+        _cfg(chunked_prefill={"enabled": True, "chunk_tokens": 8}),
+        injector=FaultInjector("serve.chunk:raise@2"))
+    req = sched.submit(long_p, SamplingParams(max_new_tokens=4))
+    faults, steps = 0, 0
+    cursor_at_fault = None
+    while sched.has_work():
+        try:
+            sched.step()
+        except FaultInjected:
+            faults += 1
+            cursor_at_fault = req.prefill_pos
+            sched.block_mgr.check_invariant()
+        steps += 1
+        assert steps < 500
+    assert faults == 1
+    # the fault fired between chunks: progress committed before it survived
+    assert cursor_at_fault is not None and cursor_at_fault > 0
+    np.testing.assert_array_equal(
+        np.asarray(req.output_ids), _static_reference(eng, long_p, 4))
+    assert sched.block_mgr.num_allocated_blocks == 0
+
+
+def test_chunk_fault_deny_defers_and_completes(served):
+    """``serve.chunk`` deny: the row is deferred (counted) for the denied
+    iterations and still completes to parity."""
+    m, eng = served
+    long_p, _ = _prompts(seed=9)
+    sched = ContinuousBatchingScheduler(
+        m, eng.params,
+        _cfg(chunked_prefill={"enabled": True, "chunk_tokens": 8}),
+        injector=FaultInjector("serve.chunk:deny@1"))
+    req = sched.submit(long_p, SamplingParams(max_new_tokens=4))
+    sched.run_until_idle()
+    assert sched.metrics.counters["chunks_deferred"] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(req.output_ids), _static_reference(eng, long_p, 4))
+
+
+# --------------------------------------------------------------------- QoS
+def test_shed_cutoff_unit():
+    from deepspeed_tpu.runtime.config import SLOConfig
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    from deepspeed_tpu.telemetry.anomaly import SLOTracker
+    cfg = SLOConfig(enabled=True, shed_enabled=True, shed_min_requests=2,
+                    shed_burn_threshold=0.5, shed_queue_fraction=0.5,
+                    classes={"premium": {"ttft_ms": 10, "priority": 2},
+                             "standard": {"tpot_ms": 10, "priority": 1},
+                             "bulk": {"priority": 0}})
+    slo = SLOTracker(cfg, MetricsRegistry())
+    assert slo.class_priority("premium") == 2
+    assert slo.class_priority("nonsense") == 0      # default's priority
+    assert slo.shed_cutoff(0, 100) is None          # healthy: no shed
+    # a burning mid class sheds only classes BELOW it
+    for _ in range(3):
+        slo.observe("standard", None, 5.0)          # tpot blown
+    cut = slo.shed_cutoff(0, 100)
+    assert cut is not None and cut["priority"] == 1
+    # queue pressure sheds the lowest class outright
+    empty = SLOTracker(cfg, MetricsRegistry())
+    cut = empty.shed_cutoff(60, 100)
+    assert cut is not None and cut["priority"] == 1
+    assert empty.shed_cutoff(10, 100) is None
+    # a class without targets can never burn-shed, and below
+    # shed_min_requests the burn rate is not trusted
+    fresh = SLOTracker(cfg, MetricsRegistry())
+    fresh.observe("premium", 5.0, None)             # 1 < min_requests
+    assert fresh.shed_cutoff(0, 100) is None
+    # no priority ladder (empty / flat classes) -> queue pressure never
+    # sheds: there is no "lowest class" and a cutoff would blanket-429
+    # everything, strictly worse than queueing to the max_queued 429
+    flat = SLOTracker(SLOConfig(enabled=True, shed_enabled=True),
+                      MetricsRegistry())
+    assert flat.shed_cutoff(99, 100) is None
+    flat2 = SLOTracker(
+        SLOConfig(enabled=True, shed_enabled=True,
+                  classes={"a": {"priority": 3}, "b": {"priority": 3},
+                           "default": {"priority": 3}}),
+        MetricsRegistry())
+    assert flat2.shed_cutoff(99, 100) is None
+
+
+def test_shed_lowest_class_first_under_saturation(served):
+    """Injected saturation (premium burning its TTFT target) sheds bulk
+    submissions 429-style with Retry-After while premium still queues;
+    the shed request's flight timeline ends in a terminal reject."""
+    m, eng = served
+    cfg = _cfg(max_queued=8,
+               slo={"enabled": True, "shed_enabled": True,
+                    "shed_min_requests": 2, "shed_burn_threshold": 0.5,
+                    "retry_after_s": 2.0,
+                    "classes": {"premium": {"ttft_ms": 10, "priority": 2},
+                                "bulk": {"priority": 0}}})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg,
+                                        flightrec=_private_flightrec())
+    for _ in range(4):                # premium blowing its target
+        sched.slo.observe("premium", 5.0, None)
+    p = np.random.default_rng(10).integers(1, 128, (6,)).astype(np.int32)
+    with pytest.raises(RequestShedError) as ei:
+        sched.submit(p, SamplingParams(max_new_tokens=2),
+                     slo_class="bulk")
+    assert ei.value.retry_after_s == 2.0
+    assert sched.metrics.counters["rejected_shed"] == 1
+    rejected_id = sched._next_id - 1
+    evs = sched.flightrec.events(corr=f"req-{rejected_id}")
+    assert evs and evs[-1]["kind"] == "req/reject" \
+        and evs[-1]["reason"] == "shed"
+    # premium (above the cutoff) still admits and completes
+    r = sched.submit(p, SamplingParams(max_new_tokens=2),
+                     slo_class="premium")
+    sched.run_until_idle()
+    assert r.state == RequestState.FINISHED
+
+
+def test_chunk_service_orders_by_class_priority(served):
+    """Two PREFILLING rows: the higher class's chunks are serviced
+    first, so it reaches DECODE strictly earlier."""
+    m, eng = served
+    rng = np.random.default_rng(11)
+    pa = rng.integers(1, 128, (64,)).astype(np.int32)
+    pb = rng.integers(1, 128, (64,)).astype(np.int32)
+    cfg = _cfg(max_num_seqs=2,
+               chunked_prefill={"enabled": True, "chunk_tokens": 16},
+               slo={"enabled": True,
+                    "classes": {"chat": {"priority": 1},
+                                "batch": {"priority": 0}}})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    rb = sched.submit(pb, SamplingParams(max_new_tokens=2),
+                      slo_class="batch")
+    ra = sched.submit(pa, SamplingParams(max_new_tokens=2),
+                      slo_class="chat")
+    a_done_step = b_done_step = None
+    steps = 0
+    while sched.has_work():
+        sched.step()
+        steps += 1
+        if a_done_step is None and ra.state != RequestState.PREFILLING \
+                and ra.num_generated:
+            a_done_step = steps
+        if b_done_step is None and rb.state != RequestState.PREFILLING \
+                and rb.num_generated:
+            b_done_step = steps
+        assert steps < 500
+    assert a_done_step < b_done_step, \
+        (f"chat finished prefill at step {a_done_step}, batch at "
+         f"{b_done_step}: class priority did not order chunk service")
+    # anti-starvation aging: among equal-QoS requests the preemption
+    # victim ordering deprioritizes already-preempted rows
+    ra.num_preemptions, rb.num_preemptions = 2, 0
+    ra.slo_class = rb.slo_class = "chat"
+    ra.priority = rb.priority = 0
+    assert sched._qos_key(ra) > sched._qos_key(rb)
+    # deferral was real: the allowance couldn't serve both every step
+    assert sched.metrics.counters["chunks_deferred"] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(ra.output_ids), _static_reference(eng, pa, 2))
+    np.testing.assert_array_equal(
+        np.asarray(rb.output_ids), _static_reference(eng, pb, 2))
