@@ -210,14 +210,19 @@ class Autotuner:
             import jax
             stats = jax.devices()[0].memory_stats() or {}
             hbm = stats.get("bytes_limit")
-        except Exception:
-            pass
+        except Exception as e:
+            # a backend without memory_stats (CPU) degrades to the
+            # unbounded cost model — but say so, silently mis-sized
+            # search spaces are hard to debug
+            logger.debug(f"autotuner: no device memory stats ({e}); "
+                         "HBM ceiling disabled")
         n_dev = 1
         try:
             import jax
             n_dev = len(jax.devices())
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug(f"autotuner: device count probe failed ({e}); "
+                         "assuming 1")
         return CostModel(
             n_params=(probe.meta or {}).get("n_params", 0),
             d_model=getattr(cfg, "d_model", 0),

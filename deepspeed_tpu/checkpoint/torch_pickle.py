@@ -186,8 +186,8 @@ class _TorchUnpickler(pickle.Unpickler):
             import importlib
             try:
                 return getattr(importlib.import_module(module), name)
-            except Exception:
-                pass
+            except (ImportError, AttributeError):
+                pass  # allowlist miss falls through to an inert stub
         # torch dtype globals (torch.float32 ...), argparse.Namespace,
         # Megatron/DeepSpeed classes, and EVERYTHING else — including the
         # rest of numpy (numpy.testing._private.utils.runstring executes

@@ -31,8 +31,11 @@ def _op_report():
         try:
             compatible = b.is_compatible()
             cached = os.path.exists(b.so_path())
-        except Exception:
-            pass
+        except Exception as e:
+            # the report row itself is the surface: a probe crash reads
+            # as [NO], but leave the reason on stderr for bug reports
+            print(f"op probe {b.__class__.__name__} failed: {e}",
+                  file=sys.stderr)
         rows.append((b.__class__.__name__.replace("Builder", "").lower(),
                      compatible, cached))
     return rows

@@ -122,6 +122,9 @@ class AsyncIOHandle:
         if h:
             try:
                 self._lib.ds_aio_handle_free(h)
+            # dslint: disable=DSL005 -- interpreter-teardown __del__: the
+            # shared lib may already be unloaded, and raising from __del__
+            # only prints an unraisable-exception warning anyway
             except Exception:
                 pass
             self._h = None

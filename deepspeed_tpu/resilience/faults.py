@@ -61,6 +61,30 @@ from deepspeed_tpu.utils.logging import logger
 ENV_VAR = "DS_FAULTS"
 ACTIONS = ("raise", "kill", "sigterm", "stall", "deny", "truncate")
 
+#: THE fault-site registry (dslint DSL004): every site fired through
+#: ``check``/``deny``/``truncate_bytes`` anywhere in the tree must be
+#: declared here, and every declared site must still be fired somewhere
+#: — so the chaos matrix (scripts/chaos_smoke.py) can never silently
+#: lose coverage of a hook that was renamed or deleted.  Descriptions
+#: land verbatim in docs/reference/registries.md.
+KNOWN_FAULT_SITES = {
+    "ckpt.save": "engine state serialization during save_checkpoint",
+    "ckpt.aux": "auxiliary checkpoint artifacts (client state, rng)",
+    "ckpt.manifest": "manifest write (shapes/dtypes/crc32 inventory)",
+    "ckpt.publish": "tmp->final atomic rename window of a tag",
+    "ckpt.latest": "the 'latest' pointer write",
+    "train.step": "one engine train_batch iteration",
+    "serve.step": "one serving scheduler iteration (fires outside the "
+                  "scheduler lock)",
+    "serve.spec": "speculative-decode verify pass (degrades to plain "
+                  "decode)",
+    "serve.chunk": "one chunked-prefill window (resumes from the "
+                   "committed cursor)",
+    "kv.alloc": "KV block-pool allocation (deny = pool exhausted)",
+    "kv.cache": "prefix-cache match/attach (deny = cache-blind full "
+                "prefill)",
+}
+
 _SPEC_RE = re.compile(
     r"^(?P<site>[\w.]+):(?P<action>[a-z]+)(?:=(?P<param>[-\w.]+))?"
     r"@(?P<when>\*|\d+\+?|p[0-9.]+s\d+)$")

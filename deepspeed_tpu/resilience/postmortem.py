@@ -184,7 +184,10 @@ def write_postmortem(out_dir: str, reason: str, *,
         logger.error(f"postmortem: manifest write failed: {e}")
     try:
         reg.inc("postmortem/bundles")
-    except Exception:                   # noqa: BLE001
+    # dslint: disable=DSL005 -- write_postmortem must NEVER raise: a
+    # broken metrics registry mid-crash must not mask the bundle that
+    # was already written
+    except Exception:
         pass
     rec.record("postmortem", reason=reason, path=path)
     get_tracer().instant("postmortem", cat="resilience",

@@ -33,6 +33,34 @@ from typing import Any, Dict, List, Optional
 #: overrides, 0 disables recording entirely
 DEFAULT_CAPACITY = 8192
 
+#: THE event-kind registry (dslint DSL004): every kind passed to
+#: ``record()`` anywhere in the tree must be declared here (a trailing
+#: ``/`` declares a prefix family).  Post-mortem tooling and the
+#: /debug/flightrec ``kind=`` filter key on these exact strings, so a
+#: renamed kind without a registry update is silent forensic loss.
+#: Descriptions land verbatim in docs/reference/registries.md.
+KNOWN_EVENT_KINDS = {
+    "req/queue": "request accepted into the scheduler queue",
+    "req/admit": "queued request admitted into a decode slot",
+    "req/resume": "preempted request re-admitted (recompute or "
+                  "prefix-cache re-attach)",
+    "req/prefix_hit": "admission matched cached prefix blocks",
+    "req/prefill_chunk": "one committed chunked-prefill window "
+                         "(cursor/total in fields)",
+    "req/spec_accept": "speculative window verified (accepted length "
+                       "in fields)",
+    "req/preempt": "request evicted under pool pressure",
+    "req/retire": "request finished and its blocks recycled",
+    "req/reject": "terminal admission rejection (too long / queue "
+                  "full / shed)",
+    "req/slo_violation": "request finished over its class targets",
+    "serve/step": "one scheduler iteration (duration, active, queued)",
+    "train/step": "one train_batch iteration (duration)",
+    "anomaly/": "prefix family: step-latency outliers flagged by the "
+                "MAD detector (anomaly/train.step, anomaly/serve.step)",
+    "postmortem": "a post-mortem bundle was written",
+}
+
 
 class FlightRecorder:
     """Bounded ring of structured events.  Thread-safe: one plain lock

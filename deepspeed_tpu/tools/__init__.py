@@ -1,0 +1,8 @@
+"""Repo-native developer tooling (no runtime dependencies).
+
+Packages under here must stay importable without jax/numpy — they run
+in pre-commit hooks and CI collection phases where pulling the full
+framework (and an XLA client) for a lint pass would be absurd.  That is
+also why ``scripts/dslint.py`` imports ``dslint`` directly off this
+directory instead of through ``deepspeed_tpu.__init__``.
+"""

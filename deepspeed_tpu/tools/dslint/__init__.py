@@ -1,0 +1,41 @@
+"""dslint — repo-native static analysis for the hazards this codebase
+has actually paid to discover (ISSUE 10).
+
+Five AST checkers encode the house rules:
+
+- **DSL001 donation-safety** — a buffer donated to a jitted call must
+  not be read afterwards or handed live to a thread/async engine (the
+  PR 3 async-checkpoint race, as a lint rule).
+- **DSL002 lock-discipline** — no blocking I/O inside scheduler-lock
+  bodies; no lock acquisition in the watchdog//debug/flight-recorder
+  read paths, which are lock-free by contract.
+- **DSL003 jit-boundary hygiene** — no Python branching on traced
+  values, no host syncs inside jitted bodies, no per-item ``.item()``
+  syncs in decode/verify hot paths, no unhashable static args.
+- **DSL004 string-registry consistency** — fault sites, DS_* env vars,
+  ``serving.*``/``telemetry.*``/``resilience.*`` config keys, metric
+  names, and flight-recorder event kinds all cross-checked against
+  their declaring registries (built on a generated whole-repo
+  inventory; also keeps ``docs/reference/registries.md`` in sync).
+- **DSL005 resilience hygiene** — bare excepts, swallowed broad
+  exceptions, rename-without-fsync in checkpoint code.
+
+The package is stdlib-only (no jax import) so it can run in hooks and
+collection phases; ``scripts/dslint.py`` is the CLI.  Everything is
+plugin-shaped: subclass :class:`~dslint.core.Checker`, decorate with
+``@register``, drop the module into ``checkers/``.
+"""
+from .core import (Checker, Finding, LintResult, ModuleFile, RULES,
+                   lint_paths, lint_source, load_baseline, register,
+                   render_json, render_text, write_baseline)
+from .inventory import Inventory, generate_registries_md
+
+# importing the subpackage registers every built-in checker
+from . import checkers as _checkers  # noqa: F401  (registration side effect)
+
+__all__ = [
+    "Checker", "Finding", "Inventory", "LintResult", "ModuleFile",
+    "RULES", "generate_registries_md", "lint_paths", "lint_source",
+    "load_baseline", "register", "render_json", "render_text",
+    "write_baseline",
+]

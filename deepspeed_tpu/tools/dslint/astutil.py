@@ -1,0 +1,60 @@
+"""Shared AST helpers for the dslint checkers and inventory.
+
+ONE home for the attribute-chain and literal-collection walkers — a
+future fix (say, seeing through ``ast.Subscript`` links) lands once,
+not once per checker.
+"""
+import ast
+from typing import Iterable, Optional, Set
+
+
+def iter_scope(node: ast.AST,
+               include_root: bool = False) -> Iterable[ast.AST]:
+    """Walk ``node``'s subtree WITHOUT entering nested function /
+    lambda / class bodies — a deferred callback defined under a lock
+    does not execute under it, and a nested def's file writes belong to
+    its own scope.  Nested defs are still yielded (not descended)."""
+    stack = [node] if include_root else list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def dotted(node) -> Optional[str]:
+    """'self.fault_injector' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def int_values(node) -> Set[int]:
+    """Int literals in a constant or tuple/list display (the
+    ``donate_argnums=(0, 1)`` / ``static_argnums=0`` shapes)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)}
+    return set()
+
+
+def str_values(node) -> Set[str]:
+    """Str literals in a constant or tuple/list display (the
+    ``static_argnames=("cfg",)`` shapes)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)}
+    return set()

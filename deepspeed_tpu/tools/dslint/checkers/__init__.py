@@ -1,0 +1,8 @@
+"""Built-in checkers.  Importing this package registers every rule;
+adding a checker = dropping a module here that imports ``register``
+from ``..core`` and decorates a ``Checker`` subclass."""
+from . import donation      # noqa: F401  DSL001
+from . import locks         # noqa: F401  DSL002
+from . import jit_hygiene   # noqa: F401  DSL003
+from . import registries    # noqa: F401  DSL004
+from . import resilience    # noqa: F401  DSL005

@@ -1,0 +1,266 @@
+"""DSL001 — donation safety.
+
+The incident this rule encodes: PR 3's async-save race.  The engine's
+train step donates its state buffers (``jax.jit(...,
+donate_argnums=(0,))``); ``AsyncOrbaxCheckpointEngine.save`` was handed
+the *live* tree and kept zero-copy views while a background thread
+serialized — so the next (donating) train step overwrote the bytes
+being written and the restored checkpoint silently equalled the
+post-mutation state.  The fix is a host snapshot
+(``np.array(a, copy=True)``) before the handoff.
+
+Two flavors are flagged, per lexical scope:
+
+1. **read-after-donate** — a name passed at a donated position of a
+   jit-with-donation callable is read later in the same scope without
+   an intervening rebind.  The donated buffer is dead; XLA may have
+   already reused its memory.
+2. **escape-to-thread/async** — a name that is donated *anywhere* in
+   the scope is also passed (bare, unsnapshotted) to a thread or
+   async-engine sink: ``threading.Thread(...)``, ``executor.submit``,
+   ``*.apply_async``, ``*.run_in_executor``, or any method call on a
+   receiver whose name contains ``async``.  Order doesn't matter — in
+   a loop the donation in iteration N races the background consumer
+   from iteration N-1.  Wrapping the argument in any call (a snapshot:
+   ``np.array(x, copy=True)``, ``jax.device_get(x)``) satisfies the
+   rule.
+"""
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import dotted as _dotted
+from ..astutil import int_values as _int_values
+from ..astutil import str_values as _str_values
+from ..core import Checker, Finding, ModuleFile, register
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_SINK_ATTRS = {"submit", "apply_async", "run_in_executor", "start_soon"}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_ASYNC_RECV_RE = re.compile(r"async", re.IGNORECASE)
+
+
+def _donating_jit(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(donated positions, donated argnames) when ``call`` is
+    ``jax.jit(..., donate_argnums=...)``; None otherwise."""
+    if _dotted(call.func) not in _JIT_NAMES:
+        return None
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums |= _int_values(kw.value)
+        elif kw.arg == "donate_argnames":
+            names |= _str_values(kw.value)
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+def _iter_scope_nodes(body: List[ast.stmt]) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested function/class
+    bodies (each gets its own scope analysis)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+Donors = Dict[str, Tuple[Set[int], Set[str]]]
+
+
+def _collect_donors(body: List[ast.stmt]) -> Donors:
+    """Bindings in this scope to a donating jit callable:
+    ``step = jax.jit(f, donate_argnums=(0,))`` /
+    ``self._fn = jax.jit(...)``."""
+    donors: Donors = {}
+    for node in _iter_scope_nodes(body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @partial(jax.jit, donate_argnums=...) decorated def
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    don = _donating_jit(dec)
+                    if don is None and _dotted(dec.func) in (
+                            "partial", "functools.partial") and dec.args \
+                            and _dotted(dec.args[0]) in _JIT_NAMES:
+                        don = _donating_partial(dec)
+                    if don is not None:
+                        donors[node.name] = don
+            continue
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        don = _donating_jit(node.value)
+        if don is None:
+            # conditional binding: x = jit(...) if cond else jit(...)
+            continue
+        for t in node.targets:
+            name = _dotted(t)
+            if name:
+                donors[name] = don
+    return donors
+
+
+def _donating_partial(call: ast.Call) -> Optional[Tuple[Set[int],
+                                                        Set[str]]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums |= _int_values(kw.value)
+        elif kw.arg == "donate_argnames":
+            names |= _str_values(kw.value)
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+@register
+class DonationSafetyChecker(Checker):
+    rule = "DSL001"
+    name = "donation-safety"
+    doc = ("donated jit buffers must not be read after the call or "
+           "escape live to a thread/async engine (the PR 3 async-save "
+           "race)")
+
+    def check(self, mod: ModuleFile, inv) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        module_donors = _collect_donors(mod.tree.body)
+        # class-level donors: self._fn bound in one method (usually
+        # __init__), called from another
+        class_donors: Dict[ast.ClassDef, Donors] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                merged: Donors = {}
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        for k, v in _collect_donors(meth.body).items():
+                            if k.startswith("self."):
+                                merged[k] = v
+                class_donors[node] = merged
+
+        def analyze(body: List[ast.stmt], inherited: Donors):
+            donors = dict(inherited)
+            donors.update(_collect_donors(body))
+            if not donors:
+                return
+            donations: List[Tuple[str, int]] = []   # (name, lineno)
+            loads: List[Tuple[str, int, ast.AST]] = []
+            stores: List[Tuple[str, int]] = []
+            sinks: List[ast.Call] = []
+            for node in _iter_scope_nodes(body):
+                if isinstance(node, ast.Call):
+                    donations.extend(self._donated_args(node, donors))
+                    if self._is_sink(node):
+                        sinks.append(node)
+                elif isinstance(node, (ast.Name, ast.Attribute)):
+                    name = _dotted(node)
+                    if name is None:
+                        continue
+                    if isinstance(node.ctx, ast.Load):
+                        loads.append((name, node.lineno, node))
+                    else:
+                        stores.append((name, node.lineno))
+            if not donations:
+                return
+            # 1. read-after-donate
+            seen = set()
+            for name, dline in donations:
+                for lname, lline, lnode in loads:
+                    if lname != name or lline <= dline:
+                        continue
+                    if any(sname == name and dline <= sline <= lline
+                           for sname, sline in stores):
+                        continue
+                    key = (name, lline)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(self.finding(
+                        mod, lnode,
+                        f"'{name}' is read after being donated to a "
+                        f"jitted call at line {dline}; the buffer is "
+                        "dead after donation — rebind the result or "
+                        "snapshot to host first"))
+            # 2. escape to thread/async sink (order-independent)
+            donated_names = {name for name, _ in donations}
+            for sink in sinks:
+                for arg in self._sink_args(sink):
+                    name = _dotted(arg)
+                    if name in donated_names:
+                        findings.append(self.finding(
+                            mod, arg,
+                            f"'{name}' is donated to a jitted call in "
+                            "this scope but escapes live to "
+                            f"'{_dotted(sink.func)}' — a background "
+                            "consumer races the donation (the PR 3 "
+                            "async-save bug); pass a host snapshot "
+                            "(np.array(x, copy=True) / "
+                            "jax.device_get) instead"))
+
+        # one ownership pass, not one module walk per function
+        owner: Dict[int, ast.ClassDef] = {}
+        for cls in class_donors:
+            for child in cls.body:
+                owner[id(child)] = cls
+
+        analyze(mod.tree.body, {})
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inherited = dict(module_donors)
+                cls = owner.get(id(node))
+                if cls is not None:
+                    inherited.update(class_donors.get(cls, {}))
+                analyze(node.body, inherited)
+        return findings
+
+    @staticmethod
+    def _donated_args(call: ast.Call, donors: Donors
+                      ) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        key = _dotted(call.func)
+        don = donors.get(key) if key else None
+        if don is None and isinstance(call.func, ast.Call):
+            # immediate call: jax.jit(f, donate_argnums=(0,))(state, b)
+            don = _donating_jit(call.func)
+        if don is None:
+            return out
+        nums, names = don
+        for pos in nums:
+            if pos < len(call.args):
+                name = _dotted(call.args[pos])
+                if name:
+                    out.append((name, call.lineno))
+        for kw in call.keywords:
+            if kw.arg in names:
+                name = _dotted(kw.value)
+                if name:
+                    out.append((name, call.lineno))
+        return out
+
+    @staticmethod
+    def _is_sink(call: ast.Call) -> bool:
+        key = _dotted(call.func)
+        if key in _THREAD_CTORS:
+            return True
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in _SINK_ATTRS:
+                return True
+            recv = _dotted(call.func.value)
+            if recv and _ASYNC_RECV_RE.search(recv):
+                return True
+        return False
+
+    @staticmethod
+    def _sink_args(call: ast.Call) -> Iterable[ast.AST]:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for e in arg.elts:
+                    yield e
+            else:
+                yield arg

@@ -1,0 +1,242 @@
+"""DSL003 — jit-boundary hygiene.
+
+The traced/host boundary is where JAX code rots silently:
+
+1. **Python branching on traced values** — an ``if``/``while`` on a
+   (non-static) parameter of a jitted function either raises a
+   ``TracerBoolConversionError`` at trace time or, worse, constant-
+   folds on the first trace and silently serves stale control flow.
+   Structural tests (``x is None``, ``isinstance``, ``.shape``/
+   ``.ndim``/``.dtype``/``.size``/``len()`` — all static under trace)
+   are exempt.
+2. **Host syncs inside jitted bodies** — ``.item()``, ``.tolist()``,
+   ``np.asarray``/``np.array``/``jax.device_get`` inside a jitted
+   function either fail to trace or silently bake a constant.
+3. **Per-item host syncs in decode/verify hot paths** — ``.item()`` /
+   ``.tolist()`` in the serving hot loop (``_decode*``/``_prefill*``/
+   ``*verify*`` in ``serving/``/``models/serving.py``) turn one batch
+   fetch into per-token device round-trips; fetch once with
+   ``np.asarray`` and index on host.
+4. **Unhashable static args** — a list/dict/set literal passed at a
+   ``static_argnums`` position of a known jitted callable raises
+   ``ValueError: unhashable`` at call time; pass a tuple.
+"""
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..astutil import dotted as _dotted
+from ..astutil import int_values as _int_values
+from ..astutil import str_values as _str_values
+from ..core import Checker, Finding, ModuleFile, register
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_HOST_SYNC_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                     "numpy.array", "jax.device_get", "onp.asarray",
+                     "onp.array"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+_HOT_PATH_FILE_RE = re.compile(r"(serving/.*\.py|models/serving\.py)$")
+_HOT_PATH_FN_RE = re.compile(r"(^_decode|^_spec_decode|^_prefill|verify)")
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static_argnums, static_argnames) when ``call`` is jax.jit(...)."""
+    if _dotted(call.func) not in _JIT_NAMES:
+        # functools.partial(jax.jit, ...) decorator form
+        if _dotted(call.func) in ("partial", "functools.partial") \
+                and call.args and _dotted(call.args[0]) in _JIT_NAMES:
+            pass
+        else:
+            return None
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= _int_values(kw.value)
+        elif kw.arg == "static_argnames":
+            names |= _str_values(kw.value)
+    return nums, names
+
+
+class _JitIndex:
+    """Which function defs are jitted, and with what static args."""
+
+    def __init__(self, tree: ast.AST):
+        #: id(FunctionDef) -> (static_argnums, static_argnames)
+        self.jitted: Dict[int, Tuple[Set[int], Set[str]]] = {}
+        #: local binding name -> (static_argnums, static_argnames) for
+        #: call-site checks (rule 4)
+        self.bindings: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+                info = self._decorated(node)
+                if info is not None:
+                    self.jitted[id(node)] = info
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info = _jit_call_info(node)
+            if info is None:
+                continue
+            # jax.jit(fn, ...) — mark the wrapped local def as jitted
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = defs.get(node.args[0].id)
+                if target is not None:
+                    self.jitted[id(target)] = info
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                info = _jit_call_info(node.value)
+                if info is not None:
+                    for t in node.targets:
+                        name = _dotted(t)
+                        if name:
+                            self.bindings[name] = info
+
+    @staticmethod
+    def _decorated(fn) -> Optional[Tuple[Set[int], Set[str]]]:
+        for dec in fn.decorator_list:
+            if _dotted(dec) in _JIT_NAMES:
+                return set(), set()
+            if isinstance(dec, ast.Call):
+                d = _dotted(dec.func)
+                if d in _JIT_NAMES:
+                    return _jit_call_info(dec) or (set(), set())
+                if d in ("partial", "functools.partial") and dec.args \
+                        and _dotted(dec.args[0]) in _JIT_NAMES:
+                    return _jit_call_info(dec) or (set(), set())
+        return None
+
+
+@register
+class JitHygieneChecker(Checker):
+    rule = "DSL003"
+    name = "jit-boundary-hygiene"
+    doc = ("no Python branches on traced values or host syncs in jitted "
+           "bodies; no per-item .item() syncs in decode/verify hot "
+           "paths; static args must be hashable")
+
+    def check(self, mod: ModuleFile, inv) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        index = _JitIndex(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = index.jitted.get(id(node))
+                if info is not None:
+                    self._check_jitted_body(mod, node, info, findings)
+                elif (_HOT_PATH_FILE_RE.search(mod.relpath)
+                        and _HOT_PATH_FN_RE.search(node.name)):
+                    self._check_hot_path(mod, node, findings)
+            elif isinstance(node, ast.Call):
+                self._check_static_call(mod, node, index, findings)
+        return findings
+
+    # ------------------------------------------------------- jitted body
+    def _check_jitted_body(self, mod, fn, info, findings):
+        nums, names = info
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+        static = {p for i, p in enumerate(params) if i in nums}
+        static |= {p for p in params if p in names}
+        traced = {p for p in params if p not in static and p != "self"}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                bad = self._traced_names_in_test(node.test, traced)
+                if bad:
+                    findings.append(self.finding(
+                        mod, node,
+                        f"Python '{'if' if isinstance(node, ast.If) else 'while'}'"
+                        f" on traced value(s) {sorted(bad)} inside "
+                        f"jitted '{fn.name}' — use jnp.where/lax.cond "
+                        "or mark the arg static"))
+            elif isinstance(node, ast.Call):
+                sync = self._host_sync(node)
+                if sync is not None:
+                    findings.append(self.finding(
+                        mod, node,
+                        f"host sync {sync} inside jitted '{fn.name}' — "
+                        "this either fails to trace or bakes a "
+                        "constant; move it outside the jit boundary"))
+
+    @staticmethod
+    def _host_sync(call: ast.Call) -> Optional[str]:
+        key = _dotted(call.func)
+        if key in _HOST_SYNC_DOTTED:
+            return key
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _HOST_SYNC_METHODS:
+            return f".{call.func.attr}()"
+        return None
+
+    def _traced_names_in_test(self, test, traced: Set[str]) -> Set[str]:
+        """Names of traced params used non-structurally in a test."""
+        bad: Set[str] = set()
+
+        def visit(node, benign: bool):
+            if isinstance(node, ast.Name):
+                if not benign and node.id in traced:
+                    bad.add(node.id)
+                return
+            # x.shape / x.ndim / x.dtype / x.size are static under trace
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _STATIC_ATTRS:
+                return
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d in ("isinstance", "len", "hasattr", "getattr",
+                         "callable", "type"):
+                    return
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    visit(a, benign)
+                return
+            if isinstance(node, ast.Compare):
+                ops = node.ops
+                # `x is None` / `x is not None` are structural
+                if all(isinstance(o, (ast.Is, ast.IsNot)) for o in ops):
+                    comparators = [node.left] + node.comparators
+                    if any(isinstance(c, ast.Constant)
+                           and c.value is None for c in comparators):
+                        return
+            for child in ast.iter_child_nodes(node):
+                visit(child, benign)
+
+        visit(test, False)
+        return bad
+
+    # --------------------------------------------------------- hot paths
+    def _check_hot_path(self, mod, fn, findings):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_SYNC_METHODS:
+                findings.append(self.finding(
+                    mod, node,
+                    f"per-item host sync .{node.func.attr}() in serving "
+                    f"hot path '{fn.name}' — each call is a device "
+                    "round-trip; fetch the batch once with np.asarray "
+                    "and index on host"))
+
+    # ------------------------------------------------------- static args
+    def _check_static_call(self, mod, call, index: _JitIndex, findings):
+        key = _dotted(call.func)
+        info = index.bindings.get(key) if key else None
+        if info is None and isinstance(call.func, ast.Call):
+            info = _jit_call_info(call.func)
+        if info is None:
+            return
+        nums, names = info
+        bad_args = [(i, a) for i, a in enumerate(call.args) if i in nums]
+        bad_args += [(kw.arg, kw.value) for kw in call.keywords
+                     if kw.arg in names]
+        for where, arg in bad_args:
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                kind = type(arg).__name__.lower()
+                findings.append(self.finding(
+                    mod, arg,
+                    f"unhashable {kind} literal passed at static arg "
+                    f"{where!r} of jitted '{key}' — static args are "
+                    "hashed for the compile cache; pass a tuple"))

@@ -1,0 +1,183 @@
+"""DSL002 — lock discipline.
+
+Two contracts, both paid for in incidents:
+
+1. **No blocking operations inside a lock body.**  ``with self._lock:``
+   in the serving scheduler/server guards the step loop; a file write,
+   socket call, or ``time.sleep`` inside it stalls every submitter and
+   the /metrics scrape.  Flagged calls: ``open``, ``time.sleep``,
+   ``os.fsync/replace/rename/remove/unlink/makedirs``, ``subprocess.*``,
+   ``socket.*``, ``urllib``/``requests``, ``.block_until_ready()``,
+   ``.wait_until_finished()``.  (Jit *dispatch* under the scheduler
+   lock is by design — a fresh bucket legitimately compiles for
+   minutes, which is exactly why the watchdog below must stay
+   lock-free.)
+
+2. **No lock acquisition in lock-free-by-contract read paths.**  The
+   watchdog (`resilience/health.py SchedulerWatchdog`), the /debug
+   views, and ``*_unlocked`` helpers exist to observe a scheduler whose
+   wedged ``step()`` is *holding* the lock; if they acquire it (or call
+   a locking scheduler method like ``has_work()``), they join the
+   deadlock they were built to report.  Zones: functions named
+   ``*_unlocked`` or ``debug_*``, everything in ``telemetry/debug.py``,
+   methods of ``*Watchdog`` classes, and any function whose docstring
+   contains ``lock-free``.
+"""
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from ..astutil import dotted as _dotted
+from ..core import Checker, Finding, ModuleFile, register
+
+_LOCK_NAME_RE = re.compile(r"(^|[._])(_?lock)$", re.IGNORECASE)
+
+#: dotted-call blocklist inside lock bodies
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "os.replace", "os.rename", "os.remove",
+    "os.unlink", "os.makedirs", "os.rmdir", "shutil.rmtree",
+    "shutil.copy", "shutil.copytree", "shutil.move",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.socket", "socket.create_connection",
+    "urllib.request.urlopen", "requests.get", "requests.post",
+}
+_BLOCKING_BARE = {"open", "input"}
+_BLOCKING_METHODS = {"block_until_ready", "wait_until_finished"}
+
+#: scheduler methods that take the scheduler lock — calling them from a
+#: lock-free zone deadlocks against a wedged step()
+_LOCKING_SCHED_METHODS = {"has_work", "queue_depth", "active_requests",
+                          "metrics_snapshot", "render_metrics", "submit",
+                          "step", "run_until_idle"}
+
+_ZONE_FILE_RES = (re.compile(r"telemetry/debug\.py$"),)
+_ZONE_FN_RE = re.compile(r"(_unlocked$|^debug_)")
+_WATCHDOG_CLASS_RE = re.compile(r"Watchdog$")
+_DOCSTRING_MARK = "lock-free"
+
+
+def _is_lock_expr(node) -> bool:
+    name = _dotted(node)
+    return bool(name and _LOCK_NAME_RE.search(name))
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    key = _dotted(call.func)
+    if key in _BLOCKING_DOTTED:
+        return key
+    if isinstance(call.func, ast.Name) and call.func.id in _BLOCKING_BARE:
+        return call.func.id
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _BLOCKING_METHODS:
+        return f".{call.func.attr}()"
+    return None
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "DSL002"
+    name = "lock-discipline"
+    doc = ("no blocking I/O inside lock bodies; no lock acquisition in "
+           "watchdog//debug/lock-free-by-contract read paths")
+
+    def check(self, mod: ModuleFile, inv) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._check_lock_bodies(mod, findings)
+        self._check_lockfree_zones(mod, findings)
+        return findings
+
+    # ----------------------------------------------- blocking under lock
+    def _check_lock_bodies(self, mod: ModuleFile,
+                           findings: List[Finding]):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(_is_lock_expr(item.context_expr)
+                       for item in node.items):
+                continue
+            lock_name = next(
+                (_dotted(i.context_expr) for i in node.items
+                 if _is_lock_expr(i.context_expr)), "_lock")
+            # scope-bounded walk: a deferred callback (nested def /
+            # lambda) defined under the lock runs later, outside it;
+            # a nested lock-with reports its own body once, not per
+            # enclosing with
+            stack = [s for s in node.body]
+            while stack:
+                inner = stack.pop()
+                if isinstance(inner, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(inner, (ast.With, ast.AsyncWith)) and any(
+                        _is_lock_expr(i.context_expr)
+                        for i in inner.items):
+                    continue
+                if isinstance(inner, ast.Call):
+                    reason = _blocking_reason(inner)
+                    if reason is not None:
+                        findings.append(self.finding(
+                            mod, inner,
+                            f"blocking call {reason} inside "
+                            f"'with {lock_name}:' — I/O and sleeps "
+                            "under the lock stall every submitter and "
+                            "scrape; move it outside the critical "
+                            "section"))
+                stack.extend(ast.iter_child_nodes(inner))
+
+    # -------------------------------------------------- lock-free zones
+    def _check_lockfree_zones(self, mod: ModuleFile,
+                              findings: List[Finding]):
+        file_zone = any(r.search(mod.relpath) for r in _ZONE_FILE_RES)
+        for cls, fn in self._functions_with_class(mod.tree):
+            zone = (file_zone
+                    or _ZONE_FN_RE.search(fn.name) is not None
+                    or (cls is not None
+                        and _WATCHDOG_CLASS_RE.search(cls.name))
+                    or _DOCSTRING_MARK in (ast.get_docstring(fn) or ""))
+            if not zone:
+                continue
+            for inner in ast.walk(fn):
+                if isinstance(inner, (ast.With, ast.AsyncWith)):
+                    for item in inner.items:
+                        if _is_lock_expr(item.context_expr):
+                            findings.append(self.finding(
+                                mod, inner,
+                                f"'{fn.name}' is lock-free by contract "
+                                "(watchdog//debug/flight-recorder read "
+                                "path) but acquires "
+                                f"'{_dotted(item.context_expr)}' — a "
+                                "wedged step() holding the lock makes "
+                                "this join the deadlock"))
+                elif isinstance(inner, ast.Call):
+                    key = _dotted(inner.func)
+                    if key and key.endswith("._lock.acquire"):
+                        findings.append(self.finding(
+                            mod, inner,
+                            f"'{fn.name}' is lock-free by contract but "
+                            f"calls {key}()"))
+                    elif isinstance(inner.func, ast.Attribute) and \
+                            inner.func.attr in _LOCKING_SCHED_METHODS:
+                        recv = _dotted(inner.func.value) or ""
+                        if re.search(r"sched", recv, re.IGNORECASE):
+                            findings.append(self.finding(
+                                mod, inner,
+                                f"'{fn.name}' is lock-free by contract "
+                                f"but calls {recv}.{inner.func.attr}(), "
+                                "which acquires the scheduler lock — "
+                                "use the *_unlocked variant or a "
+                                "GIL-atomic attribute read"))
+
+    @staticmethod
+    def _functions_with_class(tree: ast.AST):
+        owner = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        owner[id(child)] = node
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield owner.get(id(node)), node

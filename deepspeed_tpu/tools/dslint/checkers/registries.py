@@ -1,0 +1,150 @@
+"""DSL004 — string-registry consistency.
+
+Built on the whole-repo :class:`~dslint.inventory.Inventory`; each
+sub-check is a use/declaration cross-reference:
+
+- a fault site fired by ``injector.check/deny/truncate_bytes`` must be
+  declared in ``resilience/faults.py KNOWN_FAULT_SITES`` (and declared
+  sites must still be fired somewhere — dead sites rot the chaos
+  matrix);
+- a ``DS_*`` env var read must be documented in
+  ``tools/dslint/registry_docs.py ENV_VARS`` (and vice versa);
+- a dotted ``serving.*``/``telemetry.*``/``resilience.*`` key in any
+  code string must resolve against the ``runtime/config.py`` models;
+- a metric emitted through the registry API (or the ServingMetrics
+  counter/gauge dicts) must be documented in ``registry_docs.METRICS``
+  (and vice versa);
+- a flight-recorder event kind must be declared in
+  ``telemetry/flight_recorder.py KNOWN_EVENT_KINDS``;
+- ``docs/reference/registries.md`` must match its generated content
+  (regenerate with ``scripts/dslint.py --write-registries``).
+
+Use-side findings anchor at the use; declaration-side (never-used)
+findings anchor at the declaring file so ``--changed`` runs touching
+only the declaration still see them.
+"""
+import os
+from typing import Iterable, List
+
+from ..core import Checker, Finding, ModuleFile, register
+from ..inventory import (FAULTS_PATH, FLIGHTREC_PATH, REGISTRIES_MD,
+                         Inventory, generate_registries_md)
+
+REGISTRY_DOCS_PATH = "deepspeed_tpu/tools/dslint/registry_docs.py"
+
+
+@register
+class RegistryConsistencyChecker(Checker):
+    rule = "DSL004"
+    name = "string-registry-consistency"
+    doc = ("fault sites, DS_* envs, config keys, metric names, and "
+           "flight-event kinds must match their declaring registries")
+
+    def check(self, mod: ModuleFile, inv: Inventory) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        rel = mod.relpath
+        # ---- use-side: anchored in this module
+        for site, refs in inv.fault_sites_fired.items():
+            if site in inv.fault_sites_declared:
+                continue
+            for r in refs:
+                if r.path == rel:
+                    findings.append(Finding(
+                        path=rel, line=r.line, rule=self.rule,
+                        message=f"fault site '{site}' is fired but not "
+                                f"declared in {FAULTS_PATH} "
+                                "KNOWN_FAULT_SITES"))
+        for name, refs in inv.env_reads.items():
+            if name in inv.env_documented:
+                continue
+            for r in refs:
+                if r.path == rel:
+                    findings.append(Finding(
+                        path=rel, line=r.line, rule=self.rule,
+                        message=f"env var '{name}' is read but not "
+                                f"documented in {REGISTRY_DOCS_PATH} "
+                                "ENV_VARS"))
+        for ref in inv.config_refs:
+            if ref.path != rel:
+                continue
+            if not inv.config_key_exists(ref.value):
+                findings.append(Finding(
+                    path=rel, line=ref.line, rule=self.rule,
+                    message=f"config key '{ref.value}' does not resolve "
+                            "against the deepspeed_tpu/runtime/config.py "
+                            "models"))
+        for name, refs in inv.metrics_emitted.items():
+            if name in inv.metrics_documented:
+                continue
+            for r in refs:
+                if r.path == rel:
+                    findings.append(Finding(
+                        path=rel, line=r.line, rule=self.rule,
+                        message=f"metric '{name}' is emitted but not "
+                                f"documented in {REGISTRY_DOCS_PATH} "
+                                "METRICS"))
+        for kind, refs in inv.flight_kinds_recorded.items():
+            if inv.flight_kind_known(kind):
+                continue
+            for r in refs:
+                if r.path == rel:
+                    findings.append(Finding(
+                        path=rel, line=r.line, rule=self.rule,
+                        message=f"flight-recorder event kind '{kind}' "
+                                f"is recorded but not declared in "
+                                f"{FLIGHTREC_PATH} KNOWN_EVENT_KINDS"))
+        # ---- declaration-side: anchored at the declaring file, emitted
+        # only while checking it (so a full run reports each exactly once)
+        if rel == FAULTS_PATH:
+            for site in sorted(inv.fault_sites_declared):
+                if site not in inv.fault_sites_fired:
+                    findings.append(Finding(
+                        path=rel, line=1, rule=self.rule,
+                        message=f"declared fault site '{site}' is never "
+                                "fired anywhere in the tree (dead "
+                                "declaration — delete it or wire the "
+                                "hook)"))
+        if rel == FLIGHTREC_PATH:
+            for kind in sorted(inv.flight_kinds_declared):
+                if kind.endswith("/"):
+                    used = any(k.startswith(kind)
+                               for k in inv.flight_kinds_recorded)
+                else:
+                    used = kind in inv.flight_kinds_recorded
+                if not used:
+                    findings.append(Finding(
+                        path=rel, line=1, rule=self.rule,
+                        message=f"declared flight-recorder kind "
+                                f"'{kind}' is never recorded anywhere "
+                                "in the tree"))
+        if rel == REGISTRY_DOCS_PATH:
+            for name in sorted(inv.env_documented):
+                if name not in inv.env_reads:
+                    findings.append(Finding(
+                        path=rel, line=1, rule=self.rule,
+                        message=f"ENV_VARS documents '{name}' but "
+                                "nothing in the tree reads it"))
+            for name in sorted(inv.metrics_documented):
+                if name not in inv.metrics_emitted:
+                    findings.append(Finding(
+                        path=rel, line=1, rule=self.rule,
+                        message=f"METRICS documents '{name}' but "
+                                "nothing in the tree emits it"))
+            # generated-doc freshness rides on the docs registry module:
+            # any change to the inventory shows up as drift here
+            md_path = os.path.join(inv.repo_root, REGISTRIES_MD)
+            if inv.repo_root:
+                expected = generate_registries_md(inv)
+                try:
+                    with open(md_path, encoding="utf-8") as f:
+                        actual = f.read()
+                except OSError:
+                    actual = None
+                if actual != expected:
+                    findings.append(Finding(
+                        path=rel, line=1, rule=self.rule,
+                        message=f"{REGISTRIES_MD} is out of sync with "
+                                "the inventory — regenerate with "
+                                "'python scripts/dslint.py "
+                                "--write-registries'"))
+        return findings

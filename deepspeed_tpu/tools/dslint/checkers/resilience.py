@@ -1,0 +1,134 @@
+"""DSL005 — resilience hygiene.
+
+Three patterns that rot crash-safety:
+
+1. **bare ``except:``** — catches ``KeyboardInterrupt``/``SystemExit``
+   and hides the injected faults the chaos harness relies on; name the
+   exception (``except Exception:`` at minimum).
+2. **swallowed broad exceptions** — ``except Exception: pass`` (or
+   ``continue``) silently eats errors; in retry paths this converts a
+   failing save into a missing checkpoint nobody notices.  Narrow
+   except-pass (``except ImportError: pass`` dependency gating) is
+   fine.
+3. **rename-without-fsync in checkpoint code** — ``os.replace``/
+   ``os.rename`` publishing a file written in the same function without
+   any ``fsync`` means the atomic rename can publish zero-length or
+   torn content after a crash (the resilience/ckpt.py protocol exists
+   because of this).  Scoped to checkpoint-ish files
+   (``*ckpt*``/``*checkpoint*`` paths).
+"""
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from ..astutil import dotted as _dotted
+from ..astutil import iter_scope
+from ..core import Checker, Finding, ModuleFile, register
+
+_BROAD = {"Exception", "BaseException"}
+_CKPT_FILE_RE = re.compile(r"(ckpt|checkpoint)", re.IGNORECASE)
+_RENAME_FNS = {"os.replace", "os.rename"}
+
+
+def _exc_names(node) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for e in node.elts for n in _exc_names(e)]
+    d = _dotted(node)
+    return [d] if d else []
+
+
+def _is_trivial_body(body: List[ast.stmt]) -> bool:
+    """Only pass/continue/ellipsis — nothing logged, nothing re-raised,
+    nothing recorded."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Constant):
+            continue  # docstring/ellipsis
+        return False
+    return True
+
+
+def _opens_for_write(fn) -> bool:
+    for node in iter_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id == "open"):
+            continue
+        mode = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if isinstance(mode, str) and any(c in mode for c in "wax+"):
+            return True
+    return False
+
+
+def _has_fsync(fn) -> bool:
+    for node in iter_scope(fn):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d == "os.fsync" or (isinstance(node.func, ast.Attribute)
+                                   and node.func.attr == "fsync"):
+                return True
+    return False
+
+
+@register
+class ResilienceHygieneChecker(Checker):
+    rule = "DSL005"
+    name = "resilience-hygiene"
+    doc = ("no bare excepts or swallowed broad exceptions; checkpoint "
+           "renames must fsync what they publish")
+
+    def check(self, mod: ModuleFile, inv) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler):
+                self._check_handler(mod, node, findings)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_rename_fsync(mod, node, findings)
+        return findings
+
+    def _check_handler(self, mod, node: ast.ExceptHandler,
+                       findings: List[Finding]):
+        names = _exc_names(node.type)
+        bare = node.type is None
+        if bare:
+            findings.append(self.finding(
+                mod, node,
+                "bare 'except:' catches KeyboardInterrupt/SystemExit "
+                "(and injected kill faults) — name the exception"))
+        broad = bare or any(n.split(".")[-1] in _BROAD for n in names)
+        if broad and _is_trivial_body(node.body):
+            findings.append(self.finding(
+                mod, node,
+                "broad exception silently swallowed (body is only "
+                "pass/continue) — log it, narrow the type, or handle "
+                "it; in retry paths this hides real failures"))
+
+    def _check_rename_fsync(self, mod, fn, findings: List[Finding]):
+        if not _CKPT_FILE_RE.search(mod.relpath):
+            return
+        # own-scope only: a nested def's writes/renames are analyzed
+        # when the walk reaches that def itself — pairing an outer
+        # fn's rename with an inner fn's write conflates scopes
+        renames = [n for n in iter_scope(fn)
+                   if isinstance(n, ast.Call)
+                   and _dotted(n.func) in _RENAME_FNS]
+        if not renames:
+            return
+        if _opens_for_write(fn) and not _has_fsync(fn):
+            findings.append(self.finding(
+                mod, renames[0],
+                f"'{fn.name}' writes a file and publishes it with "
+                f"{_dotted(renames[0].func)} without any fsync — after "
+                "a crash the rename can publish torn/empty content "
+                "(resilience/ckpt.py protocol: write tmp, fsync, "
+                "rename)"))

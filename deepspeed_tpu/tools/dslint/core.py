@@ -1,0 +1,437 @@
+"""dslint visitor core: findings, checker registry, suppressions,
+baseline, and the lint driver.
+
+Design contract (ISSUE 10):
+
+- a checker sees one parsed :class:`ModuleFile` plus the whole-repo
+  :class:`~dslint.inventory.Inventory` and yields :class:`Finding`s;
+- ``# dslint: disable=DSL00X -- why`` suppresses a rule on that line
+  (or, on a ``def``/``class``/``with``/``for``/``try`` header, over the
+  whole compound statement); a suppression **must** carry a ``-- why``
+  justification or it is itself a finding (DSL000);
+- the committed baseline (``baseline.json``) grandfathers findings by
+  ``(rule, path, message)`` — line-number drift does not resurrect
+  them, and stale entries are reported so the baseline only shrinks.
+"""
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: rule id -> Checker subclass (the plugin registry)
+RULES: Dict[str, type] = {}
+
+#: rule id for framework-level findings (parse errors, malformed or
+#: unjustified suppressions) — not a pluggable checker
+META_RULE = "DSL000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dslint:\s*(disable|disable-file)\s*=\s*"
+    r"(?P<rules>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s+--\s*(?P<why>\S.*))?")
+
+_DEF_EXTS = (".py",)
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding.  Identity for baseline purposes is
+    ``(rule, path, message)`` — deliberately line-free, so edits above a
+    grandfathered finding don't resurrect it."""
+    path: str          # repo-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class Checker:
+    """Base checker.  Subclass, set ``rule``/``name``/``doc``, implement
+    :meth:`check`, and decorate with :func:`register`."""
+
+    rule = "DSL999"
+    name = "unnamed"
+    #: one-line description shown by ``scripts/dslint.py --rules``
+    doc = ""
+
+    def check(self, mod: "ModuleFile", inv) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ helpers
+    def finding(self, mod: "ModuleFile", node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1) if node is not None else 1
+        return Finding(path=mod.relpath, line=line, rule=self.rule,
+                       message=message)
+
+
+def register(cls):
+    """Plugin hook: ``@register`` adds the checker class to RULES."""
+    if cls.rule in RULES and RULES[cls.rule] is not cls:
+        raise ValueError(f"duplicate dslint rule id {cls.rule}: "
+                         f"{RULES[cls.rule].__name__} vs {cls.__name__}")
+    RULES[cls.rule] = cls
+    return cls
+
+
+# --------------------------------------------------------------- modules
+class ModuleFile:
+    """One parsed source file: AST + per-line suppression map.
+
+    ``suppress_ranges`` maps a rule id to a list of (start, end) line
+    ranges (inclusive).  A suppression comment on a compound-statement
+    header line (``def``/``with``/``for``/``class``/``try``/``if``)
+    covers the statement's whole body, so one justified comment can
+    bless a deliberate zone (e.g. the watchdog's lock-free reads).
+    """
+
+    def __init__(self, abspath: str, relpath: str, source: str):
+        self.abspath = abspath
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)  # may raise
+        self.meta_findings: List[Finding] = []
+        self._line_rules: Dict[int, Set[str]] = {}
+        #: next-code-line targets of standalone comments — line-scoped,
+        #: never widened to a compound statement's range
+        self._next_line_rules: Dict[int, Set[str]] = {}
+        self._file_rules: Set[str] = set()
+        self._parse_suppressions()
+        self.suppress_ranges = self._expand_ranges()
+
+    # -------------------------------------------------------- suppression
+    def _comment_lines(self):
+        """(lineno, comment text) via tokenize — a docstring that merely
+        *mentions* the suppression syntax must not parse as one."""
+        import io
+        import tokenize
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            return [(tok.start[0], tok.string) for tok in tokens
+                    if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            return []
+
+    def _parse_suppressions(self):
+        for i, text in self._comment_lines():
+            if "dslint" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                if re.search(r"#\s*dslint\s*:", text):
+                    self.meta_findings.append(Finding(
+                        path=self.relpath, line=i, rule=META_RULE,
+                        message="malformed dslint comment (expected "
+                                "'# dslint: disable=DSL00X -- why')"))
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            why = m.group("why")
+            if not why:
+                self.meta_findings.append(Finding(
+                    path=self.relpath, line=i, rule=META_RULE,
+                    message="suppression without justification (append "
+                            "' -- <why this pattern is deliberate>')"))
+            unknown = {r for r in rules
+                       if r not in RULES and r != META_RULE}
+            if unknown:
+                self.meta_findings.append(Finding(
+                    path=self.relpath, line=i, rule=META_RULE,
+                    message=f"suppression names unknown rule(s) "
+                            f"{sorted(unknown)}"))
+            if m.group(1) == "disable-file":
+                self._file_rules |= rules
+            elif self.lines[i - 1].lstrip().startswith("#"):
+                # a standalone comment suppresses the NEXT code line
+                # only (the justified-suppression-above-an-except
+                # idiom).  Deliberately line-scoped: it must not widen
+                # to a following compound statement's whole body.
+                target = self._next_code_line(i)
+                if target is not None:
+                    self._next_line_rules.setdefault(
+                        target, set()).update(rules)
+            else:
+                self._line_rules.setdefault(i, set()).update(rules)
+
+    def _next_code_line(self, after: int) -> Optional[int]:
+        for j in range(after, len(self.lines)):
+            text = self.lines[j].strip()
+            if text and not text.startswith("#"):
+                return j + 1
+        return None
+
+    def _expand_ranges(self) -> Dict[str, List[Tuple[int, int]]]:
+        ranges: Dict[str, List[Tuple[int, int]]] = {}
+        for src in (self._line_rules, self._next_line_rules):
+            for line, rules in src.items():
+                for r in rules:
+                    ranges.setdefault(r, []).append((line, line))
+        # a suppression on a compound-statement header covers its body
+        for node in ast.walk(self.tree):
+            lineno = getattr(node, "lineno", None)
+            end = getattr(node, "end_lineno", None)
+            if lineno is None or end is None or end <= lineno:
+                continue
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.With, ast.For,
+                                     ast.While, ast.If, ast.Try,
+                                     ast.ExceptHandler)):
+                continue
+            # ONLY the header line itself widens the scope — a
+            # suppression on the first body line must stay line-scoped,
+            # or one blessed line would silently cover the whole body
+            for r in self._line_rules.get(lineno, ()):
+                ranges.setdefault(r, []).append((lineno, end))
+        return ranges
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_rules:
+            return True
+        for start, end in self.suppress_ranges.get(rule, ()):
+            if start <= line <= end:
+                return True
+        return False
+
+    # ------------------------------------------------------------ helpers
+    def dotted(self, node) -> Optional[str]:
+        """'self.fault_injector' for Attribute/Name chains, else None."""
+        from .astutil import dotted
+        return dotted(node)
+
+
+# --------------------------------------------------------------- results
+@dataclass
+class LintResult:
+    findings: List[Finding]          # post-suppression, post-baseline
+    baselined: List[Finding]         # matched a baseline entry
+    stale_baseline: List[dict]       # baseline entries nothing matched
+    files_checked: int
+    #: repo-relative paths this run actually examined — a scoped
+    #: --write-baseline must not touch entries outside this set
+    checked_paths: frozenset = frozenset()
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def collect_files(paths: Sequence[str], repo_root: str) -> List[str]:
+    """Expand files/directories into a sorted list of .py files.
+
+    A path that doesn't exist raises — a typo'd directory in a CI hook
+    must fail loudly, not report the tree clean forever."""
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if not os.path.exists(ap):
+            raise FileNotFoundError(f"dslint: no such file or "
+                                    f"directory: {p}")
+        if os.path.isfile(ap):
+            if ap.endswith(_DEF_EXTS) or _is_python_script(ap):
+                out.append(os.path.abspath(ap))
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                if fn.endswith(_DEF_EXTS) or (
+                        os.sep + "bin" + os.sep in full + os.sep
+                        and _is_python_script(full)):
+                    out.append(os.path.abspath(full))
+    return sorted(set(out))
+
+
+def _is_python_script(path: str) -> bool:
+    """bin/ entry points have no .py suffix; sniff the shebang."""
+    if path.endswith(_DEF_EXTS):
+        return False
+    try:
+        with open(path, "rb") as f:
+            first = f.readline(80)
+    except OSError:
+        return False
+    return first.startswith(b"#!") and b"python" in first
+
+
+def load_modules(files: Sequence[str], repo_root: str
+                 ) -> Tuple[List[ModuleFile], List[Finding]]:
+    out: List[ModuleFile] = []
+    errors: List[Finding] = []
+    for path in files:
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            errors.append(Finding(path=rel, line=1, rule=META_RULE,
+                                  message=f"unreadable: {e}"))
+            continue
+        try:
+            out.append(ModuleFile(path, rel, source))
+        except SyntaxError as e:
+            errors.append(Finding(path=rel, line=e.lineno or 1,
+                                  rule=META_RULE,
+                                  message=f"syntax error: {e.msg}"))
+    return out, errors
+
+
+# -------------------------------------------------------------- baseline
+def baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, "deepspeed_tpu", "tools", "dslint",
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", []) if isinstance(doc, dict) else doc
+    return [e for e in entries if isinstance(e, dict)
+            and {"rule", "path", "message"} <= set(e)]
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   keep: Sequence[dict] = ()) -> None:
+    """Write the baseline from ``findings`` plus ``keep`` — existing
+    entries a scoped run did not examine and therefore must not drop
+    (the --changed + --write-baseline combination)."""
+    entries = sorted({(f.rule, f.path, f.message) for f in findings}
+                     | {(e["rule"], e["path"], e["message"])
+                        for e in keep})
+    doc = {
+        "comment": "dslint grandfathered findings. Entries match by "
+                   "(rule, path, message) — line drift is tolerated. "
+                   "This file should only ever shrink; fix the finding "
+                   "or add an inline justified suppression instead of "
+                   "growing it.",
+        "entries": [{"rule": r, "path": p, "message": m}
+                    for r, p, m in entries],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def _apply_baseline(findings: List[Finding], baseline: List[dict]
+                    ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    keys = {(e["rule"], e["path"], e["message"]) for e in baseline}
+    new, grandfathered = [], []
+    used = set()
+    for f in findings:
+        if f.key() in keys:
+            grandfathered.append(f)
+            used.add(f.key())
+        else:
+            new.append(f)
+    stale = [e for e in baseline
+             if (e["rule"], e["path"], e["message"]) not in used]
+    return new, grandfathered, stale
+
+
+# ---------------------------------------------------------------- driver
+def lint_paths(paths: Sequence[str], repo_root: str,
+               rules: Optional[Sequence[str]] = None,
+               baseline: Optional[Sequence[dict]] = None,
+               inventory=None) -> LintResult:
+    """Run the registered checkers over ``paths``.
+
+    The DSL004 inventory always scans the whole repo (declarations live
+    in files that may be out of scope) while findings are only emitted
+    for in-scope files — so ``--changed`` mode stays sound.
+    """
+    from .inventory import Inventory
+    files = collect_files(paths, repo_root)
+    modules, findings = load_modules(files, repo_root)
+    if inventory is None:
+        # hand over the already-parsed trees — the inventory scans the
+        # whole repo but must not re-read/re-parse the in-scope files
+        inventory = Inventory.build(
+            repo_root, parsed={m.relpath: m.tree for m in modules})
+    active = [RULES[r]() for r in sorted(RULES)
+              if rules is None or r in rules]
+    for mod in modules:
+        findings.extend(f for f in mod.meta_findings
+                        if rules is None or META_RULE in rules
+                        or f.rule != META_RULE)
+        for checker in active:
+            for f in checker.check(mod, inventory):
+                if not mod.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort()
+    if baseline is None:
+        baseline = load_baseline(baseline_path(repo_root))
+    scoped_paths = frozenset(
+        os.path.relpath(f, repo_root).replace(os.sep, "/")
+        for f in files)
+    scoped_baseline = [e for e in baseline if e["path"] in scoped_paths]
+    new, grandfathered, stale = _apply_baseline(findings, scoped_baseline)
+    return LintResult(findings=new, baselined=grandfathered,
+                      stale_baseline=stale, files_checked=len(files),
+                      checked_paths=scoped_paths)
+
+
+def lint_source(source: str, relpath: str = "snippet.py",
+                rules: Optional[Sequence[str]] = None,
+                inventory=None, repo_root: Optional[str] = None
+                ) -> List[Finding]:
+    """Test/embedding helper: lint a source string in memory.
+
+    ``inventory`` may be a prebuilt Inventory (DSL004 needs one); when
+    omitted an empty inventory is used, which effectively disables the
+    cross-repo consistency checks for the snippet.
+    """
+    from .inventory import Inventory
+    mod = ModuleFile(relpath, relpath, source)
+    inv = inventory if inventory is not None else Inventory.empty()
+    out = list(mod.meta_findings)
+    for rule in sorted(RULES):
+        if rules is not None and rule not in rules:
+            continue
+        for f in RULES[rule]().check(mod, inv):
+            if not mod.is_suppressed(f.rule, f.line):
+                out.append(f)
+    if rules is not None and META_RULE not in rules:
+        out = [f for f in out if f.rule != META_RULE]
+    return sorted(out)
+
+
+# ---------------------------------------------------------------- output
+def render_text(result: LintResult, verbose: bool = False) -> str:
+    lines = [f.format() for f in result.findings]
+    if verbose and result.baselined:
+        lines.append(f"# {len(result.baselined)} grandfathered finding(s) "
+                     "suppressed by baseline")
+    for e in result.stale_baseline:
+        lines.append(f"# stale baseline entry (fixed? prune it): "
+                     f"{e['rule']} {e['path']}: {e['message']}")
+    counts: Dict[str, int] = {}
+    for f in result.findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
+    lines.append(f"dslint: {len(result.findings)} finding(s) in "
+                 f"{result.files_checked} file(s)"
+                 + (f" [{summary}]" if summary else ""))
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps({
+        "findings": [f.to_json() for f in result.findings],
+        "baselined": [f.to_json() for f in result.baselined],
+        "stale_baseline": result.stale_baseline,
+        "files_checked": result.files_checked,
+        "ok": result.ok,
+    }, indent=2) + "\n"

@@ -1,0 +1,160 @@
+"""Curated documentation tables for the registries that have no
+in-code declaration site: DS_* environment variables and metric names.
+
+DSL004 enforces both directions: a ``DS_*`` read (or a metric emission)
+with no entry here fails the lint, and an entry here that nothing in
+the tree reads/emits fails too — so this file can neither lag nor
+bloat.  ``docs/reference/registries.md`` is generated from these plus
+the scanned use sites (``scripts/dslint.py --write-registries``).
+
+Keep descriptions to one line; they land verbatim in the generated
+reference tables.
+"""
+
+#: DS_* environment variable -> one-line description
+ENV_VARS = {
+    "DS_ACCELERATOR": "force the accelerator backend (tpu/cpu) instead "
+                      "of auto-detection",
+    "DS_FAULTS": "fault-injection spec string (site:action[=param]@when;"
+                 " appended to resilience.faults)",
+    "DS_FLASH_KERNEL": "attention dispatch override: pallas flash kernel"
+                       " vs xla reference",
+    "DS_FLASH_VMEM_MB": "VMEM budget the flash-attention block-size "
+                        "autotuner fits under",
+    "DS_GGEMM_BLOCKS": "grouped-GEMM (bm,bk,bn) block-shape override "
+                       "(ggemm_sweep winners)",
+    "DS_GGEMM_INTERPRET": "run the grouped-GEMM Pallas kernels in "
+                          "interpret mode (CPU tier-1)",
+    "DS_MOE_DISPATCH": "MoE expert-dispatch override: auto/einsum/"
+                       "grouped (wins over config)",
+    "DS_PEAK_FLOPS": "per-device peak FLOPs for MFU math (wins over "
+                     "telemetry.peak_flops)",
+    "DS_QGEMM": "0 disables the fused-dequant int8 qgemm kernel "
+                "(per-layer dequant fallback)",
+    "DS_QGEMM_BLOCKS": "qgemm (bm,bk,bn) block-shape override "
+                       "(qgemm_sweep winners)",
+    "DS_QGEMM_INTERPRET": "run the qgemm Pallas kernel in interpret "
+                          "mode (CPU tier-1)",
+    "DS_QUANT_SCAN_THRESHOLD_MB": "int8 decode loop-form threshold "
+                                  "(wins over serving."
+                                  "quant_scan_threshold_mb)",
+    "DS_RESUME": "checkpoint tag to resume from ('latest' after a "
+                 "preemption exit-86 restart)",
+    "DS_SERVE_DEBUG": "1 arms the per-step block-pool invariant check "
+                      "(O(num_blocks) under the lock)",
+    "DS_SERVE_STALL_TIMEOUT_S": "scheduler-watchdog stall verdict "
+                                "override (wins over serving."
+                                "stall_timeout_s)",
+    "DS_SPEC_VERIFY": "'scan' forces the scan_verify_fn fallback for "
+                      "speculative verification",
+    "DS_TRACE": "Chrome-trace output path; arms span tracing (wins "
+                "over telemetry.trace)",
+}
+
+#: metric name (as exposed on /metrics, after the ServingMetrics
+#: ``serving/`` prefix normalization) -> one-line description
+METRICS = {
+    # --- training engine
+    "train/steps": "train_batch iterations completed",
+    "train/step_latency_s": "per-step wall-clock histogram",
+    "train/tokens_per_s": "training token throughput gauge",
+    "train/model_flops_per_s": "achieved model FLOP/s gauge",
+    "train/mfu": "model FLOPs utilization vs device peak",
+    "train/profiled_flops_per_s": "flops-profiler measured FLOP/s",
+    "train/profiled_mfu": "flops-profiler measured MFU",
+    # --- checkpointing
+    "ckpt/saves": "checkpoint publishes (sync + async)",
+    "ckpt/restores": "checkpoint restores",
+    "ckpt/save_duration_s": "stage+publish duration histogram",
+    "ckpt/restore_duration_s": "restore duration histogram",
+    "ckpt/fallbacks": "restores that fell back to an older valid tag",
+    "retry/retries": "checkpoint-I/O retry attempts, labeled by op",
+    # --- anomaly / postmortem
+    "anomaly/last_score": "most recent MAD score per step kind",
+    "postmortem/bundles": "post-mortem bundles written",
+    # --- MoE routing health
+    "moe/dispatch_tokens": "tokens routed into expert dispatch",
+    "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "
+                          "grouped pins 0)",
+    "moe_drop_fraction": "dropped/dispatched fraction gauge",
+    # --- serving: request lifecycle counters
+    "serving/received": "requests accepted into the queue",
+    "serving/completed": "requests finished",
+    "serving/resumed": "preempted requests re-admitted",
+    "serving/preemptions": "evictions under pool pressure",
+    "serving/rejected_too_long": "rejections: prompt+max_new exceeds "
+                                 "capacity",
+    "serving/rejected_queue_full": "rejections: queue at max_queued",
+    "serving/rejected_timeout": "rejections: queued past timeout",
+    "serving/rejected_shed": "rejections: SLO overload shedding (429 + "
+                             "Retry-After)",
+    "serving/rejected_not_accepting": "rejections: draining/degraded "
+                                      "server",
+    # --- serving: throughput / tokens
+    "serving/generated_tokens": "decode tokens emitted",
+    "serving/prefill_tokens": "prompt tokens prefilled",
+    "serving/recomputed_tokens": "tokens recomputed after preemption "
+                                 "(goodput loss)",
+    "serving/decode_steps": "jitted decode dispatches",
+    "serving/tokens_per_s": "cumulative decode rate gauge",
+    "serving/goodput": "non-recomputed fraction of generated tokens",
+    "serving/step_prefill_tokens": "this iteration's prefill token "
+                                   "spend gauge",
+    "serving/step_decode_tokens": "this iteration's decode emissions "
+                                  "gauge",
+    "serving/chunks_deferred": "chunked-prefill windows deferred by the "
+                               "per-iteration allowance",
+    # --- serving: occupancy / health
+    "serving/queue_depth": "queued requests gauge",
+    "serving/active_seqs": "occupied decode slots gauge",
+    "serving/decode_occupancy": "active/max_num_seqs histogram",
+    "serving/prefill_batch_tokens": "per-iteration prefill batch-size "
+                                    "histogram",
+    "serving/block_pool_utilization": "allocated fraction of the KV "
+                                      "pool",
+    "serving/free_blocks": "free-list size gauge",
+    "serving/loop_failures": "consecutive serving-loop step failures",
+    "serving/stalls": "watchdog stall verdicts",
+    "serving/health_state": "numeric health state (0=ready .. "
+                            "4=stopped)",
+    # --- serving: latency histograms (+ quantile gauges)
+    "serving/ttft_s": "time-to-first-token histogram",
+    "serving/token_latency_s": "per-token decode latency histogram",
+    "serving/latency_s": "end-to-end request latency histogram",
+    "serving/queue_wait_s": "admission queue wait histogram",
+    # --- serving: prefix cache
+    "serving/prefix_cache_hit": "admissions that attached cached "
+                                "blocks",
+    "serving/prefix_cache_miss": "admissions with no usable cached "
+                                 "prefix",
+    "serving/prefix_cache_evict": "cached blocks evicted from the LRU",
+    "serving/prefix_cache_cow_forks": "copy-on-write forks of a cached "
+                                      "block",
+    "serving/prefix_cache_hit_rate": "hit/(hit+miss) gauge",
+    "serving/cached_blocks": "refcount-0 blocks retained in the cache",
+    # --- serving: speculative decoding
+    "serving/spec_drafted_tokens": "draft tokens proposed",
+    "serving/spec_accepted_tokens": "draft tokens accepted by verify",
+    "serving/spec_rolled_back_tokens": "draft tokens rolled back",
+    "serving/spec_verify_steps": "speculative verify dispatches",
+    "serving/spec_faults": "serve.spec faults degraded to plain decode",
+    "serving/spec_auto_disabled": "requests whose accept EMA disabled "
+                                  "drafting",
+    "serving/spec_throttled": "draft-k clamps while prefill chunks "
+                              "pending",
+    "serving/spec_accept_rate": "accepted/drafted gauge",
+    "serve/spec_accept_len": "tokens emitted per verify pass histogram "
+                             "(+ p50/p90/p99/mean gauges)",
+    # --- serving: SLO accounting
+    "serving/slo_requests": "finished requests with SLO accounting, "
+                            "labeled by class",
+    "serving/slo_violations": "requests over their class targets",
+    "serving/slo_ttft_violations": "TTFT target misses, labeled by "
+                                   "class",
+    "serving/slo_tpot_violations": "TPOT target misses, labeled by "
+                                   "class",
+    "serving/slo_ttft_burn_rate": "rolling TTFT violation fraction "
+                                  "gauge",
+    "serving/slo_tpot_burn_rate": "rolling TPOT violation fraction "
+                                  "gauge",
+}

@@ -29,13 +29,22 @@ import subprocess
 import sys
 import tempfile
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# no persistent compile cache: donated train steps over restored state
-# under a warm cache corrupt the heap on old jaxlibs (see
-# tests/test_resilience.py), and this runner restores constantly
-os.environ.setdefault("XLA_FLAGS", "--xla_backend_optimization_level=0")
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, ROOT)
+
+
+def _setup_process_env():
+    """CLI-entry environment prep.  Deliberately NOT at module import —
+    dslint (and anything else) must be able to import this file as a
+    module without it mutating os.environ or sys.path (ISSUE 10).  Runs
+    before the first jax import (every case imports jax lazily)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # no persistent compile cache: donated train steps over restored
+    # state under a warm cache corrupt the heap on old jaxlibs (see
+    # tests/test_resilience.py), and this runner restores constantly
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_backend_optimization_level=0")
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)
 
 
 def _make_engine(tmp, async_save=False):
@@ -77,6 +86,8 @@ def case_ckpt_fault(spec, async_save):
         try:
             engine.save_checkpoint(tmp)
             engine.wait_pending_checkpoint()
+        # dslint: disable=DSL005 -- the armed fault spec is SUPPOSED to
+        # fail this save; the asserts below verify fallback recovery
         except Exception:
             pass
         engine.fault_injector = NULL_INJECTOR
@@ -317,6 +328,7 @@ def main(argv=None):
     p.add_argument("--child-ckpt", metavar="DIR", default=None,
                    help=argparse.SUPPRESS)   # internal: kill-case worker
     args = p.parse_args(argv)
+    _setup_process_env()
     if args.child_ckpt:
         return child_ckpt(args.child_ckpt)
 

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""dslint CLI — repo-native static analysis (ISSUE 10).
+
+Usage::
+
+    python scripts/dslint.py                      # default scope
+    python scripts/dslint.py deepspeed_tpu/       # explicit paths
+    python scripts/dslint.py --changed            # git-diff-scoped
+    python scripts/dslint.py --json               # machine output
+    python scripts/dslint.py --rules              # rule catalog
+    python scripts/dslint.py --select DSL002      # one rule only
+    python scripts/dslint.py --write-baseline     # regrandfather
+    python scripts/dslint.py --write-registries   # regen the docs table
+
+Exit codes: 0 clean (modulo baseline), 1 findings, 2 usage/internal
+error.  The tool is stdlib-only — it never imports jax — so it is safe
+in pre-commit hooks and collection phases.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# import the tool WITHOUT deepspeed_tpu.__init__ (which pulls jax):
+# deepspeed_tpu/tools is designed to be importable standalone
+sys.path.insert(0, os.path.join(ROOT, "deepspeed_tpu", "tools"))
+
+import dslint  # noqa: E402
+from dslint.core import baseline_path, load_baseline  # noqa: E402
+from dslint.inventory import REGISTRIES_MD, SCAN_ROOTS  # noqa: E402
+
+DEFAULT_PATHS = [r for r in SCAN_ROOTS]
+
+
+def changed_files() -> list:
+    """Working-tree changes vs HEAD plus untracked files — the fast
+    inner-loop scope (the DSL004 inventory still scans the whole repo,
+    so cross-registry checks stay sound)."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "diff", "--name-only", "--cached"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(args, cwd=ROOT, capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            out.update(line.strip() for line in r.stdout.splitlines()
+                       if line.strip())
+    scoped = []
+    for rel in sorted(out):
+        top = rel.split("/", 1)[0]
+        if top not in SCAN_ROOTS:
+            continue
+        # bin/ entry points have no .py suffix (shebang-sniffed later)
+        if not rel.endswith(".py") and top != "bin":
+            continue
+        if os.path.exists(os.path.join(ROOT, rel)):
+            scoped.append(rel)
+    return scoped
+
+
+def baseline_entries_to_keep(baseline, checked_paths, select):
+    """Entries a scoped --write-baseline must preserve: a scoped run
+    (--changed / explicit paths / --select) regenerates only the
+    entries its scope could have produced, so out-of-scope paths AND
+    non-selected rules survive untouched."""
+    return [e for e in baseline
+            if e["path"] not in checked_paths
+            or (select and e["rule"] not in select)]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dslint", description="repo-native static analysis "
+        "(DSL001 donation-safety, DSL002 lock-discipline, DSL003 "
+        "jit-hygiene, DSL004 registry-consistency, DSL005 "
+        "resilience-hygiene)")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs HEAD (+ untracked)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--select", action="append", metavar="RULE",
+                   help="run only these rule ids (repeatable)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report grandfathered findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite baseline.json from the current "
+                        "findings (grandfather everything)")
+    p.add_argument("--write-registries", action="store_true",
+                   help=f"regenerate {REGISTRIES_MD} from the "
+                        "inventory and exit")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.rules:
+        for rule in sorted(dslint.RULES):
+            cls = dslint.RULES[rule]
+            print(f"{rule} ({cls.name}): {cls.doc}")
+        return 0
+
+    if args.write_registries:
+        inv = dslint.Inventory.build(ROOT)
+        path = os.path.join(ROOT, REGISTRIES_MD)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(dslint.generate_registries_md(inv))
+        print(f"wrote {os.path.relpath(path, ROOT)}")
+        return 0
+
+    if args.changed:
+        paths = changed_files()
+        if not paths:
+            print("dslint: no changed python files in scope")
+            return 0
+    else:
+        paths = args.paths or DEFAULT_PATHS
+
+    baseline = ([] if args.no_baseline
+                else load_baseline(baseline_path(ROOT)))
+    try:
+        result = dslint.lint_paths(paths, ROOT, rules=args.select,
+                                   baseline=baseline)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        keep = baseline_entries_to_keep(
+            load_baseline(baseline_path(ROOT)),
+            result.checked_paths, args.select)
+        dslint.write_baseline(baseline_path(ROOT),
+                              result.findings + result.baselined,
+                              keep=keep)
+        n = len(result.findings) + len(result.baselined) + len(keep)
+        print(f"wrote {n} entries to "
+              f"{os.path.relpath(baseline_path(ROOT), ROOT)}"
+              + (f" ({len(keep)} kept from outside the scoped run)"
+                 if keep else ""))
+        return 0
+
+    if args.as_json:
+        sys.stdout.write(dslint.render_json(result))
+    else:
+        print(dslint.render_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

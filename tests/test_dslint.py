@@ -1,0 +1,803 @@
+"""dslint test suite (ISSUE 10).
+
+One known-bad snippet per rule (each must flag) with a known-good twin
+(each must pass), the suppression/baseline machinery, the DSL004
+inventory extraction, and — marked ``dslint`` — the tier-1 acceptance
+pass asserting the live tree lints clean modulo the committed baseline.
+
+Everything here is stdlib-only (no jax): dslint is designed to run in
+hooks and collection phases, and these tests hold it to that.
+"""
+import ast
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "deepspeed_tpu", "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import dslint  # noqa: E402
+from dslint.core import (baseline_path, lint_paths,  # noqa: E402
+                         lint_source, load_baseline, write_baseline)
+from dslint.inventory import (Inventory, SCAN_ROOTS,  # noqa: E402
+                              generate_registries_md)
+
+
+@pytest.fixture(scope="session")
+def inv():
+    return Inventory.build(ROOT)
+
+
+def _snippet_inv(inv, source, relpath):
+    """A copy of the repo inventory that has also scanned ``source`` —
+    DSL004 findings are cross-repo, so snippet uses must enter the
+    inventory the checker reads."""
+    inv2 = copy.deepcopy(inv)
+    inv2.scan_module(ast.parse(source), relpath)
+    return inv2
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# =====================================================================
+# DSL001 donation-safety
+# =====================================================================
+
+# THE PR 3 pattern (acceptance criterion): a live donated buffer handed
+# to the async checkpoint engine while the donating train step reuses it
+_DSL001_BAD_ASYNC = '''
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def train_loop(state, async_engine, batches, tag):
+    for b in batches:
+        async_engine.save(state, tag)     # live donated buffer escapes
+        state = step(state, b)
+'''
+
+_DSL001_GOOD_ASYNC = '''
+import jax
+import numpy as np
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def train_loop(state, async_engine, batches, tag):
+    for b in batches:
+        snap = jax.tree.map(lambda a: np.array(a, copy=True), state)
+        async_engine.save(snap, tag)      # host snapshot — safe
+        state = step(state, b)
+'''
+
+_DSL001_BAD_READ = '''
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def f(state, batch):
+    new = step(state, batch)
+    loss = state["loss"]                  # read after donation
+    return new, loss
+'''
+
+_DSL001_GOOD_READ = '''
+import jax
+
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+def f(state, batch):
+    loss = state["loss"]                  # read BEFORE donation: fine
+    state = step(state, batch)
+    return state, loss
+'''
+
+
+def test_dsl001_flags_pr3_async_donation_race():
+    findings = lint_source(_DSL001_BAD_ASYNC, rules=["DSL001"])
+    assert _rules(findings) == ["DSL001"]
+    assert any("escapes live" in f.message and "async_engine.save"
+               in f.message for f in findings)
+
+
+def test_dsl001_good_async_snapshot_passes():
+    assert lint_source(_DSL001_GOOD_ASYNC, rules=["DSL001"]) == []
+
+
+def test_dsl001_flags_read_after_donate():
+    findings = lint_source(_DSL001_BAD_READ, rules=["DSL001"])
+    assert _rules(findings) == ["DSL001"]
+    assert any("read after being donated" in f.message for f in findings)
+
+
+def test_dsl001_good_read_before_donate_passes():
+    assert lint_source(_DSL001_GOOD_READ, rules=["DSL001"]) == []
+
+
+def test_dsl001_thread_escape_and_self_attr_donor():
+    src = '''
+import jax, threading
+
+class Engine:
+    def __init__(self):
+        self._fused = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def run(self, state):
+        t = threading.Thread(target=self.save, args=(state,))
+        t.start()
+        state = self._fused(state)
+        return state
+'''
+    findings = lint_source(src, rules=["DSL001"])
+    assert any("threading.Thread" in f.message for f in findings)
+
+
+# =====================================================================
+# DSL002 lock-discipline
+# =====================================================================
+
+_DSL002_BAD = '''
+import time
+
+class Scheduler:
+    def step(self):
+        with self._lock:
+            time.sleep(0.5)                       # blocking under lock
+            with open("/tmp/x", "w") as f:        # I/O under lock
+                f.write("state")
+
+    def debug_requests(self):
+        with self._lock:                          # lock-free contract
+            return list(self._queue)
+
+
+class ServeWatchdog:
+    def _run(self):
+        if self.scheduler.has_work():             # locking call
+            self.flag()
+'''
+
+_DSL002_GOOD = '''
+import time
+
+class Scheduler:
+    def step(self):
+        payload = None
+        with self._lock:
+            payload = self._render()
+        with open("/tmp/x", "w") as f:            # I/O OUTSIDE the lock
+            f.write(payload)
+        time.sleep(0.5)
+
+    def debug_requests(self):
+        return [r for r in list(self._queue) if r is not None]
+
+
+class ServeWatchdog:
+    def _run(self):
+        if self.scheduler.has_work_unlocked():    # lock-free variant
+            self.flag()
+'''
+
+
+def test_dsl002_flags_blocking_and_contract_violations():
+    findings = lint_source(_DSL002_BAD, rules=["DSL002"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.sleep" in msgs
+    assert "open" in msgs
+    assert "debug_requests" in msgs and "lock-free by contract" in msgs
+    assert "has_work" in msgs
+    assert len(findings) == 4
+
+
+def test_dsl002_good_twin_passes():
+    assert lint_source(_DSL002_GOOD, rules=["DSL002"]) == []
+
+
+def test_dsl002_docstring_contract_zone():
+    src = '''
+class View:
+    def snapshot(self):
+        """Racy lock-free scheduler view for forensics."""
+        with self._sched._lock:
+            return dict(self._sched.state)
+'''
+    findings = lint_source(src, rules=["DSL002"])
+    assert len(findings) == 1 and "lock-free by contract" in \
+        findings[0].message
+
+
+# =====================================================================
+# DSL003 jit-boundary hygiene
+# =====================================================================
+
+_DSL003_BAD = '''
+import jax
+import numpy as np
+from functools import partial
+
+@partial(jax.jit, static_argnums=(2,))
+def decode(x, mask, n):
+    if mask:                      # Python branch on traced value
+        x = x * n
+    y = np.asarray(x)             # host sync inside jit
+    return y
+
+g = jax.jit(lambda a, cfg: a, static_argnums=(1,))
+out = g(1, [1, 2])                # unhashable static arg
+'''
+
+_DSL003_GOOD = '''
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@partial(jax.jit, static_argnums=(2,))
+def decode(x, mask, n):
+    if n > 4:                     # static arg: fine
+        x = x * n
+    if mask is None:              # structural: fine
+        return x
+    if x.ndim == 2:               # shape attr: static under trace
+        x = x.sum(-1)
+    return jnp.where(mask, x, 0)
+
+g = jax.jit(lambda a, cfg: a, static_argnums=(1,))
+out = g(1, (1, 2))                # hashable tuple
+'''
+
+
+def test_dsl003_flags_branch_sync_and_static():
+    findings = lint_source(_DSL003_BAD, rules=["DSL003"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "Python 'if' on traced value(s) ['mask']" in msgs
+    assert "np.asarray" in msgs
+    assert "unhashable list literal" in msgs
+    assert len(findings) == 3
+
+
+def test_dsl003_good_twin_passes():
+    assert lint_source(_DSL003_GOOD, rules=["DSL003"]) == []
+
+
+def test_dsl003_hot_path_item_sync():
+    src = '''
+import numpy as np
+
+class Sched:
+    def _decode(self, logits, rows):
+        toks = []
+        for r in rows:
+            toks.append(logits[r].item())    # per-row device round-trip
+        return toks
+'''
+    findings = lint_source(src, relpath="deepspeed_tpu/serving/x.py",
+                           rules=["DSL003"])
+    assert len(findings) == 1 and ".item()" in findings[0].message
+    # same code outside a serving hot path is not flagged
+    assert lint_source(src, relpath="deepspeed_tpu/other/x.py",
+                       rules=["DSL003"]) == []
+
+
+# =====================================================================
+# DSL004 string-registry consistency
+# =====================================================================
+
+_DSL004_BAD = '''
+import os
+
+def serve_step(self):
+    self.injector.check("serve.nonexistent_site")
+    self.flightrec.record("req/made_up_kind", corr="req-1")
+    self.registry.inc("serving/not_a_documented_metric")
+    lvl = os.environ.get("DS_TOTALLY_UNDOCUMENTED", "")
+    raise ValueError("serving.not_a_real_key must be >= 1")
+'''
+
+_DSL004_GOOD = '''
+import os
+
+def serve_step(self):
+    self.injector.check("serve.step")
+    self.flightrec.record("req/admit", corr="req-1")
+    self.registry.inc("serving/generated_tokens")
+    lvl = os.environ.get("DS_TRACE", "")
+    raise ValueError("serving.max_num_seqs must be >= 1")
+'''
+
+
+def test_dsl004_flags_every_registry_drift(inv):
+    rel = "deepspeed_tpu/serving/snippet.py"
+    inv2 = _snippet_inv(inv, _DSL004_BAD, rel)
+    findings = lint_source(_DSL004_BAD, relpath=rel, rules=["DSL004"],
+                           inventory=inv2)
+    msgs = "\n".join(f.message for f in findings)
+    assert "serve.nonexistent_site" in msgs          # fault site
+    assert "req/made_up_kind" in msgs                # flight kind
+    assert "serving/not_a_documented_metric" in msgs  # metric
+    assert "DS_TOTALLY_UNDOCUMENTED" in msgs         # env var
+    assert "serving.not_a_real_key" in msgs          # config key
+    assert len(findings) == 5
+
+
+def test_dsl004_good_twin_passes(inv):
+    rel = "deepspeed_tpu/serving/snippet.py"
+    inv2 = _snippet_inv(inv, _DSL004_GOOD, rel)
+    assert lint_source(_DSL004_GOOD, relpath=rel, rules=["DSL004"],
+                       inventory=inv2) == []
+
+
+def test_dsl004_config_key_resolution(inv):
+    assert inv.config_key_exists("serving.block_size")
+    assert inv.config_key_exists("serving.spec.max_draft_tokens")
+    assert inv.config_key_exists("serving.prefix_cache.max_cached_blocks")
+    assert inv.config_key_exists("serving.slo.classes")
+    assert inv.config_key_exists(
+        "serving.slo.classes.interactive.ttft_ms")
+    assert inv.config_key_exists("serving.chunked_prefill.chunk_tokens")
+    assert inv.config_key_exists("resilience.retry.deadline_s")
+    assert inv.config_key_exists("telemetry.flightrec_events")
+    assert not inv.config_key_exists("serving.bogus")
+    assert not inv.config_key_exists("serving.spec.bogus")
+    assert not inv.config_key_exists("serving.block_size.nested")
+    assert not inv.config_key_exists("telemetry.trace.bogus")
+
+
+def test_dsl004_inventory_extraction_shapes(inv):
+    # the whole-tree scan found the registries PRs 1-9 built
+    assert "serve.step" in inv.fault_sites_fired
+    assert "ckpt.manifest" in inv.fault_sites_fired   # site= kw form
+    assert "serve.chunk" in inv.fault_sites_declared
+    assert "req/resume" in inv.flight_kinds_recorded  # IfExp arg form
+    assert "anomaly/*" in inv.flight_kinds_recorded   # f-string prefix
+    assert inv.flight_kind_known("anomaly/train.step")
+    assert not inv.flight_kind_known("nonsense/kind")
+    assert "DS_FAULTS" in inv.env_reads               # module-const form
+    assert "DS_SERVE_DEBUG" in inv.env_reads
+    assert "serving/generated_tokens" in inv.metrics_emitted
+    assert "serving/goodput" in inv.metrics_emitted   # gauges.update kw
+    assert "train/step_latency_s" in inv.metrics_emitted
+    assert any(r.value == "serving.block_size" for r in inv.config_refs)
+
+
+# =====================================================================
+# DSL005 resilience hygiene
+# =====================================================================
+
+_DSL005_BAD = '''
+import os
+
+def save_tag(path, blob):
+    try:
+        risky()
+    except:                       # bare
+        cleanup()
+    try:
+        retry()
+    except Exception:             # swallowed broad
+        pass
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)         # rename without fsync
+'''
+
+_DSL005_GOOD = '''
+import os
+
+def save_tag(path, blob):
+    try:
+        risky()
+    except OSError:
+        cleanup()
+    try:
+        retry()
+    except ValueError as e:
+        log(e)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+'''
+
+
+def test_dsl005_flags_all_three_patterns():
+    findings = lint_source(_DSL005_BAD,
+                           relpath="deepspeed_tpu/resilience/ckpt.py",
+                           rules=["DSL005"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "bare 'except:'" in msgs
+    assert "silently swallowed" in msgs
+    assert "without any fsync" in msgs
+    assert len(findings) == 3
+
+
+def test_dsl005_good_twin_passes():
+    assert lint_source(_DSL005_GOOD,
+                       relpath="deepspeed_tpu/resilience/ckpt.py",
+                       rules=["DSL005"]) == []
+
+
+def test_dsl005_rename_rule_scoped_to_checkpoint_files():
+    # same rename-without-fsync outside checkpoint code: not this rule's
+    # business (tracing flushes etc. make their own durability calls)
+    findings = lint_source(_DSL005_BAD,
+                           relpath="deepspeed_tpu/telemetry/x.py",
+                           rules=["DSL005"])
+    assert all("fsync" not in f.message for f in findings)
+
+
+# =====================================================================
+# suppressions + baseline machinery
+# =====================================================================
+
+def test_suppression_with_justification_silences():
+    src = '''
+def f():
+    try:
+        g()
+    # dslint: disable=DSL005 -- deliberate: teardown best-effort
+    except Exception:
+        pass
+'''
+    assert lint_source(src, rules=["DSL005"]) == []
+
+
+def test_suppression_same_line_and_header_scope():
+    src = '''
+import time
+
+def f(lock):
+    with lock._lock:  # dslint: disable=DSL002 -- test double, no loop
+        time.sleep(0.1)
+        time.sleep(0.2)
+'''
+    assert lint_source(src, rules=["DSL002"]) == []
+
+
+def test_unjustified_suppression_is_a_finding():
+    src = '''
+def f():
+    try:
+        g()
+    # dslint: disable=DSL005
+    except Exception:
+        pass
+'''
+    findings = lint_source(src)
+    assert any(f.rule == "DSL000" and "justification" in f.message
+               for f in findings)
+    # the suppression still applies — DSL005 itself is silenced
+    assert all(f.rule != "DSL005" for f in findings)
+
+
+def test_unknown_rule_suppression_is_a_finding():
+    src = "x = 1  # dslint: disable=DSL999 -- no such rule\n"
+    findings = lint_source(src)
+    assert any(f.rule == "DSL000" and "unknown rule" in f.message
+               for f in findings)
+
+
+def test_docstring_mentioning_syntax_is_not_a_suppression():
+    src = '''
+def f():
+    """Docs may say '# dslint: disable=DSL005 -- why' freely."""
+    try:
+        g()
+    except Exception:
+        pass
+'''
+    findings = lint_source(src)
+    assert any(f.rule == "DSL005" for f in findings)
+    assert all(f.rule != "DSL000" for f in findings)
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    bad = tmp_path / "deepspeed_tpu"
+    bad.mkdir()
+    f = bad / "victim.py"
+    f.write_text("def g():\n    try:\n        h()\n    except Exception:"
+                 "\n        pass\n")
+    # no baseline: finding reported
+    res = lint_paths([str(f)], str(tmp_path), rules=["DSL005"],
+                     baseline=[])
+    assert len(res.findings) == 1 and not res.ok
+    entry = res.findings[0]
+    baseline = [{"rule": entry.rule, "path": entry.path,
+                 "message": entry.message},
+                {"rule": "DSL005", "path": entry.path,
+                 "message": "this one was fixed long ago"}]
+    res2 = lint_paths([str(f)], str(tmp_path), rules=["DSL005"],
+                      baseline=baseline)
+    assert res2.ok and len(res2.baselined) == 1
+    assert len(res2.stale_baseline) == 1
+    assert "fixed long ago" in res2.stale_baseline[0]["message"]
+    # line drift doesn't resurrect: shift the finding down two lines
+    f.write_text("X = 1\nY = 2\ndef g():\n    try:\n        h()\n"
+                 "    except Exception:\n        pass\n")
+    res3 = lint_paths([str(f)], str(tmp_path), rules=["DSL005"],
+                      baseline=baseline[:1])
+    assert res3.ok and len(res3.baselined) == 1
+
+
+def test_standalone_suppression_is_line_scoped():
+    # review regression: a standalone comment suppresses only its next
+    # code line — it must NOT widen to a following compound statement's
+    # whole body (one blessed line covering a whole function)
+    src = '''
+def f():
+    try:
+        g()
+    # dslint: disable=DSL005 -- only this first one is deliberate
+    except Exception:
+        pass
+    try:
+        h()
+    except Exception:
+        pass
+'''
+    findings = lint_source(src, rules=["DSL005"])
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert findings[0].line > 8  # the second handler still flags
+    # and a comment above a `def`/`try` header blesses nothing inside
+    src2 = '''
+# dslint: disable=DSL005 -- misplaced blanket attempt
+def f():
+    try:
+        g()
+    except Exception:
+        pass
+'''
+    assert len(lint_source(src2, rules=["DSL005"])) == 1
+
+
+def test_scoped_write_baseline_keeps_out_of_scope_entries(tmp_path):
+    # review regression: --write-baseline on a scoped run must not drop
+    # grandfathered entries for files outside the scope
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    bad = "def g():\n    try:\n        h()\n    except Exception:\n" \
+          "        pass\n"
+    (pkg / "a.py").write_text(bad)
+    (pkg / "b.py").write_text(bad)
+    bl_dir = pkg / "tools" / "dslint"
+    bl_dir.mkdir(parents=True)
+    bl_path = baseline_path(str(tmp_path))
+    full = lint_paths([str(pkg)], str(tmp_path), rules=["DSL005"],
+                      baseline=[])
+    write_baseline(bl_path, full.findings)
+    assert len(load_baseline(bl_path)) == 2
+    # scoped run over a.py only, then rewrite merging out-of-scope
+    scoped = lint_paths([str(pkg / "a.py")], str(tmp_path),
+                        rules=["DSL005"], baseline=load_baseline(bl_path))
+    keep = [e for e in load_baseline(bl_path)
+            if e["path"] not in scoped.checked_paths]
+    assert len(keep) == 1 and keep[0]["path"].endswith("b.py")
+    write_baseline(bl_path, scoped.findings + scoped.baselined,
+                   keep=keep)
+    assert len(load_baseline(bl_path)) == 2  # b.py's entry survived
+    full2 = lint_paths([str(pkg)], str(tmp_path), rules=["DSL005"],
+                       baseline=load_baseline(bl_path))
+    assert full2.ok and len(full2.baselined) == 2
+
+
+def test_nonexistent_path_raises_not_clean(tmp_path):
+    # review regression: a typo'd path must error, not report 0
+    # findings forever
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "no_such_dir")], str(tmp_path),
+                   baseline=[])
+    script = os.path.join(ROOT, "scripts", "dslint.py")
+    r = subprocess.run([sys.executable, script, "no/such/dir"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    assert "no such file" in r.stderr
+
+
+def test_deferred_callbacks_not_flagged_under_lock():
+    # review regression: a nested def/lambda defined under the lock
+    # runs LATER, outside it — and nested lock-withs report once
+    src = '''
+import time
+
+class S:
+    def step(self):
+        with self._lock:
+            self._cb = lambda: open("/tmp/x").read()
+            def deferred():
+                time.sleep(1)
+            self._later = deferred
+'''
+    assert lint_source(src, rules=["DSL002"]) == []
+    nested = '''
+import time
+
+class S:
+    def step(self):
+        with self._lock:
+            with self._other_lock:
+                time.sleep(1)
+'''
+    assert len(lint_source(nested, rules=["DSL002"])) == 1
+
+
+def test_fsync_rule_does_not_conflate_nested_scopes():
+    # review regression: an inner def's fsync-less write must not pair
+    # with the outer fn's rename of an unrelated file
+    src = '''
+import os
+
+def publish(path):
+    def _scratch():
+        with open("/tmp/scratch", "w") as f:
+            f.write("x")
+    _scratch()
+    os.replace(path + ".ready", path)   # renames a file it never wrote
+'''
+    findings = lint_source(src, relpath="deepspeed_tpu/resilience/ckpt.py",
+                           rules=["DSL005"])
+    assert all("fsync" not in f.message for f in findings), \
+        [f.format() for f in findings]
+
+
+def test_injector_regex_not_fooled_by_default():
+    src = '''
+def f(self):
+    self.default.check("not.a.fault.site")
+'''
+    import dslint.inventory as di
+    inv2 = Inventory.empty()
+    inv2.scan_module(ast.parse(src), "deepspeed_tpu/x.py")
+    assert inv2.fault_sites_fired == {}
+    assert di._INJECTOR_RE.search("self.fault_injector")
+    assert di._INJECTOR_RE.search("inj")
+    assert not di._INJECTOR_RE.search("self.default")
+
+
+def test_select_write_baseline_keeps_other_rules():
+    # review regression: --select + --write-baseline must not drop
+    # grandfathered entries of non-selected rules on in-scope paths
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dslint_runner", os.path.join(ROOT, "scripts", "dslint.py"))
+    runner = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(runner)
+    entry_other_rule = {"rule": "DSL005", "path": "deepspeed_tpu/a.py",
+                        "message": "grandfathered other-rule"}
+    entry_selected = {"rule": "DSL002", "path": "deepspeed_tpu/a.py",
+                      "message": "selected-rule, in scope: regenerated"}
+    entry_other_path = {"rule": "DSL002", "path": "deepspeed_tpu/b.py",
+                        "message": "out of scope: kept"}
+    keep = runner.baseline_entries_to_keep(
+        [entry_other_rule, entry_selected, entry_other_path],
+        checked_paths={"deepspeed_tpu/a.py"}, select=["DSL002"])
+    assert keep == [entry_other_rule, entry_other_path]
+    # unscoped rules (select=None): only path scoping applies
+    keep2 = runner.baseline_entries_to_keep(
+        [entry_other_rule, entry_other_path],
+        checked_paths={"deepspeed_tpu/a.py"}, select=None)
+    assert keep2 == [entry_other_path]
+
+
+def test_parse_error_is_reported_not_fatal(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    res = lint_paths([str(bad)], str(tmp_path), baseline=[])
+    assert len(res.findings) == 1
+    assert res.findings[0].rule == "DSL000"
+    assert "syntax error" in res.findings[0].message
+
+
+# =====================================================================
+# tier-1 acceptance: the live tree lints clean (modulo baseline)
+# =====================================================================
+
+@pytest.mark.dslint
+def test_live_tree_lints_clean(inv):
+    """ISSUE 10 acceptance: `python scripts/dslint.py deepspeed_tpu/`
+    exits 0 on the final tree with an empty-or-justified baseline."""
+    result = lint_paths(list(SCAN_ROOTS), ROOT, inventory=inv)
+    assert result.files_checked > 150
+    formatted = "\n".join(f.format() for f in result.findings)
+    assert result.ok, f"dslint found new violations:\n{formatted}"
+    # the baseline may grandfather, but it must not rot
+    assert result.stale_baseline == [], (
+        "baseline entries no longer match any finding — prune them: "
+        f"{result.stale_baseline}")
+    # committed baseline is empty-or-justified (acceptance wording)
+    entries = load_baseline(baseline_path(ROOT))
+    assert entries == [], "baseline must stay empty on this tree"
+
+
+@pytest.mark.dslint
+def test_registries_doc_in_sync(inv):
+    path = os.path.join(ROOT, "docs", "reference", "registries.md")
+    with open(path, encoding="utf-8") as f:
+        actual = f.read()
+    assert actual == generate_registries_md(inv), (
+        "docs/reference/registries.md drifted — regenerate with "
+        "'python scripts/dslint.py --write-registries'")
+
+
+@pytest.mark.dslint
+def test_runner_cli(tmp_path):
+    """scripts/dslint.py end-to-end: rule catalog, JSON output + exit
+    codes on a known-bad file, --changed smoke."""
+    script = os.path.join(ROOT, "scripts", "dslint.py")
+    r = subprocess.run([sys.executable, script, "--rules"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for rule in ("DSL001", "DSL002", "DSL003", "DSL004", "DSL005"):
+        assert rule in r.stdout
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    f()\nexcept:\n    pass\n")
+    r = subprocess.run([sys.executable, script, "--json", str(bad)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["ok"] is False
+    assert any(f["rule"] == "DSL005" for f in doc["findings"])
+    r = subprocess.run([sys.executable, script, "--changed"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode in (0, 1), r.stdout + r.stderr
+
+
+# =====================================================================
+# importability satellite: scripts analyze as modules, no side effects
+# =====================================================================
+
+def _import_script(name):
+    import importlib.util
+    path = os.path.join(ROOT, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_dslint_test_{name}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_smoke_imports_without_side_effects():
+    env_before = dict(os.environ)
+    path_before = list(sys.path)
+    mod = _import_script("chaos_smoke")
+    assert dict(os.environ) == env_before, \
+        "importing chaos_smoke mutated os.environ"
+    assert sys.path == path_before, \
+        "importing chaos_smoke mutated sys.path"
+    assert callable(mod.main)
+
+
+def test_trace_validate_imports_and_validates(tmp_path):
+    env_before = dict(os.environ)
+    mod = _import_script("trace_validate")
+    assert dict(os.environ) == env_before
+    trace = tmp_path / "t.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "s", "ph": "B", "ts": 1, "pid": 1, "tid": 1},
+        {"name": "s", "ph": "E", "ts": 2, "pid": 1, "tid": 1},
+    ]}))
+    assert mod.validate(str(trace)) == []
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "s", "ph": "B", "ts": 5, "pid": 1, "tid": 1},
+        {"name": "s", "ph": "E", "ts": 2, "pid": 1, "tid": 1},
+    ]}))
+    assert mod.validate(str(trace)) != []
+
+
+def test_scripts_are_in_lint_scope(inv):
+    # chaos_smoke/trace_validate are analyzed as modules by the same
+    # pass that covers deepspeed_tpu/ (the ISSUE 10 satellite)
+    result = lint_paths(["scripts/chaos_smoke.py",
+                         "scripts/trace_validate.py"], ROOT,
+                        inventory=inv)
+    assert result.files_checked == 2
+    assert result.ok, "\n".join(f.format() for f in result.findings)
