@@ -16,7 +16,7 @@ key; specs separated by ``;`` or whitespace)::
 
     site    dotted hook name: ckpt.save ckpt.aux ckpt.manifest
             ckpt.publish ckpt.latest train.step serve.step serve.spec
-            serve.chunk kv.alloc kv.cache ...
+            serve.chunk kv.alloc kv.cache fleet.dispatch ...
     action  raise      raise FaultInjected at the site
             kill       os._exit(param or 1) — a hard crash, no cleanup
             sigterm    deliver SIGTERM to this process (preemption)
@@ -83,6 +83,8 @@ KNOWN_FAULT_SITES = {
     "kv.alloc": "KV block-pool allocation (deny = pool exhausted)",
     "kv.cache": "prefix-cache match/attach (deny = cache-blind full "
                 "prefill)",
+    "fleet.dispatch": "fleet router replica selection (raise = dispatch "
+                      "failure, deny = policy-blind misroute)",
 }
 
 _SPEC_RE = re.compile(

@@ -582,6 +582,69 @@ class ChunkedPrefillConfig(DeepSpeedConfigModel):
                 f"{self.chunk_tokens}: must be >= 1")
 
 
+class FleetConfig(DeepSpeedConfigModel):
+    """``serving.fleet`` — replica-fleet serving (ISSUE 11): a Router
+    dispatching requests across N in-process replicas (each its own
+    ContinuousBatchingScheduler + HealthMonitor + metrics registry)
+    with a weighted policy stack — least-loaded by outstanding token
+    budget, session affinity, and prefix-cache-aware scoring against a
+    bounded per-replica cache digest.  Membership is health-gated: a
+    DRAINING/DEGRADED replica stops receiving new work and its in-flight
+    requests are resubmitted to a healthy replica through the existing
+    evict/resume machinery."""
+    #: replicas ``bin/ds_router`` / ``ds_serve --replicas N`` build over
+    #: one shared model+params; 1 = the plain single-scheduler server
+    num_replicas: int = 1
+    #: "scored" combines the weighted policy stack below; "round_robin"
+    #: ignores it (the serve_bench A/B baseline)
+    policy: str = "scored"
+    #: weight of the normalized outstanding-token load penalty
+    least_loaded_weight: float = 1.0
+    #: bonus for the replica a live session last decoded on (its KV /
+    #: prefix blocks are still warm there)
+    affinity_weight: float = 1.0
+    #: weight of the matched-prefix fraction from the replica cache
+    #: digest (PR 6 chained block hashes — the routing key)
+    prefix_weight: float = 1.0
+    #: router-side replica-cache digest max age before a dispatch
+    #: refreshes it (0 = refresh on every scored dispatch)
+    digest_refresh_s: float = 0.5
+    #: newest-N hash-chain heads kept per replica digest (bounds router
+    #: memory AND the per-dispatch prompt hashing work)
+    digest_max_entries: int = 512
+    #: times one request may be resubmitted to another replica (drain /
+    #: replica loss) before it fails; 0 = never resubmit
+    resubmit_budget: int = 3
+    #: bounded session->replica affinity map (LRU beyond this)
+    session_capacity: int = 4096
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.num_replicas < 1:
+            raise ValueError(f"serving.fleet.num_replicas="
+                             f"{self.num_replicas}: must be >= 1")
+        if self.policy not in ("scored", "round_robin"):
+            raise ValueError(f"serving.fleet.policy={self.policy!r}: "
+                             "choose scored | round_robin")
+        for k in ("least_loaded_weight", "affinity_weight",
+                  "prefix_weight"):
+            if getattr(self, k) < 0:
+                raise ValueError(
+                    f"serving.fleet.{k}={getattr(self, k)}: must be >= 0")
+        if self.digest_refresh_s < 0:
+            raise ValueError(f"serving.fleet.digest_refresh_s="
+                             f"{self.digest_refresh_s}: must be >= 0")
+        if self.digest_max_entries < 1:
+            raise ValueError(f"serving.fleet.digest_max_entries="
+                             f"{self.digest_max_entries}: must be >= 1")
+        if self.resubmit_budget < 0:
+            raise ValueError(f"serving.fleet.resubmit_budget="
+                             f"{self.resubmit_budget}: must be >= 0")
+        if self.session_capacity < 1:
+            raise ValueError(f"serving.fleet.session_capacity="
+                             f"{self.session_capacity}: must be >= 1")
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving (deepspeed_tpu/serving/): block-pool
     sizing, iteration-level scheduler budgets, admission control.  TPU-
@@ -644,11 +707,15 @@ class ServingConfig(DeepSpeedConfigModel):
     slo: Any = None
     #: chunked-prefill sub-section (same pattern; ISSUE 9)
     chunked_prefill: Any = None
+    #: replica-fleet sub-section (same pattern; ISSUE 11)
+    fleet: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
         if not isinstance(self.spec, SpecDecodeConfig):
             self.spec = SpecDecodeConfig(**(self.spec or {}))
+        if not isinstance(self.fleet, FleetConfig):
+            self.fleet = FleetConfig(**(self.fleet or {}))
         if not isinstance(self.prefix_cache, PrefixCacheConfig):
             self.prefix_cache = PrefixCacheConfig(
                 **(self.prefix_cache or {}))

@@ -25,6 +25,10 @@ kernel, `InferenceEngine` prefill/decode fns):
   driving the scheduler on a background thread (bin/ds_serve)
 - `spec/`        — speculative decoding (ISSUE 5): ngram/draft-model
   proposers, one-weight-pass window verification, paged-KV rollback
+- `fleet/`       — replica-fleet serving (ISSUE 11): Replica wrapper +
+  Router with least-loaded / session-affine / prefix-cache-aware
+  dispatch, health-gated membership, drain/loss resubmission, and the
+  ``bin/ds_router`` front-end (``ds_serve --replicas N``)
 """
 from deepspeed_tpu.serving.request import (RequestState, SamplingParams,
                                            ServeRequest, AdmissionError,
@@ -35,6 +39,9 @@ from deepspeed_tpu.serving.block_manager import BlockManager
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 from deepspeed_tpu.serving.spec import (DraftModelProposer, NgramProposer,
                                         Proposer)
+from deepspeed_tpu.serving.fleet import (FleetRequest,
+                                         FleetUnavailableError, Replica,
+                                         Router)
 
 __all__ = [
     "RequestState", "SamplingParams", "ServeRequest",
@@ -42,4 +49,5 @@ __all__ = [
     "RequestTooLongError",
     "BlockManager", "ContinuousBatchingScheduler",
     "Proposer", "NgramProposer", "DraftModelProposer",
+    "Replica", "Router", "FleetRequest", "FleetUnavailableError",
 ]

@@ -319,6 +319,27 @@ class BlockManager:
             self._hash_of[b] = h
             self._by_hash[h] = b
 
+    def cache_digest(self, max_entries: int = 0) -> Dict:
+        """Bounded router-facing cache summary (ISSUE 11 satellite): the
+        newest ``max_entries`` prefix hash-chain heads — publication
+        order, so later entries pin longer prefixes — plus the total
+        cached-entry count (hashed blocks, live AND LRU-retained).
+
+        A fleet router holding this digest can score "which replica
+        already holds this prompt's prefix" without touching the
+        replica: it chains the prompt's block hashes (the same
+        ``_chain_hash`` recipe) and tests membership — each chain hash
+        pins the *entire* causal prefix, so a single membership hit is
+        a whole-prefix match, and the longest hit is the replica's
+        usable cache depth for that prompt.  Read-only; stable across
+        ``acquire_prefix`` ref bumps and copy-on-write forks (the
+        shared source block stays published) — only eviction removes
+        entries.  ``max_entries=0`` = unbounded."""
+        hashes = list(self._by_hash)
+        if max_entries and len(hashes) > max_entries:
+            hashes = hashes[-max_entries:]
+        return {"hashes": hashes, "cached_blocks": len(self._by_hash)}
+
     def check_invariant(self):
         """Allocation-accounting invariant, extended to the ref-counted
         prefix-cache world (ISSUE 6 satellite)::

@@ -1,0 +1,36 @@
+"""Fleet serving (ISSUE 11 tentpole): a replica router with
+health-gated, prefix-cache-aware dispatch.
+
+- `replica.py` — :class:`Replica`: one ContinuousBatchingScheduler +
+  its HealthMonitor + an isolated metrics registry, exposing the load /
+  queue-depth / health / prefix-cache summaries the router reads;
+- `router.py` — :class:`Router`: weighted policy stack (least-loaded by
+  outstanding token budget, session affinity, prefix-aware scoring
+  against bounded per-replica cache digests keyed on the PR 6 chained
+  block hashes), health-gated membership, drain/loss resubmission
+  through the existing evict/resume machinery, and the
+  ``fleet.dispatch`` chaos site;
+- `server.py` — the ``bin/ds_router`` HTTP front-end (/generate proxy,
+  aggregate /healthz, merged per-``replica``-label /metrics,
+  /debug/fleet) plus :func:`build_fleet` — the one constructor both
+  ``ds_router`` and ``ds_serve --replicas N`` share.
+
+This is the "one chip -> a pod" seam (ROADMAP item 1): scaling serving
+across replicas becomes a deployment choice (``serving.fleet``), and
+prefill/decode disaggregation or pjit-sharded replicas land behind the
+same Replica abstraction later.
+"""
+from deepspeed_tpu.serving.fleet.replica import Replica
+from deepspeed_tpu.serving.fleet.router import (FleetRequest,
+                                                FleetUnavailableError,
+                                                Router,
+                                                merge_prometheus_texts)
+from deepspeed_tpu.serving.fleet.server import (build_fleet,
+                                                make_fleet_server,
+                                                serve_fleet_forever)
+
+__all__ = [
+    "Replica", "Router", "FleetRequest", "FleetUnavailableError",
+    "merge_prometheus_texts", "build_fleet", "make_fleet_server",
+    "serve_fleet_forever",
+]

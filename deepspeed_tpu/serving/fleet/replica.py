@@ -1,0 +1,120 @@
+"""One fleet member (ISSUE 11 tentpole): a ContinuousBatchingScheduler
+wrapped with its own HealthMonitor, its own isolated metrics registry,
+and the load / queue-depth / health / prefix-cache summaries the Router
+dispatches on.
+
+A Replica can run in two modes:
+
+- **started** (``start()``): its own :class:`ServingLoop` background
+  thread drives ``scheduler.step()`` — the fleet HTTP server mode;
+- **manual**: the caller (tests, benches, ``Router.run_until_idle``)
+  steps the scheduler directly — deterministic and thread-free.
+
+Health is the PR 3 state machine wired exactly like the single-replica
+server (``_wire_health``): DRAINING/DEGRADED/STOPPED replicas stop
+receiving new work (the Router's membership gate), and every transition
+lands in the replica's metrics and the shared trace timeline.
+"""
+from typing import Dict, Optional
+
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+
+class Replica:
+    """Scheduler + health + registry, addressable by ``replica_id``."""
+
+    def __init__(self, replica_id: int, model, params, config,
+                 kv_cache_dtype=None, injector=None, registry=None,
+                 flightrec=None, proposer=None, monitor=None):
+        from deepspeed_tpu.serving.server import _wire_health
+        from deepspeed_tpu.telemetry import MetricsRegistry
+        self.replica_id = int(replica_id)
+        #: isolated per replica — the fleet ``/metrics`` merges each
+        #: registry under a ``replica="<id>"`` label instead of letting
+        #: N schedulers clobber one shared counter space
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self.scheduler = ContinuousBatchingScheduler(
+            model, params, config, kv_cache_dtype=kv_cache_dtype,
+            monitor=monitor, injector=injector, registry=self.registry,
+            flightrec=flightrec, proposer=proposer)
+        self.health = _wire_health(self.scheduler)
+        # constructed replicas are immediately routable; started-mode
+        # ServingLoop.start() re-marks ready (idempotent no-op)
+        self.health.mark_ready(f"replica {self.replica_id} up")
+        self._loop = None
+
+    # ------------------------------------------------------------ driving
+    def start(self) -> "Replica":
+        """Run the replica on its own ServingLoop thread (HTTP mode)."""
+        from deepspeed_tpu.serving.server import ServingLoop
+        if self._loop is None:
+            self._loop = ServingLoop(self.scheduler, health=self.health)
+            self._loop.start()
+        return self
+
+    @property
+    def started(self) -> bool:
+        return self._loop is not None
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for a started replica's loop to exit (drain completion);
+        True when it has."""
+        if self._loop is None:
+            return True
+        return self._loop.join(timeout)
+
+    def shutdown(self):
+        if self._loop is not None:
+            self._loop.shutdown()
+            self._loop = None
+
+    # ---------------------------------------------------------- dispatch
+    def is_accepting(self) -> bool:
+        """The Router's health gate: only READY replicas take new work."""
+        return self.health.is_accepting()
+
+    def submit(self, prompt_ids, sampling=None, priority: int = 0,
+               timeout_s: float = 0.0, slo_class: str = "default"):
+        return self.scheduler.submit(prompt_ids, sampling,
+                                     priority=priority,
+                                     timeout_s=timeout_s,
+                                     slo_class=slo_class)
+
+    # ------------------------------------------------------------- views
+    def outstanding_tokens(self) -> int:
+        """Least-loaded policy input: prefill+decode tokens still owed
+        (lock-free — dispatch never queues behind a step)."""
+        return self.scheduler.outstanding_tokens_unlocked()
+
+    def cache_digest(self, max_entries: int = 0) -> Optional[Dict]:
+        """Router-facing prefix-cache digest (the PR 6 hash-chain heads
+        + cached-entry count), or ``None`` when the scheduler lock is
+        busy.  The snapshot wants the lock for consistency, but a
+        dispatch decision must NEVER queue behind a long (or wedged)
+        step — the same reasoning as ``outstanding_tokens_unlocked`` —
+        so this is a non-blocking acquire and the Router keeps serving
+        its stale digest on a miss."""
+        lock = self.scheduler._lock
+        if not lock.acquire(blocking=False):
+            return None
+        try:
+            return self.scheduler.block_mgr.cache_digest(max_entries)
+        finally:
+            lock.release()
+
+    def summary(self) -> Dict:
+        """One row of ``/healthz`` / ``/debug/fleet``: health + load at
+        a glance (lock-free reads, same contract as the debug views)."""
+        sched = self.scheduler
+        return {
+            "replica": self.replica_id,
+            "health": self.health.snapshot(),
+            "accepting": self.is_accepting(),
+            "started": self.started,
+            "step_count": sched.step_count,
+            "queued": len(list(sched._queue)),
+            "active": sum(r is not None for r in list(sched._slots)),
+            "outstanding_tokens": self.outstanding_tokens(),
+            "cached_blocks": sched.block_mgr.num_cached_blocks,
+        }

@@ -1,0 +1,625 @@
+"""Fleet request router (ISSUE 11 tentpole).
+
+Dispatches incoming requests across N :class:`Replica` members with a
+weighted policy stack (``serving.fleet``):
+
+- **least-loaded**: penalize each candidate by its outstanding token
+  budget (prefill still owed + decode still to emit), normalized over
+  the candidate set;
+- **session affinity**: a live ``session_id`` sticks to the replica it
+  last decoded on — its KV / prefix-cache blocks are still warm there —
+  via a bounded LRU session map;
+- **prefix-aware**: hash the prompt with the PR 6 chained block hash
+  and prefer the replica whose cache already holds the longest prefix,
+  scored against a router-side bounded per-replica cache digest
+  (refreshed every ``digest_refresh_s``; each chain hash pins the whole
+  causal prefix, so one membership hit is a whole-prefix match).
+
+Membership is **health-gated**: only READY replicas receive new work.
+A drained replica's queued AND active requests are extracted through
+the scheduler's standard eviction path and resubmitted to a healthy
+replica as ``prompt + generated-so-far`` — recompute-on-resume
+semantics make the continued stream token-identical to the
+uninterrupted one (greedy AND sampled: the position-keyed rng sees the
+same absolute positions).  A replica LOST mid-flight (DEGRADED /
+STOPPED with work unfinished) is detected at ``poll()`` and its
+requests resubmitted the same way, bounded by ``resubmit_budget``.
+
+The ``fleet.dispatch`` fault site chaos-tests the dispatch edge:
+``raise`` = dispatch failure surfaces to the caller, ``deny`` = a
+policy-blind misroute (the request lands on an arbitrary healthy
+replica — correctness must not depend on routing quality).
+
+Threading: the Router has no thread of its own.  ``poll()`` is cheap
+and idempotent; HTTP handlers call it from ``await_result`` while they
+wait, tests/benches call it from ``run_until_idle``.
+"""
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.serving.block_manager import BlockManager
+from deepspeed_tpu.serving.fleet.replica import Replica
+from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
+                                           RequestState, SamplingParams,
+                                           ServeRequest)
+from deepspeed_tpu.utils.logging import logger
+
+
+class FleetUnavailableError(AdmissionError):
+    """No READY replica to dispatch to (all draining/degraded)."""
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """Router-side handle for one request's whole fleet lifetime —
+    survives resubmission across replicas; ``done`` fires exactly once,
+    when the request finishes or terminally fails."""
+    fleet_id: int
+    prompt_ids: np.ndarray
+    sampling: SamplingParams
+    priority: int = 0
+    timeout_s: float = 0.0
+    slo_class: str = "default"
+    session_id: Optional[str] = None
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+    # -- router-owned runtime state ------------------------------------
+    #: live per-replica request (rebound on resubmit)
+    current: Optional[ServeRequest] = dataclasses.field(default=None,
+                                                        repr=False)
+    replica_id: int = -1
+    #: tokens committed on PREVIOUS replicas (carried across resubmits)
+    prefix_output: List[int] = dataclasses.field(default_factory=list)
+    #: final merged output (set at finalize)
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    replica_history: List[int] = dataclasses.field(default_factory=list)
+    resubmits: int = 0
+    state: str = "inflight"
+    reject_reason: Optional[str] = None
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False)
+
+    @property
+    def corr(self) -> str:
+        """Flight-recorder correlation id for the WHOLE fleet lifetime
+        (distinct from the per-replica ``req-<n>`` ids, which restart
+        per scheduler and change on resubmit)."""
+        return f"req-f{self.fleet_id}"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.arrival_time
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_finish is None:
+            return None
+        return self.t_finish - self.arrival_time
+
+    def to_response(self) -> dict:
+        out = {
+            "request_id": self.fleet_id,
+            "state": self.state,
+            "output_ids": list(self.output_ids),
+            "replica_history": list(self.replica_history),
+            "resubmits": self.resubmits,
+        }
+        if self.session_id is not None:
+            out["session_id"] = self.session_id
+        if self.reject_reason is not None:
+            out["reject_reason"] = self.reject_reason
+        if self.ttft_s is not None:
+            out["ttft_ms"] = round(self.ttft_s * 1e3, 3)
+        if self.latency_s is not None:
+            out["latency_ms"] = round(self.latency_s * 1e3, 3)
+        return out
+
+
+class Router:
+    """Health-gated, prefix-cache-aware dispatch across replicas."""
+
+    def __init__(self, replicas: List[Replica], config, injector=None,
+                 registry=None, flightrec=None):
+        from deepspeed_tpu.resilience.faults import resolve_injector
+        from deepspeed_tpu.telemetry import MetricsRegistry
+        from deepspeed_tpu.telemetry.flight_recorder import \
+            get_flight_recorder
+        if not replicas:
+            raise ValueError("Router needs >= 1 replica")
+        self.replicas = list(replicas)
+        #: replica_id -> Replica; ids are caller-supplied and need not
+        #: be list positions (a future dynamic fleet removes members)
+        self._replica_by_id = {r.replica_id: r for r in self.replicas}
+        if len(self._replica_by_id) != len(self.replicas):
+            raise ValueError("Router replicas carry duplicate replica_ids")
+        self.cfg = config
+        self.injector = (injector if injector is not None
+                         else resolve_injector())
+        #: the router's OWN registry (fleet/* metrics); replica metrics
+        #: stay in each replica's isolated registry and merge at render
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self.flightrec = (flightrec if flightrec is not None
+                          else get_flight_recorder())
+        self._lock = threading.Lock()
+        #: serializes supervision (poll/drain): resubmission must run
+        #: at most once per lost request, and every waiting HTTP handler
+        #: polls concurrently
+        self._supervise_lock = threading.Lock()
+        self._next_id = 0
+        self._rr = 0                      # round-robin cursor
+        #: fleet_id -> live handle
+        self._inflight: Dict[int, FleetRequest] = {}
+        #: (replica_id, per-replica request_id) -> fleet_id (drain
+        #: extraction hands back ServeRequests; this maps them home)
+        self._by_replica_req: Dict[Tuple[int, int], int] = {}
+        #: session -> replica_id, LRU-bounded at session_capacity
+        self._sessions: "OrderedDict[str, int]" = OrderedDict()
+        #: replica_id -> (frozenset of digest hashes, refreshed_at)
+        self._digests: Dict[int, Tuple[frozenset, float]] = {}
+        self._block_size = self.replicas[0].scheduler.cfg.block_size
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt_ids, sampling=None, priority: int = 0,
+               timeout_s: float = 0.0, slo_class: str = "default",
+               session_id: Optional[str] = None) -> FleetRequest:
+        """Dispatch one request onto the best healthy replica.  Raises
+        the scheduler's AdmissionError family exactly like a direct
+        ``scheduler.submit`` (RequestTooLongError / RequestShedError
+        propagate; QueueFullError fails over to the next-best candidate
+        first), plus :class:`FleetUnavailableError` when no replica is
+        READY."""
+        candidates = [r for r in self.replicas if r.is_accepting()]
+        if not candidates:
+            self.registry.inc("fleet/unroutable")
+            raise FleetUnavailableError(
+                "no READY replica (all draining/degraded/stopped)")
+        with self._lock:
+            handle = FleetRequest(
+                fleet_id=self._next_id,
+                prompt_ids=np.asarray(prompt_ids, np.int32).reshape(-1),
+                sampling=sampling or SamplingParams(),
+                priority=priority, timeout_s=timeout_s,
+                slo_class=slo_class, session_id=session_id)
+            self._next_id += 1
+        # prompt hashing only pays off where a policy reads it
+        hashes = (self._prompt_hashes(handle.prompt_ids)
+                  if self.cfg.policy == "scored" else [])
+        # chaos edge (ISSUE 11), ONE invocation per dispatch: a raise
+        # spec surfaces as a dispatch failure (nothing bound yet), a
+        # deny spec misroutes policy-blind — correctness must survive
+        # bad routing, only efficiency may suffer
+        if self.injector.deny("fleet.dispatch"):
+            ordered = [candidates[handle.fleet_id % len(candidates)]]
+            info = {"misroute": True}
+            self.registry.inc("fleet/misroutes")
+        else:
+            ordered, info = self._rank(candidates, hashes, session_id)
+        last_exc = None
+        for rep in ordered:
+            # the submit+bind pair rides the supervision lock: a
+            # concurrent drain_replica must never extract a request in
+            # the window where it is in the scheduler but not yet in
+            # _by_replica_req — it would read as "not router-owned"
+            # and be dropped instead of resubmitted
+            with self._supervise_lock:
+                try:
+                    req = rep.submit(handle.prompt_ids, handle.sampling,
+                                     priority=priority,
+                                     timeout_s=timeout_s,
+                                     slo_class=slo_class)
+                except QueueFullError as e:
+                    last_exc = e        # fail over to the next candidate
+                    continue
+                self._bind(handle, rep, req)
+            self.registry.inc("fleet/dispatches",
+                              replica=str(rep.replica_id))
+            if info.get("prefix_blocks"):
+                self.registry.inc("fleet/prefix_routed")
+            if info.get("affinity"):
+                self.registry.inc("fleet/affinity_hits")
+            self.flightrec.record(
+                "route/dispatch", corr=handle.corr,
+                replica=rep.replica_id, session=session_id,
+                prompt_tokens=int(handle.prompt_ids.size), **info)
+            return handle
+        raise last_exc      # every candidate queue-full: surface the 429
+
+    def _bind(self, handle: FleetRequest, rep: Replica, req: ServeRequest):
+        """Attach a freshly-submitted per-replica request to its handle
+        (dispatch and resubmit share this)."""
+        with self._lock:
+            handle.current = req
+            handle.replica_id = rep.replica_id
+            handle.replica_history.append(rep.replica_id)
+            self._inflight[handle.fleet_id] = handle
+            self._by_replica_req[(rep.replica_id, req.request_id)] = \
+                handle.fleet_id
+            if handle.session_id is not None:
+                self._sessions[handle.session_id] = rep.replica_id
+                self._sessions.move_to_end(handle.session_id)
+                while len(self._sessions) > self.cfg.session_capacity:
+                    self._sessions.popitem(last=False)
+
+    # ------------------------------------------------------------ policy
+    def _rank(self, candidates: List[Replica], prompt_hashes: List[str],
+              session_id: Optional[str]
+              ) -> Tuple[List[Replica], Dict]:
+        """Candidates best-first under the configured policy, plus the
+        winner's score breakdown (flight-recorder fields).  A scored
+        fleet down to ONE healthy candidate still scores it — the
+        flight events keep reporting the configured policy and the
+        affinity/prefix metrics keep counting through a drain."""
+        if self.cfg.policy == "round_robin":
+            with self._lock:
+                i = self._rr % len(candidates)
+                self._rr += 1
+            ordered = candidates[i:] + candidates[:i]
+            return ordered, {"policy": "round_robin"}
+        loads = {r.replica_id: r.outstanding_tokens() for r in candidates}
+        max_load = max(loads.values()) or 1
+        with self._lock:
+            sticky = (self._sessions.get(session_id)
+                      if session_id is not None else None)
+        scored = []
+        for r in candidates:
+            matched = self._digest_match(r, prompt_hashes)
+            frac = matched / len(prompt_hashes) if prompt_hashes else 0.0
+            affine = sticky == r.replica_id
+            score = (self.cfg.prefix_weight * frac
+                     + (self.cfg.affinity_weight if affine else 0.0)
+                     - self.cfg.least_loaded_weight
+                     * loads[r.replica_id] / max_load)
+            scored.append((score, -loads[r.replica_id], -r.replica_id,
+                           r, matched, affine))
+        scored.sort(reverse=True)       # ties: least loaded, lowest id
+        _, _, _, best, matched, affine = scored[0]
+        return ([s[3] for s in scored],
+                {"policy": "scored", "prefix_blocks": matched,
+                 "affinity": bool(affine),
+                 "load": loads[best.replica_id]})
+
+    def _prompt_hashes(self, prompt_ids: np.ndarray) -> List[str]:
+        """The prompt's full-block chain hashes (the PR 6 recipe) —
+        the routing key.  Bounded by ``digest_max_entries``: hashing
+        more blocks than any digest retains cannot change a score."""
+        bs = self._block_size
+        n = min(int(prompt_ids.size) // bs, self.cfg.digest_max_entries)
+        out: List[str] = []
+        h: Optional[str] = None
+        for i in range(n):
+            h = BlockManager._chain_hash(h, prompt_ids[i * bs:(i + 1) * bs])
+            out.append(h)
+        return out
+
+    def _digest_match(self, rep: Replica, hashes: List[str]) -> int:
+        """Longest cached prefix (in blocks) the replica's digest claims
+        for this prompt.  Scans longest-first: a chain hash pins its
+        whole prefix, so the FIRST membership hit is the answer."""
+        if not hashes:
+            return 0
+        digest = self._replica_digest(rep)
+        for i in range(len(hashes), 0, -1):
+            if hashes[i - 1] in digest:
+                return i
+        return 0
+
+    def _replica_digest(self, rep: Replica) -> frozenset:
+        now = time.monotonic()
+        with self._lock:
+            cached = self._digests.get(rep.replica_id)
+        if cached is not None and now - cached[1] < self.cfg.digest_refresh_s:
+            return cached[0]
+        dg = rep.cache_digest(self.cfg.digest_max_entries)
+        if dg is None:
+            # the replica's step holds its lock right now — score on
+            # the stale digest (or none) rather than stall EVERY
+            # dispatch behind one busy/wedged member
+            return cached[0] if cached is not None else frozenset()
+        fresh = frozenset(dg["hashes"])
+        with self._lock:
+            self._digests[rep.replica_id] = (fresh, now)
+        self.registry.inc("fleet/digest_refreshes")
+        return fresh
+
+    # -------------------------------------------------------- completion
+    def poll(self):
+        """One supervision pass: finalize finished handles, fail
+        terminal rejects, and resubmit every handle whose replica was
+        lost (DEGRADED, or STOPPED with the request unfinished).  Cheap
+        and idempotent — HTTP handlers call it while waiting, tests and
+        benches call it between steps."""
+        from deepspeed_tpu.resilience.health import HealthState
+        if not self._supervise_lock.acquire(blocking=False):
+            return          # another waiter is already supervising
+        try:
+            with self._lock:
+                handles = list(self._inflight.values())
+            for h in handles:
+                cur = h.current
+                if cur is not None and cur.done.is_set():
+                    if cur.state == RequestState.FINISHED:
+                        self._finalize(h)
+                    elif cur.state == RequestState.REJECTED:
+                        self._fail(h, cur.reject_reason or "rejected")
+                    continue
+                rep = self._replica_by_id[h.replica_id]
+                if rep.health.state in (HealthState.DEGRADED,
+                                        HealthState.STOPPED):
+                    self._resubmit(h, reason=f"replica {h.replica_id} "
+                                             f"{rep.health.state.value}")
+            self._update_gauges()
+        finally:
+            self._supervise_lock.release()
+
+    def drain_replica(self, replica_id: int,
+                      reason: str = "fleet drain") -> int:
+        """Gracefully remove one replica from the fleet: flip its health
+        to DRAINING (the membership gate closes immediately), extract
+        its queued AND active requests through the scheduler's standard
+        eviction path, and resubmit each to a healthy replica.  Returns
+        the number of requests moved.  A started replica's loop then
+        drains empty and exits on its own."""
+        rep = self._replica_by_id[replica_id]
+        rep.health.begin_drain(reason)
+        self.registry.inc("fleet/drains")
+        extracted = rep.scheduler.extract_for_resubmit()
+        moved = 0
+        with self._supervise_lock:      # serialize vs concurrent polls
+            for req in extracted:
+                with self._lock:
+                    fid = self._by_replica_req.pop(
+                        (replica_id, req.request_id), None)
+                    h = (self._inflight.get(fid)
+                         if fid is not None else None)
+                if h is None:
+                    continue    # not router-owned (direct submit)
+                self.flightrec.record(
+                    "route/drain", corr=h.corr, replica=replica_id,
+                    generated=len(req.output_ids), reason=reason)
+                self._resubmit(h, reason=f"drain: {reason}")
+                moved += 1
+        self._update_gauges()
+        return moved
+
+    def _resubmit(self, h: FleetRequest, reason: str):
+        """Move one handle to a healthy replica, carrying the committed
+        generated tail: the new submission's prompt is ``original prompt
+        + generated-so-far`` with the remaining new-token budget, which
+        recompute-on-resume semantics continue token-identically."""
+        old, old_rid = h.current, h.replica_id
+        with self._lock:
+            if old is not None:
+                self._by_replica_req.pop((old_rid, old.request_id), None)
+        if old is not None:
+            h.prefix_output.extend(old.output_ids)
+            if h.t_first_token is None and old.t_first_token is not None:
+                h.t_first_token = old.t_first_token
+        carried = len(h.prefix_output)
+        remaining = h.sampling.max_new_tokens - carried
+        eos = h.sampling.eos_token_id
+        if remaining <= 0 or (carried and eos is not None
+                              and h.prefix_output[-1] == eos):
+            # the stream actually completed before the replica went away
+            self._finalize(h)
+            return
+        if h.resubmits >= self.cfg.resubmit_budget:
+            self._fail(h, f"resubmit budget ({self.cfg.resubmit_budget}) "
+                          f"exhausted after {reason}")
+            return
+        candidates = [r for r in self.replicas
+                      if r.is_accepting() and r.replica_id != old_rid]
+        if not candidates:
+            self._fail(h, f"no healthy replica to resubmit to ({reason})")
+            return
+        h.resubmits += 1
+        prompt = np.concatenate(
+            [h.prompt_ids, np.asarray(h.prefix_output, np.int32)])
+        samp = dataclasses.replace(h.sampling, max_new_tokens=remaining)
+        hashes = (self._prompt_hashes(prompt)
+                  if self.cfg.policy == "scored" else [])
+        ordered, _info = self._rank(candidates, hashes, h.session_id)
+        for rep in ordered:
+            try:
+                req = rep.submit(prompt, samp, priority=h.priority,
+                                 timeout_s=h.timeout_s,
+                                 slo_class=h.slo_class)
+            except AdmissionError as e:
+                logger.warning(f"fleet: resubmit of {h.corr} to replica "
+                               f"{rep.replica_id} refused: {e}")
+                continue
+            self._bind(h, rep, req)
+            self.registry.inc("fleet/resubmits")
+            self.flightrec.record(
+                "route/resubmit", corr=h.corr, from_replica=old_rid,
+                to_replica=rep.replica_id, carried_tokens=carried,
+                remaining=remaining, reason=reason)
+            return
+        self._fail(h, f"every healthy replica refused the resubmit "
+                      f"({reason})")
+
+    def _finalize(self, h: FleetRequest):
+        cur = h.current
+        with self._lock:
+            if self._inflight.pop(h.fleet_id, None) is None:
+                return                  # already finalized (poll races)
+            if cur is not None:
+                self._by_replica_req.pop(
+                    (h.replica_id, cur.request_id), None)
+        h.output_ids = list(h.prefix_output) + (
+            list(cur.output_ids) if cur is not None else [])
+        if h.t_first_token is None and cur is not None:
+            h.t_first_token = cur.t_first_token
+        h.t_finish = time.monotonic()
+        h.state = "finished"
+        self.registry.inc("fleet/completed")
+        self.flightrec.record("route/retire", corr=h.corr,
+                              replica=h.replica_id,
+                              generated=len(h.output_ids),
+                              resubmits=h.resubmits, state="finished")
+        h.done.set()
+
+    def _fail(self, h: FleetRequest, reason: str):
+        with self._lock:
+            if self._inflight.pop(h.fleet_id, None) is None:
+                return
+            if h.current is not None:
+                self._by_replica_req.pop(
+                    (h.replica_id, h.current.request_id), None)
+        h.state = "rejected"
+        h.reject_reason = reason
+        h.output_ids = list(h.prefix_output)
+        h.t_finish = time.monotonic()
+        self.registry.inc("fleet/failed")
+        self.flightrec.record("route/retire", corr=h.corr,
+                              replica=h.replica_id, reason=reason,
+                              resubmits=h.resubmits, state="rejected")
+        logger.warning(f"fleet: request {h.corr} failed: {reason}")
+        h.done.set()
+
+    # ------------------------------------------------------------ driving
+    def has_inflight(self) -> bool:
+        with self._lock:
+            return bool(self._inflight)
+
+    def await_result(self, handle: FleetRequest, poll_s: float = 0.05,
+                     timeout: Optional[float] = None) -> bool:
+        """Wait for one handle, supervising the fleet while waiting
+        (the HTTP handler's loop).  True = done, False = timed out."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not handle.done.wait(poll_s):
+            self.poll()
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+        return True
+
+    def run_until_idle(self, max_steps: int = 100_000) -> int:
+        """Manual-mode driver (tests/benches): step every un-started
+        healthy replica with work, then poll, until every handle
+        completes.  Started replicas progress on their own threads."""
+        steps = 0
+        while self.has_inflight():
+            progressed = False
+            for rep in self.replicas:
+                if rep.started or rep.health.is_degraded():
+                    continue
+                if rep.scheduler.has_work():
+                    rep.scheduler.step()
+                    progressed = True
+            self.poll()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_steps} steps")
+            if not progressed and self.has_inflight():
+                time.sleep(0.001)       # started replicas are working
+        return steps
+
+    # ------------------------------------------------------------- views
+    def _update_gauges(self):
+        healthy = sum(r.is_accepting() for r in self.replicas)
+        self.registry.set_gauge("fleet/healthy_replicas", healthy)
+        with self._lock:
+            self.registry.set_gauge("fleet/inflight", len(self._inflight))
+        hits = misses = 0
+        for rep in self.replicas:
+            c = rep.scheduler.metrics.counters
+            hits += c["prefix_cache_hit"]
+            misses += c["prefix_cache_miss"]
+            self.registry.set_gauge("fleet/outstanding_tokens",
+                                    rep.outstanding_tokens(),
+                                    replica=str(rep.replica_id))
+        if hits + misses:
+            self.registry.set_gauge("fleet/prefix_cache_hit_rate",
+                                    round(hits / (hits + misses), 4))
+
+    def aggregate_prefix_hit_rate(self) -> Optional[float]:
+        """Fleet-wide prefix-cache hit rate (the SERVE_MODE=fleet A/B
+        acceptance column): total hits / lookups across replicas."""
+        hits = misses = 0
+        for rep in self.replicas:
+            c = rep.scheduler.metrics.counters
+            hits += c["prefix_cache_hit"]
+            misses += c["prefix_cache_miss"]
+        if hits + misses == 0:
+            return None
+        return hits / (hits + misses)
+
+    def render_metrics(self) -> str:
+        """One merged Prometheus exposition: the router's own fleet/*
+        registry plus every replica's registry under a ``replica`` label
+        (duplicate TYPE lines dropped at the seams)."""
+        texts = [self.registry.render_prometheus()]
+        for rep in self.replicas:
+            texts.append(rep.scheduler.render_metrics(
+                extra_labels={"replica": str(rep.replica_id)}))
+        return merge_prometheus_texts(texts)
+
+    def debug_fleet(self) -> Dict:
+        """The ``/debug/fleet`` body.  Lock-free by the debug-surface
+        contract (ISSUE 7): GIL-atomic snapshots of plain dicts, so it
+        answers even while a dispatch or supervision pass holds the
+        router lock."""
+        inflight = len(self._inflight)
+        sessions = len(self._sessions)
+        digest_ages = {
+            rid: round(time.monotonic() - at, 3)
+            for rid, (_d, at) in list(self._digests.items())}
+        return {
+            "policy": self.cfg.policy,
+            "num_replicas": len(self.replicas),
+            "inflight": inflight,
+            "sessions": sessions,
+            "digest_age_s": digest_ages,
+            "dispatches": {
+                str(r.replica_id): self.registry.get_counter(
+                    "fleet/dispatches", replica=str(r.replica_id))
+                for r in self.replicas},
+            "resubmits": self.registry.get_counter("fleet/resubmits"),
+            "misroutes": self.registry.get_counter("fleet/misroutes"),
+            "aggregate_prefix_hit_rate": self.aggregate_prefix_hit_rate(),
+            "replicas": [r.summary() for r in self.replicas],
+        }
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "Router":
+        for rep in self.replicas:
+            rep.start()
+        return self
+
+    def drain_all(self, reason: str = "fleet shutdown"):
+        """Whole-fleet drain (SIGTERM): every replica finishes its own
+        admitted work in place — with the entire fleet going away there
+        is nowhere to resubmit to."""
+        for rep in self.replicas:
+            rep.health.begin_drain(reason)
+
+    def shutdown(self):
+        for rep in self.replicas:
+            rep.shutdown()
+
+
+def merge_prometheus_texts(texts: List[str]) -> str:
+    """Concatenate Prometheus text expositions, keeping only the FIRST
+    ``# TYPE`` line per metric name (the exposition format allows one)."""
+    seen = set()
+    out: List[str] = []
+    for text in texts:
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                name = line.split()[2]
+                if name in seen:
+                    continue
+                seen.add(name)
+            if line:
+                out.append(line)
+    return "\n".join(out) + "\n"

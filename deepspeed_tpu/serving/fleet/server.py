@@ -1,0 +1,214 @@
+"""Stdlib HTTP front-end for the replica fleet (``bin/ds_router``,
+``ds_serve --replicas N`` — ISSUE 11).
+
+Endpoints:
+
+  POST /generate      same body as the single-replica server plus an
+                      optional ``session_id`` (affinity key); proxied
+                      through the Router to an in-process replica
+                      -> 200 with merged output (+ replica_history),
+                      429 (+ Retry-After) on queue-full/shed, 400 on
+                      malformed bodies, 503 when no replica is READY
+  GET  /healthz       aggregate member states: 200 while ANY replica
+                      accepts work, 503 otherwise; per-replica rows
+  GET  /metrics       ONE merged Prometheus exposition: the router's
+                      fleet/* registry + every replica's registry under
+                      a ``replica="<id>"`` label
+  GET  /debug/fleet   router + per-replica live state
+  GET  /debug/stacks  all-thread stack dump (lock-free, as ever)
+  GET  /debug/flightrec  shared flight-recorder ring (?n=/?corr=/?kind=)
+
+Replicas run their own ServingLoops; handler threads dispatch through
+the Router and supervise it (``await_result`` polls) while they wait —
+the Router needs no thread of its own.
+"""
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepspeed_tpu.serving.fleet.replica import Replica
+from deepspeed_tpu.serving.fleet.router import (FleetUnavailableError,
+                                                Router)
+from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
+                                           RequestShedError)
+from deepspeed_tpu.serving.server import (parse_generate_body,
+                                          send_json_response)
+from deepspeed_tpu.utils.logging import logger
+
+
+def build_fleet(model, params, serving_cfg, num_replicas=None,
+                kv_cache_dtype=None, injector=None, flightrec=None,
+                monitor=None) -> Router:
+    """N replicas over ONE shared model+params (weights are never
+    duplicated — each replica owns only its scheduler, KV pool, health,
+    and registry), routed by a Router configured from
+    ``serving.fleet``."""
+    n = int(num_replicas if num_replicas is not None
+            else serving_cfg.fleet.num_replicas)
+    replicas = [Replica(i, model, params, serving_cfg,
+                        kv_cache_dtype=kv_cache_dtype, injector=injector,
+                        flightrec=flightrec, monitor=monitor)
+                for i in range(n)]
+    return Router(replicas, serving_cfg.fleet, injector=injector,
+                  flightrec=flightrec)
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    # injected by make_fleet_server
+    router: Router = None
+    default_timeout_s = 0.0
+
+    def log_message(self, fmt, *args):
+        logger.debug("ds_router: " + fmt % args)
+
+    def _send_json(self, code: int, payload: dict,
+                   retry_after_s: float = None):
+        # serving/server.py owns the shape + Retry-After clamp for both
+        # front doors
+        send_json_response(self, code, payload,
+                           retry_after_s=retry_after_s)
+
+    def _send_text(self, code: int, text: str):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):
+        from deepspeed_tpu.telemetry.debug import (flightrec_payload,
+                                                   format_thread_stacks,
+                                                   parse_debug_query)
+        router = self.router
+        if self.path == "/healthz":
+            rows = [r.summary() for r in router.replicas]
+            accepting = sum(r["accepting"] for r in rows)
+            self._send_json(
+                200 if accepting else 503,
+                {"status": "ok" if accepting else "unavailable",
+                 "accepting": accepting, "replicas": rows})
+            return
+        if self.path == "/metrics":
+            self._send_text(200, router.render_metrics())
+            return
+        route, query = parse_debug_query(self.path)
+        if route == "/debug/fleet":
+            self._send_json(200, router.debug_fleet())
+            return
+        if route == "/debug/stacks":
+            self._send_text(200, format_thread_stacks())
+            return
+        if route == "/debug/flightrec":
+            self._send_json(200, flightrec_payload(router.flightrec,
+                                                   query))
+            return
+        self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/generate":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            parsed = parse_generate_body(body, self.default_timeout_s)
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        router = self.router
+        try:
+            handle = router.submit(
+                parsed["input_ids"], parsed["sampling"],
+                priority=parsed["priority"],
+                timeout_s=parsed["timeout_s"],
+                slo_class=parsed["slo_class"],
+                session_id=parsed["session_id"])
+        except FleetUnavailableError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except RequestShedError as e:
+            self._send_json(429, {"error": str(e), "shed": True},
+                            retry_after_s=e.retry_after_s)
+            return
+        except QueueFullError as e:
+            # every replica queue-full: the same Retry-After contract
+            # (serving.slo.retry_after_s) as the single-replica server
+            self._send_json(
+                429, {"error": str(e)},
+                retry_after_s=router.replicas[0].scheduler
+                .slo.retry_after_s)
+            return
+        except AdmissionError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        router.await_result(handle)
+        resp = handle.to_response()
+        if handle.reject_reason is not None:
+            self._send_json(429, resp)
+            return
+        self._send_json(200, resp)
+
+
+def make_fleet_server(router: Router, host: str = "127.0.0.1",
+                      port: int = 8000, default_timeout_s: float = 0.0):
+    """ThreadingHTTPServer over a Router — the caller starts the
+    replicas (``router.start()``) and serves.  ``port=0`` binds an
+    ephemeral port (tests)."""
+    handler = type("FleetHandler", (_FleetHandler,),
+                   {"router": router,
+                    "default_timeout_s": default_timeout_s})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_fleet_forever(router: Router, host: str = "127.0.0.1",
+                        port: int = 8000, default_timeout_s: float = 0.0,
+                        install_signal_handlers: bool = True
+                        ):  # pragma: no cover
+    """Start every replica's loop and serve HTTP until a drain
+    completes.  SIGTERM/SIGINT = whole-fleet drain: every replica
+    finishes its admitted work in place (with the whole fleet going
+    away there is no healthy member to resubmit to), then the server
+    exits.  A second signal stops immediately."""
+    router.start()
+    httpd = make_fleet_server(router, host, port, default_timeout_s)
+
+    draining = threading.Event()
+
+    def _on_signal(signum, frame):
+        if draining.is_set():
+            logger.warning(f"ds_router: second signal {signum}; "
+                           "stopping now")
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+            return
+        draining.set()
+        router.drain_all(f"signal {signum}")
+
+        def _await_drain():
+            for rep in router.replicas:
+                rep.join()
+            httpd.shutdown()
+
+        threading.Thread(target=_await_drain, daemon=True).start()
+
+    if install_signal_handlers:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_signal)
+    n = len(router.replicas)
+    cfg = router.replicas[0].scheduler.cfg
+    logger.info(
+        f"ds_router: listening on http://{host}:{httpd.server_port} "
+        f"({n} replicas x {cfg.num_blocks}x{cfg.block_size}-token pools, "
+        f"policy={router.cfg.policy})")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        router.drain_all("KeyboardInterrupt")
+    finally:
+        router.shutdown()
+        httpd.server_close()

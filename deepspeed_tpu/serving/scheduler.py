@@ -175,11 +175,12 @@ class ServingMetrics:
         return [(name, value, step)
                 for name, value in sorted(self.snapshot().items())]
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, extra_labels=None) -> str:
         """Single exposition path: mirror the counters/gauges (and the
         quantile gauges the dashboards want pre-computed) into the
         registry, then render its text format — histogram buckets
-        included."""
+        included.  ``extra_labels`` ride every sample line (the fleet
+        front-end's per-``replica`` label, ISSUE 11)."""
         for k, v in self.counters.items():
             self.registry.set_counter(f"serving/{k}", float(v))
         for k, v in self.gauges.items():
@@ -194,7 +195,7 @@ class ServingMetrics:
                     f"serving/{stem}_{tag}_ms", round(v * 1e3, 3))
         for name, value in self._spec_accept_gauges().items():
             self.registry.set_gauge(name, value)
-        return self.registry.render_prometheus()
+        return self.registry.render_prometheus(extra_labels=extra_labels)
 
 
 class ContinuousBatchingScheduler:
@@ -637,6 +638,52 @@ class ContinuousBatchingScheduler:
         return bool(self._queue) or any(
             r is not None for r in self._slots)
 
+    def outstanding_tokens_unlocked(self) -> int:
+        """Lock-free outstanding-work estimate for the fleet router's
+        least-loaded policy (ISSUE 11): prefill tokens still owed plus
+        decode tokens still to emit, over queued AND active requests.
+        Same GIL-atomic-snapshot reasoning as ``has_work_unlocked`` — a
+        dispatch decision must not queue behind a long step, and an
+        estimate a few tokens stale routes just as well."""
+        total = 0
+        for r in list(self._queue):
+            total += r.prompt_len + max(r.remaining_new_tokens, 0)
+        for r in list(self._slots):
+            if r is None:
+                continue
+            total += max(r.remaining_new_tokens, 0)
+            inputs = r.prefill_inputs
+            if inputs is not None:
+                total += max(int(inputs.size) - r.prefill_pos, 0)
+        return total
+
+    def extract_for_resubmit(self, include_active: bool = True
+                             ) -> List[ServeRequest]:
+        """Fleet drain support (ISSUE 11): remove every queued request
+        and — with ``include_active`` — evict every active row through
+        the standard eviction path (blocks released into the prefix
+        cache, committed generated tail preserved on the request), then
+        hand them ALL back without completing them.  The caller (the
+        fleet Router) resubmits each as a fresh request — prompt plus
+        the generated-so-far tail — on a healthy replica; recompute-on-
+        resume semantics make the continued stream token-identical to
+        the uninterrupted one.  ``done`` is never set here: the original
+        request objects are abandoned carriers, not completions."""
+        with self._lock:
+            extracted = list(self._queue)
+            self._queue.clear()
+            if include_active:
+                for req in list(self._slots):
+                    if req is None:
+                        continue
+                    # the standard eviction frees blocks (publishing
+                    # committed full blocks to the cache) and requeues —
+                    # reclaim it from the queue it just joined
+                    self._evict(req)
+                    self._queue.remove(req)
+                    extracted.append(req)
+            return extracted
+
     @property
     def step_count(self) -> int:
         return self._step_count
@@ -648,11 +695,13 @@ class ContinuousBatchingScheduler:
         with self._lock:
             return self.metrics.snapshot()
 
-    def render_metrics(self) -> str:
+    def render_metrics(self, extra_labels=None) -> str:
         """Prometheus text for the /metrics endpoint (locked, same
-        exposition function as the training-side metrics server)."""
+        exposition function as the training-side metrics server).  The
+        fleet front-end passes ``extra_labels={"replica": "<id>"}`` so
+        N replicas merge into one labeled exposition (ISSUE 11)."""
         with self._lock:
-            return self.metrics.render_prometheus()
+            return self.metrics.render_prometheus(extra_labels=extra_labels)
 
     # ------------------------------------------------- debug introspection
     # Both views below are deliberately LOCK-FREE (ISSUE 7): they exist

@@ -56,6 +56,52 @@ def model_from_spec(spec: str, **overrides):
     return registry[arch](size or "custom", **overrides)
 
 
+def send_json_response(handler, code: int, payload: dict,
+                       retry_after_s: float = None):
+    """Shared JSON responder for BOTH front doors (this single-replica
+    handler and the fleet's, ISSUE 11) — one place owns the error-body
+    shape and the Retry-After rule: integer seconds (RFC 9110), never
+    advertising 0 (the client would hammer straight back into the
+    shed)."""
+    body = json.dumps(payload).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    if retry_after_s is not None:
+        handler.send_header("Retry-After",
+                            str(max(1, int(round(retry_after_s)))))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def parse_generate_body(body: dict, default_timeout_s: float = 0.0):
+    """Decode one ``/generate`` JSON body into scheduler submit args —
+    shared by the single-replica handler here and the fleet front-end
+    (``serving/fleet/server.py``, ISSUE 11) so the two front doors can
+    never drift.  Raises KeyError/TypeError/ValueError on malformed
+    bodies (both handlers map those to 400)."""
+    input_ids = body["input_ids"]
+    sampling = SamplingParams(
+        max_new_tokens=int(body.get("max_new_tokens", 16)),
+        do_sample=bool(body.get("do_sample", False)),
+        temperature=float(body.get("temperature", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        eos_token_id=body.get("eos_token_id"),
+        seed=int(body.get("seed", 0)))
+    return {
+        "input_ids": input_ids,
+        "sampling": sampling,
+        "priority": int(body.get("priority", 0)),
+        "timeout_s": float(body.get("timeout_s", default_timeout_s)),
+        "slo_class": str(body.get("slo_class", "default")),
+        # fleet session affinity (ISSUE 11); the single-replica
+        # scheduler has nowhere to route by it and ignores it
+        "session_id": (str(body["session_id"])
+                       if body.get("session_id") is not None else None),
+    }
+
+
 class ServingLoop:
     """Background thread driving scheduler.step(); idles when drained.
 
@@ -130,7 +176,8 @@ class ServingLoop:
     def shutdown(self):
         self._stop.set()
         self.watchdog.stop()
-        self._thread.join(timeout=5)
+        if self._thread.ident is not None:   # never-started loop: no-op
+            self._thread.join(timeout=5)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -145,17 +192,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ helpers
     def _send_json(self, code: int, payload: dict,
                    retry_after_s: float = None):
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        if retry_after_s is not None:
-            # Retry-After is integer seconds (RFC 9110); never advertise
-            # 0 — the client would hammer straight back into the shed
-            self.send_header("Retry-After",
-                             str(max(1, int(round(retry_after_s)))))
-        self.end_headers()
-        self.wfile.write(body)
+        send_json_response(self, code, payload,
+                           retry_after_s=retry_after_s)
 
     # ------------------------------------------------------------- routes
     def do_GET(self):
@@ -237,27 +275,16 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             n = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(n) or b"{}")
-            input_ids = body["input_ids"]
-            sampling = SamplingParams(
-                max_new_tokens=int(body.get("max_new_tokens", 16)),
-                do_sample=bool(body.get("do_sample", False)),
-                temperature=float(body.get("temperature", 1.0)),
-                top_k=int(body.get("top_k", 0)),
-                top_p=float(body.get("top_p", 1.0)),
-                eos_token_id=body.get("eos_token_id"),
-                seed=int(body.get("seed", 0)))
-            priority = int(body.get("priority", 0))
-            timeout_s = float(body.get("timeout_s",
-                                       self.default_timeout_s))
-            slo_class = str(body.get("slo_class", "default"))
+            parsed = parse_generate_body(body, self.default_timeout_s)
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         try:
-            req = self.scheduler.submit(input_ids, sampling,
-                                        priority=priority,
-                                        timeout_s=timeout_s,
-                                        slo_class=slo_class)
+            req = self.scheduler.submit(parsed["input_ids"],
+                                        parsed["sampling"],
+                                        priority=parsed["priority"],
+                                        timeout_s=parsed["timeout_s"],
+                                        slo_class=parsed["slo_class"])
         except RequestShedError as e:
             # SLO admission control (ISSUE 9): saturated, and this
             # request's class is below the shed cutoff — bounded
@@ -266,7 +293,12 @@ class _Handler(BaseHTTPRequestHandler):
                             retry_after_s=e.retry_after_s)
             return
         except QueueFullError as e:
-            self._send_json(429, {"error": str(e)})
+            # queue-full is the same transient-overload signal as a
+            # shed (ISSUE 11 satellite): both 429 flavors carry the
+            # Retry-After hint so well-behaved clients back off instead
+            # of hammering the full queue
+            self._send_json(429, {"error": str(e)},
+                            retry_after_s=self.scheduler.slo.retry_after_s)
             return
         except AdmissionError as e:
             self._send_json(400, {"error": str(e)})

@@ -56,6 +56,13 @@ KNOWN_EVENT_KINDS = {
     "req/slo_violation": "request finished over its class targets",
     "serve/step": "one scheduler iteration (duration, active, queued)",
     "train/step": "one train_batch iteration (duration)",
+    "route/dispatch": "fleet router placed a request on a replica "
+                      "(policy scores in fields)",
+    "route/drain": "a draining replica's request was extracted for "
+                   "resubmission",
+    "route/resubmit": "request resubmitted to another replica (drain or "
+                      "replica loss; carried tokens in fields)",
+    "route/retire": "fleet request completed or failed at the router",
     "anomaly/": "prefix family: step-latency outliers flagged by the "
                 "MAD detector (anomaly/train.step, anomaly/serve.step)",
     "postmortem": "a post-mortem bundle was written",

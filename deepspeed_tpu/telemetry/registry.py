@@ -235,10 +235,16 @@ class MetricsRegistry:
         return out
 
     # --------------------------------------------------------- exposition
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, extra_labels: Optional[Dict[str, str]]
+                          = None) -> str:
         """THE text exposition function: Prometheus 0.0.4 text format,
         rendered identically by ``ds_serve /metrics`` and the training
-        metrics endpoint."""
+        metrics endpoint.  ``extra_labels`` are appended to every sample
+        line — the fleet front-end (ISSUE 11) renders each replica's
+        isolated registry with ``{"replica": "<id>"}`` and merges the
+        texts into one exposition."""
+        extra = tuple(sorted((str(k), str(v))
+                             for k, v in (extra_labels or {}).items()))
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
@@ -254,20 +260,21 @@ class MetricsRegistry:
         for (name, lk), v in counters:
             n = _prom_name(name)
             type_line(n, "counter")
-            lines.append(f"{n}{_prom_labels(lk)} {_fmt(v)}")
+            lines.append(f"{n}{_prom_labels(lk, extra)} {_fmt(v)}")
         for (name, lk), v in gauges:
             n = _prom_name(name)
             type_line(n, "gauge")
-            lines.append(f"{n}{_prom_labels(lk)} {_fmt(v)}")
+            lines.append(f"{n}{_prom_labels(lk, extra)} {_fmt(v)}")
         for (name, lk), h in hists:
             n = _prom_name(name)
             type_line(n, "histogram")
             for bound, acc in h.cumulative_counts():
                 le = "+Inf" if bound == float("inf") else _fmt(bound)
                 lines.append(
-                    f"{n}_bucket{_prom_labels(lk, (('le', le),))} {acc}")
-            lines.append(f"{n}_sum{_prom_labels(lk)} {_fmt(h.sum)}")
-            lines.append(f"{n}_count{_prom_labels(lk)} {h.count}")
+                    f"{n}_bucket"
+                    f"{_prom_labels(lk, extra + (('le', le),))} {acc}")
+            lines.append(f"{n}_sum{_prom_labels(lk, extra)} {_fmt(h.sum)}")
+            lines.append(f"{n}_count{_prom_labels(lk, extra)} {h.count}")
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------ monitor bridge

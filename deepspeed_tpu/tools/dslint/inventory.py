@@ -48,6 +48,7 @@ SUBMODELS = {
     "serving.prefix_cache": "PrefixCacheConfig",
     "serving.slo": "SLOConfig",
     "serving.chunked_prefill": "ChunkedPrefillConfig",
+    "serving.fleet": "FleetConfig",
     "resilience.retry": "RetryConfig",
 }
 DICT_SUBMODELS = {

@@ -145,6 +145,25 @@ METRICS = {
     "serving/spec_accept_rate": "accepted/drafted gauge",
     "serve/spec_accept_len": "tokens emitted per verify pass histogram "
                              "(+ p50/p90/p99/mean gauges)",
+    # --- fleet routing (serving/fleet, ISSUE 11)
+    "fleet/dispatches": "requests placed on a replica, labeled by "
+                        "replica",
+    "fleet/misroutes": "fleet.dispatch deny faults routed policy-blind",
+    "fleet/unroutable": "submissions with no READY replica",
+    "fleet/resubmits": "requests moved to another replica (drain / "
+                       "replica loss)",
+    "fleet/drains": "replica drains initiated through the router",
+    "fleet/completed": "fleet requests finished",
+    "fleet/failed": "fleet requests terminally failed at the router",
+    "fleet/prefix_routed": "dispatches won by a prefix-digest match",
+    "fleet/affinity_hits": "dispatches that honored session affinity",
+    "fleet/digest_refreshes": "replica cache-digest refreshes",
+    "fleet/healthy_replicas": "READY replicas gauge",
+    "fleet/inflight": "router-tracked in-flight requests gauge",
+    "fleet/outstanding_tokens": "per-replica outstanding token budget "
+                                "gauge, labeled by replica",
+    "fleet/prefix_cache_hit_rate": "fleet-aggregate prefix-cache hit "
+                                   "rate gauge",
     # --- serving: SLO accounting
     "serving/slo_requests": "finished requests with SLO accounting, "
                             "labeled by class",

@@ -13,7 +13,9 @@ kills the process:
 - serving-loop step failures degrading health instead of spinning;
 - kv.alloc denial driving preemption + recompute-on-resume;
 - serve.chunk raise mid-chunked-prefill resuming from the committed
-  cursor (ISSUE 9).
+  cursor (ISSUE 9);
+- fleet replica loss mid-stream: the router resubmits the committed
+  stream to a surviving replica, token-identical (ISSUE 11).
 
 Usage::
 
@@ -321,6 +323,53 @@ def case_chunk_fault_resumes_from_cursor():
     sched.block_mgr.check_invariant()
 
 
+def case_fleet_replica_loss_resubmits():
+    """Fleet replica loss mid-stream (ISSUE 11): two replicas behind
+    the Router, a request decoding on one of them when that replica is
+    lost (DEGRADED, never stepped again).  poll() must resubmit the
+    stream — prompt + committed tokens — to the surviving replica, the
+    completed output must be token-identical to the uninterrupted
+    greedy reference, and the flight recorder must show the
+    dispatch -> resubmit arc under the request's fleet corr id."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import SamplingParams
+    from deepspeed_tpu.serving.fleet import Replica, Router
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        max_fused_steps=1,
+                        fleet={"num_replicas": 2, "digest_refresh_s": 0})
+    replicas = [Replica(i, model, eng.params, cfg) for i in range(2)]
+    router = Router(replicas, cfg.fleet)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 128, (10,)).astype(np.int32)
+    h = router.submit(prompt, SamplingParams(max_new_tokens=12),
+                      session_id="chaos")
+    victim = replicas[h.replica_id]
+    # decode a few tokens on the victim, then lose it mid-stream
+    while len(h.current.output_ids) < 3:
+        victim.scheduler.step()
+    victim.health.mark_degraded("chaos: replica lost")
+    router.run_until_idle()
+    ref = np.asarray(eng.generate(prompt[None], max_new_tokens=12,
+                                  do_sample=False))[0, prompt.size:]
+    assert h.state == "finished", h.state
+    assert h.resubmits == 1, h.resubmits
+    assert len(set(h.replica_history)) == 2, h.replica_history
+    assert np.array_equal(np.asarray(h.output_ids), ref)
+    kinds = [e["kind"] for e in router.flightrec.events(corr=h.corr)]
+    assert kinds[0] == "route/dispatch" and "route/resubmit" in kinds \
+        and kinds[-1] == "route/retire", kinds
+    # session affinity followed the stream to the surviving replica
+    assert router._sessions.get("chaos") == h.replica_id
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="resilience chaos smoke")
     p.add_argument("--fast", action="store_true",
@@ -355,6 +404,8 @@ def main(argv=None):
                   case_prefix_cache_fault_degrades))
     cases.append(("serve.chunk fault resumes from committed cursor",
                   case_chunk_fault_resumes_from_cursor))
+    cases.append(("fleet replica loss resubmits mid-stream",
+                  case_fleet_replica_loss_resubmits))
 
     results = []
     for name, fn in cases:

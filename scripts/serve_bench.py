@@ -16,6 +16,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=moe python scripts/serve_bench.py            # mixtral A/B
     SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
     SERVE_MODE=slo SERVE_LONG_LEN=8192 python scripts/serve_bench.py
+    SERVE_MODE=fleet SERVE_REPLICAS=2 python scripts/serve_bench.py
     SERVE_MODE=cb python scripts/serve_bench.py --json out.json
 
 ``--json out.json`` (ISSUE 7 satellite) additionally writes the result
@@ -48,6 +49,12 @@ reporting p50/p99 TPOT and TTFT per SLO class.  The acceptance shape:
 with chunking OFF the chat class's p99 TPOT spikes at each long-prompt
 arrival (the whole prefill runs in one scheduler iteration); with
 chunking ON it stays bounded near p50.
+Fleet mode (ISSUE 11) routes a shared-prefix workload across N replica
+schedulers (each with its own prefix cache) through the fleet Router,
+A/B'ing the prefix-aware scored policy vs round-robin — token-identical
+outputs asserted — and reports the aggregate prefix-cache hit rate per
+policy (the acceptance column: scored routing concentrates same-prefix
+traffic on the replica that already holds it, round-robin scatters it).
 Off-TPU this still runs (tiny default shapes) as a plumbing smoke.
 """
 import argparse
@@ -133,7 +140,7 @@ def main(argv=None):
         size = size or "tiny"
         kwargs = {}
     elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe",
-                                          "slo"):
+                                          "slo", "fleet"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -145,7 +152,7 @@ def main(argv=None):
     # cb/spec modes size their own workloads (spec's motif-tiled prompts
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
-    if _mode not in ("cb", "spec", "prefix", "moe", "slo"):
+    if _mode not in ("cb", "spec", "prefix", "moe", "slo", "fleet"):
         cb_ctx = 0
     elif _mode == "slo":
         # headroom for the adversarial long prompts (heavy-prefill
@@ -154,9 +161,9 @@ def main(argv=None):
             "SERVE_LONG_LEN", 8192 if on_tpu else 640)) + 256
     elif on_tpu:
         cb_ctx = 768 + 384
-    elif _mode == "prefix":
+    elif _mode in ("prefix", "fleet"):
         # headroom for the shared system prompts — the long-shared-head
-        # short-tail regime is the whole point of this mode
+        # short-tail regime is the whole point of these modes
         cb_ctx = int(os.environ.get("SERVE_SYS_LEN", 512)) + 128
     else:
         cb_ctx = 96 if _mode in ("cb", "moe") else 128
@@ -198,6 +205,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "slo":
         return bench_slo_chunked(model, eng, spec, kv_dtype, on_tpu,
                                  json_path)
+    if os.environ.get("SERVE_MODE") == "fleet":
+        return bench_fleet_routing(model, eng, spec, kv_dtype, on_tpu,
+                                   json_path)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -693,6 +703,117 @@ def bench_slo_chunked(model, eng, spec, kv_dtype, on_tpu,
         "value": detail["chat_tpot_p99_ms"],
         "unit": "chat_p99_tpot_ms",
         "detail": detail,
+    }, json_path)
+
+
+def bench_fleet_routing(model, eng, spec, kv_dtype, on_tpu,
+                        json_path=None):
+    """Shared-prefix workload through the fleet Router (ISSUE 11):
+    N requests over M shared system prompts dispatched across
+    ``SERVE_REPLICAS`` replica schedulers, submitted in waves (the
+    steady-traffic regime — routing decisions see the caches earlier
+    waves populated).  A/B: the prefix-aware scored policy vs
+    round-robin, token-identical greedy outputs asserted; the record
+    carries the aggregate prefix-cache hit rate per policy (the
+    acceptance column: scored > round_robin) plus per-replica dispatch
+    counts and resubmit/misroute counters."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import SamplingParams
+    from deepspeed_tpu.serving.fleet import Replica, Router
+
+    n_replicas = int(os.environ.get("SERVE_REPLICAS", 2))
+    n_reqs = int(os.environ.get("SERVE_REQS", 32 if on_tpu else 12))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    n_sys = int(os.environ.get("SERVE_SYS_PROMPTS", 4 if on_tpu else 3))
+    sys_len = int(os.environ.get("SERVE_SYS_LEN", 512))
+    wave = int(os.environ.get("SERVE_WAVE", max(n_replicas * 2, 4)))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    t_lo, t_hi = ((16, 96) if on_tpu else (4, 16))
+    n_lo, n_hi = ((32, 128) if on_tpu else (6, 20))
+    systems = [rng.integers(1, V, (sys_len,)).astype(np.int32)
+               for _ in range(n_sys)]
+    workload = []
+    for i in range(n_reqs):
+        tail = rng.integers(1, V, (int(rng.integers(t_lo, t_hi)),))
+        prompt = np.concatenate([systems[int(rng.integers(n_sys))], tail])
+        workload.append((prompt.astype(np.int32),
+                         int(rng.integers(n_lo, n_hi))))
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 8
+    need = -(-max_len // bs) + 1
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * (max_seqs + n_sys + 1),
+                max_num_batched_tokens=1 << 30,
+                prefix_cache={"enabled": True})
+
+    def run(policy):
+        cfg = ServingConfig(**base, fleet={
+            "num_replicas": n_replicas, "policy": policy,
+            # always-fresh digests: the A/B measures the POLICY, not
+            # digest staleness
+            "digest_refresh_s": 0})
+        replicas = [Replica(i, model, eng.params, cfg,
+                            kv_cache_dtype=kv_dtype)
+                    for i in range(n_replicas)]
+        router = Router(replicas, cfg.fleet)
+
+        def dispatch_counts():
+            return {str(r.replica_id): int(router.registry.get_counter(
+                "fleet/dispatches", replica=str(r.replica_id)))
+                for r in replicas}
+
+        outs, warm = None, {}
+        for it in range(2):         # warm compiles, then measure
+            handles = []
+            t0 = _time.time()
+            for i in range(0, n_reqs, wave):
+                handles.extend(
+                    router.submit(p, SamplingParams(max_new_tokens=nn))
+                    for p, nn in workload[i:i + wave])
+                router.run_until_idle()
+            dt = _time.time() - t0
+            assert all(len(h.output_ids) == nn
+                       for h, (_, nn) in zip(handles, workload))
+            outs = [list(h.output_ids) for h in handles]
+            if it == 0:
+                warm = dispatch_counts()   # the record reports only the
+        counts = {rid: n - warm.get(rid, 0)  # measured pass's spread
+                  for rid, n in dispatch_counts().items()}
+        return dt, outs, router.aggregate_prefix_hit_rate(), counts
+
+    sc_s, sc_out, sc_hit, sc_counts = run("scored")
+    rr_s, rr_out, rr_hit, rr_counts = run("round_robin")
+    assert sc_out == rr_out, \
+        "routing policy changed greedy output (parity violation)"
+    if n_replicas > 1 and n_sys > 1:
+        # the acceptance column: concentrating same-prefix traffic can
+        # never LOSE to scattering it (strictly above on the default
+        # smoke: 0.873 vs 0.831 — see PERF.md PR 11)
+        assert sc_hit >= rr_hit, \
+            (f"prefix-aware routing hit rate {sc_hit} fell below "
+             f"round-robin {rr_hit}")
+    emit({
+        "metric": f"{spec}_serve_fleet"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / sc_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "replicas": n_replicas, "requests": n_reqs,
+            "system_prompts": n_sys, "system_len": sys_len,
+            "wave": wave, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "block_size": bs,
+            "scored_tok_s": round(useful / sc_s, 1),
+            "round_robin_tok_s": round(useful / rr_s, 1),
+            "prefix_hit_rate_scored": (round(sc_hit, 4)
+                                       if sc_hit is not None else None),
+            "prefix_hit_rate_round_robin": (
+                round(rr_hit, 4) if rr_hit is not None else None),
+            "dispatches_scored": sc_counts,
+            "dispatches_round_robin": rr_counts,
+        },
     }, json_path)
 
 

@@ -683,6 +683,63 @@ def test_prefix_cache_metrics_surface(served):
 
 
 # ------------------------------------------------------------ HTTP layer
+def test_both_429_flavors_carry_retry_after(served):
+    """ISSUE 11 satellite: the queue-full 429 carries a Retry-After
+    header exactly like the shed 429 (PR 9 added it only on the shed
+    path) — both are transient-overload signals clients should back
+    off from, not hammer."""
+    from deepspeed_tpu.serving.server import make_server
+    m, eng = served
+    cfg = ServingConfig(
+        block_size=8, num_blocks=32, max_num_seqs=2, max_queued=4,
+        slo={"enabled": True, "shed_enabled": True,
+             "shed_queue_fraction": 0.5,
+             "classes": {"chat": {"priority": 1},
+                         "batch": {"priority": 0}}})
+    sched = ContinuousBatchingScheduler(m, eng.params, cfg)
+    httpd, loop = make_server(sched, port=0)
+    # the loop is deliberately NOT started: queued work stays queued,
+    # so both overload paths are reachable deterministically
+    loop.health.mark_ready()
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_port}"
+
+    def post(slo_class):
+        body = json.dumps({"input_ids": [1, 2, 3], "max_new_tokens": 2,
+                           "slo_class": slo_class}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, dict(resp.headers), {}
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+    try:
+        prompts = _mixed_prompts(4, seed=20)
+        # queue pressure at shed_queue_fraction: the lowest class sheds
+        for p in prompts[:2]:
+            sched.submit(p, SamplingParams(max_new_tokens=4),
+                         slo_class="chat")
+        code, headers, body = post("batch")
+        assert code == 429 and body.get("shed") is True
+        assert int(headers["Retry-After"]) >= 1
+        # queue full: the blanket 429 now carries the same hint
+        for p in prompts[2:]:
+            sched.submit(p, SamplingParams(max_new_tokens=4),
+                         slo_class="chat")
+        code, headers, body = post("chat")
+        assert code == 429 and "queue full" in body["error"]
+        assert not body.get("shed")
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
 def test_ds_serve_help_smoke():
     """tier-1 CLI smoke: bin/ds_serve --help exits 0."""
     out = subprocess.run([sys.executable, "bin/ds_serve", "--help"],
