@@ -25,6 +25,8 @@ combined group the reference uses when Ulysses is active (engine.py:1460,
 groups.py:459 ``_get_sequence_data_parallel_group``) — and expert parameters over
 ``("data", "seq")`` (the expert-data-parallel group).
 """
+import contextlib
+import contextvars
 from dataclasses import dataclass, field
 from typing import Optional, Sequence, Tuple
 
@@ -212,6 +214,42 @@ class MeshTopology:
 
 
 _TOPOLOGY: Optional[MeshTopology] = None
+
+
+#: trace-time switch for layout pins (``pin_sharding`` below).  Default
+#: on: the SPMD training/static-inference programs rely on them.
+_PIN_SHARDINGS: contextvars.ContextVar = contextvars.ContextVar(
+    "ds_pin_shardings", default=True)
+
+
+@contextlib.contextmanager
+def sharding_pin_scope(enabled: bool):
+    """Disable (or force) intermediate-layout pins for code TRACED inside
+    this scope.  The serving scheduler wraps its compiled programs with
+    ``enabled=False``: those programs are single-device by design
+    (ROADMAP item 1 — the fleet/sharded tier is the multi-device path),
+    and a training-mesh pin engaging inside them (possible whenever a
+    batched-window token count divides the data axis) hands this
+    jaxlib's SPMD partitioner a gather/scatter-heavy program it
+    miscompiles (reproduced: mixtral spec verify, window width 8, 8
+    virtual CPU devices → zero logits; width 5 — pin skipped on
+    divisibility — correct)."""
+    token = _PIN_SHARDINGS.set(enabled)
+    try:
+        yield
+    finally:
+        _PIN_SHARDINGS.reset(token)
+
+
+def pin_sharding(x, sharding):
+    """``with_sharding_constraint`` that ``sharding_pin_scope(False)``
+    turns into a no-op — every intermediate-layout pin in model code
+    should route through this so single-device serving programs can
+    shed the training-mesh pins at trace time."""
+    if not _PIN_SHARDINGS.get():
+        return x
+    import jax.lax
+    return jax.lax.with_sharding_constraint(x, sharding)
 
 
 def set_topology(topo: MeshTopology):

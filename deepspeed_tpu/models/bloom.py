@@ -211,6 +211,24 @@ def _serving_fns(config: BloomConfig):
                 config.layer_norm_eps)
         return x @ params["wte"].astype(dt).T
 
+    # fused per-layer megakernel wiring (ISSUE 12): head-major fused QKV
+    # + ALiBi decode attention + GELU MLP in one Pallas call
+    from deepspeed_tpu.ops.pallas.fused_decode import FusedLayerSpec
+    fused_spec = FusedLayerSpec(
+        num_heads=config.num_heads, num_kv_heads=config.num_heads,
+        head_dim=config.head_dim, d_model=config.d_model,
+        norm="ln", eps=config.layer_norm_eps, qkv="headmajor",
+        qkv_bias=True, out_bias=True, mlp="gelu_tanh", mlp_bias=True,
+        alibi=True)
+
+    def fused_weights(layer):
+        return {"n1_s": layer["ln1_scale"], "n1_b": layer["ln1_bias"],
+                "wqkv": layer["qkv_w"], "bqkv": layer["qkv_b"],
+                "wo": layer["dense_w"], "bo": layer["dense_b"],
+                "n2_s": layer["ln2_scale"], "n2_b": layer["ln2_bias"],
+                "w_in": layer["mlp_in_w"], "b_in": layer["mlp_in_b"],
+                "w_out": layer["mlp_out_w"], "b_out": layer["mlp_out_b"]}
+
     def init_cache_fn(bs, max_len, dtype=None):
         return serving.init_cache(config.num_layers, config.num_heads,
                                   config.head_dim, bs, max_len, dtype,
@@ -227,13 +245,15 @@ def _serving_fns(config: BloomConfig):
         return serving.decode_step(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads, alibi_slopes=slopes)
+            num_heads=config.num_heads, alibi_slopes=slopes,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     def verify_fn(p, t, c, l):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads, alibi_slopes=slopes)
+            num_heads=config.num_heads, alibi_slopes=slopes,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 

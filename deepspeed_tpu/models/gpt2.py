@@ -300,6 +300,29 @@ def forward(params: dict, batch: dict, config: GPT2Config, rng=None):
 # inference containers' cache management).  Caches are [L, B, S_max, H, hd];
 # decode is a lax.scan over layers with a single-token decode-attention kernel.
 
+def _fused_spec(config: GPT2Config, sm_scale=None):
+    """Fused-megakernel layer spec (ISSUE 12): LN + fused QKV + decode
+    attention + GELU MLP, serial residual.  ``sm_scale`` is the GPT-Neo
+    unscaled-score hook (a static float, so it rides the spec); the
+    ``min_pos_fn`` sliding-window hook keeps the unfused path."""
+    from deepspeed_tpu.ops.pallas.fused_decode import FusedLayerSpec
+    mlp = {"gelu": "gelu_tanh", "gelu_exact": "gelu_exact",
+           "relu": "relu"}.get(config.activation, "gelu_tanh")
+    return FusedLayerSpec(
+        num_heads=config.num_heads, num_kv_heads=config.num_heads,
+        head_dim=config.head_dim, d_model=config.d_model,
+        norm="ln", eps=config.layer_norm_eps, qkv="fused", qkv_bias=True,
+        out_bias=True, mlp=mlp, mlp_bias=True, sm_scale=sm_scale)
+
+
+def _fused_weights(layer):
+    return {"n1_s": layer["ln1_scale"], "n1_b": layer["ln1_bias"],
+            "wqkv": layer["qkv_w"], "bqkv": layer["qkv_b"],
+            "wo": layer["proj_w"], "bo": layer["proj_b"],
+            "n2_s": layer["ln2_scale"], "n2_b": layer["ln2_bias"],
+            "w_in": layer["mlp_in_w"], "b_in": layer["mlp_in_b"],
+            "w_out": layer["mlp_out_w"], "b_out": layer["mlp_out_b"]}
+
 def init_cache(config: GPT2Config, batch_size: int, max_len: int, dtype=None):
     """``dtype="int8"`` selects the quantized cache: int8 payload + one
     fp32 scale per cached head-vector — half the HBM bytes the
@@ -374,14 +397,17 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
 
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
-    if (use_scan_decode(params["blocks"])
+    from deepspeed_tpu.models import serving as _sv
+    fused = (min_pos_fn is None
+             and _sv.fused_decode_active(params["blocks"],
+                                         _fused_spec(config, sm_scale)))
+    if (use_scan_decode(params["blocks"], fused=fused)
             and sm_scale is None and min_pos_fn is None):
         # large int8 models: scan serializes the per-layer dequant (the
         # unrolled loop lets XLA materialize every layer's bf16 weights
         # at once — see serving.quantized_layer_bytes).  The GPT-Neo
         # hooks (sm_scale/min_pos_fn) keep the unrolled form — those
         # variants don't reach this scale quantized.
-        from deepspeed_tpu.models import serving as _sv
         return _sv.decode_step_scan(
             params, x, cache, lengths,
             qkv_fn=lambda xx, layer, pos: _block_qkv(xx, layer, config),
@@ -389,6 +415,12 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
                 xx, attn, layer, config),
             head_fn=lambda p, xx: head(p, xx, config),
             num_heads=config.num_heads)
+    if fused:
+        # ONE Pallas call per layer (ISSUE 12)
+        x, cache = _sv._fused_layer_pass(
+            params, x[:, None, :], cache, lengths,
+            spec=_fused_spec(config, sm_scale), weights_fn=_fused_weights)
+        return head(params, x, config)[:, 0], cache
 
     # python-unrolled layer loop with in-place one-hot cache writes: 2.2x
     # faster than the round-4 lax.scan + scatter form (the scan
@@ -446,6 +478,14 @@ def verify_window(params, tokens, cache, lengths, config: GPT2Config,
     positions = lengths[:, None] + jnp.arange(W)[None, :]   # [B, W]
     x = (params["wte"].astype(dtype)[tokens] +
          params["wpe"].astype(dtype)[positions])            # [B, W, D]
+    from deepspeed_tpu.models import serving as _sv
+    if min_pos_fn is None and _sv.fused_decode_active(
+            params["blocks"], _fused_spec(config, sm_scale)):
+        # the whole window per layer in ONE Pallas call (ISSUE 12)
+        x, cache = _sv._fused_layer_pass(
+            params, x, cache, lengths,
+            spec=_fused_spec(config, sm_scale), weights_fn=_fused_weights)
+        return head(params, x, config), cache
     quantized = "k_s" in cache
     keep_q = qgemm_active(params["blocks"])
     kc, vc = cache["k"], cache["v"]

@@ -276,6 +276,27 @@ def _serving_fns(config: LlamaConfig):
     def head_fn(params, x):
         return head(params, x, config)
 
+    # fused per-layer megakernel wiring (ISSUE 12): RMSNorm + split QKV
+    # + full rotary + GQA decode attention + SwiGLU in one Pallas call
+    from deepspeed_tpu.ops.pallas.fused_decode import FusedLayerSpec
+    fused_spec = FusedLayerSpec(
+        num_heads=config.num_heads, num_kv_heads=config.num_kv_heads,
+        head_dim=config.head_dim, d_model=config.d_model,
+        norm="rms", eps=config.rms_norm_eps, qkv="split",
+        qkv_bias=config.attn_bias, out_bias=config.attn_bias,
+        mlp="swiglu", mlp_bias=False, rotary_dims=config.head_dim,
+        rope_theta=config.rope_theta)
+
+    def fused_weights(layer):
+        cw = {"n1_s": layer["attn_norm"], "wq": layer["wq"],
+              "wk": layer["wk"], "wv": layer["wv"], "wo": layer["wo"],
+              "n2_s": layer["mlp_norm"], "w_gate": layer["w_gate"],
+              "w_up": layer["w_up"], "w_down": layer["w_down"]}
+        if config.attn_bias:
+            cw.update(bq=layer["wq_b"], bk=layer["wk_b"],
+                      bv=layer["wv_b"], bo=layer["wo_b"])
+        return cw
+
     def init_cache_fn(bs, max_len, dtype=None):
         return serving.init_cache(config.num_layers, config.num_kv_heads,
                                   config.head_dim, bs, max_len, dtype,
@@ -292,13 +313,15 @@ def _serving_fns(config: LlamaConfig):
         return serving.decode_step(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     def verify_fn(p, t, c, l):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 

@@ -212,6 +212,28 @@ def _serving_fns(config: MixtralConfig):
         x = _rms_norm(x, params["final_norm"], config.rms_norm_eps)
         return x @ params["lm_head"].astype(jnp.dtype(config.dtype))
 
+    # fused per-layer megakernel wiring (ISSUE 12): the kernel fuses
+    # RMSNorm + QKV + rotary + GQA decode attention + attn-out
+    # (mlp="none"); the routed-expert FFN stays OUTSIDE as the
+    # ``moe_tail_fn`` so it keeps riding the grouped-GEMM slot kernels
+    # (ISSUE 8) — one megakernel launch + the expert dispatch per layer
+    from deepspeed_tpu.ops.pallas.fused_decode import FusedLayerSpec
+    fused_spec = FusedLayerSpec(
+        num_heads=config.num_heads, num_kv_heads=config.num_kv_heads,
+        head_dim=config.head_dim, d_model=config.d_model,
+        norm="rms", eps=config.rms_norm_eps, qkv="split",
+        qkv_bias=False, out_bias=False, mlp="none",
+        rotary_dims=config.head_dim, rope_theta=config.rope_theta)
+
+    def fused_weights(layer):
+        return {"n1_s": layer["attn_norm"], "wq": layer["wq"],
+                "wk": layer["wk"], "wv": layer["wv"], "wo": layer["wo"]}
+
+    def moe_tail(x, layer):
+        h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+        moe_out, _ = moe_layer(layer["moe"], h, config.moe, train=False)
+        return x + moe_out
+
     def init_cache_fn(bs, max_len, dtype=None):
         return serving.init_cache(config.num_layers, config.num_kv_heads,
                                   config.head_dim, bs, max_len, dtype,
@@ -229,14 +251,18 @@ def _serving_fns(config: MixtralConfig):
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads,
-            moe_grouped=serving.moe_dispatch_grouped(config.moe))
+            moe_grouped=serving.moe_dispatch_grouped(config.moe),
+            fused_spec=fused_spec, fused_weights_fn=fused_weights,
+            moe_tail_fn=moe_tail)
 
     def verify_fn(p, t, c, l):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads,
-            moe_grouped=serving.moe_dispatch_grouped(config.moe))
+            moe_grouped=serving.moe_dispatch_grouped(config.moe),
+            fused_spec=fused_spec, fused_weights_fn=fused_weights,
+            moe_tail_fn=moe_tail)
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 

@@ -226,6 +226,30 @@ def _serving_fns(config: NeoXConfig):
                 jnp.dtype(config.dtype))
         return logits
 
+    # fused per-layer megakernel wiring (ISSUE 12): head-major fused QKV
+    # + partial rotary + parallel/serial residual in one Pallas call.
+    # GPT-J-converted checkpoints (rotary_interleaved) keep the unfused
+    # path — the spec reports itself unsupported
+    from deepspeed_tpu.ops.pallas.fused_decode import FusedLayerSpec
+    fused_spec = FusedLayerSpec(
+        num_heads=config.num_heads, num_kv_heads=config.num_heads,
+        head_dim=config.head_dim, d_model=config.d_model,
+        norm="ln", eps=config.layer_norm_eps, qkv="headmajor",
+        qkv_bias=True, out_bias=True,
+        mlp="gelu_tanh" if config.gelu_approximate else "gelu_exact",
+        mlp_bias=True,
+        residual="parallel" if config.use_parallel_residual else "serial",
+        rotary_dims=config.rotary_ndims, rope_theta=config.rope_theta,
+        rotary_interleaved=config.rotary_interleaved)
+
+    def fused_weights(layer):
+        return {"n1_s": layer["ln1_scale"], "n1_b": layer["ln1_bias"],
+                "wqkv": layer["qkv_w"], "bqkv": layer["qkv_b"],
+                "wo": layer["dense_w"], "bo": layer["dense_b"],
+                "n2_s": layer["ln2_scale"], "n2_b": layer["ln2_bias"],
+                "w_in": layer["mlp_in_w"], "b_in": layer["mlp_in_b"],
+                "w_out": layer["mlp_out_w"], "b_out": layer["mlp_out_b"]}
+
     def init_cache_fn(bs, max_len, dtype=None):
         return serving.init_cache(config.num_layers, config.num_heads,
                                   config.head_dim, bs, max_len, dtype,
@@ -242,13 +266,15 @@ def _serving_fns(config: NeoXConfig):
         return serving.decode_step(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     def verify_fn(p, t, c, l):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
-            num_heads=config.num_heads)
+            num_heads=config.num_heads,
+            fused_spec=fused_spec, fused_weights_fn=fused_weights)
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 

@@ -195,7 +195,80 @@ def qgemm_active(blocks) -> bool:
                    blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
 
 
-def use_scan_decode(blocks, moe_grouped: bool = False) -> bool:
+def fused_decode_active(blocks, spec) -> bool:
+    """Whether the decode/verify-window paths should take the fused
+    per-layer megakernel path (``ops/pallas/fused_decode.ds_fused_layer``
+    — ISSUE 12): the family wired a supported ``FusedLayerSpec`` AND the
+    toggle resolution (scope > DS_FUSED_DECODE > serving.fused_decode >
+    auto-on-TPU) says fused.  The unfused composition stays the
+    DS_FUSED_DECODE=0 fallback and the only path for variants the spec
+    can't express (GPT-Neo's per-layer sliding-window floor, GPT-J
+    interleaved rotary)."""
+    from deepspeed_tpu.ops.pallas.fused_decode import fused_decode_enabled
+    if spec is None or not spec.supported():
+        return False
+    return fused_decode_enabled()
+
+
+def _fused_keep_quantized(blocks) -> bool:
+    """Int8 2-D projection weights stay ``QuantizedTensor`` into the
+    fused path when SOME kernel consumes them in place: the megakernel
+    itself (in-kernel selector-matmul dequant) when it is real, else
+    the qgemm kernel the reference composition's qdot sites call."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    from deepspeed_tpu.ops.pallas.fused_decode import fused_kernel_real
+    has_q2 = any(isinstance(leaf, QuantizedTensor) and leaf.q.ndim == 3
+                 for leaf in jax.tree_util.tree_leaves(
+                     blocks, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
+    if not has_q2:
+        return False
+    return fused_kernel_real() or qgemm_active(blocks)
+
+
+def _fused_layer_pass(params, x, cache, lengths, *, spec, weights_fn,
+                      alibi_slopes=None, moe_tail_fn=None,
+                      moe_grouped: bool = False):
+    """The fused per-layer loop shared by decode_step (W=1) and
+    verify_window: ONE ``ds_fused_layer`` call per layer replaces the
+    qkv_fn / per-position cache-write / decode_attention / finish_fn
+    composition (~6 kernel launches per layer on chip), then the
+    window's new KV vectors land in the stacked cache with the same
+    ``write_token`` select the unfused path uses.  ``moe_tail_fn(x,
+    layer) -> x`` runs a family's routed-expert FFN outside the kernel
+    (mlp="none" specs — the expert GEMMs ride the grouped-GEMM slot
+    kernels, ISSUE 8).  Returns (x [B, W, D], cache)."""
+    from deepspeed_tpu.models.model import maybe_stream
+    from deepspeed_tpu.ops.pallas.fused_decode import ds_fused_layer
+    quantized = "k_s" in cache
+    keep_q = _fused_keep_quantized(params["blocks"])
+    kc, vc = cache["k"], cache["v"]
+    ksc, vsc = (cache["k_s"], cache["v_s"]) if quantized else (None, None)
+    W = x.shape[1]
+    L = kc.shape[0]
+    for l in range(L):
+        layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
+                             keep_quantized=keep_q,
+                             keep_moe_quantized=moe_grouped)
+        x, nk, nv, nks, nvs = ds_fused_layer(
+            x, weights_fn(layer), kc[l], vc[l], lengths, spec,
+            ks_l=ksc[l] if quantized else None,
+            vs_l=vsc[l] if quantized else None,
+            alibi_slopes=alibi_slopes)
+        for j in range(W):
+            kc = write_token(kc, l, nk[:, j], lengths + j)
+            vc = write_token(vc, l, nv[:, j], lengths + j)
+            if quantized:
+                ksc = write_token(ksc, l, nks[:, j], lengths + j)
+                vsc = write_token(vsc, l, nvs[:, j], lengths + j)
+        if moe_tail_fn is not None:
+            x = moe_tail_fn(x, layer)
+    if quantized:
+        return x, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
+    return x, {"k": kc, "v": vc}
+
+
+def use_scan_decode(blocks, moe_grouped: bool = False,
+                    fused: bool = False) -> bool:
     """The ONE dispatch rule for the decode loop form (both the shared
     scaffold and gpt2's own decode call this): scan when a full dequant
     of the quantized blocks that the qgemm KERNEL does not absorb would
@@ -209,8 +282,19 @@ def use_scan_decode(blocks, moe_grouped: bool = False) -> bool:
     int8 Mixtral keeps the unrolled loop at any scale.  When qgemm is
     merely FORCED onto the jnp reference (DS_QGEMM=1 off-chip /
     multi-device), every projection still dequantizes per matmul, so
-    all bytes count and the scan defense re-engages."""
+    all bytes count and the scan defense re-engages.
+
+    ``fused`` (ISSUE 12): the caller resolved the fused megakernel path
+    for this program.  The megakernel consumes int8 2-D projection
+    weights in place with its own in-kernel selector-matmul dequant, so
+    when the fused KERNEL is real those leaves must not count against
+    the threshold even with qgemm off — the pre-fix accounting
+    double-counted them and could bounce a fused int8 model onto the
+    (unfused) scan path its own kernel had made unnecessary."""
     residual_only = qgemm_active(blocks) and qgemm_kernel_real()
+    if fused:
+        from deepspeed_tpu.ops.pallas.fused_decode import fused_kernel_real
+        residual_only = residual_only or fused_kernel_real()
     residual = quantized_layer_bytes(
         blocks, residual_only=residual_only,
         moe_grouped=moe_grouped and residual_only)
@@ -296,7 +380,8 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
 
 def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
                 finish_fn, head_fn, num_heads, alibi_slopes=None,
-                moe_grouped: bool = False):
+                moe_grouped: bool = False, fused_spec=None,
+                fused_weights_fn=None, moe_tail_fn=None):
     """One decode step: tokens [B], lengths [B] current fill counts.
     Rotary positions are per-row; the GQA cache stays compact (KV heads) —
     the decode kernel handles the query-group mapping.  ``alibi_slopes``
@@ -315,11 +400,21 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
-    if use_scan_decode(params["blocks"], moe_grouped=moe_grouped):
+    fused = fused_decode_active(params["blocks"], fused_spec)
+    if use_scan_decode(params["blocks"], moe_grouped=moe_grouped,
+                       fused=fused):
         return decode_step_scan(
             params, x, cache, lengths, qkv_fn=qkv_fn, finish_fn=finish_fn,
             head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes,
             moe_grouped=moe_grouped)
+    if fused:
+        # ONE Pallas call per layer (ISSUE 12): LN + QKV + KV quantize +
+        # decode attention + attn-out + MLP fused; W = 1
+        x, cache = _fused_layer_pass(
+            params, x[:, None, :], cache, lengths, spec=fused_spec,
+            weights_fn=fused_weights_fn, alibi_slopes=alibi_slopes,
+            moe_tail_fn=moe_tail_fn, moe_grouped=moe_grouped)
+        return head_fn(params, x)[:, 0], cache
 
     # int8 weights: the 2-D projection weights stay QuantizedTensor and
     # the hooks' qdot sites feed them to ds_qgemm — no layer-sized
@@ -362,7 +457,8 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
 
 def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
                   finish_fn, head_fn, num_heads, alibi_slopes=None,
-                  moe_grouped: bool = False):
+                  moe_grouped: bool = False, fused_spec=None,
+                  fused_weights_fn=None, moe_tail_fn=None):
     """Speculative-decoding verification: score a ``W``-token window in
     ONE weight pass per layer (the whole point of speculation — k+1
     drafted positions amortize a single stream of the layer weights
@@ -392,6 +488,15 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     B, W = tokens.shape
     H = num_heads
     x = embed_fn(params, tokens)                            # [B, W, D]
+    if fused_decode_active(params["blocks"], fused_spec):
+        # the whole W-token window per layer in ONE Pallas call — the
+        # batched-window step (decode rows, spec verify, prefill chunks)
+        # all compile onto this path (ISSUE 12)
+        x, cache = _fused_layer_pass(
+            params, x, cache, lengths, spec=fused_spec,
+            weights_fn=fused_weights_fn, alibi_slopes=alibi_slopes,
+            moe_tail_fn=moe_tail_fn, moe_grouped=moe_grouped)
+        return head_fn(params, x), cache
     quantized = "k_s" in cache
     keep_q = qgemm_active(params["blocks"])
     kc, vc = cache["k"], cache["v"]

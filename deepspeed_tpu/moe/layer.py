@@ -328,7 +328,11 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     B, S, D = x.shape
     T = B * S
     mesh = get_topology().mesh
-    wsc = jax.lax.with_sharding_constraint
+    # layout pins for the SPMD partitioner; the serving scheduler's
+    # single-device programs shed them via sharding_pin_scope(False)
+    # (comm/mesh.py — a training-mesh pin inside a device-local program
+    # miscompiles on this jaxlib)
+    from deepspeed_tpu.comm.mesh import pin_sharding as wsc
     # token dim = flattened (batch-sharded, seq-sharded) dims: pin every
     # token-major tensor to the same layout so the SPMD partitioner never
     # falls back to replicate-then-repartition on the backward transposes

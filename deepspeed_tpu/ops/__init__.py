@@ -1,2 +1,4 @@
 from deepspeed_tpu.ops.attention import causal_attention
 from deepspeed_tpu.ops.pallas.qgemm import ds_qgemm
+from deepspeed_tpu.ops.pallas.fused_decode import (FusedLayerSpec,
+                                                   ds_fused_layer)

@@ -1,0 +1,868 @@
+"""Fused per-layer decode/window megakernel (``ds_fused_layer``).
+
+Reference capability: the fused inference ops around
+``ds_softmax_context`` (csrc/transformer/inference/csrc/pt_binding.cpp:
+1911-1974) — DeepSpeed lowers a whole inference transformer layer to a
+handful of fused CUDA launches.  PERF.md's decode budget shows why this
+matters here: ~0.3 of 0.7 ms/step at bench shapes is kernel launches and
+scaffolding, not math.  This module fuses ONE decoder layer's whole
+decode/verify-window step into ONE Pallas call:
+
+    norm1 -> QKV projection (+bias, rotary/partial-rotary) ->
+    KV quantize (int8 cache) -> decode attention over the streamed
+    cache AND the window's own tokens -> attn-out projection ->
+    norm2 -> MLP (gelu / swiglu; "none" for MoE layers, whose expert
+    FFN rides the grouped-GEMM slot kernels outside) -> residuals
+
+so a decode step issues L launches instead of ~6L.  Design points:
+
+- the KV cache streams through VMEM **read-only** in ``block_s`` blocks
+  with the decode-attention online softmax; the window's new K/V tokens
+  never round-trip through HBM — they are computed at grid step 0, held
+  in VMEM scratch, attended as one extra "virtual block" at the last
+  grid step (each window position j attends cache positions < len plus
+  window positions <= j, exactly the unfused ``verify_window`` order),
+  and emitted as outputs.  The caller scatters them into the cache with
+  the same fused XLA select/scatter the unfused path uses — the cache
+  WRITE was never a kernel launch, and keeping the cache input-only
+  avoids paying a full cache write-back per layer (a copy-through
+  aliased output would double decode's cache bandwidth).
+- layer weights ride constant-index BlockSpecs: Pallas DMAs each weight
+  into VMEM exactly ONCE per call and keeps it resident across the
+  cache-stream grid — the weight traffic of a fused step is the int8 /
+  bf16 bytes, once, which is the weight-streaming floor.
+- int8 projection weights (``QuantizedTensor`` leaves in the
+  block_quantize_int8 layout) dequantize in-kernel right before their
+  single use with the qgemm selector-matmul scale expansion — no
+  compute-dtype copy of any weight ever exists outside VMEM.
+- grouped-query attention keeps the decode kernel's group-major packed
+  layout; the head-major<->group-major moves happen on ACTIVATIONS via
+  0/1 selector matmuls (the blockdiag idiom), never on weights.
+
+Applicability: the kernel wants the whole layer resident in VMEM, so it
+gates on an estimated VMEM budget (``_VMEM_BUDGET``) and falls back to
+the jnp reference composition above it.  ``_ref_fused_layer`` composes
+the EXACT unfused math (same ``decode_attention`` dispatch, same
+``quantize_kv``/``select_token`` helpers), so fused-vs-unfused parity
+off-TPU is trivially bitwise; ``DS_FUSED_DECODE_INTERPRET=1`` runs the
+real kernel in interpret mode for the CPU suite.  ``DS_FUSED_DECODE``
+(0/1) and the ``serving.fused_decode`` config key toggle the fused path;
+``DS_FUSED_DECODE_BLOCKS`` overrides the cache-stream block
+(``scripts/fused_sweep.py`` sweeps it).
+"""
+import contextlib
+import math
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+#: VMEM budget (bytes) for the resident layer weights + window scratch;
+#: past this the dispatch falls back to the reference composition (the
+#: unfused path's scan/qgemm defenses still apply there).  Generous for
+#: current-gen cores; DS_FUSED_DECODE_VMEM_MB overrides for sweeps.
+_VMEM_BUDGET = 96 << 20
+
+_DEFAULT_BLOCK_S = 512
+
+
+@dataclass(frozen=True)
+class FusedLayerSpec:
+    """Static description of one decoder layer's fused-step shape.
+
+    ``qkv``: "fused" ([D, 3D] thirds — gpt2), "headmajor" ([D, H*3hd]
+    per-head [q|k|v] — neox/bloom), "split" (wq/wk/wv — llama/mixtral).
+    ``mlp``: "gelu_tanh" / "gelu_exact" / "relu" / "swiglu" / "none"
+    ("none" returns after the attn-out residual; MoE layers run their
+    routed-expert FFN outside on the grouped-GEMM kernels).
+    ``rotary_dims``: 0 = none; == head_dim = full rope; < head_dim =
+    NeoX partial rotary.  ``rotary_interleaved`` (GPT-J pairing) is NOT
+    kernel-supported — callers keep the unfused path for it.
+    """
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    d_model: int
+    norm: str = "ln"                 # "ln" (scale+bias) | "rms"
+    eps: float = 1e-5
+    qkv: str = "fused"               # "fused" | "headmajor" | "split"
+    qkv_bias: bool = True
+    out_bias: bool = True
+    mlp: str = "gelu_tanh"
+    mlp_bias: bool = True
+    residual: str = "serial"         # "serial" | "parallel"
+    rotary_dims: int = 0
+    rope_theta: float = 10000.0
+    rotary_interleaved: bool = False
+    alibi: bool = False
+    sm_scale: Optional[float] = None
+
+    @property
+    def rep(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def supported(self) -> bool:
+        """Whether the Pallas kernel covers this variant (the reference
+        composition covers everything)."""
+        if self.rotary_interleaved:
+            return False
+        if self.norm not in ("ln", "rms"):
+            return False
+        if self.qkv not in ("fused", "headmajor", "split"):
+            return False
+        if self.mlp not in ("gelu_tanh", "gelu_exact", "relu", "swiglu",
+                            "none"):
+            return False
+        if self.num_heads % self.num_kv_heads:
+            return False
+        if self.rotary_dims % 2:
+            return False
+        return True
+
+
+# ----------------------------------------------------------- toggles
+_fused_forced = None            # fused_decode_scope override
+_configured_fused = None        # serving.fused_decode (scheduler installs)
+
+
+@contextlib.contextmanager
+def fused_decode_scope(enabled: bool):
+    """Force the fused per-layer path on/off for code TRACED inside this
+    scope (A/B benches, fallback tests).  Same trace-time caveat as
+    ``qgemm_scope``: the choice bakes into compiled programs — build a
+    fresh scheduler/jitted fn inside each scope."""
+    global _fused_forced
+    prev, _fused_forced = _fused_forced, enabled
+    try:
+        yield
+    finally:
+        _fused_forced = prev
+
+
+def set_fused_decode_override(enabled) -> None:
+    """Install the ``serving.fused_decode`` config choice (None resets
+    to auto).  Called by the continuous-batching scheduler at
+    construction; the DS_FUSED_DECODE env still wins at trace time."""
+    global _configured_fused
+    _configured_fused = enabled
+
+
+def fused_decode_interpret() -> bool:
+    return os.environ.get("DS_FUSED_DECODE_INTERPRET") == "1"
+
+
+def fused_kernel_real() -> bool:
+    """Whether ``ds_fused_layer`` runs the actual Pallas megakernel
+    (single TPU device, or interpret mode) rather than the jnp
+    reference composition."""
+    if fused_decode_interpret():
+        return True
+    from deepspeed_tpu.ops.attention import _on_tpu
+    return _on_tpu() and jax.device_count() == 1
+
+
+def fused_decode_enabled() -> bool:
+    """Resolution: ``fused_decode_scope`` > DS_FUSED_DECODE env >
+    ``serving.fused_decode`` config > auto (on exactly when the kernel
+    is real — which includes interpret mode; off-TPU the fused path
+    would re-route decode through the reference composition for no
+    structural gain).  DS_FUSED_DECODE_INTERPRET feeds only the auto
+    tier: it makes the kernel real for the CPU suite, it does NOT
+    override an explicit ``serving.fused_decode: false`` (the
+    fused-vs-unfused A/B under interpret relies on 'off' staying
+    off)."""
+    if _fused_forced is not None:
+        return _fused_forced
+    env = os.environ.get("DS_FUSED_DECODE")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    if _configured_fused is not None:
+        return bool(_configured_fused)
+    return fused_kernel_real()
+
+
+def _env_block_s() -> Optional[int]:
+    env = os.environ.get("DS_FUSED_DECODE_BLOCKS")
+    return int(env) if env else None
+
+
+def _vmem_budget() -> int:
+    env = os.environ.get("DS_FUSED_DECODE_VMEM_MB")
+    return (int(env) << 20) if env else _VMEM_BUDGET
+
+
+# ------------------------------------------------ canonical weights
+#: canonical weight-dict keys, in kernel argument order per variant
+def _weight_order(spec: FusedLayerSpec):
+    order = ["n1_s"] + (["n1_b"] if spec.norm == "ln" else [])
+    if spec.qkv == "split":
+        order += ["wq", "wk", "wv"]
+        if spec.qkv_bias:
+            order += ["bq", "bk", "bv"]
+    else:
+        order += ["wqkv"]
+        if spec.qkv_bias:
+            order += ["bqkv"]
+    order += ["wo"]
+    if spec.out_bias:
+        order += ["bo"]
+    if spec.mlp != "none":
+        order += ["n2_s"] + (["n2_b"] if spec.norm == "ln" else [])
+        if spec.mlp == "swiglu":
+            order += ["w_gate", "w_up", "w_down"]
+        else:
+            order += ["w_in"] + (["b_in"] if spec.mlp_bias else [])
+            order += ["w_out"] + (["b_out"] if spec.mlp_bias else [])
+    return order
+
+
+def fused_weight_bytes(spec: FusedLayerSpec, cw: dict) -> int:
+    """Resident-VMEM estimate for the layer's weights as the kernel will
+    hold them (int8 q + fp32 scales for QuantizedTensor leaves, else the
+    stored dtype)."""
+    from deepspeed_tpu.models.model import QuantizedTensor
+    total = 0
+    for key in _weight_order(spec):
+        w = cw[key]
+        if isinstance(w, QuantizedTensor):
+            total += int(w.q.size) + 4 * int(w.s.size)
+        else:
+            total += int(w.size) * jnp.dtype(w.dtype).itemsize
+    return total
+
+
+# ------------------------------------------------------ jnp reference
+def _apply_norm(x, spec, scale, bias):
+    x32 = x.astype(jnp.float32)
+    if spec.norm == "rms":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + spec.eps) * scale).astype(x.dtype)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + spec.eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _mlp_act(h, spec):
+    if spec.mlp == "relu":
+        return jax.nn.relu(h)
+    return jax.nn.gelu(h, approximate=spec.mlp != "gelu_exact")
+
+
+def _ref_qkv(x, cw, spec: FusedLayerSpec, positions):
+    """norm1 + QKV (+rotary), matching each family's _block_qkv math."""
+    from deepspeed_tpu.models.model import qdot
+    B, W, D = x.shape
+    H, KV, hd = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    h = _apply_norm(x, spec, cw["n1_s"], cw.get("n1_b"))
+    dt = h.dtype
+    if spec.qkv == "split":
+        q = qdot(h, cw["wq"])
+        kk = qdot(h, cw["wk"])
+        v = qdot(h, cw["wv"])
+        if spec.qkv_bias:
+            q = q + cw["bq"].astype(dt)
+            kk = kk + cw["bk"].astype(dt)
+            v = v + cw["bv"].astype(dt)
+        q = q.reshape(B, W, H, hd)
+        kk = kk.reshape(B, W, KV, hd)
+        v = v.reshape(B, W, KV, hd)
+    else:
+        qkv = qdot(h, cw["wqkv"])
+        if spec.qkv_bias:
+            qkv = qkv + cw["bqkv"].astype(dt)
+        if spec.qkv == "headmajor":
+            q, kk, v = jnp.split(qkv.reshape(B, W, H, 3 * hd), 3, axis=-1)
+        else:
+            q, kk, v = (t.reshape(B, W, H, hd)
+                        for t in jnp.split(qkv, 3, axis=-1))
+    if spec.rotary_dims:
+        q = _ref_rope(q, spec, positions)
+        kk = _ref_rope(kk, spec, positions)
+    return q, kk, v
+
+
+def _ref_rope(x, spec: FusedLayerSpec, positions):
+    """Full or partial (NeoX) rotary with the split-half pairing —
+    matches models/llama.rope / models/neox._partial_rope."""
+    from deepspeed_tpu.models.llama import rope
+    rot = spec.rotary_dims
+    hd = x.shape[-1]
+    if rot == hd:
+        return rope(x, spec.rope_theta, positions,
+                    interleaved=spec.rotary_interleaved)
+    xr = rope(x[..., :rot], spec.rope_theta, positions,
+              interleaved=spec.rotary_interleaved)
+    return jnp.concatenate([xr, x[..., rot:]], axis=-1)
+
+
+def _ref_finish(x, attn_flat, cw, spec: FusedLayerSpec):
+    """attn-out + residual(s) + MLP, matching each family's
+    _block_finish math (``mlp == "none"`` stops after the attention
+    residual — the MoE tail runs outside)."""
+    from deepspeed_tpu.models.model import qdot
+    dt = x.dtype
+    attn_out = qdot(attn_flat, cw["wo"])
+    if spec.out_bias:
+        attn_out = attn_out + cw["bo"].astype(dt)
+    if spec.mlp == "none":
+        return x + attn_out
+    if spec.residual == "parallel":
+        h2 = _apply_norm(x, spec, cw["n2_s"], cw.get("n2_b"))
+    else:
+        x = x + attn_out
+        h2 = _apply_norm(x, spec, cw["n2_s"], cw.get("n2_b"))
+    if spec.mlp == "swiglu":
+        m = jax.nn.silu(qdot(h2, cw["w_gate"])) * qdot(h2, cw["w_up"])
+        m = qdot(m, cw["w_down"])
+    else:
+        m = qdot(h2, cw["w_in"])
+        if spec.mlp_bias:
+            m = m + cw["b_in"].astype(dt)
+        m = _mlp_act(m, spec)
+        m = qdot(m, cw["w_out"])
+        if spec.mlp_bias:
+            m = m + cw["b_out"].astype(dt)
+    if spec.residual == "parallel":
+        return x + attn_out + m
+    return x + m
+
+
+def _ref_fused_layer(x, cw, k_l, v_l, lengths, spec: FusedLayerSpec,
+                     ks_l, vs_l, alibi_slopes):
+    """Reference composition: EXACTLY the unfused per-layer body
+    (``models/serving.py`` decode_step/verify_window inner loop) —
+    same decode_attention dispatch, same quantize_kv, same select_token
+    write order — so fused-vs-unfused parity off-TPU is trivial."""
+    from deepspeed_tpu.models.serving import select_token
+    from deepspeed_tpu.ops.pallas.decode_attention import (decode_attention,
+                                                           quantize_kv)
+    B, W, D = x.shape
+    H, hd = spec.num_heads, spec.head_dim
+    quantized = ks_l is not None
+    positions = lengths[:, None] + jnp.arange(W)[None, :]
+    q, kk, v = _ref_qkv(x, cw, spec, positions)
+    new_k, new_v = [], []
+    new_ks, new_vs = [], []
+    attn_cols = []
+    for j in range(W):
+        if quantized:
+            kq, ks1 = quantize_kv(kk[:, j])
+            vq, vs1 = quantize_kv(v[:, j])
+            k_l = select_token(k_l, kq, lengths + j)
+            v_l = select_token(v_l, vq, lengths + j)
+            ks_l = select_token(ks_l, ks1, lengths + j)
+            vs_l = select_token(vs_l, vs1, lengths + j)
+            new_k.append(kq)
+            new_v.append(vq)
+            new_ks.append(ks1)
+            new_vs.append(vs1)
+        else:
+            k_l = select_token(k_l, kk[:, j], lengths + j)
+            v_l = select_token(v_l, v[:, j], lengths + j)
+            new_k.append(kk[:, j].astype(k_l.dtype))
+            new_v.append(v[:, j].astype(v_l.dtype))
+        attn_cols.append(decode_attention(
+            q[:, j], k_l, v_l, lengths + j + 1, sm_scale=spec.sm_scale,
+            k_scale=ks_l if quantized else None,
+            v_scale=vs_l if quantized else None,
+            alibi_slopes=alibi_slopes))
+    attn = jnp.stack(attn_cols, axis=1)                 # [B, W, H, hd]
+    x_out = _ref_finish(x, attn.reshape(B, W, H * hd).astype(x.dtype), cw,
+                        spec)
+    out = (x_out, jnp.stack(new_k, axis=1), jnp.stack(new_v, axis=1))
+    if quantized:
+        return out + (jnp.stack(new_ks, axis=1), jnp.stack(new_vs, axis=1))
+    return out + (None, None)
+
+
+# ------------------------------------------------------------- kernel
+def _dequant_full(qv, sv, out_dtype):
+    """In-kernel whole-weight dequant: [K, N] int8 + [K, nb] scales ->
+    compute-dtype [K, N] via the qgemm selector-matmul scale expansion
+    (the weight's single use site immediately consumes it — the
+    dequantized value never leaves VMEM)."""
+    K, N = qv.shape
+    nb = sv.shape[1]
+    qblock = -(-N // nb)
+    g_iota = jax.lax.broadcasted_iota(jnp.int32, (nb, N), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (nb, N), 1)
+    sel = (g_iota == col // qblock).astype(jnp.float32)
+    s_exp = jax.lax.dot(sv, sel, preferred_element_type=jnp.float32)
+    return (qv.astype(jnp.float32) * s_exp).astype(out_dtype)
+
+
+def _kernel_rope(x, spec: FusedLayerSpec, pos, n_heads):
+    """Rotary on a packed [R, n_heads*hd] row-block at scalar position
+    ``pos`` (same position for every row is NOT assumed — ``pos`` is a
+    per-call scalar; the caller loops window positions).  Split-half
+    pairing via lane-index masks + static rolls."""
+    hd = spec.head_dim
+    rot = spec.rotary_dims
+    r2 = rot // 2
+    R, Dk = x.shape
+    li = jax.lax.broadcasted_iota(jnp.int32, (R, Dk), 1) % hd
+    fi = (li % r2).astype(jnp.float32)
+    inv = jnp.exp(fi * (-math.log(spec.rope_theta) / r2))
+    ang = pos.astype(jnp.float32) * inv
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    xf = x.astype(jnp.float32)
+    lo = li % hd < r2                   # first half of the rotated dims
+    partner = jnp.where(lo, jnp.roll(xf, -r2, axis=1),
+                        jnp.roll(xf, r2, axis=1))
+    sign = jnp.where(lo, -1.0, 1.0)
+    rotated = xf * cos + sign * partner * sin
+    return jnp.where(li < rot, rotated, xf).astype(x.dtype)
+
+
+def _group_selector(H, KV, hd, r):
+    """0/1 selector S_r [H*hd, KV*hd]: S_r[(kvh*rep+r)*hd+d, kvh*hd+d]=1.
+    ``q_hm @ S_r`` extracts query group r in the decode kernel's packed
+    group-major layout; ``attn_r @ S_r.T`` scatters it back — activation
+    lane moves as matmuls (the blockdiag idiom), never weight moves."""
+    rep = H // KV
+    row_h = jax.lax.broadcasted_iota(jnp.int32, (H * hd, KV * hd), 0)
+    col_h = jax.lax.broadcasted_iota(jnp.int32, (H * hd, KV * hd), 1)
+    match_head = (row_h // hd) == (col_h // hd) * rep + r
+    match_dim = (row_h % hd) == (col_h % hd)
+    return (match_head & match_dim).astype(jnp.float32)
+
+
+def _qkv_split_selector(H, hd, part):
+    """[H*3hd, H*hd] selector extracting q/k/v (part 0/1/2) from the
+    head-major [q|k|v]-per-head fused projection (neox/bloom)."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (H * 3 * hd, H * hd), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (H * 3 * hd, H * hd), 1)
+    same_head = (row // (3 * hd)) == (col // hd)
+    same_dim = (row % (3 * hd)) == (col % hd) + part * hd
+    return (same_head & same_dim).astype(jnp.float32)
+
+
+def _kernel_norm(x, spec, s_ref, b_ref):
+    x32 = x.astype(jnp.float32)
+    if spec.norm == "rms":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + spec.eps) * s_ref[:]
+        return y.astype(x.dtype)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + spec.eps)
+    return (y * s_ref[:] + b_ref[:]).astype(x.dtype)
+
+
+def _kernel_quantize_kv(vec, KV, hd):
+    """[1, KV*hd] f32 -> (int8 [1, KV*hd], scales [1, KV], dequantized
+    f32 [1, KV*hd]) with quantize_kv's exact per-head-vector math; the
+    dequantized values feed the window-self attention so fused logits
+    match the unfused path's quantized-cache numerics."""
+    Dk = KV * hd
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (KV, Dk), 0)
+              == jax.lax.broadcasted_iota(jnp.int32, (KV, Dk), 1) // hd
+              ).astype(jnp.float32)                       # [KV, Dk]
+    amax = jnp.max(jnp.where(onehot > 0, jnp.abs(vec), 0.0),
+                   axis=1)                                # [KV]
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)        # [KV]
+    scale_l = jax.lax.dot(scale[None, :], onehot,
+                          preferred_element_type=jnp.float32)  # [1, Dk]
+    q = jnp.clip(jnp.round(vec / scale_l), -127, 127)
+    return q.astype(jnp.int8), scale[None, :], q * scale_l
+
+
+def _fused_kernel(len_ref, *refs, spec: FusedLayerSpec, W, block_s, n_s,
+                  S_max, quant_cache, wq_flags, order, precision,
+                  compute_dtype, cache_dtype):
+    """Grid (B, n_s): S is minor so the online-softmax scratch carries
+    across one row's cache blocks; weights use constant index maps and
+    stay VMEM-resident for the whole call."""
+    H, KV, hd = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    rep, D = spec.rep, spec.d_model
+    Dk = KV * hd
+    sm_scale = spec.sm_scale if spec.sm_scale is not None else hd ** -0.5
+    refs = list(refs)
+    x_ref = refs.pop(0)
+    wrefs = {}
+    for key in order:
+        if wq_flags[key]:
+            wrefs[key] = (refs.pop(0), refs.pop(0))
+        else:
+            wrefs[key] = refs.pop(0)
+    k_ref, v_ref = refs.pop(0), refs.pop(0)
+    ks_ref = vs_ref = sl_ref = None
+    if quant_cache:
+        ks_ref, vs_ref = refs.pop(0), refs.pop(0)
+    if spec.alibi:
+        sl_ref = refs.pop(0)
+    xo_ref, nk_ref, nv_ref = refs.pop(0), refs.pop(0), refs.pop(0)
+    nks_ref = nvs_ref = None
+    if quant_cache:
+        nks_ref, nvs_ref = refs.pop(0), refs.pop(0)
+    q_s, nk_s, nv_s, m_s, l_s, acc_s = refs
+
+    s_idx = pl.program_id(1)
+    b = pl.program_id(0)
+    cache_len = len_ref[b]
+
+    def weight(key):
+        w = wrefs[key]
+        if wq_flags[key]:
+            return _dequant_full(w[0][:], w[1][:], compute_dtype)
+        return w[:].astype(compute_dtype)
+
+    def dot(a, w):
+        return jax.lax.dot(a, w, preferred_element_type=jnp.float32,
+                           precision=precision).astype(compute_dtype)
+
+    blockdiag = (jax.lax.broadcasted_iota(jnp.int32, (Dk, KV), 0) // hd
+                 == jax.lax.broadcasted_iota(jnp.int32, (Dk, KV), 1))
+
+    # ---------------- phase 0: norm1 + QKV + rotary + KV quantize
+    @pl.when(s_idx == 0)
+    def _qkv_phase():
+        x = x_ref[:]                                    # [W, D]
+        h = _kernel_norm(x, spec, wrefs["n1_s"],
+                         wrefs.get("n1_b"))
+        if spec.qkv == "split":
+            q_hm = dot(h, weight("wq"))
+            k_all = dot(h, weight("wk"))
+            v_all = dot(h, weight("wv"))
+            if spec.qkv_bias:
+                q_hm = q_hm + wrefs["bq"][:].astype(q_hm.dtype)
+                k_all = k_all + wrefs["bk"][:].astype(k_all.dtype)
+                v_all = v_all + wrefs["bv"][:].astype(v_all.dtype)
+        else:
+            qkv = dot(h, weight("wqkv"))
+            if spec.qkv_bias:
+                qkv = qkv + wrefs["bqkv"][:].astype(qkv.dtype)
+            if spec.qkv == "headmajor":
+                q_hm = dot(qkv, _qkv_split_selector(H, hd, 0).astype(
+                    qkv.dtype))
+                k_all = dot(qkv, _qkv_split_selector(H, hd, 1).astype(
+                    qkv.dtype))
+                v_all = dot(qkv, _qkv_split_selector(H, hd, 2).astype(
+                    qkv.dtype))
+            else:
+                q_hm = qkv[:, :H * hd]
+                k_all = qkv[:, H * hd:2 * H * hd]
+                v_all = qkv[:, 2 * H * hd:]
+        # per window position: rotary + quantize + stash
+        for j in range(W):
+            pos = cache_len + j
+            qj = q_hm[j, :][None, :].astype(jnp.float32)
+            kj = k_all[j, :][None, :].astype(jnp.float32)
+            vj = v_all[j, :][None, :].astype(jnp.float32)
+            if spec.rotary_dims:
+                qj = _kernel_rope(qj, spec, pos, H)
+                kj = _kernel_rope(kj, spec, pos, KV)
+            # group-major query packing (rep == 1: identity)
+            for r in range(rep):
+                if rep == 1:
+                    q_s[j, :] = qj[0]
+                else:
+                    q_s[j * rep + r, :] = jax.lax.dot(
+                        qj, _group_selector(H, KV, hd, r),
+                        preferred_element_type=jnp.float32)[0]
+            if quant_cache:
+                kq, ks1, kdq = _kernel_quantize_kv(kj, KV, hd)
+                vq, vs1, vdq = _kernel_quantize_kv(vj, KV, hd)
+                nk_ref[j, :] = kq[0]
+                nv_ref[j, :] = vq[0]
+                nks_ref[j, :] = ks1[0]
+                nvs_ref[j, :] = vs1[0]
+                nk_s[j, :] = kdq[0]
+                nv_s[j, :] = vdq[0]
+            else:
+                # cast round-trip through the cache dtype so the
+                # window-self attention sees exactly what a cache
+                # write+read would have produced
+                kc = kj.astype(cache_dtype).astype(jnp.float32)
+                vc = vj.astype(cache_dtype).astype(jnp.float32)
+                nk_ref[j, :] = kj[0].astype(nk_ref.dtype)
+                nv_ref[j, :] = vj[0].astype(nv_ref.dtype)
+                nk_s[j, :] = kc[0]
+                nv_s[j, :] = vc[0]
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # ---------------- streamed-cache attention (every block < cache_len)
+    def _attend_block(k_blk, v_blk, pos_col, valid, w_rows):
+        """Online-softmax update for one [rows, Dk] K/V block; ``valid``
+        [rows, KV] mask, ``pos_col`` [rows, KV] absolute positions (for
+        ALiBi), ``w_rows``: per-(j) extra causal mask or None."""
+        for j in range(W):
+            jvalid = valid if w_rows is None else (valid & w_rows[j])
+            for r in range(rep):
+                jr = j * rep + r
+                q_r = q_s[jr, :]                        # [Dk] f32
+                w = jnp.where(blockdiag, q_r[:, None], 0.0).astype(
+                    k_blk.dtype)
+                scores = jax.lax.dot(
+                    k_blk, w, preferred_element_type=jnp.float32,
+                    precision=precision) * sm_scale
+                if spec.alibi:
+                    scores = scores + (sl_ref[r, :][None, :]
+                                       * pos_col.astype(jnp.float32))
+                scores = jnp.where(jvalid, scores, NEG_INF)
+                m_prev, l_prev = m_s[jr, :], l_s[jr, :]
+                m_cur = jnp.max(scores, axis=0)
+                m_new = jnp.maximum(m_prev, m_cur)
+                corr = jnp.exp(m_prev - m_new)
+                p = jnp.exp(scores - m_new[None, :])
+                p = jnp.where(jvalid, p, 0.0)
+                l_s[jr, :] = l_prev * corr + jnp.sum(p, axis=0)
+                m_s[jr, :] = m_new
+                p_exp = jax.lax.dot(
+                    p.astype(v_blk.dtype), blockdiag.astype(v_blk.dtype).T,
+                    preferred_element_type=jnp.float32,
+                    precision=precision)                # [rows, Dk]
+                acc_s[jr, :] = acc_s[jr, :] * jnp.where(
+                    blockdiag, corr[None, :], 0.0).sum(axis=1) + jnp.sum(
+                    p_exp * v_blk.astype(jnp.float32), axis=0)
+
+    s_start = s_idx * block_s
+
+    @pl.when(s_start < cache_len)
+    def _cache_block():
+        if quant_cache:
+            expand = blockdiag.astype(jnp.float32).T    # [KV, Dk]
+            k_sc = jax.lax.dot(ks_ref[:], expand,
+                               preferred_element_type=jnp.float32)
+            v_sc = jax.lax.dot(vs_ref[:], expand,
+                               preferred_element_type=jnp.float32)
+            k_blk = k_ref[:].astype(jnp.float32) * k_sc
+            v_blk = v_ref[:].astype(jnp.float32) * v_sc
+        else:
+            k_blk = k_ref[:]
+            v_blk = v_ref[:]
+        pos = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_s, KV), 0)
+        valid = pos < cache_len
+        _attend_block(k_blk, v_blk, pos, valid, None)
+
+    # ---------------- final block: window-self attention + finish
+    @pl.when(s_idx == n_s - 1)
+    def _finish_phase():
+        # the window's own tokens as one extra "virtual block": position
+        # jj (= cache_len + jj) is visible to window position j iff
+        # jj <= j — the same causal order the unfused write-then-attend
+        # loop produces
+        k_blk = nk_s[:]                                 # [W, Dk] f32
+        v_blk = nv_s[:]
+        jj_col = jax.lax.broadcasted_iota(jnp.int32, (W, KV), 0)
+        pos = cache_len + jj_col
+        w_rows = [jj_col <= j for j in range(W)]
+        _attend_block(k_blk, v_blk, pos,
+                      jnp.ones((W, KV), dtype=jnp.bool_), w_rows)
+        # finalize + unpack group-major -> head-major
+        attn_rows = []
+        for j in range(W):
+            flat = None
+            for r in range(rep):
+                jr = j * rep + r
+                l_exp = jnp.where(blockdiag, l_s[jr, :][None, :],
+                                  0.0).sum(axis=1)
+                o_r = (acc_s[jr, :] / jnp.maximum(l_exp, 1e-30))[None, :]
+                if rep == 1:
+                    flat = o_r
+                else:
+                    contrib = jax.lax.dot(
+                        o_r, _group_selector(H, KV, hd, r).T,
+                        preferred_element_type=jnp.float32)
+                    flat = contrib if flat is None else flat + contrib
+            attn_rows.append(flat)
+        attn = jnp.concatenate(attn_rows, axis=0).astype(compute_dtype)
+        x = x_ref[:]                                    # [W, D]
+        attn_out = dot(attn, weight("wo"))
+        if spec.out_bias:
+            attn_out = attn_out + wrefs["bo"][:].astype(attn_out.dtype)
+        if spec.mlp == "none":
+            xo_ref[:] = (x + attn_out).astype(xo_ref.dtype)
+            return
+        if spec.residual == "parallel":
+            h2 = _kernel_norm(x, spec, wrefs["n2_s"], wrefs.get("n2_b"))
+        else:
+            x = x + attn_out
+            h2 = _kernel_norm(x, spec, wrefs["n2_s"], wrefs.get("n2_b"))
+        if spec.mlp == "swiglu":
+            g = dot(h2, weight("w_gate")).astype(jnp.float32)
+            m = (jax.nn.silu(g).astype(compute_dtype)
+                 * dot(h2, weight("w_up")))
+            m = dot(m, weight("w_down"))
+        else:
+            m = dot(h2, weight("w_in"))
+            if spec.mlp_bias:
+                m = m + wrefs["b_in"][:].astype(m.dtype)
+            m32 = m.astype(jnp.float32)
+            if spec.mlp == "relu":
+                m32 = jax.nn.relu(m32)
+            else:
+                m32 = jax.nn.gelu(m32, approximate=spec.mlp != "gelu_exact")
+            m = dot(m32.astype(compute_dtype), weight("w_out"))
+            if spec.mlp_bias:
+                m = m + wrefs["b_out"][:].astype(m.dtype)
+        if spec.residual == "parallel":
+            xo_ref[:] = (x + attn_out + m).astype(xo_ref.dtype)
+        else:
+            xo_ref[:] = (x + m).astype(xo_ref.dtype)
+
+
+def _pallas_fused_layer(x, cw, k_l, v_l, lengths, spec: FusedLayerSpec,
+                        ks_l, vs_l, alibi_slopes, block_s, interpret):
+    from deepspeed_tpu.models.model import QuantizedTensor
+    B, W, D = x.shape
+    H, KV, hd = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    rep = spec.rep
+    Dk = KV * hd
+    S_max = k_l.shape[1]
+    quant_cache = ks_l is not None
+    compute_dtype = x.dtype
+    cache_dtype = k_l.dtype
+
+    # cache-stream block: largest multiple-of-8 divisor of S_max under
+    # the requested cap (decode_attention's divisor discipline)
+    cap = min(block_s or _env_block_s() or _DEFAULT_BLOCK_S, S_max)
+    best = 0
+    for cand in range(8, cap + 1, 8):
+        if S_max % cand == 0:
+            best = cand
+    if not best:
+        pad = -S_max % 128
+        k_l = jnp.pad(k_l, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v_l = jnp.pad(v_l, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if quant_cache:
+            ks_l = jnp.pad(ks_l, ((0, 0), (0, pad), (0, 0)))
+            vs_l = jnp.pad(vs_l, ((0, 0), (0, pad), (0, 0)))
+        S_max += pad
+        best = min(cap, S_max)
+        while S_max % best:
+            best //= 2
+    block_s = best
+    n_s = S_max // block_s
+
+    order = _weight_order(spec)
+    wq_flags = {}
+    args = [lengths.astype(jnp.int32), x]
+    in_specs = [
+        pl.BlockSpec((B,), lambda b, s: (0,), memory_space=pltpu.SMEM),
+        pl.BlockSpec((None, W, D), lambda b, s: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+
+    def const_spec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, lambda b, s, _n=nd: (0,) * _n,
+                            memory_space=pltpu.VMEM)
+
+    for key in order:
+        w = cw[key]
+        if isinstance(w, QuantizedTensor):
+            wq_flags[key] = True
+            args += [w.q, w.s.astype(jnp.float32)]
+            in_specs += [const_spec(w.q.shape), const_spec(w.s.shape)]
+        else:
+            wq_flags[key] = False
+            w2 = w if w.ndim == 2 else w[None, :]       # vectors -> [1, N]
+            args.append(w2)
+            in_specs.append(const_spec(w2.shape))
+
+    cache_spec = pl.BlockSpec((None, block_s, Dk), lambda b, s: (b, s, 0),
+                              memory_space=pltpu.VMEM)
+    args += [k_l.reshape(B, S_max, Dk), v_l.reshape(B, S_max, Dk)]
+    in_specs += [cache_spec, cache_spec]
+    if quant_cache:
+        scale_spec = pl.BlockSpec((None, block_s, KV),
+                                  lambda b, s: (b, s, 0),
+                                  memory_space=pltpu.VMEM)
+        args += [ks_l.astype(jnp.float32), vs_l.astype(jnp.float32)]
+        in_specs += [scale_spec, scale_spec]
+    if spec.alibi:
+        sl_rk = jnp.asarray(alibi_slopes, jnp.float32).reshape(
+            KV, rep).transpose(1, 0)
+        args.append(sl_rk)
+        in_specs.append(const_spec((rep, KV)))
+
+    out_shapes = [
+        jax.ShapeDtypeStruct((B, W, D), compute_dtype),         # x_out
+        jax.ShapeDtypeStruct((B, W, Dk), cache_dtype),          # new k
+        jax.ShapeDtypeStruct((B, W, Dk), cache_dtype),          # new v
+    ]
+    row_spec = pl.BlockSpec((None, W, D), lambda b, s: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    nk_spec = pl.BlockSpec((None, W, Dk), lambda b, s: (b, 0, 0),
+                           memory_space=pltpu.VMEM)
+    out_specs = [row_spec, nk_spec, nk_spec]
+    if quant_cache:
+        out_shapes += [jax.ShapeDtypeStruct((B, W, KV), jnp.float32),
+                       jax.ShapeDtypeStruct((B, W, KV), jnp.float32)]
+        ns_spec = pl.BlockSpec((None, W, KV), lambda b, s: (b, 0, 0),
+                               memory_space=pltpu.VMEM)
+        out_specs += [ns_spec, ns_spec]
+
+    precision = (jax.lax.Precision.HIGHEST
+                 if compute_dtype == jnp.float32 else None)
+    kernel = partial(
+        _fused_kernel, spec=spec, W=W, block_s=block_s, n_s=n_s,
+        S_max=S_max, quant_cache=quant_cache, wq_flags=wq_flags,
+        order=order, precision=precision, compute_dtype=compute_dtype,
+        cache_dtype=cache_dtype)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(B, n_s),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((W * rep, Dk), jnp.float32),     # packed q
+            pltpu.VMEM((W, Dk), jnp.float32),           # new k (dequant)
+            pltpu.VMEM((W, Dk), jnp.float32),           # new v (dequant)
+            pltpu.VMEM((W * rep, KV), jnp.float32),     # m
+            pltpu.VMEM((W * rep, KV), jnp.float32),     # l
+            pltpu.VMEM((W * rep, Dk), jnp.float32),     # acc
+        ],
+        interpret=interpret,
+    )(*args)
+    x_out, nk, nv = outs[0], outs[1], outs[2]
+    nk = nk.reshape(B, W, KV, hd)
+    nv = nv.reshape(B, W, KV, hd)
+    if quant_cache:
+        return x_out, nk, nv, outs[3], outs[4]
+    return x_out, nk, nv, None, None
+
+
+def ds_fused_layer(x, cw, k_l, v_l, lengths, spec: FusedLayerSpec,
+                   ks_l=None, vs_l=None, alibi_slopes=None,
+                   block_s=None, interpret=None):
+    """One decoder layer's fused window step.
+
+    ``x`` [B, W, D] layer input; ``k_l``/``v_l`` [B, S, KV, hd] this
+    layer's dense cache (PRE-window: positions < ``lengths`` are valid);
+    ``lengths`` [B] first window position per row; int8 caches pass
+    ``ks_l``/``vs_l`` [B, S, KV].  Returns ``(x_out [B, W, D],
+    new_k [B, W, KV, hd], new_v, new_ks, new_vs)`` — the caller writes
+    the window's new KV vectors into the cache (the same fused XLA
+    select/scatter the unfused path uses) and they are NOT yet visible
+    in ``k_l``; the kernel attends them from VMEM.
+
+    Dispatch: the Pallas megakernel when it is real (TPU single-device
+    or ``DS_FUSED_DECODE_INTERPRET=1``), the variant is supported, and
+    the resident-layer VMEM estimate fits the budget; the jnp reference
+    composition (exactly the unfused math) otherwise."""
+    if interpret is None:
+        interpret = fused_decode_interpret()
+    use_kernel = (spec.supported()
+                  and (interpret or fused_kernel_real())
+                  and fused_weight_bytes(spec, cw) <= _vmem_budget())
+    if not use_kernel:
+        return _ref_fused_layer(x, cw, k_l, v_l, lengths, spec, ks_l,
+                                vs_l, alibi_slopes)
+    return _pallas_fused_layer(x, cw, k_l, v_l, lengths, spec, ks_l,
+                               vs_l, alibi_slopes, block_s, interpret)

@@ -685,6 +685,13 @@ class ServingConfig(DeepSpeedConfigModel):
     #: time).  "grouped" is the megablocks-style drop-free ragged GEMM
     #: (ops/pallas/grouped_gemm.py — ISSUE 8).
     moe_dispatch: Optional[str] = None
+    #: fused decode megakernel toggle (ops/pallas/fused_decode.py —
+    #: ISSUE 12: one Pallas call per layer for decode/verify/chunk
+    #: windows): None = auto (on exactly when the kernel is real — a
+    #: single TPU device, or DS_FUSED_DECODE_INTERPRET=1); True/False
+    #: installs a serving-wide override at scheduler construction (the
+    #: DS_FUSED_DECODE env still wins at trace time).
+    fused_decode: Optional[bool] = None
     #: scheduler watchdog: seconds of pending work with step_count frozen
     #: before the server goes DEGRADED (waiting /generate handlers then
     #: 503 instead of hanging).  Generous default = the old handler-local

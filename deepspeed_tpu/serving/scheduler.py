@@ -11,11 +11,13 @@ Each ``step()`` is one engine iteration:
    uncached suffix prefills (ISSUE 6); with ``serving.chunked_prefill``
    on (ISSUE 9), a prefill larger than the per-iteration chunk allowance
    admits into a persistent PREFILLING state instead of running whole;
-2b. service PREFILLING rows (``_prefill_chunks``): each iteration runs at
-   most ``chunk_tokens`` of pending prefill — highest class first — as
-   suffix-prefill verify windows from each request's committed cursor,
-   interleaved with the decode batch below, so one 32k-token prompt can
-   never spike every active stream's TPOT;
+2b. service PREFILLING rows: each iteration runs at most
+   ``chunk_tokens`` of pending prefill — highest class first — from
+   each request's committed cursor, riding the SAME batched-window
+   program as the decode rows (``_window_step``, ISSUE 12), so one
+   32k-token prompt can never spike every active stream's TPOT and a
+   chunk's layer weight pass is shared with decode instead of paid
+   separately;
 3. grow each active row's block table for the token it is about to write
    (allocate-on-decode); under pool exhaustion the lowest-priority active
    request is preempted (blocks freed, request requeued; it resumes later
@@ -60,11 +62,24 @@ def _round_up(n: int, q: int) -> int:
     return -(-n // q) * q
 
 
-def _pow2ceil(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+def _jit_device_local(fn):
+    """``jax.jit`` with the body TRACED under
+    ``sharding_pin_scope(False)`` (comm/mesh.py): the scheduler's
+    compiled programs are single-device by design (ROADMAP item 1 — the
+    fleet router / sharded-serving tier is the multi-device path), so
+    the training-mesh layout pins model code carries (e.g.
+    ``moe_layer``'s token-major constraint over the zero-shard axes)
+    must not engage inside them.  On a multi-device host a pin engages
+    whenever the token count divides the data axis — and this jaxlib's
+    SPMD partitioner miscompiles the scheduler's gather/scatter-heavy
+    programs under it (reproduced: mixtral spec verify at window width
+    8 on the 8-device CPU harness returns zero logits; width 5 —
+    non-divisible, pin skipped — is correct)."""
+    def traced(*args):
+        from deepspeed_tpu.comm.mesh import sharding_pin_scope
+        with sharding_pin_scope(False):
+            return fn(*args)
+    return jax.jit(traced)
 
 
 def _sample_rows(logits, seeds, positions, temps, top_ks, top_ps, do_flags,
@@ -311,7 +326,7 @@ class ContinuousBatchingScheduler:
                               self.metrics.registry)
         # chunked prefill (ISSUE 9): prefill becomes a per-iteration
         # resource — admissions larger than the chunk allowance persist
-        # in PREFILLING state and the _prefill_chunks phase services
+        # in PREFILLING state and the batched-window step services
         # them, highest SLO class first, within the shared token budget
         cp = getattr(config, "chunked_prefill", None)
         self._chunked_on = bool(getattr(cp, "enabled", False))
@@ -321,8 +336,17 @@ class ContinuousBatchingScheduler:
         self._prefill_fns = {}
         self._decode_fns = {}
         self._sample1_fns = {}
-        self._verify_fns = {}
+        self._window_fns = {}
         self._suffix_prefill_fns = {}
+        # fused decode megakernel (ISSUE 12): an explicit
+        # serving.fused_decode installs the process override so every
+        # model-side fused_decode_active resolution — decode, verify,
+        # suffix prefill — sees it (DS_FUSED_DECODE env wins at trace
+        # time; None leaves auto-on-TPU in force)
+        if config.fused_decode is not None:
+            from deepspeed_tpu.ops.pallas.fused_decode import \
+                set_fused_decode_override
+            set_fused_decode_override(bool(config.fused_decode))
         self._copy_fn = None            # COW-fork block copy (lazy jit)
         self._finished_this_step: List[ServeRequest] = []
         # --- speculative decoding (ISSUE 5): resolve the proposer from
@@ -372,12 +396,12 @@ class ContinuousBatchingScheduler:
                     pool, cache)
                 return logits[0, length[0] - 1][None], pool
 
-            self._prefill_fns[sp] = jax.jit(fn)
+            self._prefill_fns[sp] = _jit_device_local(fn)
         return self._prefill_fns[sp]
 
     def _sample1_fn(self, any_sampling: bool):
         if any_sampling not in self._sample1_fns:
-            self._sample1_fns[any_sampling] = jax.jit(
+            self._sample1_fns[any_sampling] = _jit_device_local(
                 lambda lg, s, pos, t, k, p, d: _sample_rows(
                     lg, s, pos, t, k, p, d, any_sampling))
         return self._sample1_fns[any_sampling]
@@ -423,24 +447,32 @@ class ContinuousBatchingScheduler:
                     body, (pool, tokens, lengths), dest_steps)
                 return toks, pool               # toks [k, B]
 
-            self._decode_fns[key] = jax.jit(fn)
+            self._decode_fns[key] = _jit_device_local(fn)
         return self._decode_fns[key]
 
-    def _verify_fn(self, W: int, any_sampling: bool):
-        """Speculative verify program (ISSUE 5): gather the pool dense,
-        score a ``W``-token window per row in ONE call to the model's
-        ``verify_fn`` (one weight pass per layer when the family wires
-        the native window scorer; a scan of ``decode_fn`` otherwise /
-        under DS_SPEC_VERIFY=scan), scatter the window's KV vectors back
-        (pad positions land in the trash block), and run the
-        accept/emit math on device.
+    def _window_fn(self, W: int, any_sampling: bool):
+        """THE batched-window program (ISSUE 12): one compiled family —
+        keyed only by (window bucket, sampling?) — through which plain
+        decode rows (window width 1), speculative-verify windows
+        (ISSUE 5), and chunked-prefill chunks (ISSUE 9) all ride the
+        SAME per-layer weight pass: one dense pool gather, the model's
+        ``verify_fn`` (the fused megakernel path when enabled — ONE
+        Pallas call per layer), ONE windowed scatter back, and the
+        accept/emit math on device.  This replaces the PR 5 verify
+        family and the PR 9 per-request chunk programs: a prefill chunk
+        now amortizes the decode batch's weight stream instead of
+        paying its own (Sarathi-style piggybacking).
 
-        Packing: ints [4 + 2W, B] — rows 0..W-1 window tokens (col 0 =
-        last committed token, then padded drafts), W: lengths, W+1:
-        draft_len, W+2: seeds, W+3: top_ks, W+4..: per-window-position
-        pool destinations; floats [2, B]: temps, top_ps."""
+        Packing: ints [4 + 2W, B] — rows 0..W-1 window tokens (decode
+        rows: col 0 = last committed token then padded drafts; chunk
+        rows: the prompt slice at the cursor), W: first window position
+        (decode: seq-1; chunk: cursor), W+1: draft_len (chunk rows:
+        take-1 so the bonus column lands on the chunk's last real
+        position), W+2: seeds, W+3: top_ks, W+4..: per-window-position
+        pool destinations (pads point at the trash block); floats
+        [2, B]: temps, top_ps."""
         key = (W, any_sampling)
-        if key not in self._verify_fns:
+        if key not in self._window_fns:
             from deepspeed_tpu.serving.spec.verifier import (accept_tokens,
                                                              scan_verify_fn)
             model = self.model
@@ -453,24 +485,42 @@ class ContinuousBatchingScheduler:
                 lengths = ints[W]
                 draft_len = ints[W + 1]
                 seeds, top_ks = ints[W + 2], ints[W + 3]
-                dests = ints[W + 4:]
+                dests = ints[W + 4:]                    # [W, B]
                 temps, top_ps = floats[0], floats[1]
-                rows = jnp.arange(tokens.shape[0])
+                B = tokens.shape[0]
+                rows = jnp.arange(B)
                 dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
                 logits, new_cache = vf(params, tokens, dense, lengths)
-                for j in range(W):
-                    vecs = jax.tree.map(
-                        lambda c: c[:, rows, lengths + j], new_cache)
-                    pool = jax.tree.map(
-                        lambda p, nv: p.at[:, dests[j]].set(nv),
-                        pool, vecs)
+                # ONE windowed scatter for the whole batch: clamped
+                # GATHER of each row's window from the dense view (the
+                # _suffix_prefill_fn clamp reasoning — pad rows whose
+                # window overruns s_pad read clamped positions but their
+                # dests point at the trash block), then one flat .set
+                win_pos = lengths[:, None] + jnp.arange(W)[None, :]
+                flat = dests.T.reshape(-1)              # [B*W]
+                pool = jax.tree.map(
+                    lambda p, c: p.at[:, flat].set(
+                        c[:, rows[:, None],
+                          jnp.minimum(win_pos, c.shape[2] - 1)].reshape(
+                            (c.shape[0], B * W) + c.shape[3:])),
+                    pool, new_cache)
                 acc, out = accept_tokens(
                     logits, tokens, draft_len, seeds, lengths + 1,
                     temps, top_ks, top_ps, do_flags, any_sampling)
                 return acc, out, pool
 
-            self._verify_fns[key] = jax.jit(fn)
-        return self._verify_fns[key]
+            self._window_fns[key] = _jit_device_local(fn)
+        return self._window_fns[key]
+
+    def _window_bucket(self, need: int) -> int:
+        """Window widths compile per bucket: 1 and 2 exactly (the plain
+        and minimal-draft steps), then SUFFIX_BUCKET multiples — one
+        family covers spec verify AND chunk service up to SUFFIX_CHUNK
+        (wider drafts keep rounding up, so program count stays
+        bounded)."""
+        if need <= 2:
+            return need
+        return _round_up(need, self.SUFFIX_BUCKET)
 
     #: suffix-prefill chunk width (ISSUE 6): cached-prefix admissions
     #: prefill only the uncached tail, riding the verify-window path in
@@ -520,7 +570,7 @@ class ContinuousBatchingScheduler:
                     lambda p, w: p.at[:, dests].set(w), pool, win)
                 return logits, pool             # logits [1, W, V]
 
-            self._suffix_prefill_fns[W] = jax.jit(fn)
+            self._suffix_prefill_fns[W] = _jit_device_local(fn)
         return self._suffix_prefill_fns[W]
 
     def _cow_copy(self, pair):
@@ -529,7 +579,7 @@ class ContinuousBatchingScheduler:
         destination block (the BlockManager already swapped the table
         entry).  One jitted gather/scatter, reused for every fork."""
         if self._copy_fn is None:
-            self._copy_fn = jax.jit(lambda pool, src, dst: jax.tree.map(
+            self._copy_fn = _jit_device_local(lambda pool, src, dst: jax.tree.map(
                 lambda p: p.at[:, dst].set(p[:, src]), pool))
         src, dst = pair
         bs = self.block_mgr.block_size
@@ -955,7 +1005,7 @@ class ContinuousBatchingScheduler:
         per-iteration cap: an admission whose uncached prefill fits the
         remaining chunk allowance still runs the one-shot prefill
         program here; anything larger enters PREFILLING with a progress
-        cursor and is serviced chunk-by-chunk by ``_prefill_chunks`` —
+        cursor and is serviced chunk-by-chunk by ``_window_step`` —
         the old first-admission escape (one 32k prompt monopolizing an
         iteration, spiking every active stream's TPOT) is gone."""
         budget = self.cfg.max_num_batched_tokens
@@ -1137,13 +1187,16 @@ class ContinuousBatchingScheduler:
         self._finish_prefill(req, inputs, last_logits)
 
     def _finish_prefill(self, req: ServeRequest, inputs: np.ndarray,
-                        last_logits):
-        """Shared prefill epilogue (one-shot, cached-suffix, and chunked
-        completion): publish the prefilled blocks to the prefix cache,
-        flip to DECODE, and sample the first token from the last real
-        position's logits — unless the request already carries a
-        generated tail (resumed mid-decode: its next token is already
-        on record, decode continues it)."""
+                        last_logits, tok: Optional[int] = None):
+        """Shared prefill epilogue (one-shot, cached-suffix, and
+        batched-window chunked completion): publish the prefilled blocks
+        to the prefix cache, flip to DECODE, and emit the first token —
+        sampled here from the last real position's logits, or passed in
+        as ``tok`` when the window program's bonus column already drew
+        it (same rng-position key family, so both forms are
+        token-identical).  A request that already carries a generated
+        tail (resumed mid-decode) emits nothing — its next token is on
+        record and decode continues it."""
         # the prompt's full blocks are cache content from here on —
         # registering BEFORE the first sample lets the next admission in
         # this very step hit them (materialized = exactly the prefilled
@@ -1155,17 +1208,19 @@ class ContinuousBatchingScheduler:
         req.prefill_pos = 0
         if req.num_generated:
             return                  # generated tail already sampled
-        s = req.sampling
-        tok = int(np.asarray(self._sample1_fn(bool(s.do_sample))(
-            last_logits,
-            # 31-bit mask: the decode path packs seeds as int32 — both
-            # paths must derive the SAME key for one request's stream
-            jnp.asarray([s.seed & 0x7FFFFFFF], np.uint32),
-            jnp.asarray([req.prompt_len], np.int32),
-            jnp.asarray([s.temperature], np.float32),
-            jnp.asarray([s.top_k], np.int32),
-            jnp.asarray([s.top_p], np.float32),
-            jnp.asarray([s.do_sample])))[0])
+        if tok is None:
+            s = req.sampling
+            tok = int(np.asarray(self._sample1_fn(bool(s.do_sample))(
+                last_logits,
+                # 31-bit mask: the decode path packs seeds as int32 —
+                # both paths must derive the SAME key for one request's
+                # stream
+                jnp.asarray([s.seed & 0x7FFFFFFF], np.uint32),
+                jnp.asarray([req.prompt_len], np.int32),
+                jnp.asarray([s.temperature], np.float32),
+                jnp.asarray([s.top_k], np.int32),
+                jnp.asarray([s.top_p], np.float32),
+                jnp.asarray([s.do_sample])))[0])
         req.record_token(tok)
         self.metrics.counters["generated_tokens"] += 1
         if req.finished_by(tok):
@@ -1222,77 +1277,37 @@ class ContinuousBatchingScheduler:
         return any(r is not None and r.state == RequestState.PREFILLING
                    for r in self._slots)
 
-    def _prefill_chunks(self):
-        """Chunked-prefill service phase (ISSUE 9 tentpole): give every
-        PREFILLING row — highest SLO class / priority first — its share
-        of this iteration's prefill allowance, at most ``chunk_tokens``
-        total, riding the suffix-prefill verify-window programs from the
-        request's committed cursor.  Rows the allowance can't reach this
-        iteration are deferred (counted) and keep their cursor; the row
-        whose final chunk lands samples its first token exactly like a
-        one-shot prefill."""
+    def _chunk_takes(self):
+        """Plan this iteration's chunked-prefill service (ISSUE 9
+        semantics on the ISSUE 12 batched-window surface): split the
+        per-iteration prefill allowance across PREFILLING rows —
+        highest SLO class / priority first — as request_id -> total
+        tokens this iteration.  Rows the allowance can't reach (not
+        even one bucket or the tiny remainder) are deferred (counted)
+        and keep their cursor.  The ``serve.chunk`` fault site fires
+        here, BEFORE any KV write: a ``raise`` propagates out of step()
+        (cursor and block table untouched), a ``deny`` defers the row
+        this iteration."""
         if not self._chunked_on:
-            return
+            return {}
         rows = [r for r in self._slots if r is not None
                 and r.state == RequestState.PREFILLING]
         if not rows:
-            return
+            return {}
         allow = self._prefill_allowance()
         rows.sort(key=self._qos_key, reverse=True)
+        takes = {}
         for req in rows:
-            left = allow - self._prefill_spent
-            if left < min(self.SUFFIX_BUCKET,
-                          int(req.prefill_inputs.size) - req.prefill_pos):
-                # not even one bucket (or the tiny remainder) left this
-                # iteration — the row keeps its cursor and waits
+            left = allow - self._prefill_spent - sum(takes.values())
+            remaining = int(req.prefill_inputs.size) - req.prefill_pos
+            if left < min(self.SUFFIX_BUCKET, remaining):
                 self.metrics.counters["chunks_deferred"] += 1
                 continue
-            self._run_prefill_chunk(req, left)
-
-    def _run_prefill_chunk(self, req: ServeRequest, budget: int):
-        """Run up to ``budget`` prefill tokens for one PREFILLING row.
-        The ``serve.chunk`` fault site fires BEFORE any KV write: a
-        ``raise`` propagates out of step() (the serving loop retries;
-        cursor and block table untouched — the request resumes from its
-        last committed chunk), a ``deny`` defers the row this iteration.
-        The cursor advances only after each window program completes, so
-        a fault between windows is equally consistent."""
-        from deepspeed_tpu.telemetry import get_tracer
-        if self.injector.deny("serve.chunk"):
-            self.metrics.counters["chunks_deferred"] += 1
-            return
-        inputs = req.prefill_inputs
-        n_in = int(inputs.size)
-        take_total = min(budget, n_in - req.prefill_pos)
-        with get_tracer().span(
-                "serve/chunk", cat="serving", corr=f"req-{req.request_id}",
-                args={"request_id": req.request_id,
-                      "offset": int(req.prefill_pos),
-                      "tokens": int(take_total),
-                      "remaining": int(n_in - req.prefill_pos
-                                       - take_total)}):
-            pos_idx = self._pos_idx_row(req.request_id)[None]
-            done, last = 0, None
-            while done < take_total:
-                take = min(self.SUFFIX_CHUNK, take_total - done)
-                last = self._prefill_window(req, inputs,
-                                            req.prefill_pos, take,
-                                            pos_idx)
-                req.prefill_pos += take
-                done += take
-                self.flightrec.record(
-                    "req/prefill_chunk", corr=f"req-{req.request_id}",
-                    tokens=take, offset=req.prefill_pos - take,
-                    cursor=req.prefill_pos, total=n_in)
-        self._prefill_spent += take_total
-        self.metrics.counters["prefill_tokens"] += take_total
-        # committed chunks become prefix-cache content immediately: a
-        # same-prefix admission (or this row's own post-eviction resume)
-        # attaches them instead of recomputing
-        self.block_mgr.register_committed(req.request_id, inputs,
-                                          materialized=req.prefill_pos)
-        if req.prefill_pos >= n_in:
-            self._finish_prefill(req, inputs, last)
+            if self.injector.deny("serve.chunk"):
+                self.metrics.counters["chunks_deferred"] += 1
+                continue
+            takes[req.request_id] = min(left, remaining)
+        return takes
 
     # ------------------------------------------------- decode iteration
     def _grow_tables(self):
@@ -1357,11 +1372,12 @@ class ContinuousBatchingScheduler:
         return k
 
     def _decode(self):
+        """All-plain decode iteration (no drafts, no pending chunks):
+        the k-step fused decode program (``max_fused_steps``) — the
+        batched-window step owns every iteration that has window work."""
         active = [r for r in self._slots if r is not None
                   and r.state == RequestState.DECODE]
         if not active:
-            return
-        if self.proposer is not None and self._spec_decode(active):
             return
         B = self.cfg.max_num_seqs
         bm = self.block_mgr
@@ -1456,42 +1472,87 @@ class ContinuousBatchingScheduler:
             drafts[req.request_id] = d
         return drafts
 
-    def _spec_decode(self, active) -> bool:
-        """One drafted-verify iteration: propose per row, score the whole
-        window in one verify pass, accept the longest valid prefix plus
-        the bonus token, roll rejected suffixes back through the block
-        tables.  Rows without a draft ride the same window as plain
-        single-step decode.  Returns False to fall back to the plain
-        (fused) decode path — nothing drafted this round, or a
-        ``serve.spec`` fault (raise/deny) fired BEFORE any KV write, so
-        degradation is always to a correct plain step."""
+    def _window_step(self) -> bool:
+        """The unified batched-window iteration (ISSUE 12 tentpole):
+        decode rows (with their speculative drafts when a proposer is
+        armed) AND every PREFILLING row's chunk share ride ONE
+        ``_window_fn`` execution — one pool gather, one per-layer
+        weight pass (the fused megakernel when enabled), one windowed
+        scatter.  When a chunk share exceeds the window cap the step
+        loops chunk-only passes until the iteration's allowance is
+        spent (same per-iteration boundedness as the PR 9 phase, fewer
+        launches — chunk rows batch together instead of running B=1
+        programs).  Returns False when there is no window work at all —
+        the all-plain k-step fused decode path then runs instead.
+
+        Fault degradation is unchanged: ``serve.spec`` (raise/deny)
+        fires before any KV write and drops every draft (the step
+        degrades to plain-decode-in-window); ``serve.chunk`` fires in
+        the planning walk before any KV write."""
         from deepspeed_tpu.resilience.faults import FaultInjected
-        drafts = self._propose_drafts(active)
-        if not drafts:
-            return False
         bm = self.block_mgr
-        try:
-            denied = self.injector.deny("serve.spec")
-        except FaultInjected:
-            denied = True
-        if denied:
-            # degrade to plain decode for this step; hand back the
-            # window blocks the dropped drafts had reserved
-            self.metrics.counters["spec_faults"] += 1
-            for rid in drafts:
-                req = self._request_in_slot(rid)
-                if req is not None:
-                    bm.truncate(rid, int(req.all_token_ids.size))
+        active = [r for r in self._slots if r is not None
+                  and r.state == RequestState.DECODE]
+        takes = self._chunk_takes()
+        drafts = {}
+        if self.proposer is not None and active:
+            drafts = self._propose_drafts(active)
+        if drafts:
+            try:
+                denied = self.injector.deny("serve.spec")
+            except FaultInjected:
+                denied = True
+            if denied:
+                # degrade to plain decode this step; hand back the
+                # window blocks the dropped drafts had reserved
+                self.metrics.counters["spec_faults"] += 1
+                for rid in drafts:
+                    req = self._request_in_slot(rid)
+                    if req is not None:
+                        bm.truncate(rid, int(req.all_token_ids.size))
+                drafts = {}
+        if not drafts and not takes:
             return False
+        # first pass: decode rows + each chunk row's first window
+        self._run_window(active, drafts, takes)
+        # chunk-only passes spend the rest of the allowance (decode rows
+        # already emitted this iteration)
+        while takes:
+            takes = {rid: t for rid, t in takes.items() if t > 0
+                     and self._request_in_slot(rid) is not None}
+            if not takes:
+                break
+            self._run_window([], {}, takes)
+        return True
+
+    def _run_window(self, decode_rows, drafts, takes):
+        """Execute ONE batched-window program over the given decode rows
+        (+drafts) and chunk rows (``takes`` mutates: each serviced row's
+        remaining iteration share decrements).  Host epilogue: the spec
+        acceptance walk for decode rows, cursor advance / completion
+        sampling for chunk rows."""
+        bm = self.block_mgr
         B = self.cfg.max_num_seqs
-        maxd = max(int(d.size) for d in drafts.values())
-        W = 1 + _pow2ceil(maxd)        # one compiled program per bucket
+        chunk_rows = []                 # (req, take-this-pass)
+        need = 1 if decode_rows else 0
+        for d in drafts.values():
+            need = max(need, 1 + int(d.size))
+        for rid, left in takes.items():
+            req = self._request_in_slot(rid)
+            if req is None or left <= 0:
+                continue
+            take = min(self.SUFFIX_CHUNK, left)
+            chunk_rows.append((req, take))
+            need = max(need, take)
+        if need == 0:
+            return
+        W = self._window_bucket(need)
         ints = np.zeros((4 + 2 * W, B), np.int32)
         ints[W + 4:] = (np.arange(W) % bm.block_size)[:, None]  # trash
         floats = np.ones((2, B), np.float32)
         do_flags = np.zeros((B,), bool)
         pos_idx = np.zeros((B, self.s_pad), np.int32)
-        for req in active:
+        for req in decode_rows:
             b = req.slot
             seq = req.all_token_ids
             d = drafts.get(req.request_id)
@@ -1511,13 +1572,72 @@ class ContinuousBatchingScheduler:
                     req.request_id, seq.size - 1 + j)
             floats[0, b], floats[1, b] = s.temperature, s.top_p
             do_flags[b] = s.do_sample
+        for req, take in chunk_rows:
+            b = req.slot
+            inputs = req.prefill_inputs
+            pos = req.prefill_pos
+            pos_idx[b] = self._pos_idx_row(req.request_id)
+            s = req.sampling
+            ints[0:take, b] = inputs[pos:pos + take]
+            ints[W, b] = pos
+            # draft_len = take-1 puts the bonus column on the chunk's
+            # last real position — its emitted token IS the first-token
+            # sample when this chunk completes the prefill
+            ints[W + 1, b] = take - 1
+            ints[W + 2, b], ints[W + 3, b] = s.seed & 0x7FFFFFFF, s.top_k
+            for j in range(take):
+                ints[W + 4 + j, b] = bm.position_index(
+                    req.request_id, pos + j)
+            floats[0, b], floats[1, b] = s.temperature, s.top_p
+            do_flags[b] = s.do_sample
+        from deepspeed_tpu.telemetry import get_tracer
+        tracer = get_tracer()
         any_sampling = bool(do_flags.any())
-        acc, out, self.pool = self._verify_fn(W, any_sampling)(
-            self.params, self.pool, ints, floats, do_flags, pos_idx)
-        self.metrics.counters["spec_verify_steps"] += 1
-        self._apply_spec_result(active, drafts, np.asarray(acc),
-                                np.asarray(out))
-        return True
+        # the serve/window span carries the PASS's device time — the
+        # per-row serve/chunk spans below are host bookkeeping only (a
+        # batched program has no per-row execution time to attribute)
+        with tracer.span("serve/window", cat="serving",
+                         args={"W": W, "decode_rows": len(decode_rows),
+                               "drafted_rows": len(drafts),
+                               "chunk_rows": len(chunk_rows)}):
+            acc, out, self.pool = self._window_fn(W, any_sampling)(
+                self.params, self.pool, ints, floats, do_flags, pos_idx)
+            acc, out = np.asarray(acc), np.asarray(out)
+        self.metrics.counters["window_steps"] += 1
+        if drafts:
+            self.metrics.counters["spec_verify_steps"] += 1
+        if decode_rows:
+            self._apply_spec_result(decode_rows, drafts, acc, out)
+        for req, take in chunk_rows:
+            takes[req.request_id] -= take
+            inputs = req.prefill_inputs
+            n_in = int(inputs.size)
+            with tracer.span(
+                    "serve/chunk", cat="serving",
+                    corr=f"req-{req.request_id}",
+                    args={"request_id": req.request_id,
+                          "offset": int(req.prefill_pos),
+                          "tokens": int(take),
+                          "remaining": int(n_in - req.prefill_pos - take)}):
+                req.prefill_pos += take
+                self.flightrec.record(
+                    "req/prefill_chunk", corr=f"req-{req.request_id}",
+                    tokens=take, offset=req.prefill_pos - take,
+                    cursor=req.prefill_pos, total=n_in)
+            self._prefill_spent += take
+            self.metrics.counters["prefill_tokens"] += take
+            self.metrics.counters["window_chunk_tokens"] += take
+            # committed chunks become prefix-cache content immediately:
+            # a same-prefix admission (or this row's own post-eviction
+            # resume) attaches them instead of recomputing
+            self.block_mgr.register_committed(
+                req.request_id, inputs, materialized=req.prefill_pos)
+            if req.prefill_pos >= n_in:
+                # completion: the window's bonus column already drew the
+                # first token — ONE epilogue serves every prefill form
+                takes.pop(req.request_id, None)
+                self._finish_prefill(req, inputs, None,
+                                     tok=int(out[req.slot, take - 1]))
 
     def _pos_idx_row(self, request_id: int) -> np.ndarray:
         """One row of dense-gather indices: the flat pool position of
@@ -1626,11 +1746,6 @@ class ContinuousBatchingScheduler:
                 self._expire_queued()
                 with tracer.span("serve/admit", cat="serving"):
                     self._admit()
-                # chunked-prefill service (ISSUE 9): PREFILLING rows get
-                # their slice of the iteration's prefill allowance here,
-                # between admission and decode — per-chunk serve/chunk
-                # spans carry each request's req-<id> corr
-                self._prefill_chunks()
                 with tracer.span("serve/grow", cat="serving"):
                     self._grow_tables()
                 active = sum(r is not None and
@@ -1638,7 +1753,12 @@ class ContinuousBatchingScheduler:
                              for r in self._slots)
                 with tracer.span("serve/decode", cat="serving",
                                  args={"active": active}):
-                    self._decode()
+                    # unified batched-window step (ISSUE 12): decode
+                    # rows, spec-verify windows, and prefill chunks ride
+                    # ONE compiled family; all-plain iterations keep the
+                    # k-step fused decode program
+                    if not self._window_step():
+                        self._decode()
                 if self._prefill_spent:
                     self.metrics.prefill_batch_tokens.observe(
                         self._prefill_spent)

@@ -38,7 +38,9 @@ _HOST_SYNC_METHODS = {"item", "tolist"}
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
 
 _HOT_PATH_FILE_RE = re.compile(r"(serving/.*\.py|models/serving\.py)$")
-_HOT_PATH_FN_RE = re.compile(r"(^_decode|^_spec_decode|^_prefill|verify)")
+_HOT_PATH_FN_RE = re.compile(
+    r"(^_decode|^_spec_decode|^_prefill|^_window|^_run_window|^_chunk"
+    r"|verify)")
 
 
 def _jit_call_info(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:

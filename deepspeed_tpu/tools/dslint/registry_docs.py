@@ -23,6 +23,14 @@ ENV_VARS = {
                         "autotuner fits under",
     "DS_GGEMM_BLOCKS": "grouped-GEMM (bm,bk,bn) block-shape override "
                        "(ggemm_sweep winners)",
+    "DS_FUSED_DECODE": "0/1 disables/forces the fused per-layer decode "
+                       "megakernel path (wins over serving.fused_decode)",
+    "DS_FUSED_DECODE_BLOCKS": "fused megakernel cache-stream block_s "
+                              "override (fused_sweep winners)",
+    "DS_FUSED_DECODE_INTERPRET": "run the fused decode megakernel in "
+                                 "interpret mode (CPU tier-1)",
+    "DS_FUSED_DECODE_VMEM_MB": "resident-layer VMEM budget the fused "
+                               "megakernel dispatch fits under",
     "DS_GGEMM_INTERPRET": "run the grouped-GEMM Pallas kernels in "
                           "interpret mode (CPU tier-1)",
     "DS_MOE_DISPATCH": "MoE expert-dispatch override: auto/einsum/"
@@ -104,6 +112,10 @@ METRICS = {
                                   "gauge",
     "serving/chunks_deferred": "chunked-prefill windows deferred by the "
                                "per-iteration allowance",
+    "serving/window_steps": "unified batched-window program executions "
+                            "(decode+spec+chunks in one launch)",
+    "serving/window_chunk_tokens": "prefill tokens serviced through the "
+                                   "batched-window surface",
     # --- serving: occupancy / health
     "serving/queue_depth": "queued requests gauge",
     "serving/active_seqs": "occupied decode slots gauge",

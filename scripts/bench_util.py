@@ -1,0 +1,59 @@
+"""Shared bench timing helpers — the axon-tunnel measurement discipline
+in ONE place (ISSUE 12 satellite).
+
+PERF.md's round-4 lesson: the tunnel charges a fixed ~100 ms per
+blocking round trip, ~1.8 GB/s to fetch any returned array, and —
+crucially — ``jax.block_until_ready`` does NOT synchronize on the
+tunnel: it waits on the local future, not the remote stream, so a
+bench that "syncs" with it under-reports.  The only trustworthy sync
+is FETCHING A VALUE; the only trustworthy timing is the SLOPE between
+two on-device chained step counts, which cancels every fixed cost.
+
+Every sweep/profile script imports these instead of growing its own
+copy (decode_profile, serve_bench, qgemm_sweep, ggemm_sweep; the
+original lives in scripts/flash_ab.py)."""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fetch(x):
+    """Value-fetch synchronization: materialize ``x`` on the host and
+    return it as numpy.  This is the ONE sync primitive benches should
+    use — ``block_until_ready`` does not synchronize on the axon
+    tunnel (PERF.md round 4)."""
+    return np.asarray(x)
+
+
+def timed_chain(step_fn, state0, n, warmup=2):
+    """On-device loop slope: run ``m`` and ``5m`` chained ``step_fn``
+    applications inside one jitted ``fori_loop`` (a data dependency
+    chains them), sync by fetching a scalar, and report the per-step
+    SLOPE in seconds — fixed dispatch/tunnel costs cancel between the
+    two step counts.  ``state0`` is a tuple whose first element is an
+    array (reduced to the fetched scalar)."""
+    @jax.jit
+    def run(state, m):
+        state = lax.fori_loop(0, m, lambda i, s: step_fn(s), state)
+        return jnp.sum(state[0].astype(jnp.float32))
+
+    float(run(state0, warmup))          # compile + warm (value fetch syncs)
+
+    def once(m):
+        t0 = time.time()
+        float(run(state0, m))
+        return time.time() - t0
+
+    t_small = min(once(n), once(n))
+    t_big = min(once(5 * n), once(5 * n))
+    return (t_big - t_small) / (4 * n)
+
+
+def timed_chain_ms(step_fn, state0, n, warmup=3):
+    """``timed_chain`` in milliseconds (decode_profile's historical
+    unit)."""
+    return timed_chain(step_fn, state0, n, warmup=warmup) * 1e3

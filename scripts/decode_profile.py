@@ -41,24 +41,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def timed_chain(step_fn, state0, n, warmup=3):
-    """On-device loop slope (see flash_ab.py): time m and 5m chained steps,
-    report the per-step slope in ms."""
-    @jax.jit
-    def run(state, m):
-        state = lax.fori_loop(0, m, lambda i, s: step_fn(s), state)
-        return jnp.sum(state[0].astype(jnp.float32))
-
-    jax.block_until_ready(run(state0, warmup))
-
-    def once(m):
-        t0 = time.time()
-        jax.block_until_ready(run(state0, m))
-        return time.time() - t0
-
-    t_small = min(once(n), once(n))
-    t_big = min(once(5 * n), once(5 * n))
-    return (t_big - t_small) / (4 * n) * 1e3
+# shared slope-timing helper (scripts/bench_util.py): value-fetch sync —
+# the old local copy synced with block_until_ready, which does NOT
+# synchronize on the axon tunnel (PERF.md round 4)
+from scripts.bench_util import timed_chain_ms as timed_chain
 
 
 def moe_floor_main():
@@ -457,6 +443,56 @@ def main():
         return (tok, cache, lengths)
 
     variants["weights_floor"] = weights_floor2
+
+    # ------------------------------------------- fused megakernel A/B
+    # ISSUE 12: the same decode step through the fused per-layer path
+    # (ONE Pallas call per layer on chip; the jnp reference composition
+    # off-chip — a structural A/B only there).  Token identity between
+    # the two paths is asserted up front so the timing rows compare
+    # equal programs.
+    from deepspeed_tpu.ops.pallas.fused_decode import fused_decode_scope
+
+    def fused_decode(state):
+        # scope is a trace-time choice; timed_chain traces step_fn
+        # inside this call, so the scope covers the trace
+        with fused_decode_scope(True):
+            tok, cache, lengths = state
+            logits, cache = G.decode_step(params, tok, cache, lengths,
+                                          cfg)
+            return next_state(logits, cache, lengths)
+
+    def fused_int8w(state):
+        with fused_decode_scope(True):
+            tok, cache, lengths = state
+            qp = dict(params)
+            qp["blocks"] = qblocks
+            logits, cache = G.decode_step(qp, tok, cache, lengths, cfg)
+            return next_state(logits, cache, lengths)
+
+    variants["fused_decode"] = fused_decode
+    variants["fused_int8w_decode"] = fused_int8w
+
+    def _argmax_chain(fused, n=4):
+        tok, cache, lengths = state0
+        with fused_decode_scope(fused):
+            f = jax.jit(lambda t, c, l: G.decode_step(params, t, c, l,
+                                                      cfg))
+            out = []
+            for _ in range(n):
+                logits, cache = f(tok, cache, lengths)
+                tok = jnp.argmax(logits, -1).astype(jnp.int32)
+                lengths = lengths + 1
+                out.append(np.asarray(tok))
+        return np.stack(out)
+
+    state0 = (tok0, cache, lengths0)
+    try:
+        fused_same = bool((_argmax_chain(False)
+                           == _argmax_chain(True)).all())
+    except Exception as e:
+        fused_same = f"error: {str(e)[:200]}"
+    print(json.dumps({"variant": "fused_parity",
+                      "token_identical": fused_same}))
 
     cal = jnp.asarray(rng.standard_normal((2048, 2048)), jnp.bfloat16)
     mm = lambda s: (jnp.tanh(s[0] @ cal), s[1], s[2])

@@ -33,23 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def timed_chain(fn, state0, n, warmup=2):
-    """On-device loop slope (scripts/flash_ab.py discipline)."""
-    @jax.jit
-    def run(state, m):
-        state = lax.fori_loop(0, m, lambda i, s: fn(s), state)
-        return jnp.sum(state[0].astype(jnp.float32))
-
-    float(run(state0, warmup))          # compile + warm (value fetch syncs)
-
-    def once(m):
-        t0 = time.time()
-        float(run(state0, m))
-        return time.time() - t0
-
-    t_small = min(once(n), once(n))
-    t_big = min(once(5 * n), once(5 * n))
-    return (t_big - t_small) / (4 * n)
+from scripts.bench_util import timed_chain
 
 
 def main():

@@ -17,6 +17,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
     SERVE_MODE=slo SERVE_LONG_LEN=8192 python scripts/serve_bench.py
     SERVE_MODE=fleet SERVE_REPLICAS=2 python scripts/serve_bench.py
+    SERVE_MODE=fused python scripts/serve_bench.py   # megakernel A/B
     SERVE_MODE=cb python scripts/serve_bench.py --json out.json
 
 ``--json out.json`` (ISSUE 7 satellite) additionally writes the result
@@ -67,6 +68,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
+
+# value-fetch sync (block_until_ready does not sync on the axon tunnel)
+from scripts.bench_util import fetch
 
 
 def emit(result: dict, json_path=None) -> dict:
@@ -140,7 +144,7 @@ def main(argv=None):
         size = size or "tiny"
         kwargs = {}
     elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe",
-                                          "slo", "fleet"):
+                                          "slo", "fleet", "fused"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -152,7 +156,8 @@ def main(argv=None):
     # cb/spec modes size their own workloads (spec's motif-tiled prompts
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
-    if _mode not in ("cb", "spec", "prefix", "moe", "slo", "fleet"):
+    if _mode not in ("cb", "spec", "prefix", "moe", "slo", "fleet",
+                     "fused"):
         cb_ctx = 0
     elif _mode == "slo":
         # headroom for the adversarial long prompts (heavy-prefill
@@ -208,6 +213,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "fleet":
         return bench_fleet_routing(model, eng, spec, kv_dtype, on_tpu,
                                    json_path)
+    if os.environ.get("SERVE_MODE") == "fused":
+        return bench_fused_ab(model, eng, spec, kv_dtype, on_tpu,
+                              json_path)
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, model.config.vocab_size,
@@ -223,15 +231,15 @@ def main(argv=None):
         best = float("inf")
         for _ in range(reps):
             t0 = time.time()
-            np.asarray(eng.generate(prompts, max_new_tokens=n,
+            fetch(eng.generate(prompts, max_new_tokens=n,
                                     do_sample=False))
             best = min(best, time.time() - t0)
         return best
 
     # warmup/compile all program shapes
-    np.asarray(eng.generate(prompts, max_new_tokens=1, do_sample=False))
-    np.asarray(eng.generate(prompts, max_new_tokens=small, do_sample=False))
-    np.asarray(eng.generate(prompts, max_new_tokens=new_tokens,
+    fetch(eng.generate(prompts, max_new_tokens=1, do_sample=False))
+    fetch(eng.generate(prompts, max_new_tokens=small, do_sample=False))
+    fetch(eng.generate(prompts, max_new_tokens=new_tokens,
                             do_sample=False))
     t_prefill = timed(1)
     t_small = timed(small)
@@ -256,6 +264,74 @@ def main(argv=None):
                    "new_tokens": new_tokens,
                    "prefill_ms": round(t_prefill * 1e3, 2),
                    "total_s": round(t_full, 3)},
+    }, json_path)
+
+
+def bench_fused_ab(model, eng, spec, kv_dtype, on_tpu, json_path=None):
+    """Fused-megakernel on/off A/B through the cb scheduler (ISSUE 12):
+    the same mixed-length greedy workload twice — fused off (per-op
+    composition) vs on (``ds_fused_layer`` per layer) — with
+    token-identical outputs ASSERTED, so the A/B isolates launches and
+    scaffolding.  Off-TPU the fused path runs the jnp reference
+    composition (structural A/B only, no launch win — the CPU-crossover
+    caveat in docs/tutorials/serving.md); the on-chip rows are queued in
+    PERF.md.  ``--json`` emits both rows for bench_compare gating."""
+    import time as _time
+    from deepspeed_tpu.ops.pallas.fused_decode import fused_decode_scope
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 16 if on_tpu else 8))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    p_lo, p_hi = ((32, 512) if on_tpu else (4, 24))
+    n_lo, n_hi = ((8, 128) if on_tpu else (2, 12))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    workload = [
+        (rng.integers(1, V, (int(pl),)).astype(np.int32), int(nn))
+        for pl, nn in zip(rng.integers(p_lo, p_hi, n_reqs),
+                          rng.integers(n_lo, n_hi, n_reqs))]
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 4
+    need = -(-max_len // bs) + 1
+    cfg = ServingConfig(block_size=bs, max_num_seqs=max_seqs,
+                        num_blocks=1 + need * max_seqs,
+                        max_num_batched_tokens=1 << 30)
+
+    def run(fused):
+        with fused_decode_scope(fused):
+            sched = ContinuousBatchingScheduler(
+                model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+
+            def once():
+                t0 = _time.time()
+                reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                        for p, nn in workload]
+                sched.run_until_idle()
+                return (_time.time() - t0,
+                        [np.asarray(r.output_ids) for r in reqs])
+
+            once()                          # compile warm
+            best, outs = min((once() for _ in range(2)),
+                             key=lambda r: r[0])
+        return best, outs
+
+    off_s, off_out = run(False)
+    on_s, on_out = run(True)
+    for a, b in zip(off_out, on_out):       # the A/B contract
+        np.testing.assert_array_equal(a, b)
+    return emit({
+        "bench": "serve_fused_ab", "model": spec,
+        "kv": kv_dtype or "native", "device": jax.devices()[0].device_kind,
+        "requests": n_reqs, "useful_tokens": useful,
+        "token_identical": True,
+        "unfused": {"wall_s": round(off_s, 3),
+                    "tok_s": round(useful / off_s, 1)},
+        "fused": {"wall_s": round(on_s, 3),
+                  "tok_s": round(useful / on_s, 1)},
+        "fused_speedup": round(off_s / on_s, 3),
     }, json_path)
 
 
@@ -321,7 +397,7 @@ def bench_continuous_batching(model, eng, spec, kv_dtype, on_tpu,
             for j, (p, _) in enumerate(batch):
                 toks[j, :p.size] = p        # right-padded rectangle
             t_b = _time.time()
-            np.asarray(eng.generate(toks, max_new_tokens=new,
+            fetch(eng.generate(toks, max_new_tokens=new,
                                     do_sample=False))
             # static batches emit every token before ANY request returns:
             # TTFT = the whole batch latency, for every request in it
