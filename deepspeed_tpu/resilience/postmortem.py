@@ -17,6 +17,9 @@ that moment:
 - ``config.json``    — the scheduler's ServingConfig (or whatever the
   caller passes)
 - ``health.json``    — health state machine snapshot
+- ``perf.json``      — the perf observatory snapshot (ISSUE 13):
+  per-program cost reports + roofline floors + live achieved-vs-floor,
+  so a DEGRADED bundle shows whether the wedge was perf collapse
 - ``trace.json``     — the flushed Perfetto trace, when a tracer is
   armed
 
@@ -157,6 +160,14 @@ def write_postmortem(out_dir: str, reason: str, *,
         artifact("config.json", _cfg_payload)
     if health is not None:
         artifact("health.json", lambda p: _write_json(p, health.snapshot()))
+
+    def _perf(p):
+        from deepspeed_tpu.telemetry.roofline import perf_table
+        payload = perf_table()
+        if not payload["programs"]:
+            return False            # nothing analyzed — skip the artifact
+        return _write_json(p, payload)
+    artifact("perf.json", _perf)
 
     tracer = get_tracer()
     if getattr(tracer, "enabled", False):

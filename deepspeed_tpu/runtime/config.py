@@ -366,6 +366,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     anomaly_threshold: float = 5.0
     #: detector window (recent step latencies the median/MAD run over)
     anomaly_window: int = 64
+    #: compiled-program cost model (ISSUE 13): one-time jaxpr analysis
+    #: of the fused train step (FLOPs/bytes/launches -> perf/* gauges,
+    #: /debug/perf, post-mortem perf.json).  DS_PERF_COSTMODEL env wins.
+    costmodel: bool = True
 
     def __init__(self, **data):
         super().__init__(**data)

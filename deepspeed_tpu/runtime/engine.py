@@ -655,6 +655,12 @@ class DeepSpeedEngine:
         self.anomaly = AnomalyMonitor(
             registry=self.telemetry_registry, flightrec=self.flightrec,
             window=tcfg.anomaly_window, threshold=tcfg.anomaly_threshold)
+        # perf observatory (ISSUE 13): one-time cost analysis of the
+        # fused train-step program (perf/* gauges + span annotation).
+        # _step_cost_ok flips only when a report actually registered —
+        # a disabled/failed analysis must not leak perf gauges
+        self._step_cost_done = False
+        self._step_cost_ok = False
         self.metrics_server = None
         if tcfg.metrics_port is not None and jax.process_index() == 0:
             from deepspeed_tpu.telemetry import MetricsServer
@@ -2026,9 +2032,19 @@ class DeepSpeedEngine:
         latency, tokens/s, and MFU land in the metrics registry."""
         step = self.global_steps + 1
         t0 = time.perf_counter()
+        span_args = {"step": step}
+        if self._step_cost_ok:
+            # cost annotation (ISSUE 13): once the step program's
+            # CostReport exists, every train/step span carries it
+            from deepspeed_tpu.telemetry.costmodel import get_report
+            rep = get_report("train/step")
+            if rep is not None:
+                span_args.update(cost_flops=rep.flops,
+                                 cost_hbm_bytes=rep.hbm_bytes,
+                                 cost_pallas_launches=rep.pallas_launches)
         with self.tracer.span("train/step", cat="train",
                               corr=f"train-step-{step}",
-                              args={"step": step}):
+                              args=span_args):
             loss = self._train_batch_impl(data_iter=data_iter, batch=batch)
             # still inside the train/step span so an anomaly instant
             # lands between this step's B/E pair (the serve side keeps
@@ -2126,13 +2142,15 @@ class DeepSpeedEngine:
                 metrics = self._host_apply(grads, loss)
         else:
             fn = self._get_compiled("train_step")
+            rng = self._next_rng()
+            self._maybe_cost_report(batch, rng)
             # one fused program: fwd+bwd+apply dispatch together (the
             # per-phase split lives in the fwd/bwd/step timers when the
             # micro API drives them)
             with self.tracer.span("train/fused_step", cat="train"), \
                     self._train_scope(), self._ltd_scope(), \
                     self._aq_scope():
-                self.state, metrics = fn(self.state, batch, self._next_rng())
+                self.state, metrics = fn(self.state, batch, rng)
         self._finish_step(metrics)
         # syncing on the loss every step costs a device->host round trip
         # (~100 ms on tunneled platforms); only pay it when the user asked
@@ -2335,6 +2353,35 @@ class DeepSpeedEngine:
             msg += f" grad_norm={float(metrics.get('grad_norm', 0.0)):.3f}"
             log_dist(msg, ranks=[0])
 
+    def _maybe_cost_report(self, batch, rng):
+        """One-time jaxpr cost analysis of the fused train step
+        (ISSUE 13): dot FLOPs, boundary HBM bytes (state read+written +
+        batch — the step streams its whole state), pallas launch sites,
+        and collective bytes, registered as the ``train/step`` program
+        and published as ``perf/*`` gauges.  One extra host-side trace,
+        once per engine; never raises into the step."""
+        if self._step_cost_done:
+            return
+        self._step_cost_done = True
+        tcfg = self._config.telemetry_config
+        from deepspeed_tpu.telemetry.costmodel import costmodel_enabled
+        if not (tcfg.enabled and costmodel_enabled(tcfg.costmodel)):
+            return
+        try:
+            from deepspeed_tpu.telemetry.costmodel import analyze_fn
+            from deepspeed_tpu.telemetry.roofline import publish_report
+            with self._train_scope(), self._ltd_scope(), self._aq_scope():
+                report = analyze_fn(
+                    self._build_train_step(), self.state, batch, rng,
+                    name="train/step",
+                    detail={"tokens_per_step": self.train_batch_size()
+                            * max(self._last_seq_len or 0, 0)})
+            publish_report(self.telemetry_registry, report)
+            self._step_cost_ok = True
+        except Exception as e:          # noqa: BLE001 — best-effort
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"costmodel: train/step analysis failed: {e}")
+
     def _record_step_telemetry(self, duration_s: float):
         """Per-step registry update + monitor bridge (ISSUE 4): step
         latency histogram, tokens/s, and the MFU gauge — model FLOPs
@@ -2357,6 +2404,11 @@ class DeepSpeedEngine:
                               step=self.global_steps,
                               dur_ms=round(duration_s * 1e3, 3))
         self.anomaly.observe("train.step", duration_s, corr=corr)
+        if self._step_cost_ok:
+            # achieved-vs-floor for the fused step program (ISSUE 13);
+            # floors only resolve where the device rate tables do
+            from deepspeed_tpu.telemetry.roofline import observe_achieved
+            observe_achieved(reg, "train/step", duration_s)
         tokens = self.train_batch_size() * max(self._last_seq_len, 0)
         if tokens and duration_s > 0:
             reg.set_gauge("train/tokens_per_s", tokens / duration_s)

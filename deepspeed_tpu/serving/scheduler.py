@@ -353,6 +353,22 @@ class ContinuousBatchingScheduler:
         # serving.spec.mode; an explicit proposer wins (and implies spec
         # on even when the config section says off — test/bench intent)
         self.proposer = self._resolve_proposer(proposer)
+        # perf observatory (ISSUE 13): one dtype-aware weight-stream
+        # model per scheduler (split_quantized_bytes library math) — the
+        # HBM-byte term every compiled program family reports against
+        from deepspeed_tpu.telemetry.costmodel import (costmodel_enabled,
+                                                       param_stream_bytes)
+        self._costmodel_on = costmodel_enabled()
+        self._cost_stream = None
+        if self._costmodel_on:
+            try:
+                mcfg = getattr(self.model, "config", None)
+                self._cost_stream = param_stream_bytes(
+                    self.params, batch=self.cfg.max_num_seqs,
+                    top_k=getattr(mcfg, "top_k", None),
+                    num_experts=getattr(mcfg, "num_experts", None))
+            except Exception:       # cost accounting must never block serving
+                self._costmodel_on = False
         self.pool = self._init_pool()
 
     def _resolve_proposer(self, proposer):
@@ -382,6 +398,56 @@ class ContinuousBatchingScheduler:
         return jax.tree.map(lambda a: a[:, 0], cache)
 
     # ------------------------------------------------------- jitted fns
+    def _instrument(self, name: str, fn, variant=None):
+        """``_jit_device_local`` plus the ISSUE 13 cost model: the first
+        invocation of each program variant traces ``fn`` once more (no
+        compile) and registers a CostReport — dot FLOPs, weight-stream
+        HBM bytes, pallas launch sites, collective bytes — publishing
+        ``perf/*`` gauges into this scheduler's registry.
+
+        ``variant(args) -> (suffix, weight_passes)`` resolves per-call
+        program variants: the k-step fused decode scans k FULL weight
+        passes per execution and jit compiles one program per k, so
+        each k is its own cost family (``serve/decode:k8``) with a
+        k-scaled byte model — one shared report would understate the
+        floor by k.  Analysis failure (or DS_PERF_COSTMODEL=0) degrades
+        to plain jit; it never blocks a step."""
+        jitted = _jit_device_local(fn)
+        if not self._costmodel_on:
+            return jitted
+        analyzed = set()
+        stream = self._cost_stream or {}
+
+        def wrapper(*args):
+            vname, passes = name, 1
+            if variant is not None:
+                try:
+                    suffix, passes = variant(args)
+                    vname = name + suffix
+                except Exception:           # malformed packing: keep base
+                    vname, passes = name, 1
+            if vname not in analyzed:
+                analyzed.add(vname)
+                try:
+                    from deepspeed_tpu.telemetry.costmodel import analyze_fn
+                    from deepspeed_tpu.telemetry.roofline import \
+                        publish_report
+                    base = stream.get("weights_floor_bytes")
+                    report = analyze_fn(
+                        fn, *args, name=vname,
+                        hbm_bytes=None if base is None else base * passes,
+                        detail=dict(
+                            {k: v for k, v in stream.items()
+                             if isinstance(v, int)},
+                            weight_passes=passes))
+                    publish_report(self.metrics.registry, report)
+                except Exception as e:      # noqa: BLE001 — best-effort
+                    logger.debug(f"costmodel: {vname} analysis "
+                                 f"failed: {e}")
+            return jitted(*args)
+
+        return wrapper
+
     def _prefill_fn(self, sp: int):
         if sp not in self._prefill_fns:
             model, kv_dtype = self.model, self.kv_cache_dtype
@@ -396,7 +462,8 @@ class ContinuousBatchingScheduler:
                     pool, cache)
                 return logits[0, length[0] - 1][None], pool
 
-            self._prefill_fns[sp] = _jit_device_local(fn)
+            self._prefill_fns[sp] = self._instrument(
+                f"serve/prefill:sp{sp}", fn)
         return self._prefill_fns[sp]
 
     def _sample1_fn(self, any_sampling: bool):
@@ -447,7 +514,12 @@ class ContinuousBatchingScheduler:
                     body, (pool, tokens, lengths), dest_steps)
                 return toks, pool               # toks [k, B]
 
-            self._decode_fns[key] = _jit_device_local(fn)
+            # ints [4+k, B]: the scan length k IS the weight-pass
+            # count of one execution (see _instrument docstring)
+            self._decode_fns[key] = self._instrument(
+                "serve/decode", fn,
+                variant=lambda args: (f":k{args[2].shape[0] - 4}",
+                                      args[2].shape[0] - 4))
         return self._decode_fns[key]
 
     def _window_fn(self, W: int, any_sampling: bool):
@@ -509,7 +581,8 @@ class ContinuousBatchingScheduler:
                     temps, top_ks, top_ps, do_flags, any_sampling)
                 return acc, out, pool
 
-            self._window_fns[key] = _jit_device_local(fn)
+            self._window_fns[key] = self._instrument(
+                f"serve/window:w{W}", fn)
         return self._window_fns[key]
 
     def _window_bucket(self, need: int) -> int:
@@ -1401,9 +1474,14 @@ class ContinuousBatchingScheduler:
             floats[0, b], floats[1, b] = s.temperature, s.top_p
             do_flags[b] = s.do_sample
         any_sampling = bool(do_flags.any())
+        t0 = time.perf_counter()
         toks, self.pool = self._decode_fn(any_sampling)(
             self.params, self.pool, ints, floats, do_flags, pos_idx)
         toks = np.asarray(toks)                  # [k, B]
+        if self._costmodel_on:
+            from deepspeed_tpu.telemetry.roofline import observe_achieved
+            observe_achieved(self.metrics.registry, f"serve/decode:k{k}",
+                             time.perf_counter() - t0)
         self.metrics.counters["decode_steps"] += k
         for req in active:
             for j in range(k):
@@ -1596,13 +1674,28 @@ class ContinuousBatchingScheduler:
         # the serve/window span carries the PASS's device time — the
         # per-row serve/chunk spans below are host bookkeeping only (a
         # batched program has no per-row execution time to attribute)
-        with tracer.span("serve/window", cat="serving",
-                         args={"W": W, "decode_rows": len(decode_rows),
-                               "drafted_rows": len(drafts),
-                               "chunk_rows": len(chunk_rows)}):
+        # cost annotation (ISSUE 13): once the family's CostReport is
+        # registered (first execution analyzed it), the span carries the
+        # program's static cost beside its measured device time
+        span_args = {"W": W, "decode_rows": len(decode_rows),
+                     "drafted_rows": len(drafts),
+                     "chunk_rows": len(chunk_rows)}
+        if self._costmodel_on:
+            from deepspeed_tpu.telemetry.costmodel import get_report
+            rep = get_report(f"serve/window:w{W}")
+            if rep is not None:
+                span_args.update(cost_flops=rep.flops,
+                                 cost_hbm_bytes=rep.hbm_bytes,
+                                 cost_pallas_launches=rep.pallas_launches)
+        t0 = time.perf_counter()
+        with tracer.span("serve/window", cat="serving", args=span_args):
             acc, out, self.pool = self._window_fn(W, any_sampling)(
                 self.params, self.pool, ints, floats, do_flags, pos_idx)
             acc, out = np.asarray(acc), np.asarray(out)
+        if self._costmodel_on:
+            from deepspeed_tpu.telemetry.roofline import observe_achieved
+            observe_achieved(self.metrics.registry, f"serve/window:w{W}",
+                             time.perf_counter() - t0)
         self.metrics.counters["window_steps"] += 1
         if drafts:
             self.metrics.counters["spec_verify_steps"] += 1

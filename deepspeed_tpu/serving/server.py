@@ -19,6 +19,9 @@ Endpoints:
   GET  /debug/stacks     all-thread Python stack dump (lock-free; works
                          while the scheduler is wedged)
   GET  /debug/flightrec  flight-recorder snapshot (?n=, ?corr=, ?kind=)
+  GET  /debug/perf       per-program cost table + roofline floors +
+                         live achieved-vs-floor (?program= filter;
+                         ISSUE 13)
 
 The ``/debug/*`` surface (ISSUE 7) is read-only and never takes the
 scheduler lock — it exists precisely for the moments the lock is stuck.
@@ -235,7 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
         in flight)."""
         from deepspeed_tpu.telemetry.debug import (flightrec_payload,
                                                    format_thread_stacks,
-                                                   parse_debug_query)
+                                                   parse_debug_query,
+                                                   perf_payload)
         route, query = parse_debug_query(self.path)
         if route == "/debug/stacks":
             body = format_thread_stacks().encode()
@@ -257,6 +261,9 @@ class _Handler(BaseHTTPRequestHandler):
         if route == "/debug/flightrec":
             self._send_json(200, flightrec_payload(
                 self.scheduler.flightrec, query))
+            return
+        if route == "/debug/perf":
+            self._send_json(200, perf_payload(query))
             return
         self._send_json(404, {"error": f"no route {route}"})
 

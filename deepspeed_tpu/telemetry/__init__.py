@@ -4,7 +4,10 @@ MFU/goodput accounting — the cross-cutting observability layer train
 and serve both report through (docs/tutorials/monitoring-profiling.md).
 ISSUE 7 adds the black-box layer: a structured flight recorder,
 rolling anomaly detection + SLO burn accounting, and the live
-``/debug/*`` introspection surface.
+``/debug/*`` introspection surface.  ISSUE 13 adds the perf
+observatory: a jaxpr-walking cost model for every compiled hot-path
+program family and a roofline layer pricing each one against the
+device's FLOP/bandwidth rates (``perf/*`` gauges, ``/debug/perf``).
 """
 from deepspeed_tpu.telemetry.registry import (      # noqa: F401
     COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
@@ -20,6 +23,14 @@ from deepspeed_tpu.telemetry.flight_recorder import (  # noqa: F401
     get_flight_recorder, reset_flight_recorder)
 from deepspeed_tpu.telemetry.anomaly import (       # noqa: F401
     AnomalyMonitor, RollingMadDetector, SLOTracker)
+from deepspeed_tpu.telemetry.costmodel import (     # noqa: F401
+    COSTMODEL_ENV, CostReport, analyze_fn, analyze_jaxpr,
+    costmodel_enabled, count_pallas_launches, get_reports,
+    param_stream_bytes, register_report)
+from deepspeed_tpu.telemetry.roofline import (      # noqa: F401
+    HBM_GBPS_BY_KIND, HBM_GBPS_ENV, classify, floor_seconds,
+    hbm_bytes_per_s, observe_achieved, perf_table, publish_report)
 from deepspeed_tpu.telemetry.debug import (         # noqa: F401
-    flightrec_payload, format_thread_stacks, parse_debug_query)
+    flightrec_payload, format_thread_stacks, parse_debug_query,
+    perf_payload)
 from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

@@ -1,0 +1,343 @@
+"""Compiled-program cost model (ISSUE 13 tentpole).
+
+Every hot-path program family this framework compiles — the engine
+train step, the serving scheduler's decode/window/prefill programs,
+fused vs unfused kernel variants — should know its own cost instead of
+having it hand-computed in PERF.md prose.  This module walks a traced
+program (jaxpr) and produces a :class:`CostReport`:
+
+- **dot FLOPs** — ``2·M·N·K`` per ``dot_general``, execution-weighted
+  (a ``lax.scan`` body multiplies by its trip count, a ``pallas_call``
+  body by its grid size, a ``cond`` contributes its most expensive
+  branch);
+- **pallas launch sites** — ``pallas_call`` equations counted
+  recursively through sub-jaxprs, each one device kernel launch per
+  execution.  This is the PR 12 fused-decode L-vs-4L assertion
+  generalized into a library (:func:`count_pallas_launches`);
+- **collective bytes** — operand bytes of psum/all_gather/etc.
+  equations, execution-weighted;
+- **HBM bytes** — the dtype-aware weight stream the program must pull
+  per execution.  For the decode regime this IS the floor, and the
+  math is the existing ``split_quantized_bytes`` accounting
+  (serve_bench / decode_profile ``weights_floor_int8`` /
+  ``weights_floor_moe``) promoted to library code:
+  :func:`param_stream_bytes`.
+
+Reports register into a process-wide table (plain dict writes — the
+``/debug/perf`` reader never takes any scheduler lock) so the metrics
+surfaces, post-mortem bundles, and ``scripts/perf_report.py`` all read
+one source of truth.  Analysis costs one extra trace per program
+family; ``DS_PERF_COSTMODEL=0`` (or ``telemetry.costmodel: false``)
+disables it.
+"""
+import math
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+COSTMODEL_ENV = "DS_PERF_COSTMODEL"
+
+#: collective primitives whose operand bytes cross the interconnect
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "pgather", "reduce_scatter", "pmax", "pmin", "allreduce"})
+
+
+def costmodel_enabled(config_default: Optional[bool] = None) -> bool:
+    """Resolution order (the repo's env-wins convention):
+    ``DS_PERF_COSTMODEL`` env > the ``telemetry.costmodel`` config value
+    the caller passes > on."""
+    env = os.environ.get(COSTMODEL_ENV, "").strip()
+    if env:
+        return env not in ("0", "false", "off")
+    if config_default is not None:
+        return bool(config_default)
+    return True
+
+
+@dataclass
+class CostReport:
+    """Static cost of ONE execution of a compiled program family."""
+    name: str
+    flops: int = 0                 #: dot FLOPs (2·M·N·K, execution-weighted)
+    hbm_bytes: int = 0             #: weight-stream bytes per execution
+    pallas_launches: int = 0       #: kernel-launch sites in the program
+    collective_bytes: int = 0      #: interconnect payload per execution
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+    def arithmetic_intensity(self) -> Optional[float]:
+        """FLOPs per HBM byte (None when the byte model is empty)."""
+        if self.hbm_bytes <= 0:
+            return None
+        return self.flops / self.hbm_bytes
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "flops": int(self.flops),
+                "hbm_bytes": int(self.hbm_bytes),
+                "pallas_launches": int(self.pallas_launches),
+                "collective_bytes": int(self.collective_bytes),
+                "detail": dict(self.detail)}
+
+
+# ------------------------------------------------------------ jaxpr walk
+def _aval_bytes(aval) -> int:
+    try:
+        import numpy as np
+        return int(aval.size) * int(np.dtype(aval.dtype).itemsize)
+    except Exception:   # abstract tokens, opaque avals
+        return 0
+
+
+def _sub_jaxprs(eqn):
+    """Every (Closed)Jaxpr reachable from an equation's params."""
+    import jax
+    for v in eqn.params.values():
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        for it in items:
+            if isinstance(it, jax.core.ClosedJaxpr):
+                yield it.jaxpr
+            elif isinstance(it, jax.core.Jaxpr):
+                yield it
+
+
+def count_pallas_launches(jaxpr) -> int:
+    """Kernel-launch SITES in a traced program: ``pallas_call``
+    equations, recursively through sub-jaxprs (scan/cond/jit bodies).
+    Each site is one device kernel launch per execution — countable on
+    CPU, where interpret-mode kernels still trace as ``pallas_call``
+    equations.  This is the PR 12 fused-decode launch-count contract
+    (``<= L + k`` fused vs ``~(4-6)L`` unfused) as a shared API."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)      # accept ClosedJaxpr
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            n += 1
+        for sub in _sub_jaxprs(eqn):
+            n += count_pallas_launches(sub)
+    return n
+
+
+def _dot_flops(eqn) -> int:
+    """2·(output elements)·(contraction length) for a dot_general."""
+    try:
+        (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        k = 1
+        for d in lhs_c:
+            k *= int(lhs.shape[d])
+        out = eqn.outvars[0].aval
+        return 2 * int(out.size) * k
+    except Exception:
+        return 0
+
+
+def _grid_size(eqn) -> int:
+    gm = eqn.params.get("grid_mapping")
+    grid = getattr(gm, "grid", None) or ()
+    n = 1
+    for g in grid:
+        if isinstance(g, int):
+            n *= g
+    return max(n, 1)
+
+
+def _walk(jaxpr, mult: int, acc: Dict[str, int]):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            acc["flops"] += mult * _dot_flops(eqn)
+        elif prim in COLLECTIVE_PRIMITIVES:
+            acc["collective_bytes"] += mult * sum(
+                _aval_bytes(v.aval) for v in eqn.invars)
+        if prim == "pallas_call":
+            acc["launches"] += 1
+        if prim == "cond":
+            # a cond executes ONE branch: charge the most expensive
+            branches = eqn.params.get("branches", ())
+            best = None
+            for br in branches:
+                sub_acc = {"flops": 0, "collective_bytes": 0, "launches": 0}
+                _walk(getattr(br, "jaxpr", br), mult, sub_acc)
+                if best is None or sub_acc["flops"] > best["flops"]:
+                    best = sub_acc
+            if best is not None:
+                acc["flops"] += best["flops"]
+                acc["collective_bytes"] += best["collective_bytes"]
+                acc["launches"] += best["launches"]
+            continue
+        sub_mult = mult
+        if prim == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1))
+        elif prim == "pallas_call":
+            sub_mult = mult * _grid_size(eqn)
+        for sub in _sub_jaxprs(eqn):
+            _walk(sub, sub_mult, acc)
+
+
+def analyze_jaxpr(closed_jaxpr, name: str = "program",
+                  hbm_bytes: Optional[int] = None) -> CostReport:
+    """Cost-walk a (Closed)Jaxpr.  ``hbm_bytes`` is the caller's
+    dtype-aware weight-stream model (:func:`param_stream_bytes`); when
+    absent, the program-boundary bytes (inputs + outputs) stand in as
+    an upper bound and are flagged in the detail dict."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    acc = {"flops": 0, "collective_bytes": 0, "launches": 0}
+    _walk(jaxpr, 1, acc)
+    detail: Dict[str, Any] = {}
+    if hbm_bytes is None:
+        hbm_bytes = sum(_aval_bytes(v.aval) for v in jaxpr.invars) + \
+            sum(_aval_bytes(v.aval) for v in jaxpr.outvars)
+        detail["hbm_bytes_source"] = "program_boundary_upper_bound"
+    else:
+        detail["hbm_bytes_source"] = "param_stream"
+    return CostReport(name=name, flops=acc["flops"],
+                      hbm_bytes=int(hbm_bytes),
+                      pallas_launches=acc["launches"],
+                      collective_bytes=acc["collective_bytes"],
+                      detail=detail)
+
+
+def analyze_fn(fn, *args, name: str = "program",
+               hbm_bytes: Optional[int] = None,
+               detail: Optional[Dict[str, Any]] = None) -> CostReport:
+    """Trace ``fn(*args)`` (one extra host-side trace, no compile) and
+    cost-walk the result."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*args)
+    report = analyze_jaxpr(closed, name=name, hbm_bytes=hbm_bytes)
+    if detail:
+        report.detail.update(detail)
+    return report
+
+
+# -------------------------------------------------- weight-stream floors
+def param_stream_bytes(params, *, batch: int = 1,
+                       top_k: Optional[int] = None,
+                       num_experts: Optional[int] = None
+                       ) -> Dict[str, int]:
+    """The decode-regime weight-stream byte model, library-ized from
+    serve_bench / decode_profile (``split_quantized_bytes`` is the one
+    shared walk, so the scripts and this model can never drift):
+
+    - ``dense_int8_bytes`` / ``expert_int8_bytes`` — stored int8 form
+      (q + fp32 scales) split at the stacked-expert rank;
+    - ``plain_bytes`` — unquantized floating leaves at their dtype
+      width (the bf16/f32 weight stream);
+    - ``weights_floor_int8`` — every stored byte once per step (the
+      dense-model int8 byte-stream floor);
+    - ``weights_floor_moe`` — dense bytes + only ``min(batch·top_k,
+      E)`` DISTINCT experts' bytes (the slot-kernel schedule fetches
+      each distinct routed expert exactly once per step).  Present only
+      when ``num_experts``/``top_k`` describe a routed model.
+    """
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.model import QuantizedTensor
+    from deepspeed_tpu.models.serving import split_quantized_bytes
+
+    dense_b, expert_b = split_quantized_bytes(params)
+    plain = 0
+    is_q = lambda x: isinstance(x, QuantizedTensor)
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_q):
+        if is_q(leaf):
+            continue
+        try:
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                plain += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        except (TypeError, AttributeError):
+            continue            # non-array leaf (config scalar, None)
+    out: Dict[str, int] = {
+        "dense_int8_bytes": dense_b,
+        "expert_int8_bytes": expert_b,
+        "plain_bytes": plain,
+        "weights_floor_int8": dense_b + expert_b,
+        "weights_floor_bytes": dense_b + expert_b + plain,
+    }
+    if num_experts and top_k and expert_b:
+        per_expert = expert_b // num_experts      # all layers, one expert
+        distinct = min(max(batch, 1) * top_k, num_experts)
+        out["distinct_experts"] = distinct
+        out["per_expert_bytes"] = per_expert
+        out["weights_floor_moe"] = dense_b + distinct * per_expert
+        out["weights_floor_bytes"] = (dense_b + distinct * per_expert
+                                      + plain)
+    return out
+
+
+def abstract_quantized_blocks(model, block: int = 256):
+    """Shape-only int8 packing of a model's stacked transformer blocks:
+    ``jax.eval_shape`` of ``init_fn`` (no parameter materialization —
+    7B floors cost nothing), then the serving ``_pack`` rule (floating
+    leaves of ndim >= 3 quantize) mapped to abstract
+    ``QuantizedTensor`` leaves with the ``block_quantize_int8`` layout
+    (scales ``[..., ceil(C/block)]`` fp32).  Feed the result to
+    :func:`param_stream_bytes` for bench-shape floors."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.model import QuantizedTensor
+
+    shapes = jax.eval_shape(model.init_fn, jax.random.PRNGKey(0))
+    blocks = shapes["blocks"] if isinstance(shapes, dict) and \
+        "blocks" in shapes else shapes
+
+    def pack(leaf):
+        if leaf.ndim >= 3 and jnp.issubdtype(leaf.dtype, jnp.floating):
+            c = int(leaf.shape[-1])
+            s_shape = tuple(leaf.shape[:-1]) + (math.ceil(c / block),)
+            return QuantizedTensor(
+                jax.ShapeDtypeStruct(leaf.shape, jnp.int8),
+                jax.ShapeDtypeStruct(s_shape, jnp.float32), "bfloat16")
+        return leaf
+
+    return jax.tree.map(pack, blocks)
+
+
+# ------------------------------------------------- process-wide registry
+_LOCK = threading.Lock()                 # writers only; readers are lock-free
+_REPORTS: Dict[str, CostReport] = {}
+#: program -> (last_ms, count, total_ms) — written by the roofline
+#: observer, read (dict snapshot) by /debug/perf with no lock
+_ACHIEVED: Dict[str, tuple] = {}
+
+
+def register_report(report: CostReport):
+    with _LOCK:
+        _REPORTS[report.name] = report
+
+
+def get_reports() -> Dict[str, CostReport]:
+    """Snapshot of the registered program cost table (lock-free read:
+    one dict copy under the GIL)."""
+    return dict(_REPORTS)
+
+
+def get_report(name: str) -> Optional[CostReport]:
+    return _REPORTS.get(name)
+
+
+def record_achieved(name: str, duration_s: float):
+    """One measured execution.  The FIRST sample of a program carries
+    jit compile + the analysis trace, so it is kept as ``last_ms`` (it
+    self-heals on the next execution) but excluded from the running
+    total — ``achieved_mean_ms`` reports warm steps only.  Writes take
+    the module lock (concurrent fleet replicas share these keys);
+    readers still only snapshot."""
+    ms = float(duration_s) * 1e3
+    with _LOCK:
+        prev = _ACHIEVED.get(name)
+        if prev is None:
+            _ACHIEVED[name] = (ms, 1, 0.0)      # warmup sample: last only
+        else:
+            _ACHIEVED[name] = (ms, prev[1] + 1, prev[2] + ms)
+
+
+def get_achieved() -> Dict[str, tuple]:
+    return dict(_ACHIEVED)
+
+
+def reset_reports():
+    """Tests: clear the process-wide cost table."""
+    with _LOCK:
+        _REPORTS.clear()
+        _ACHIEVED.clear()

@@ -11,6 +11,11 @@ The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
   cooperation from the stuck thread).
 - ``flightrec_payload()`` — the ``/debug/flightrec`` JSON body with
   ``?n=``/``?corr=``/``?kind=`` filtering.
+- ``perf_payload()`` — the ``/debug/perf`` JSON body (ISSUE 13): the
+  registered per-program cost table with roofline floors and live
+  achieved-vs-floor.  Reads only dict snapshots from the cost-model
+  store — never a scheduler lock — so it answers while a step is
+  wedged (the same contract the chaos acceptance test enforces).
 - ``parse_debug_query()`` — tiny query-string parsing shared by both
   HTTP front doors.
 
@@ -72,3 +77,17 @@ def flightrec_payload(recorder, query: Optional[Dict[str, str]] = None
         "returned": len(events),
         "events": events,
     }
+
+
+def perf_payload(query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """The ``/debug/perf`` body: device rates + the per-program cost
+    table (static cost, roofline floor, bound classification, live
+    achieved-vs-floor).  ``?program=<substring>`` filters rows."""
+    from deepspeed_tpu.telemetry.roofline import perf_table
+    payload = perf_table()
+    want = (query or {}).get("program")
+    if want:
+        payload["programs"] = {k: v for k, v
+                               in payload["programs"].items()
+                               if want in k}
+    return payload

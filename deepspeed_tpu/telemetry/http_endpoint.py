@@ -16,6 +16,9 @@ Routes:
                        while the training loop is wedged)
   ``/debug/flightrec`` flight-recorder snapshot (``?n=``, ``?corr=``,
                        ``?kind=`` filters)
+  ``/debug/perf``      per-program cost table + roofline floors +
+                       live achieved-vs-floor (``?program=`` filter;
+                       lock-free, ISSUE 13)
 """
 import json
 import threading
@@ -47,7 +50,7 @@ class MetricsServer:
             def do_GET(self):
                 from deepspeed_tpu.telemetry.debug import (
                     flightrec_payload, format_thread_stacks,
-                    parse_debug_query)
+                    parse_debug_query, perf_payload)
                 from deepspeed_tpu.telemetry.flight_recorder import \
                     get_flight_recorder
                 route, query = parse_debug_query(self.path)
@@ -63,6 +66,9 @@ class MetricsServer:
                 elif route == "/debug/flightrec":
                     body = json.dumps(flightrec_payload(
                         get_flight_recorder(), query)).encode()
+                    code, ctype = 200, "application/json"
+                elif route == "/debug/perf":
+                    body = json.dumps(perf_payload(query)).encode()
                     code, ctype = 200, "application/json"
                 else:
                     body = f"no route {route}\n".encode()
@@ -80,7 +86,8 @@ class MetricsServer:
         self._thread.start()
         logger.info(f"telemetry: metrics endpoint on "
                     f"http://{self.host}:{self.port}/metrics "
-                    f"(+ /healthz, /debug/stacks, /debug/flightrec)")
+                    f"(+ /healthz, /debug/stacks, /debug/flightrec, "
+                    f"/debug/perf)")
         return self
 
     def stop(self):

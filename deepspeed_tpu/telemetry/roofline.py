@@ -1,0 +1,202 @@
+"""Roofline attribution over the cost model (ISSUE 13 tentpole).
+
+``mfu.py`` answers "what fraction of peak FLOPs did we achieve";
+this module answers the decode-regime question PERF.md has been
+answering by hand: **what is the hardware floor for this program, and
+how far above it are we running**.  A per-device HBM-bandwidth table
+(same shape as ``PEAK_FLOPS_BY_KIND``) prices a program's
+:class:`~deepspeed_tpu.telemetry.costmodel.CostReport` into
+
+- ``floor_ms`` — ``max(flops/peak, hbm_bytes/bandwidth)`` per
+  execution, the roofline lower bound;
+- a compute-bound vs bandwidth-bound classification (which term won);
+- ``achieved_vs_floor`` — measured wall clock over the floor, the
+  "4-5x-over-floor" gap as a live gauge instead of a PERF.md table.
+
+On CPU neither table resolves and every floor-dependent output is None
+— **no fictitious floors**.  ``DS_HBM_GBPS`` overrides per device
+(it is also how CPU tier-1 tests exercise the floor math).  Gauges
+land in the shared metrics registry under ``perf/*`` labeled by
+program, on both /metrics surfaces.
+"""
+import os
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry import costmodel as _cm
+from deepspeed_tpu.telemetry.mfu import peak_flops_per_device
+
+HBM_GBPS_ENV = "DS_HBM_GBPS"
+
+#: HBM bandwidth per chip (GB/s) by device-kind substring (lowercase).
+#: Sources: published TPU system specs (per-chip).
+HBM_GBPS_BY_KIND = {
+    "v5p": 2765.0,
+    "v5e": 819.0,
+    "v5litepod": 819.0,
+    "v4": 1228.0,
+    "v3": 900.0,
+    "v2": 700.0,
+}
+
+
+def hbm_bytes_per_s(device=None, env: Optional[dict] = None
+                    ) -> Optional[float]:
+    """HBM bandwidth for one device in bytes/s: ``DS_HBM_GBPS`` env
+    wins, then the device-kind table; None when unknown (CPU, exotic
+    parts) — callers must skip floor math rather than report against a
+    made-up bandwidth."""
+    env = os.environ if env is None else env
+    override = env.get(HBM_GBPS_ENV, "").strip()
+    if override:
+        return float(override) * 1e9
+    if device is None:
+        import jax
+        device = jax.local_devices()[0]
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for sub, gbps in HBM_GBPS_BY_KIND.items():
+        if sub in kind:
+            return gbps * 1e9
+    return None
+
+
+def floor_seconds(report, peak_flops: Optional[float] = None,
+                  hbm_bps: Optional[float] = None) -> Optional[float]:
+    """Roofline lower bound for one execution: the slower of the
+    compute term and the bandwidth term, over the terms whose hardware
+    rate is known.  None when neither rate resolves."""
+    terms = []
+    if peak_flops and peak_flops > 0 and report.flops > 0:
+        terms.append(report.flops / peak_flops)
+    if hbm_bps and hbm_bps > 0 and report.hbm_bytes > 0:
+        terms.append(report.hbm_bytes / hbm_bps)
+    if not terms:
+        return None
+    return max(terms)
+
+
+def classify(report, peak_flops: Optional[float] = None,
+             hbm_bps: Optional[float] = None) -> Optional[str]:
+    """"compute_bound" / "bandwidth_bound" by which roofline term
+    dominates; None when the comparison needs a rate we don't have."""
+    if not (peak_flops and hbm_bps and report.flops > 0
+            and report.hbm_bytes > 0):
+        return None
+    compute_s = report.flops / peak_flops
+    memory_s = report.hbm_bytes / hbm_bps
+    return "compute_bound" if compute_s >= memory_s else "bandwidth_bound"
+
+
+#: (DS_HBM_GBPS, DS_PEAK_FLOPS) env values -> resolved rates; the
+#: device kind is constant per process, so rates only change when the
+#: env overrides do — observe_achieved runs per decode step and must
+#: not pay jax.local_devices + table walks every time
+_RATES_CACHE: Dict[tuple, Dict[str, Optional[float]]] = {}
+
+
+def device_rates(env: Optional[dict] = None) -> Dict[str, Optional[float]]:
+    """(peak_flops, hbm_bps) for the first local device, None-safe on
+    any backend (one place resolves both tables + envs).  Cached per
+    (env-override) pair; pass an explicit ``env`` dict to bypass the
+    cache (tests)."""
+    from deepspeed_tpu.telemetry.mfu import PEAK_FLOPS_ENV
+    cache_key = None
+    if env is None:
+        cache_key = (os.environ.get(HBM_GBPS_ENV, ""),
+                     os.environ.get(PEAK_FLOPS_ENV, ""))
+        hit = _RATES_CACHE.get(cache_key)
+        if hit is not None:
+            return hit
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+    except Exception:
+        dev = None
+    try:
+        peak = peak_flops_per_device(dev, env=env) if dev is not None \
+            else None
+    except Exception:
+        peak = None
+    try:
+        bw = hbm_bytes_per_s(dev, env=env) if dev is not None else None
+    except Exception:
+        bw = None
+    rates = {"peak_flops": peak, "hbm_bytes_per_s": bw,
+             "device_kind": str(getattr(dev, "device_kind", "unknown"))}
+    if cache_key is not None:
+        _RATES_CACHE[cache_key] = rates
+    return rates
+
+
+def publish_report(registry, report):
+    """Static cost gauges for one program family, labeled by program —
+    rendered identically by ds_serve /metrics and the training
+    endpoint.  Floor gauges appear only when a hardware rate resolves
+    (no fictitious floors on CPU)."""
+    _cm.register_report(report)
+    name = report.name
+    registry.set_gauge("perf/flops", float(report.flops), program=name)
+    registry.set_gauge("perf/hbm_bytes", float(report.hbm_bytes),
+                       program=name)
+    registry.set_gauge("perf/pallas_launches",
+                       float(report.pallas_launches), program=name)
+    registry.set_gauge("perf/collective_bytes",
+                       float(report.collective_bytes), program=name)
+    rates = device_rates()
+    floor = floor_seconds(report, rates["peak_flops"],
+                          rates["hbm_bytes_per_s"])
+    if floor is not None:
+        registry.set_gauge("perf/floor_ms", floor * 1e3, program=name)
+
+
+def observe_achieved(registry, name: str, duration_s: float):
+    """One measured execution of a registered program: updates the
+    lock-free achieved table and the ``perf/achieved_ms`` gauge, and —
+    when the program's floor resolves — the ``perf/achieved_vs_floor``
+    ratio (the live "N-x-over-floor" gap)."""
+    _cm.record_achieved(name, duration_s)
+    registry.set_gauge("perf/achieved_ms", duration_s * 1e3, program=name)
+    report = _cm.get_report(name)
+    if report is None:
+        return
+    rates = device_rates()
+    floor = floor_seconds(report, rates["peak_flops"],
+                          rates["hbm_bytes_per_s"])
+    if floor and floor > 0:
+        registry.set_gauge("perf/achieved_vs_floor",
+                           duration_s / floor, program=name)
+
+
+def perf_table(env: Optional[dict] = None) -> Dict[str, Any]:
+    """The ``/debug/perf`` body and the post-mortem ``perf.json``
+    payload: device rates + one row per registered program (static
+    cost, floor, classification, live achieved stats).  Lock-free with
+    respect to every subsystem it reports on — safe to hit while a
+    step is wedged."""
+    rates = device_rates(env=env)
+    peak, bw = rates["peak_flops"], rates["hbm_bytes_per_s"]
+    achieved = _cm.get_achieved()
+    programs = {}
+    for name, report in sorted(_cm.get_reports().items()):
+        row = report.to_dict()
+        floor = floor_seconds(report, peak, bw)
+        row["floor_ms"] = None if floor is None else round(floor * 1e3, 6)
+        row["bound"] = classify(report, peak, bw)
+        a = achieved.get(name)
+        if a is not None:
+            last_ms, count, total_ms = a
+            row["achieved_ms"] = round(last_ms, 6)
+            row["achieved_count"] = count
+            # the first sample (compile + analysis trace) is excluded
+            # from the total — the mean is over warm executions
+            row["achieved_mean_ms"] = round(
+                total_ms / (count - 1) if count > 1 else last_ms, 6)
+            if floor and floor > 0:
+                row["achieved_vs_floor"] = round(
+                    (last_ms / 1e3) / floor, 4)
+        programs[name] = row
+    return {
+        "device_kind": rates["device_kind"],
+        "peak_flops": peak,
+        "hbm_gbps": None if bw is None else bw / 1e9,
+        "programs": programs,
+    }

@@ -15,6 +15,10 @@ reference tables.
 ENV_VARS = {
     "DS_ACCELERATOR": "force the accelerator backend (tpu/cpu) instead "
                       "of auto-detection",
+    "DS_BENCH_DIR": "bench-ledger directory override (default BENCH/; "
+                    "scripts/bench_util.py)",
+    "DS_BENCH_LEDGER": "1 appends BenchRecords from the bench scripts "
+                       "to the BENCH/ ledger history",
     "DS_FAULTS": "fault-injection spec string (site:action[=param]@when;"
                  " appended to resilience.faults)",
     "DS_FLASH_KERNEL": "attention dispatch override: pallas flash kernel"
@@ -33,10 +37,15 @@ ENV_VARS = {
                                "megakernel dispatch fits under",
     "DS_GGEMM_INTERPRET": "run the grouped-GEMM Pallas kernels in "
                           "interpret mode (CPU tier-1)",
+    "DS_HBM_GBPS": "per-device HBM bandwidth (GB/s) for roofline floors "
+                   "(wins over the device-kind table; how CPU tier-1 "
+                   "exercises floor math)",
     "DS_MOE_DISPATCH": "MoE expert-dispatch override: auto/einsum/"
                        "grouped (wins over config)",
     "DS_PEAK_FLOPS": "per-device peak FLOPs for MFU math (wins over "
                      "telemetry.peak_flops)",
+    "DS_PERF_COSTMODEL": "0/1 disables/forces compiled-program cost "
+                         "analysis (wins over telemetry.costmodel)",
     "DS_QGEMM": "0 disables the fused-dequant int8 qgemm kernel "
                 "(per-layer dequant fallback)",
     "DS_QGEMM_BLOCKS": "qgemm (bm,bk,bn) block-shape override "
@@ -80,6 +89,21 @@ METRICS = {
     # --- anomaly / postmortem
     "anomaly/last_score": "most recent MAD score per step kind",
     "postmortem/bundles": "post-mortem bundles written",
+    # --- perf observatory (cost model + roofline, ISSUE 13)
+    "perf/flops": "cost-model dot FLOPs per program execution, labeled "
+                  "by program",
+    "perf/hbm_bytes": "cost-model weight-stream HBM bytes per "
+                      "execution, labeled by program",
+    "perf/pallas_launches": "kernel-launch sites in the compiled "
+                            "program, labeled by program",
+    "perf/collective_bytes": "collective payload bytes per execution, "
+                             "labeled by program",
+    "perf/floor_ms": "roofline floor per execution (ms; only where a "
+                     "device rate resolves), labeled by program",
+    "perf/achieved_ms": "latest measured program execution wall clock "
+                        "(ms), labeled by program",
+    "perf/achieved_vs_floor": "achieved/floor ratio (the live "
+                              "N-x-over-floor gap), labeled by program",
     # --- MoE routing health
     "moe/dispatch_tokens": "tokens routed into expert dispatch",
     "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "

@@ -1,34 +1,53 @@
 #!/usr/bin/env python3
 """Diff two structured bench outputs and flag regressions (ISSUE 7
-satellite).
+satellite; ISSUE 13 grows the gated-ledger mode).
 
 Inputs are the machine-readable records the benches emit — a
 ``serve_bench --json`` file, a BENCH_*.json record, a JSONL stream of
-records, a list of records, or a flat ``{name: value}`` dict.  Each
-record's ``value`` plus every numeric ``detail`` field becomes a
-comparable metric named ``<metric>`` / ``<metric>.<detail_key>``.
+records (the ``BENCH/ledger.jsonl`` history), a list of records, or a
+flat ``{name: value}`` dict.  Each record's ``value`` plus every
+numeric ``detail`` field becomes a comparable metric named
+``<metric>`` / ``<metric>.<detail_key>``.
 
 A metric regresses when it moves more than ``--threshold`` (default
-10%) in its BAD direction.  Direction is inferred from the name —
-latencies/durations/counts-of-waste (``*_ms``, ``*_s``, ``latency``,
-``wait``, ``prefill_tokens``, ``rolled_back``, ``evictions``,
-``misses``) are lower-better; rates/throughputs are higher-better —
-and can be forced per-name with ``--lower-better``/``--higher-better``.
+10%) in its BAD direction.  Direction resolution order: explicit
+``--lower-better``/``--higher-better`` > the record's own
+``direction`` field (the BenchRecord schema) > name inference
+(latencies/durations/counts-of-waste are lower-better; rates and
+throughputs higher-better).
+
+**Metadata guard (ISSUE 13):** records carrying a BenchRecord ``meta``
+envelope are refused when the two sides were measured on different
+device kinds, and per-metric when both sides declare different model
+shapes (``detail.model``) — a CPU-smoke record silently gating an
+on-chip one is exactly the failure this exists to stop.  Exit 2 with
+a diagnostic naming both sides.
+
+**History mode (ISSUE 13):** ``--history BENCH/ledger.jsonl current``
+gates ``current`` against a ROLLING baseline — per metric, the median
+of the last ``--window`` ledger values measured on the same device
+kind (and model shape, when declared).  Ledger entries from other
+device kinds are excluded; if the ledger holds records for this metric
+but none match the current device, that's the cross-device refusal
+(exit 2), not a silent pass.
 
 Usage::
 
     python scripts/bench_compare.py baseline.json current.json
     python scripts/bench_compare.py old.json new.json --threshold 0.05
     python scripts/bench_compare.py a.json b.json --metrics ttft,tok_s
+    python scripts/bench_compare.py --history BENCH/ledger.jsonl new.json
 
 Exit 0 = no regression; 1 = at least one flagged regression; 2 = bad
-input.  Improvements and within-threshold drift are reported but never
-fail the run.
+input or refused comparison (cross-device / cross-model / schema
+mismatch).  Improvements and within-threshold drift are reported but
+never fail the run.
 """
 import argparse
 import json
+import statistics
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 #: name fragments implying "smaller is better" (substring match)
 LOWER_BETTER_HINTS = ("latency", "wait", "duration", "prefill_tokens",
@@ -64,8 +83,8 @@ def _records(doc) -> List[dict]:
     return []
 
 
-def load_metrics(path: str) -> Dict[str, float]:
-    """Flatten a bench file into {metric_name: numeric_value}."""
+def load_records(path: str) -> List[dict]:
+    """Every record in a bench file (JSON, JSONL, list, or flat map)."""
     with open(path) as f:
         text = f.read()
     try:
@@ -77,33 +96,154 @@ def load_metrics(path: str) -> Dict[str, float]:
             line = line.strip()
             if line:
                 docs.append(json.loads(line))
-    out: Dict[str, float] = {}
+    out: List[dict] = []
     for doc in docs:
-        for rec in _records(doc):
-            name = str(rec.get("metric", "metric"))
-            val = rec.get("value")
-            if isinstance(val, (int, float)) and not isinstance(val, bool):
-                out[name] = float(val)
-            for k, v in (rec.get("detail") or {}).items():
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    out[f"{name}.{k}"] = float(v)
+        out.extend(_records(doc))
     return out
+
+
+def _flatten_one(rec: dict, out: Dict[str, float]):
+    name = str(rec.get("metric", "metric"))
+    val = rec.get("value")
+    if isinstance(val, (int, float)) and not isinstance(val, bool):
+        out[name] = float(val)
+    for k, v in (rec.get("detail") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[f"{name}.{k}"] = float(v)
+
+
+def flatten_records(records: List[dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for rec in records:
+        _flatten_one(rec, out)
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Flatten a bench file into {metric_name: numeric_value}."""
+    return flatten_records(load_records(path))
+
+
+# ------------------------------------------------------- metadata guard
+def records_meta(records: List[dict]) -> Optional[dict]:
+    """The file's BenchRecord envelope (last record wins — one run, one
+    environment); None for pre-schema files."""
+    meta = None
+    for rec in records:
+        m = rec.get("meta")
+        if isinstance(m, dict):
+            meta = m
+    return meta
+
+
+def records_directions(records: List[dict]) -> Dict[str, str]:
+    return {str(r["metric"]): r["direction"] for r in records
+            if isinstance(r.get("direction"), str) and "metric" in r}
+
+
+def records_models(records: List[dict]) -> Dict[str, str]:
+    """Per-metric declared model shape (``detail.model``) — the
+    cross-model comparison guard key."""
+    out = {}
+    for r in records:
+        model = (r.get("detail") or {}).get("model")
+        if model is not None and "metric" in r:
+            out[str(r["metric"])] = str(model)
+    return out
+
+
+def meta_conflict(a: Optional[dict], b: Optional[dict]) -> Optional[str]:
+    """Why these two record sets must not be diffed (None = fine).
+    Only guards what both sides declare — pre-schema records keep
+    working."""
+    if not a or not b:
+        return None
+    sa, sb = str(a.get("schema", "")), str(b.get("schema", ""))
+    if sa and sb and sa != sb:
+        return f"schema mismatch: {sa} vs {sb}"
+    ka, kb = a.get("device_kind"), b.get("device_kind")
+    if ka and kb and ka != kb:
+        return (f"cross-device diff refused: baseline measured on "
+                f"{ka!r} ({a.get('device_count')} dev), current on "
+                f"{kb!r} ({b.get('device_count')} dev) — bench floors "
+                f"and rates are not comparable across device kinds")
+    return None
+
+
+def model_conflicts(models_a: Dict[str, str], models_b: Dict[str, str]
+                    ) -> List[str]:
+    out = []
+    for name in sorted(set(models_a) & set(models_b)):
+        if models_a[name] != models_b[name]:
+            out.append(f"metric {name!r}: baseline model "
+                       f"{models_a[name]!r} vs current {models_b[name]!r}")
+    return out
+
+
+# ------------------------------------------------------------- history
+def rolling_baseline(history: List[dict], current_meta: Optional[dict],
+                     current_models: Dict[str, str], window: int = 5
+                     ) -> Tuple[Dict[str, float], List[str]]:
+    """Per-metric rolling baseline from the ledger: the median of the
+    last ``window`` values measured on the current device kind (and,
+    when both declare one, the current model shape).  Returns (baseline
+    metrics, refusal diagnostics for metrics whose ledger entries exist
+    ONLY on other device kinds)."""
+    kind = (current_meta or {}).get("device_kind")
+    series: Dict[str, List[float]] = {}
+    skipped_kinds: Dict[str, set] = {}
+    for rec in history:
+        meta = rec.get("meta") or {}
+        rkind = meta.get("device_kind")
+        name = str(rec.get("metric", "metric"))
+        if kind and rkind and rkind != kind:
+            skipped_kinds.setdefault(name, set()).add(rkind)
+            continue
+        model = (rec.get("detail") or {}).get("model")
+        want = current_models.get(name)
+        if model is not None and want is not None \
+                and str(model) != str(want):
+            continue
+        flat: Dict[str, float] = {}
+        _flatten_one(rec, flat)
+        for n, v in flat.items():
+            series.setdefault(n, []).append(v)
+    baseline = {n: statistics.median(vals[-window:])
+                for n, vals in series.items() if vals}
+    refusals = [f"metric {n!r}: ledger holds records only for device "
+                f"kind(s) {sorted(ks)} (current: {kind!r})"
+                for n, ks in sorted(skipped_kinds.items())
+                if n not in series]
+    return baseline, refusals
 
 
 def compare(old: Dict[str, float], new: Dict[str, float],
             threshold: float = 0.10, metrics=None,
-            force_lower=(), force_higher=()) -> List[dict]:
+            force_lower=(), force_higher=(),
+            directions: Optional[Dict[str, str]] = None) -> List[dict]:
     """Rows for every metric present in BOTH files; ``regressed`` set
-    when the bad-direction relative change exceeds the threshold."""
+    when the bad-direction relative change exceeds the threshold.
+    ``directions`` maps a base metric name to its declared direction
+    (BenchRecord field) — consulted after the force lists, before name
+    inference (detail metrics inherit their record's direction)."""
     rows = []
+    directions = directions or {}
     for name in sorted(set(old) & set(new)):
         if metrics and not any(m in name for m in metrics):
             continue
         a, b = old[name], new[name]
+        declared = directions.get(name) or directions.get(
+            name.split(".", 1)[0])
         if any(m in name for m in force_lower):
             lower = True
         elif any(m in name for m in force_higher):
             lower = False
+        elif declared in ("lower_better", "higher_better") \
+                and "." not in name:
+            # only the record's own value inherits the declared
+            # direction; detail fields keep name inference (one record
+            # mixes tok/s with ttft_ms details)
+            lower = declared == "lower_better"
         else:
             lower = lower_is_better(name)
         if a == 0:
@@ -128,10 +268,20 @@ def compare(old: Dict[str, float], new: Dict[str, float],
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="bench_compare",
-        description="diff two bench JSON outputs, flag >threshold "
+        description="diff two bench JSON outputs (or gate one against "
+                    "the BENCH/ ledger history), flag >threshold "
                     "regressions on named metrics")
-    p.add_argument("baseline")
-    p.add_argument("current")
+    p.add_argument("baseline", nargs="?", default=None,
+                   help="baseline file (omit with --history)")
+    p.add_argument("current", nargs="?", default=None)
+    p.add_argument("--history", default=None, metavar="LEDGER",
+                   help="BENCH ledger JSONL: gate the single input file "
+                        "against the rolling per-metric baseline "
+                        "(median of the last --window same-device "
+                        "records)")
+    p.add_argument("--window", type=int, default=5,
+                   help="history mode: rolling-baseline window "
+                        "(default 5 records)")
     p.add_argument("--threshold", type=float, default=0.10,
                    help="bad-direction relative change that counts as a "
                         "regression (default 0.10 = 10%%)")
@@ -145,9 +295,59 @@ def main(argv=None) -> int:
     p.add_argument("-q", "--quiet", action="store_true",
                    help="print only regressions")
     args = p.parse_args(argv)
+    if args.history:
+        cur_path = args.current or args.baseline
+        if cur_path is None or (args.current and args.baseline):
+            print("bench_compare: --history takes exactly one input "
+                  "file", file=sys.stderr)
+            return 2
+    elif args.baseline is None or args.current is None:
+        print("bench_compare: need baseline and current files (or "
+              "--history LEDGER current)", file=sys.stderr)
+        return 2
+    else:
+        cur_path = args.current
     try:
-        old = load_metrics(args.baseline)
-        new = load_metrics(args.current)
+        cur_records = load_records(cur_path)
+        new = flatten_records(cur_records)
+        cur_meta = records_meta(cur_records)
+        cur_models = records_models(cur_records)
+        directions = records_directions(cur_records)
+        if args.history:
+            hist_records = load_records(args.history)
+            old, refusals = rolling_baseline(
+                hist_records, cur_meta, cur_models, window=args.window)
+            if refusals:
+                print("bench_compare: refused (cross-device history):",
+                      file=sys.stderr)
+                for r in refusals:
+                    print(f"  {r}", file=sys.stderr)
+                return 2
+            directions = {**records_directions(hist_records),
+                          **directions}
+            # the rolling baseline is already filtered to the current
+            # device kind AND model shape — running the cross-model
+            # guard over the raw ledger would spuriously refuse any
+            # ledger that legitimately holds several model shapes
+            base_models = {}
+        else:
+            base_records = load_records(args.baseline)
+            old = flatten_records(base_records)
+            conflict = meta_conflict(records_meta(base_records), cur_meta)
+            if conflict:
+                print(f"bench_compare: refused: {conflict}",
+                      file=sys.stderr)
+                return 2
+            directions = {**records_directions(base_records),
+                          **directions}
+            base_models = records_models(base_records)
+        shape_conflicts = model_conflicts(base_models, cur_models)
+        if shape_conflicts:
+            print("bench_compare: refused (model-shape mismatch):",
+                  file=sys.stderr)
+            for c in shape_conflicts:
+                print(f"  {c}", file=sys.stderr)
+            return 2
     except (OSError, json.JSONDecodeError, ValueError) as e:
         print(f"bench_compare: cannot load inputs: {e}", file=sys.stderr)
         return 2
@@ -159,7 +359,8 @@ def main(argv=None) -> int:
                    force_lower=[m for m in args.lower_better.split(",")
                                 if m],
                    force_higher=[m for m in args.higher_better.split(",")
-                                 if m])
+                                 if m],
+                   directions=directions)
     if not rows:
         print("bench_compare: no common metrics to compare",
               file=sys.stderr)
@@ -175,7 +376,9 @@ def main(argv=None) -> int:
         print(f"{r['metric']:<{width}}  {r['old']:>12.4g} -> "
               f"{r['new']:>12.4g}  {r['change_pct']:>+8.2f}%  "
               f"[{arrow}]  {flag}")
-    print(f"\n{len(rows)} metrics compared, {len(regressions)} "
+    mode = (f"rolling baseline over {args.history}" if args.history
+            else "pairwise")
+    print(f"\n{len(rows)} metrics compared ({mode}), {len(regressions)} "
           f"regression(s) past {args.threshold:.0%}")
     return 1 if regressions else 0
 

@@ -11,7 +11,17 @@ two on-device chained step counts, which cancels every fixed cost.
 
 Every sweep/profile script imports these instead of growing its own
 copy (decode_profile, serve_bench, qgemm_sweep, ggemm_sweep; the
-original lives in scripts/flash_ab.py)."""
+original lives in scripts/flash_ab.py).
+
+ISSUE 13 adds the **bench ledger**: a versioned BenchRecord schema
+(git rev, device kind/count, per-metric direction) and an append-only
+``BENCH/ledger.jsonl`` history every bench script can emit into
+(``DS_BENCH_LEDGER=1``; ``DS_BENCH_DIR`` overrides the directory).
+``bench_compare --history`` gates regressions against the rolling
+baseline and refuses cross-device/cross-model diffs."""
+import json
+import os
+import subprocess
 import time
 
 import numpy as np
@@ -19,6 +29,94 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+#: BenchRecord schema version — bump on incompatible field changes;
+#: bench_compare refuses to mix major versions
+BENCH_SCHEMA = "ds-bench/1"
+LEDGER_ENV = "DS_BENCH_LEDGER"
+BENCH_DIR_ENV = "DS_BENCH_DIR"
+
+
+def git_rev() -> str:
+    """Short git revision of the working tree ("unknown" outside a
+    checkout — records stay comparable either way)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def bench_meta() -> dict:
+    """The BenchRecord envelope: where/when/what-hardware this record
+    was measured on.  ``device_kind`` is the cross-device comparison
+    guard bench_compare enforces (a CPU-smoke record must never gate an
+    on-chip one)."""
+    devs = jax.devices()
+    return {
+        "schema": BENCH_SCHEMA,
+        "git_rev": git_rev(),
+        "unix_ts": round(time.time(), 3),
+        "platform": devs[0].platform,
+        "device_kind": str(getattr(devs[0], "device_kind", "unknown")),
+        "device_count": len(devs),
+    }
+
+
+def make_record(metric: str, value, unit=None, detail=None,
+                direction=None) -> dict:
+    """A schema'd BenchRecord.  ``direction`` ("lower_better" /
+    "higher_better") makes the regression direction explicit instead of
+    name-inferred — bench_compare honors it when present."""
+    rec = {"metric": str(metric), "value": value, "meta": bench_meta()}
+    if unit is not None:
+        rec["unit"] = unit
+    if detail:
+        rec["detail"] = detail
+    if direction is not None:
+        if direction not in ("lower_better", "higher_better"):
+            raise ValueError(f"direction={direction!r}: must be "
+                             "lower_better or higher_better")
+        rec["direction"] = direction
+    return rec
+
+
+def ledger_enabled() -> bool:
+    return os.environ.get(LEDGER_ENV, "").strip() not in ("", "0")
+
+
+def ledger_path() -> str:
+    base = os.environ.get(BENCH_DIR_ENV, "").strip() or "BENCH"
+    return os.path.join(base, "ledger.jsonl")
+
+
+def append_ledger(record: dict, path=None) -> str:
+    """Append one record (JSONL) to the bench ledger; creates the
+    directory on first use.  Records without a ``meta`` envelope get
+    one (so pre-schema emitters can still ride the history)."""
+    if "meta" not in record:
+        record = dict(record, meta=bench_meta())
+    path = path or ledger_path()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    return path
+
+
+def emit_ledger(record: dict) -> dict:
+    """The one call bench scripts add beside their print: appends to
+    the ledger iff DS_BENCH_LEDGER is armed.  Returns the record."""
+    if ledger_enabled() and isinstance(record, dict) \
+            and "metric" in record:
+        append_ledger(record)
+    return record
 
 
 def fetch(x):

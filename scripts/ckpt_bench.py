@@ -1,49 +1,79 @@
-"""Async-checkpoint overlap bench: steps/s with an in-flight save vs sync save."""
+"""Async-checkpoint overlap bench: steps/s with an in-flight save vs
+sync save.
+
+``CKPT_SMOKE=1`` runs a tiny model with short loops — the CPU-smoke
+mode the tier-1 ledger round-trip test drives.  With
+``DS_BENCH_LEDGER=1`` the result lands in the BENCH/ ledger as a
+BenchRecord (ISSUE 13) so ``bench_compare --history`` can gate
+step-time regressions."""
 import json, os, shutil, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import gpt2_model
 
+SMOKE = bool(int(os.environ.get("CKPT_SMOKE", "0")))
+
 def run(async_save):
     tag_dir = f"/tmp/ckpt_bench_{'async' if async_save else 'sync'}"
     shutil.rmtree(tag_dir, ignore_errors=True)
-    model = gpt2_model("350m", max_seq_len=1024, dtype="bfloat16", remat=True)
+    if SMOKE:
+        import jax
+        model = gpt2_model("custom", vocab_size=256, num_layers=2,
+                           num_heads=4, d_model=32, max_seq_len=64)
+        # batch divisible by the data axis (the CPU harness forces 8
+        # host devices)
+        mbs, seq, warm, meas = max(2, len(jax.devices())), 32, 1, 2
+    else:
+        model = gpt2_model("350m", max_seq_len=1024, dtype="bfloat16",
+                           remat=True)
+        mbs, seq, warm, meas = 12, 1024, 3, 6
     engine, *_ = deepspeed_tpu.initialize(model=model, config={
-        "train_micro_batch_size_per_gpu": 12, "gradient_accumulation_steps": 1,
+        "train_micro_batch_size_per_gpu": mbs,
+        "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True}, "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": not SMOKE},
+        "zero_optimization": {"stage": 0 if SMOKE else 2},
         "checkpoint": {"async_save": bool(async_save)},
         "steps_per_print": 0})
     rng = np.random.default_rng(0)
     def batch():
-        return {"input_ids": rng.integers(0, 50257, size=(1, 12, 1024), dtype=np.int32)}
-    for _ in range(3):
+        return {"input_ids": rng.integers(
+            0, model.config.vocab_size,
+            size=(1, mbs, seq), dtype=np.int32)}
+    for _ in range(warm):
         loss = engine.train_batch(batch=batch())
     float(loss)
     # baseline steps/s without a save
     t0 = time.time()
-    for _ in range(6):
+    for _ in range(meas):
         loss = engine.train_batch(batch=batch())
-    float(loss); base = (time.time() - t0) / 6
+    float(loss); base = (time.time() - t0) / meas
 
     # save + train while in flight
     t0 = time.time()
     engine.save_checkpoint(tag_dir, tag="t0")
     t_save_call = time.time() - t0
     t0 = time.time()
-    for _ in range(6):
+    for _ in range(meas):
         loss = engine.train_batch(batch=batch())
     float(loss)
-    during = (time.time() - t0) / 6
+    during = (time.time() - t0) / meas
     # commit barrier (async waits here; sync already durable)
     t0 = time.time()
     engine.wait_pending_checkpoint()
     barrier = time.time() - t0
-    return {"mode": "async" if async_save else "sync",
-            "baseline_step_s": round(base, 3),
-            "save_call_s": round(t_save_call, 3),
-            "step_s_during_save": round(during, 3),
-            "commit_barrier_s": round(barrier, 3)}
+    mode = "async" if async_save else "sync"
+    detail = {"mode": mode,
+              "model": "gpt2:smoke" if SMOKE else "gpt2:350m",
+              "baseline_step_s": round(base, 3),
+              "save_call_s": round(t_save_call, 3),
+              "step_s_during_save": round(during, 3),
+              "commit_barrier_s": round(barrier, 3)}
+    from scripts.bench_util import emit_ledger
+    emit_ledger({"metric": f"ckpt_bench_{mode}",
+                 "value": round(during, 4), "unit": "s_per_step",
+                 "direction": "lower_better", "detail": detail})
+    return detail
 
 print(json.dumps(run(async_save=bool(int(os.environ.get("ASYNC", "1"))))))

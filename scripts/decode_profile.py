@@ -44,7 +44,17 @@ from jax import lax
 # shared slope-timing helper (scripts/bench_util.py): value-fetch sync —
 # the old local copy synced with block_until_ready, which does NOT
 # synchronize on the axon tunnel (PERF.md round 4)
+from scripts.bench_util import emit_ledger
 from scripts.bench_util import timed_chain_ms as timed_chain
+
+
+def _variant_record(model: str, name: str, step_ms: float) -> dict:
+    """Ledger form of one variant row (DS_BENCH_LEDGER=1, ISSUE 13):
+    step_ms is the gated value; the model shape rides detail.model so
+    bench_compare's cross-model guard engages."""
+    return {"metric": f"decode_profile_{name}", "value": step_ms,
+            "unit": "ms_per_step", "direction": "lower_better",
+            "detail": {"model": model}}
 
 
 def moe_floor_main():
@@ -143,6 +153,8 @@ def moe_floor_main():
             print(json.dumps({"variant": name, "step_ms": round(ms, 4),
                               "tok_per_s_B": (round(B / (ms * 1e-3))
                                               if ms > 0 else None)}))
+            emit_ledger(_variant_record(f"mixtral:{size}:B{B}", name,
+                                        round(ms, 4)))
         except Exception as e:
             print(json.dumps({"variant": name, "error": str(e)[:300]}))
 
@@ -530,6 +542,8 @@ def main():
             print(json.dumps({"variant": name, "step_ms": round(ms, 4),
                               "tok_per_s_B": (round(B / (ms * 1e-3))
                                               if ms > 0 else None)}))
+            emit_ledger(_variant_record(f"gpt2:{size}:B{B}:S{S}", name,
+                                        round(ms, 4)))
         except Exception as e:  # keep profiling the rest
             print(json.dumps({"variant": name,
                               "error": str(e)[:300]}))

@@ -123,9 +123,15 @@ def main():
                 if sec > 0 and (kind not in best or sec < best[kind][0]):
                     best[kind] = (sec, row)
         # winner PER KIND: float/int8 optima differ (scale expansions)
-        for kind, (_, row) in sorted(best.items()):
+        for kind, (sec_w, row) in sorted(best.items()):
             print(json.dumps({"shape": f"{D}x{H}x{hd}", "kind": kind,
                               "winner": row}))
+            from scripts.bench_util import emit_ledger
+            emit_ledger({"metric": f"fused_sweep_{kind}_{D}x{H}x{hd}",
+                         "value": row["us_per_layer"],
+                         "unit": "us_per_layer",
+                         "direction": "lower_better",
+                         "detail": {"block_s": row["block_s"]}})
 
 
 if __name__ == "__main__":

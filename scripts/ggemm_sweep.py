@@ -113,9 +113,15 @@ def main():
                 print(json.dumps(row))
                 if sec > 0 and (tag not in best or sec < best[tag][0]):
                     best[tag] = (sec, row)
-        for tag, (_, row) in sorted(best.items()):
+        for tag, (sec_w, row) in sorted(best.items()):
             print(json.dumps({"shape": f"{K}x{N}", "kind": tag,
                               "winner": row}))
+            from scripts.bench_util import emit_ledger
+            emit_ledger({"metric": f"ggemm_sweep_{tag}_{K}x{N}",
+                         "value": round(sec_w * 1e6, 2),
+                         "unit": "us_per_call",
+                         "direction": "lower_better",
+                         "detail": {"blocks": str(row["blocks"])}})
 
         # decode-regime slot kernel: one row per shape (no M sweep — the
         # row block is the padded batch; bk/bn ride the defaults)

@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Render the "where did the step go" table from a Chrome trace plus
+cost-model reports (ISSUE 13).
+
+Inputs:
+- a ``DS_TRACE`` trace file (``{"traceEvents": [...]}``) — B/E span
+  pairs are matched per (pid, tid) exactly like
+  ``scripts/trace_validate.py``;
+- optionally ``--perf perf.json`` — a ``/debug/perf`` body or a
+  post-mortem bundle's ``perf.json`` — to join each span family with
+  its program's static cost, roofline floor, and achieved-vs-floor.
+
+Output: one row per span name — count, total ms, mean ms, % of the
+trace's wall span — then, for rows whose name matches a registered
+cost-model program, the floor columns.  The table PERF.md used to
+hand-compute, from artifacts the running system already emits::
+
+    python scripts/perf_report.py trace.json
+    python scripts/perf_report.py trace.json --perf perf.json --top 15
+    python scripts/perf_report.py trace.json --json   # machine-readable
+
+Exit 0 on success, 2 on unreadable inputs.
+"""
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def load_trace(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    return [e for e in events if isinstance(e, dict)]
+
+
+def span_stats(events: List[dict]) -> Dict[str, dict]:
+    """name -> {count, total_ms, mean_ms} from matched B/E pairs per
+    (pid, tid) stack.  Unbalanced tails (a trace cut mid-span) are
+    dropped, not fatal — post-mortem traces end mid-incident by
+    design."""
+    stacks: Dict[tuple, list] = defaultdict(list)
+    acc: Dict[str, dict] = {}
+    for ev in sorted(events, key=lambda e: e.get("ts", 0)):
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks[key].append(ev)
+        elif ph == "E" and stacks[key]:
+            b = stacks[key].pop()
+            name = b.get("name", "?")
+            dur_ms = (ev.get("ts", 0) - b.get("ts", 0)) / 1e3
+            row = acc.setdefault(name, {"count": 0, "total_ms": 0.0})
+            row["count"] += 1
+            row["total_ms"] += max(dur_ms, 0.0)
+    for row in acc.values():
+        row["mean_ms"] = row["total_ms"] / max(row["count"], 1)
+    return acc
+
+
+def wall_ms(events: List[dict]) -> float:
+    ts = [e.get("ts", 0) for e in events if "ts" in e]
+    return (max(ts) - min(ts)) / 1e3 if len(ts) >= 2 else 0.0
+
+
+def join_cost(stats: Dict[str, dict], perf: Optional[dict]):
+    """Attach floor/achieved columns from a /debug/perf payload.  Span
+    names and program names share the ``serve/window``-style stems; a
+    program ``serve/window:w8`` joins the ``serve/window`` span
+    family (the span is the measured side, the program the modeled
+    side)."""
+    if not perf:
+        return
+    programs = perf.get("programs", {})
+    for name, row in stats.items():
+        exact = programs.get(name)
+        if exact is None:
+            matches = [p for pname, p in programs.items()
+                       if pname.split(":", 1)[0] == name]
+            if len(matches) > 1:
+                # several buckets of one family (serve/window:w2 + :w8
+                # after a spec+chunk run): join the LOWEST floor — the
+                # conservative bound for a span family that mixes
+                # bucket widths (weight streaming dominates, so bucket
+                # floors are near-identical anyway)
+                matches.sort(key=lambda p: (p.get("floor_ms") is None,
+                                            p.get("floor_ms") or 0))
+            exact = matches[0] if matches else None
+        if exact is None:
+            continue
+        row["floor_ms"] = exact.get("floor_ms")
+        row["bound"] = exact.get("bound")
+        row["pallas_launches"] = exact.get("pallas_launches")
+        if exact.get("floor_ms"):
+            row["mean_vs_floor"] = round(
+                row["mean_ms"] / exact["floor_ms"], 2)
+
+
+def render(stats: Dict[str, dict], wall: float, top: int) -> str:
+    rows = sorted(stats.items(), key=lambda kv: -kv[1]["total_ms"])[:top]
+    width = max([len(n) for n, _ in rows] + [4])
+    lines = [f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
+             f"{'mean ms':>9}  {'% wall':>6}  {'floor ms':>9}  "
+             f"{'x floor':>7}  bound"]
+    for name, r in rows:
+        pct = 100.0 * r["total_ms"] / wall if wall > 0 else 0.0
+        floor = r.get("floor_ms")
+        floor_cell = f"{floor:>9.4f}" if floor is not None else f"{'-':>9}"
+        ratio_cell = f"{r.get('mean_vs_floor', '-'):>7}" \
+            if floor is not None else f"{'-':>7}"
+        bound = (r.get("bound") or "-") if floor is not None else "-"
+        lines.append(
+            f"{name:<{width}}  {r['count']:>7}  {r['total_ms']:>10.3f}  "
+            f"{r['mean_ms']:>9.4f}  {pct:>5.1f}%  {floor_cell}  "
+            f"{ratio_cell}  {bound}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_report",
+        description="per-span time attribution from a DS_TRACE file, "
+                    "joined with cost-model floors when --perf is given")
+    p.add_argument("trace")
+    p.add_argument("--perf", default=None,
+                   help="/debug/perf payload or post-mortem perf.json")
+    p.add_argument("--top", type=int, default=20,
+                   help="rows to print (by total time; default 20)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the joined stats as JSON instead of a "
+                        "table")
+    args = p.parse_args(argv)
+    try:
+        events = load_trace(args.trace)
+        perf = None
+        if args.perf:
+            with open(args.perf) as f:
+                perf = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"perf_report: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    stats = span_stats(events)
+    if not stats:
+        print("perf_report: no span pairs in trace", file=sys.stderr)
+        return 2
+    wall = wall_ms(events)
+    join_cost(stats, perf)
+    if args.json:
+        print(json.dumps({"wall_ms": round(wall, 3), "spans": stats},
+                         indent=2))
+    else:
+        print(f"# trace wall: {wall:.3f} ms, "
+              f"{sum(r['count'] for r in stats.values())} spans, "
+              f"{len(stats)} families")
+        print(render(stats, wall, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

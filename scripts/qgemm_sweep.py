@@ -107,6 +107,12 @@ def main():
                 best = (sec, row)
         if best:
             print(json.dumps({"shape": f"{K}x{N}", "winner": best[1]}))
+            from scripts.bench_util import emit_ledger
+            emit_ledger({"metric": f"qgemm_sweep_{K}x{N}",
+                         "value": round(best[0] * 1e6, 2),
+                         "unit": "us_per_call",
+                         "direction": "lower_better",
+                         "detail": {"blocks": str(best[1]["blocks"])}})
 
 
 if __name__ == "__main__":

@@ -74,13 +74,17 @@ from scripts.bench_util import fetch
 
 
 def emit(result: dict, json_path=None) -> dict:
-    """Print the one-line JSON record (the existing convention) and,
-    with --json, persist it for bench_compare.py."""
+    """Print the one-line JSON record (the existing convention),
+    persist it with --json for bench_compare.py, and — when
+    DS_BENCH_LEDGER is armed — append it (BenchRecord meta envelope
+    attached) to the BENCH/ ledger history (ISSUE 13)."""
     print(json.dumps(result))
     if json_path:
         with open(json_path, "w") as f:
             json.dump(result, f, indent=2)
         print(f"# wrote {json_path}", file=sys.stderr)
+    from scripts.bench_util import emit_ledger
+    emit_ledger(result)
     return result
 
 

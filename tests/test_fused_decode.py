@@ -205,23 +205,11 @@ def test_vmem_budget_falls_back_to_reference(monkeypatch):
 
 
 # -------------------------------------------------------- launch counting
-def _count_pallas_eqns(jaxpr) -> int:
-    """Kernel-launch sites in a traced program: pallas_call equations,
-    recursively through sub-jaxprs (scan/cond/jit bodies).  Each site
-    is one device kernel launch per execution — countable on CPU, where
-    interpret-mode kernels still trace as pallas_call equations."""
-    n = 0
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "pallas_call":
-            n += 1
-        for v in eqn.params.values():
-            items = v if isinstance(v, (tuple, list)) else (v,)
-            for it in items:
-                if isinstance(it, jax.core.ClosedJaxpr):
-                    n += _count_pallas_eqns(it.jaxpr)
-                elif isinstance(it, jax.core.Jaxpr):
-                    n += _count_pallas_eqns(it)
-    return n
+# the launch-site counter graduated into the shared cost-model API
+# (ISSUE 13): the same recursion that backed this file's L-vs-4L
+# assertion now feeds perf/pallas_launches on /metrics
+from deepspeed_tpu.telemetry.costmodel import \
+    count_pallas_launches as _count_pallas_eqns  # noqa: E402
 
 
 def test_fused_step_launch_count(monkeypatch):

@@ -656,12 +656,17 @@ def test_chaos_stall_postmortem_and_debug_acceptance(tmp_path,
     drives the watchdog to DEGRADED, which writes a post-mortem bundle
     whose flight-recorder tail contains the stalled request's timeline;
     the trace validates clean WITH anomaly instants carrying step corr
-    ids; /debug/requests and /debug/scheduler answer over live HTTP
-    consistently with scheduler state."""
+    ids; /debug/requests, /debug/scheduler AND /debug/perf answer over
+    live HTTP consistently with scheduler state (the perf observatory's
+    lock-free debug contract, ISSUE 13 — DS_HBM_GBPS arms real floors
+    so perf/achieved_vs_floor is live during the incident)."""
     from deepspeed_tpu.resilience.faults import FaultInjector
     m, eng = served
     trace_path = str(tmp_path / "chaos_trace.json")
     monkeypatch.setenv("DS_TRACE", trace_path)
+    monkeypatch.setenv("DS_HBM_GBPS", "819")
+    from deepspeed_tpu.telemetry.costmodel import reset_reports
+    reset_reports()
     reset_tracer()
     tracer = configure_tracer()
     fr = FlightRecorder(capacity=4096)
@@ -716,6 +721,24 @@ def test_chaos_stall_postmortem_and_debug_acceptance(tmp_path,
         with urllib.request.urlopen(base + "/debug/stacks",
                                     timeout=10) as r:
             assert "ds-serve-loop" in r.read().decode()
+        # /debug/perf answers while the step is wedged (lock-free
+        # contract): the cost table + live achieved-vs-floor are there
+        with urllib.request.urlopen(base + "/debug/perf",
+                                    timeout=10) as r:
+            dbg_perf = json.loads(r.read())
+        assert dbg_perf["hbm_gbps"] == 819.0
+        perf_programs = dbg_perf["programs"]
+        assert any(n.startswith("serve/") for n in perf_programs)
+        decode_like = [row for n, row in perf_programs.items()
+                       if n.startswith(("serve/decode", "serve/window"))
+                       and "achieved_vs_floor" in row]
+        assert decode_like, perf_programs
+        assert all(row["floor_ms"] > 0 for row in decode_like)
+        # and the achieved-vs-floor gauge is on the /metrics exposition
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "perf_achieved_vs_floor{" in prom
+        assert "perf_floor_ms{" in prom
         # consistency with live scheduler state (racy by design; the
         # structural facts below are stable)
         assert dbg_sched["block_pool"]["num_blocks"] == cfg.num_blocks
@@ -742,6 +765,12 @@ def test_chaos_stall_postmortem_and_debug_acceptance(tmp_path,
     assert "degraded" in man["reason"] and "stalled" in man["reason"]
     assert man["files"]["flightrec.jsonl"] is True
     assert man["files"]["scheduler.json"] is True
+    # the bundle carries the perf snapshot (ISSUE 13): a DEGRADED
+    # bundle shows whether the wedge was perf collapse
+    assert man["files"]["perf.json"] is True
+    bundle_perf = json.load(open(
+        os.path.join(pm_dir, bundles[0], "perf.json")))
+    assert any(n.startswith("serve/") for n in bundle_perf["programs"])
     # the stall hit at step 20, well into decode: at least one request
     # was admitted before it — its timeline must reconstruct from the
     # bundle's flight-recorder tail alone
