@@ -17,6 +17,7 @@
 // The round-4 version exposed only a global drain, which serialized the
 // swap-in(i+1)/swap-out(i-1)/step(i) loop.
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
@@ -43,7 +44,14 @@ struct Request {
   size_t offset;
   int fd;
   long id;
+  double t_submit;  // steady-clock seconds at submit (I/O telemetry)
 };
+
+static double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 #ifdef DS_HAVE_URING
 static int sys_io_uring_setup(unsigned entries, struct io_uring_params* p) {
@@ -125,6 +133,11 @@ struct Handle {
   std::condition_variable cv_work;   // threadpool: work available
   std::condition_variable cv_done;   // a request completed
   std::unordered_map<long, int> completed;  // id -> 0 ok / -1 failed
+  //: id -> submit->completion seconds, measured entirely backend-side —
+  //: the Python caller's submit->wait window includes arbitrary caller
+  //: delay (fire-and-forget writes reaped a whole step later), which is
+  //: NOT device bandwidth
+  std::unordered_map<long, double> completed_dur;
   std::unordered_map<long, Request> pending; // id -> request (for resume)
   long next_id = 1;
   std::atomic<long> inflight{0};
@@ -180,7 +193,7 @@ struct Handle {
   long submit(int op, char* buf, size_t count, size_t offset, int fd) {
     std::unique_lock<std::mutex> lk(mu);
     long id = next_id++;
-    Request r{op, buf, count, offset, fd, id};
+    Request r{op, buf, count, offset, fd, id, now_s()};
     inflight.fetch_add(1);
     pending[id] = r;
 #ifdef DS_HAVE_URING
@@ -199,6 +212,7 @@ struct Handle {
     auto it = pending.find(id);
     if (it != pending.end()) {
       close(it->second.fd);
+      completed_dur[id] = now_s() - it->second.t_submit;
       pending.erase(it);
     }
     completed[id] = err;
@@ -309,13 +323,21 @@ struct Handle {
 #endif  // DS_HAVE_URING
 
   // ------------------------------------------------------------------ wait
-  int wait_req(long id) {
+  int wait_req(long id) { return wait_req_dur(id, nullptr); }
+
+  int wait_req_dur(long id, double* dur) {
+    if (dur) *dur = 0.0;
     std::unique_lock<std::mutex> lk(mu);
     for (;;) {
       auto it = completed.find(id);
       if (it != completed.end()) {
         int err = it->second;
         completed.erase(it);
+        auto dt = completed_dur.find(id);
+        if (dt != completed_dur.end()) {
+          if (dur) *dur = dt->second;
+          completed_dur.erase(dt);
+        }
         if (err) drain_errors--;  // consumed by this per-request wait
         return err;
       }
@@ -333,6 +355,7 @@ struct Handle {
     long errs = drain_errors;
     drain_errors = 0;
     completed.clear();  // fire-and-forget ids are spent at a full drain
+    completed_dur.clear();
     return errs;
   }
 };
@@ -365,6 +388,13 @@ long ds_aio_submit_pwrite(void* h, const char* path, char* buf, size_t count,
 
 // block until ONE request completes; 0 ok, -1 I/O failure
 int ds_aio_wait_req(void* h, long id) { return ((Handle*)h)->wait_req(id); }
+
+// wait_req + the request's backend-measured submit->completion seconds
+// (0.0 when unknown) — the honest bandwidth window for a request the
+// caller reaped long after it completed (ISSUE 14 I/O telemetry)
+int ds_aio_wait_req_dur(void* h, long id, double* dur) {
+  return ((Handle*)h)->wait_req_dur(id, dur);
+}
 
 // legacy submit API (round-4 ABI): 0 on successful submit, -1 on failure
 int ds_aio_pread(void* h, const char* path, char* buf, size_t count,

@@ -205,16 +205,17 @@ class Autotuner:
         except TypeError:
             probe = self.model_factory()
         cfg = getattr(probe, "config", None)
-        hbm = None
-        try:
-            import jax
-            stats = jax.devices()[0].memory_stats() or {}
-            hbm = stats.get("bytes_limit")
-        except Exception as e:
+        # through the accelerator abstraction + memory-ledger probe
+        # (ISSUE 14 satellite), NOT a raw jax.devices()[0] poke —
+        # CPU-degraded probes must behave identically everywhere (the
+        # probe itself swallows backend errors and returns {})
+        from deepspeed_tpu.telemetry.memory import device_memory_stats
+        hbm = device_memory_stats().get("bytes_limit") or None
+        if hbm is None:
             # a backend without memory_stats (CPU) degrades to the
             # unbounded cost model — but say so, silently mis-sized
             # search spaces are hard to debug
-            logger.debug(f"autotuner: no device memory stats ({e}); "
+            logger.debug("autotuner: no device memory stats; "
                          "HBM ceiling disabled")
         n_dev = 1
         try:

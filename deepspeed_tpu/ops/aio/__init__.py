@@ -1,12 +1,30 @@
 """Async I/O handle (reference: deepspeed/ops/aio over csrc/aio — the
-``aio_handle`` pybind object with async pread/pwrite + wait)."""
+``aio_handle`` pybind object with async pread/pwrite + wait).
+
+ISSUE 14: every handle reports completed I/O windows through the
+process-wide :class:`~deepspeed_tpu.telemetry.iostat.IoStat` when one
+is installed (:func:`set_aio_iostat`) — per-request submit→completion
+latency/bandwidth for the queue-depth paths, whole-drain windows for
+batched ``wait()``.  With no sink installed the instrumentation is a
+dict insert per submit (observability must not tax the I/O path)."""
 import ctypes
 import os
+import time
 from typing import Optional
 
 import numpy as np
 
 from op_builder import AsyncIOBuilder, load_op
+
+#: process-wide I/O observation sink (telemetry/iostat.py installs it)
+_IOSTAT = None
+
+
+def set_aio_iostat(iostat) -> None:
+    """Install (or clear, with None) the process-wide IoStat every
+    AsyncIOHandle reports through."""
+    global _IOSTAT
+    _IOSTAT = iostat
 
 
 class AsyncIOHandle:
@@ -29,6 +47,7 @@ class AsyncIOHandle:
         self._lib.ds_aio_submit_pread.restype = ctypes.c_long
         self._lib.ds_aio_submit_pwrite.restype = ctypes.c_long
         self._lib.ds_aio_wait_req.restype = ctypes.c_int
+        self._lib.ds_aio_wait_req_dur.restype = ctypes.c_int
         self._lib.ds_aio_backend.restype = ctypes.c_int
         self._h = ctypes.c_void_p(
             self._lib.ds_aio_handle_new(ctypes.c_int(thread_count)))
@@ -38,6 +57,28 @@ class AsyncIOHandle:
         # keyed by id so wait_req can release them individually
         self._pinned = []
         self._pinned_by_id = {}
+        #: rid -> (t_submit, nbytes, op) for per-request windows; the
+        #: batch path keeps (t_submit, nbytes, op) tuples until wait()
+        self._io_meta = {}
+        self._io_batch = []
+
+    def _observe(self, op: str, nbytes: int, t0: float,
+                 window: str = "op"):
+        self._observe_dur(op, nbytes, time.perf_counter() - t0,
+                          window=window)
+
+    def _observe_dur(self, op: str, nbytes: int, duration_s: float,
+                     window: str = "op"):
+        sink = _IOSTAT
+        if sink is None:
+            return
+        try:
+            sink.observe(op, nbytes, duration_s, window=window)
+        # dslint: disable=DSL005 -- observation is strictly best-effort:
+        # a broken telemetry sink must never turn a completed I/O into
+        # a failure (the bytes are already on disk / in the buffer)
+        except Exception:
+            pass
 
     def _buf_ptr(self, arr: np.ndarray):
         assert arr.flags.c_contiguous
@@ -49,6 +90,8 @@ class AsyncIOHandle:
             ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
         if rc == 0:
             self._pinned.append(buffer)
+            self._io_batch.append((time.perf_counter(), buffer.nbytes,
+                                   "read"))
         return rc
 
     def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0) -> int:
@@ -57,6 +100,8 @@ class AsyncIOHandle:
             ctypes.c_size_t(buffer.nbytes), ctypes.c_size_t(offset))
         if rc == 0:
             self._pinned.append(buffer)
+            self._io_batch.append((time.perf_counter(), buffer.nbytes,
+                                   "write"))
         return rc
 
     def submit_pread(self, buffer: np.ndarray, filename: str,
@@ -70,6 +115,7 @@ class AsyncIOHandle:
         if rid <= 0:
             raise IOError(f"aio submit_pread failed for {filename}")
         self._pinned_by_id[rid] = buffer
+        self._io_meta[rid] = (time.perf_counter(), buffer.nbytes, "read")
         return int(rid)
 
     def submit_pwrite(self, buffer: np.ndarray, filename: str,
@@ -81,14 +127,26 @@ class AsyncIOHandle:
         if rid <= 0:
             raise IOError(f"aio submit_pwrite failed for {filename}")
         self._pinned_by_id[rid] = buffer
+        self._io_meta[rid] = (time.perf_counter(), buffer.nbytes, "write")
         return int(rid)
 
     def wait_req(self, rid: int) -> int:
         """Block until request ``rid`` completes (others may stay in
         flight — THE point of the queue-depth backend).  Returns 0 on
-        success, -1 on I/O failure.  Each id may be waited once."""
-        err = self._lib.ds_aio_wait_req(self._h, ctypes.c_long(rid))
+        success, -1 on I/O failure.  Each id may be waited once.
+
+        Telemetry uses the BACKEND's submit→completion duration, not
+        this call's submit→wait window: a fire-and-forget write is
+        reaped a whole optimizer step later, and charging that step's
+        compute to the device would collapse every bandwidth gauge."""
+        dur = ctypes.c_double(0.0)
+        err = self._lib.ds_aio_wait_req_dur(self._h, ctypes.c_long(rid),
+                                            ctypes.byref(dur))
         self._pinned_by_id.pop(rid, None)
+        meta = self._io_meta.pop(rid, None)
+        if meta is not None and err == 0 and dur.value > 0:
+            _, nbytes, op = meta
+            self._observe_dur(op, nbytes, dur.value)
         return int(err)
 
     def backend(self) -> str:
@@ -112,6 +170,20 @@ class AsyncIOHandle:
         errors = self._lib.ds_aio_wait(self._h)
         self._pinned.clear()
         self._pinned_by_id.clear()
+        # batched drain: one bandwidth sample per op over the window
+        # from the oldest outstanding submit to completion.  Per-request
+        # submits that were never wait_req'd fold into the same drain
+        # sample (wait() completes them too).
+        if errors == 0 and (self._io_batch or self._io_meta):
+            pending = self._io_batch + list(self._io_meta.values())
+            for op in ("read", "write"):
+                rows = [(t0, n) for t0, n, o in pending if o == op]
+                if rows:
+                    self._observe(op, sum(n for _, n in rows),
+                                  min(t0 for t0, _ in rows),
+                                  window="drain")
+        self._io_batch.clear()
+        self._io_meta.clear()
         return int(errors)
 
     def inflight(self) -> int:

@@ -20,6 +20,9 @@ that moment:
 - ``perf.json``      — the perf observatory snapshot (ISSUE 13):
   per-program cost reports + roofline floors + live achieved-vs-floor,
   so a DEGRADED bundle shows whether the wedge was perf collapse
+- ``memory.json``    — the memory observatory snapshot (ISSUE 14):
+  tiers × owners with high-watermarks, the allocation-failure
+  forensics ring, and the swap I/O summary
 - ``trace.json``     — the flushed Perfetto trace, when a tracer is
   armed
 
@@ -168,6 +171,19 @@ def write_postmortem(out_dir: str, reason: str, *,
             return False            # nothing analyzed — skip the artifact
         return _write_json(p, payload)
     artifact("perf.json", _perf)
+
+    def _memory(p):
+        # the memory observatory snapshot (ISSUE 14): tiers × owners
+        # with high-watermarks, the allocation-failure forensics ring,
+        # and the swap I/O summary — a DEGRADED/OOM bundle must answer
+        # "where did the bytes go" without the process
+        from deepspeed_tpu.telemetry.debug import memory_payload
+        payload = memory_payload()
+        if not payload["tiers"] and not payload["failures"] \
+                and not payload["swap"]["ops"]:
+            return False            # ledger never armed — skip
+        return _write_json(p, payload)
+    artifact("memory.json", _memory)
 
     tracer = get_tracer()
     if getattr(tracer, "enabled", False):

@@ -370,6 +370,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #: of the fused train step (FLOPs/bytes/launches -> perf/* gauges,
     #: /debug/perf, post-mortem perf.json).  DS_PERF_COSTMODEL env wins.
     costmodel: bool = True
+    #: tiered memory ledger (ISSUE 14): per-step byte attribution by
+    #: tier/owner (mem/* gauges, /debug/memory, post-mortem
+    #: memory.json, OOM forensics).  DS_MEM_LEDGER env wins.
+    memory: bool = True
 
     def __init__(self, **data):
         super().__init__(**data)

@@ -197,6 +197,13 @@ class DeepSpeedEngine:
                 "— the fp16 path accumulates into fp32 masters, as the "
                 "reference's default does)")
 
+        # memory-ledger process default (ISSUE 14): installed BEFORE
+        # the offload tiers construct their swappers, so an init-time
+        # master/moment swap-out already honors telemetry.memory: false
+        from deepspeed_tpu.telemetry.memory import \
+            set_memory_config_default
+        set_memory_config_default(self._config.telemetry_config.memory)
+
         # ---- ZeRO sharding policy -------------------------------------------
         zc = self._config.zero_config
         self.zero_policy = ZeroShardingPolicy(
@@ -667,6 +674,45 @@ class DeepSpeedEngine:
             self.metrics_server = MetricsServer(
                 self.telemetry_registry,
                 port=tcfg.metrics_port).start()
+        # memory observatory (ISSUE 14): attribute the engine's big
+        # owners into the tiered ledger once (param/optimizer byte
+        # sizes never change); per-step publication + the HBM-fraction
+        # anomaly feed ride _record_step_telemetry.  The opt-in
+        # compiled activation analysis (DS_MEM_COMPILED=1 — one extra
+        # XLA compile) lands lazily beside the first-step cost report.
+        from deepspeed_tpu.telemetry.memory import memory_enabled
+        self._mem_on = tcfg.enabled and memory_enabled(tcfg.memory)
+        self._mem_compiled_done = False
+        if self._mem_on:
+            try:
+                from deepspeed_tpu.telemetry.iostat import get_iostat
+                from deepspeed_tpu.telemetry.memory import (
+                    attribute_params, get_memory_ledger, tree_bytes)
+                # swap I/O observations land in this engine's registry
+                # and feed its anomaly detector (a collapsing NVMe read
+                # rate raises anomaly/mem_swap_read before the offload
+                # pipeline stalls a step)
+                get_iostat().attach(registry=self.telemetry_registry,
+                                    anomaly=self.anomaly)
+                led = get_memory_ledger()
+                attribute_params(led, self.state["params"])
+                opt_bytes = tree_bytes(self.state.get("opt_state"))
+                if opt_bytes:
+                    led.set_bytes("device", "optimizer", opt_bytes)
+                if self.host_optimizer is not None:
+                    led.set_bytes("host", "optimizer",
+                                  self.host_optimizer.host_dram_bytes,
+                                  masters_on_nvme=self.host_optimizer
+                                  .masters_on_nvme)
+                if self.streamed_optimizer is not None:
+                    # pinned-host Adam state: fp32 master + m + v
+                    numel = sum(int(l.size) for l in
+                                jax.tree.leaves(self.state["params"]))
+                    led.set_bytes("host", "optimizer", 3 * 4 * numel,
+                                  pinned=True)
+            except Exception as e:  # accounting must never block init
+                logger.debug(f"memory ledger: attribution failed ({e})")
+                self._mem_on = False
 
         self._ltd_keep = None
         self._last_seq_len = 0
@@ -2144,6 +2190,7 @@ class DeepSpeedEngine:
             fn = self._get_compiled("train_step")
             rng = self._next_rng()
             self._maybe_cost_report(batch, rng)
+            self._maybe_memory_report(batch, rng)
             # one fused program: fwd+bwd+apply dispatch together (the
             # per-phase split lives in the fwd/bwd/step timers when the
             # micro API drives them)
@@ -2382,6 +2429,33 @@ class DeepSpeedEngine:
             from deepspeed_tpu.utils.logging import logger
             logger.debug(f"costmodel: train/step analysis failed: {e}")
 
+    def _maybe_memory_report(self, batch, rng):
+        """Opt-in activation-peak accounting (ISSUE 14): compile the
+        fused train step once more and read the backend's
+        ``memory_analysis()`` (temp = the activation/workspace peak)
+        into the ledger's ``activations`` owner.  Costs a FULL XLA
+        compile, so it only runs under ``DS_MEM_COMPILED=1``; backends
+        without the analysis quietly skip."""
+        if self._mem_compiled_done:
+            return
+        self._mem_compiled_done = True
+        if not (self._mem_on and os.environ.get(
+                "DS_MEM_COMPILED", "").strip() in ("1", "true", "on")):
+            return
+        try:
+            from deepspeed_tpu.telemetry.memory import (
+                compiled_memory_stats, get_memory_ledger)
+            with self._train_scope(), self._ltd_scope(), self._aq_scope():
+                stats = compiled_memory_stats(
+                    self._build_train_step(), self.state, batch, rng)
+            if stats:
+                get_memory_ledger().set_bytes(
+                    "device", "activations",
+                    stats.get("temp_size_in_bytes", 0), **stats)
+        except Exception as e:          # noqa: BLE001 — best-effort
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"memory ledger: compiled analysis failed: {e}")
+
     def _record_step_telemetry(self, duration_s: float):
         """Per-step registry update + monitor bridge (ISSUE 4): step
         latency histogram, tokens/s, and the MFU gauge — model FLOPs
@@ -2409,6 +2483,12 @@ class DeepSpeedEngine:
             # floors only resolve where the device rate tables do
             from deepspeed_tpu.telemetry.roofline import observe_achieved
             observe_achieved(reg, "train/step", duration_s)
+        if self._mem_on:
+            # memory observatory (ISSUE 14): mem/* gauges + the HBM
+            # used-fraction anomaly feed (a leak flags before the OOM)
+            from deepspeed_tpu.telemetry.memory import get_memory_ledger
+            get_memory_ledger().publish_and_feed(reg, self.anomaly,
+                                                 corr=corr)
         tokens = self.train_batch_size() * max(self._last_seq_len, 0)
         if tokens and duration_s > 0:
             reg.set_gauge("train/tokens_per_s", tokens / duration_s)

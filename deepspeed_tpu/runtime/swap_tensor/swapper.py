@@ -13,6 +13,13 @@ thread pool otherwise).  Every submit carries its own completion id, so
   serialized the swap-in(i+1)/swap-out(i-1)/step(i) loop the reference's
   pipelined swapper exists for);
 - ``swap_in`` waits on that one read's completion only.
+
+ISSUE 14: each swapper accounts its on-disk bytes into the memory
+ledger's ``nvme`` tier (owner ``swap:<dir>``), and every read/write it
+issues rides the process-wide IoStat (``swap/*`` metrics, achieved
+bandwidth vs the ``DS_NVME_GBPS`` floor) through the instrumented aio
+handles — offload runs emit the ROADMAP item 2 bandwidth table from
+telemetry rather than hand timing.
 """
 import os
 from typing import Dict, Optional
@@ -26,6 +33,21 @@ class AsyncTensorSwapper:
     def __init__(self, swap_dir: str, aio_config=None):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
+        #: ledger owner label: one row per swap directory, so two
+        #: swappers (optimizer moments + param shards) stay distinct —
+        #: keyed by the full normalized path, because distinct dirs can
+        #: share a basename ('/job_a/swap' vs '/job_b/swap')
+        self._mem_owner = "swap:" + os.path.normpath(swap_dir)
+        self._file_bytes: Dict[str, int] = {}    # name -> on-disk bytes
+        # arm the process-wide aio observation sink (idempotent; the
+        # first swapper in a process installs it)
+        try:
+            from deepspeed_tpu.telemetry.iostat import get_iostat
+            get_iostat()
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"swap iostat arming failed ({e}); swapping "
+                         "continues unobserved")
         threads = getattr(aio_config, "thread_count", None) or 4
         # SEPARATE handles (= separate io_uring rings / worker pools) for
         # reads and writes: buffered writes under writeback throttling
@@ -57,6 +79,24 @@ class AsyncTensorSwapper:
         arr = np.ascontiguousarray(array)
         self._inflight_writes[name] = self.aio_w.submit_pwrite(
             arr, self._path(name))
+        if self._file_bytes.get(name) != arr.nbytes:
+            self._file_bytes[name] = int(arr.nbytes)
+            self._account_nvme()
+
+    def _account_nvme(self):
+        """Ledger tap: this swapper's total on-disk bytes into the
+        ``nvme`` tier (best-effort — accounting never fails a swap)."""
+        try:
+            from deepspeed_tpu.telemetry.memory import (get_memory_ledger,
+                                                        memory_enabled)
+            if memory_enabled():
+                get_memory_ledger().set_bytes(
+                    "nvme", self._mem_owner,
+                    sum(self._file_bytes.values()),
+                    tensors=len(self._file_bytes), dir=self.swap_dir)
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"swap ledger accounting failed ({e})")
 
     def prefetch(self, name: str):
         """Start an async read; complete it with swap_in(name).  Only a

@@ -99,6 +99,13 @@ class HostOffloadOptimizer:
                            for s in self.shapes.values())
         dram_copies = ((0 if self.masters_on_nvme else 1) +
                        (0 if self.nvme is not None else self.n_moments))
+        #: memory-ledger attribution (ISSUE 14): fp32 state resident in
+        #: host DRAM vs streamed through the NVMe swap files (the
+        #: swapper accounts the nvme tier itself, per swap dir)
+        self.host_dram_bytes = master_bytes * dram_copies
+        self.nvme_bytes = master_bytes * (
+            (1 if self.masters_on_nvme else 0)
+            + (self.n_moments if self.nvme is not None else 0))
         log_dist(f"HostOffloadOptimizer: {len(self.paths)} tensors, "
                  f"{master_bytes * dram_copies / 1e9:.2f} GB host DRAM"
                  + (", masters+moments on NVMe" if self.masters_on_nvme

@@ -370,6 +370,32 @@ class ContinuousBatchingScheduler:
             except Exception:       # cost accounting must never block serving
                 self._costmodel_on = False
         self.pool = self._init_pool()
+        # memory observatory (ISSUE 14): per-step byte attribution of
+        # the KV pool (allocated / prefix-cache retained / free), the
+        # params, and the spec draft pool into the process-wide tiered
+        # ledger — mem/* gauges, /debug/memory, OOM forensics
+        from deepspeed_tpu.telemetry.memory import (get_memory_ledger,
+                                                    memory_enabled,
+                                                    tree_bytes)
+        self._mem_on = memory_enabled(getattr(
+            getattr(config, "telemetry", None), "memory", None))
+        self._mem_ledger = get_memory_ledger() if self._mem_on else None
+        self._pool_bytes = 0
+        self._bytes_per_block = 0.0
+        if self._mem_on:
+            try:
+                self._pool_bytes = tree_bytes(self.pool)
+                self._bytes_per_block = (self._pool_bytes
+                                         / self.cfg.num_blocks)
+                from deepspeed_tpu.telemetry.memory import attribute_params
+                attribute_params(self._mem_ledger, self.params,
+                                 stream=self._cost_stream)
+                draft_pool = getattr(self.proposer, "pool", None)
+                if draft_pool is not None:
+                    self._mem_ledger.set_bytes(
+                        "device", "spec_draft", tree_bytes(draft_pool))
+            except Exception:   # byte accounting must never block serving
+                self._mem_on = False
 
     def _resolve_proposer(self, proposer):
         spec = getattr(self.cfg, "spec", None)
@@ -1139,8 +1165,16 @@ class ContinuousBatchingScheduler:
                     break
                 # allocate BEFORE dequeueing: a denied allocation
                 # (injected fault or free-list race) must leave the
-                # request queued, not admit it blockless
+                # request queued, not admit it blockless.  The failure
+                # is an OOM-shaped event: snapshot the byte ledger
+                # (ISSUE 14 forensics) so the post-mortem answers
+                # "what held the pool when admission starved"
                 if bm.allocate(req.request_id, total) is None:
+                    self._record_alloc_failure(
+                        "kv.alloc", request_id=req.request_id,
+                        needed_blocks=total,
+                        free_blocks=bm.num_free_blocks,
+                        cached_blocks=bm.num_cached_blocks)
                     break
             self._queue.remove(req)
             if self._prefix_cache_on:
@@ -1403,6 +1437,17 @@ class ContinuousBatchingScheduler:
                           and r.state in (RequestState.DECODE,
                                           RequestState.PREFILLING)]
                 victim = min(active, key=self._qos_key)
+                if victim is req:
+                    # the grower is about to evict ITSELF: true pool
+                    # exhaustion, not pressure rebalancing.  Snapshot
+                    # the ledger BEFORE the eviction returns the
+                    # victim's blocks — the forensic record must show
+                    # who held the bytes at the moment of failure, not
+                    # the post-eviction state
+                    self._record_alloc_failure(
+                        "kv.alloc", request_id=req.request_id,
+                        phase="grow", needed_blocks=1,
+                        free_blocks=bm.num_free_blocks)
                 self._evict(victim)
                 if victim is req:
                     break
@@ -1841,6 +1886,11 @@ class ContinuousBatchingScheduler:
                     self._admit()
                 with tracer.span("serve/grow", cat="serving"):
                     self._grow_tables()
+                if self._mem_on:
+                    # mid-step occupancy tap: per-step pool occupancy
+                    # peaks right after growth — the watermark must see
+                    # a request that admits AND retires this iteration
+                    self._update_memory_ledger(publish=False)
                 active = sum(r is not None and
                              r.state == RequestState.DECODE
                              for r in self._slots)
@@ -1928,6 +1978,55 @@ class ContinuousBatchingScheduler:
         if c["spec_drafted_tokens"]:
             self.metrics.gauges["spec_accept_rate"] = round(
                 c["spec_accepted_tokens"] / c["spec_drafted_tokens"], 4)
+        if self._mem_on:
+            self._update_memory_ledger()
+
+    def _record_alloc_failure(self, site: str, **detail):
+        """OOM forensics (ISSUE 14): a failed pool allocation snapshots
+        the byte ledger into the forensics ring + flight recorder
+        (``mem/alloc_failure``); the /debug and post-mortem surfaces
+        read the snapshot, not the live (already-changed) pool."""
+        if not self._mem_on:
+            return
+        try:
+            self._update_memory_ledger()
+            self._mem_ledger.record_alloc_failure(
+                site, flightrec=self.flightrec,
+                step=self._step_count, **detail)
+        except Exception as e:  # forensics must never fail the step
+            logger.debug(f"memory forensics failed ({e})")
+
+    def _update_memory_ledger(self, publish: bool = True):
+        """Memory observatory tap (ISSUE 14): the KV pool's bytes split
+        by who holds them — live request tables (``kv_pool``), the
+        prefix cache's retained refcount-0 set (``prefix_cache``), the
+        free list (``kv_free``), and the reserved trash block
+        (``kv_reserved``) — so the four owners sum EXACTLY to the pool
+        pytree's leaf bytes (the parity contract the acceptance test
+        enforces).  With ``publish`` it also refreshes the ``mem/*``
+        gauges and feeds the HBM used fraction into the rolling anomaly
+        detector (a leak alerts BEFORE the OOM) where the backend
+        reports device stats; the mid-step occupancy tap (after table
+        growth — where per-step occupancy PEAKS, so the watermarks see
+        requests that admit and retire within one iteration) skips
+        that half."""
+        led = self._mem_ledger
+        bm = self.block_mgr
+        bpb = self._bytes_per_block
+        led.set_bytes("device", "kv_pool",
+                      bm.num_allocated_blocks * bpb,
+                      blocks=bm.num_allocated_blocks,
+                      block_size=bm.block_size)
+        led.set_bytes("device", "prefix_cache",
+                      bm.num_cached_blocks * bpb,
+                      blocks=bm.num_cached_blocks)
+        led.set_bytes("device", "kv_free", bm.num_free_blocks * bpb,
+                      blocks=bm.num_free_blocks)
+        led.set_bytes("device", "kv_reserved", bpb, blocks=1)
+        if not publish:
+            return
+        led.publish_and_feed(self.metrics.registry, self.anomaly,
+                             corr=f"serve-step-{self._step_count}")
 
     def run_until_idle(self, max_steps: int = 100_000):
         """Drive step() until queue and slots drain (bench/test helper)."""

@@ -22,6 +22,9 @@ Endpoints:
   GET  /debug/perf       per-program cost table + roofline floors +
                          live achieved-vs-floor (?program= filter;
                          ISSUE 13)
+  GET  /debug/memory     tiered byte ledger (tiers × owners with
+                         watermarks), OOM forensics ring, and the
+                         swap I/O summary (?tier= filter; ISSUE 14)
 
 The ``/debug/*`` surface (ISSUE 7) is read-only and never takes the
 scheduler lock — it exists precisely for the moments the lock is stuck.
@@ -238,6 +241,7 @@ class _Handler(BaseHTTPRequestHandler):
         in flight)."""
         from deepspeed_tpu.telemetry.debug import (flightrec_payload,
                                                    format_thread_stacks,
+                                                   memory_payload,
                                                    parse_debug_query,
                                                    perf_payload)
         route, query = parse_debug_query(self.path)
@@ -264,6 +268,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if route == "/debug/perf":
             self._send_json(200, perf_payload(query))
+            return
+        if route == "/debug/memory":
+            self._send_json(200, memory_payload(query))
             return
         self._send_json(404, {"error": f"no route {route}"})
 

@@ -8,6 +8,10 @@ rolling anomaly detection + SLO burn accounting, and the live
 observatory: a jaxpr-walking cost model for every compiled hot-path
 program family and a roofline layer pricing each one against the
 device's FLOP/bandwidth rates (``perf/*`` gauges, ``/debug/perf``).
+ISSUE 14 adds the memory observatory: a tiered per-owner byte ledger
+with OOM forensics (``mem/*`` gauges, ``/debug/memory``,
+``memory.json`` in post-mortem bundles) and offload I/O bandwidth
+telemetry over the aio/swap paths (``swap/*``, ``DS_NVME_GBPS``).
 """
 from deepspeed_tpu.telemetry.registry import (      # noqa: F401
     COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
@@ -30,7 +34,13 @@ from deepspeed_tpu.telemetry.costmodel import (     # noqa: F401
 from deepspeed_tpu.telemetry.roofline import (      # noqa: F401
     HBM_GBPS_BY_KIND, HBM_GBPS_ENV, classify, floor_seconds,
     hbm_bytes_per_s, observe_achieved, perf_table, publish_report)
+from deepspeed_tpu.telemetry.memory import (        # noqa: F401
+    MEM_ENV, MemoryLedger, attribute_params, compiled_memory_stats,
+    device_memory_stats, get_memory_ledger, hbm_used_fraction,
+    memory_enabled, reset_memory_ledger, tree_bytes)
+from deepspeed_tpu.telemetry.iostat import (        # noqa: F401
+    IoStat, NVME_GBPS_ENV, get_iostat, nvme_bytes_per_s, reset_iostat)
 from deepspeed_tpu.telemetry.debug import (         # noqa: F401
-    flightrec_payload, format_thread_stacks, parse_debug_query,
-    perf_payload)
+    flightrec_payload, format_thread_stacks, memory_payload,
+    parse_debug_query, perf_payload)
 from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

@@ -16,6 +16,12 @@ The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
   achieved-vs-floor.  Reads only dict snapshots from the cost-model
   store — never a scheduler lock — so it answers while a step is
   wedged (the same contract the chaos acceptance test enforces).
+- ``memory_payload()`` — the ``/debug/memory`` JSON body (ISSUE 14):
+  the tiered byte ledger (per-owner bytes, watermarks, the
+  allocation-failure forensics ring) plus the swap I/O summary.  Same
+  lock-free contract: ledger/iostat snapshots are GIL-atomic dict
+  copies, never a scheduler lock — "where did the bytes go" must be
+  answerable while the step that ran out of them is wedged.
 - ``parse_debug_query()`` — tiny query-string parsing shared by both
   HTTP front doors.
 
@@ -77,6 +83,26 @@ def flightrec_payload(recorder, query: Optional[Dict[str, str]] = None
         "returned": len(events),
         "events": events,
     }
+
+
+def memory_payload(query: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, Any]:
+    """The ``/debug/memory`` body: ledger snapshot (tiers × owners with
+    watermarks + failure ring + device stats) and the swap I/O summary.
+    ``?tier=<name>`` filters the tier table.  Reads the EXISTING iostat
+    (peek, never create/install): a read-only debug GET must not
+    mutate global state, and an aio import failure must not 500 the
+    endpoint the ledger half can still answer."""
+    from deepspeed_tpu.telemetry.iostat import peek_iostat
+    from deepspeed_tpu.telemetry.memory import get_memory_ledger
+    payload = get_memory_ledger().snapshot()
+    io = peek_iostat()
+    payload["swap"] = io.summary() if io is not None else {"ops": {}}
+    want = (query or {}).get("tier")
+    if want:
+        payload["tiers"] = {k: v for k, v in payload["tiers"].items()
+                            if k == want}
+    return payload
 
 
 def perf_payload(query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:

@@ -65,6 +65,9 @@ KNOWN_EVENT_KINDS = {
     "route/retire": "fleet request completed or failed at the router",
     "anomaly/": "prefix family: step-latency outliers flagged by the "
                 "MAD detector (anomaly/train.step, anomaly/serve.step)",
+    "mem/alloc_failure": "an allocation failed (denied kv.alloc / OOM) "
+                         "and the memory ledger was snapshotted into "
+                         "the forensics ring (ISSUE 14)",
     "postmortem": "a post-mortem bundle was written",
 }
 

@@ -19,6 +19,9 @@ Routes:
   ``/debug/perf``      per-program cost table + roofline floors +
                        live achieved-vs-floor (``?program=`` filter;
                        lock-free, ISSUE 13)
+  ``/debug/memory``    tiered byte ledger + OOM forensics ring + swap
+                       I/O summary (``?tier=`` filter; lock-free,
+                       ISSUE 14)
 """
 import json
 import threading
@@ -50,7 +53,7 @@ class MetricsServer:
             def do_GET(self):
                 from deepspeed_tpu.telemetry.debug import (
                     flightrec_payload, format_thread_stacks,
-                    parse_debug_query, perf_payload)
+                    memory_payload, parse_debug_query, perf_payload)
                 from deepspeed_tpu.telemetry.flight_recorder import \
                     get_flight_recorder
                 route, query = parse_debug_query(self.path)
@@ -70,6 +73,9 @@ class MetricsServer:
                 elif route == "/debug/perf":
                     body = json.dumps(perf_payload(query)).encode()
                     code, ctype = 200, "application/json"
+                elif route == "/debug/memory":
+                    body = json.dumps(memory_payload(query)).encode()
+                    code, ctype = 200, "application/json"
                 else:
                     body = f"no route {route}\n".encode()
                     code, ctype = 404, "text/plain"
@@ -87,7 +93,7 @@ class MetricsServer:
         logger.info(f"telemetry: metrics endpoint on "
                     f"http://{self.host}:{self.port}/metrics "
                     f"(+ /healthz, /debug/stacks, /debug/flightrec, "
-                    f"/debug/perf)")
+                    f"/debug/perf, /debug/memory)")
         return self
 
     def stop(self):

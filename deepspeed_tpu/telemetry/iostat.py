@@ -1,0 +1,201 @@
+"""Offload I/O bandwidth telemetry (ISSUE 14 tentpole).
+
+The aio layer (``ops/aio`` over io_uring/threadpool) and the NVMe
+tensor swapper (``runtime/swap_tensor``) move the bytes ZeRO-Infinity
+offload lives on, but until now their throughput was only measurable
+by hand (``scripts/swap_bench.py``).  :class:`IoStat` is the per-op
+observation layer both paths report through:
+
+- counters ``swap/in_bytes`` / ``swap/out_bytes`` and ``swap/ops``
+  (labeled ``op=read|write``);
+- histograms ``swap/op_latency_s`` and ``swap/op_gbps`` — per-request
+  submit→completion windows for the queue-depth paths, whole-drain
+  windows for batched ``wait()`` (labeled ``window=op|drain``);
+- gauges ``swap/achieved_gbps`` (latest) and — only when the operator
+  declares the device's rate via ``DS_NVME_GBPS`` — the
+  ``swap/achieved_vs_floor`` ratio.  There is **no by-kind NVMe
+  table**: unlike HBM, the swap device is unknowable from JAX, so the
+  floor exists only when declared (no fictitious floors — the
+  roofline rule).
+
+Anomaly hookup (ISSUE 14 satellite): each observation feeds the
+rolling MAD detector as **ms-per-MB** (inverse bandwidth), so a
+*collapsing* read rate registers as a positive outlier — the detector
+is one-sided-high by design — raising ``anomaly/mem_swap_read`` /
+``anomaly/mem_swap_write`` before the offload pipeline stalls a step.
+
+Wiring: ``IoStat.install()`` hands the instance to ``ops/aio`` (every
+AsyncIOHandle in the process reports through it); the swapper counts
+its per-name file bytes into the memory ledger's ``nvme`` tier.
+"""
+import os
+import threading
+from typing import Any, Dict, Optional
+
+NVME_GBPS_ENV = "DS_NVME_GBPS"
+
+#: bandwidth histogram buckets (GB/s): page-cache tmpfs (~GBs) down to
+#: a dying disk (~50 MB/s)
+GBPS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+
+def nvme_bytes_per_s(env: Optional[dict] = None) -> Optional[float]:
+    """The declared swap-device bandwidth in bytes/s (``DS_NVME_GBPS``),
+    or None — callers must skip floor math rather than report against a
+    made-up device."""
+    env = os.environ if env is None else env
+    override = str(env.get(NVME_GBPS_ENV, "") or "").strip()
+    if override:
+        return float(override) * 1e9
+    return None
+
+
+class IoStat:
+    """Per-op I/O observation fanned to the metrics registry, the
+    rolling anomaly detector, and a totals table for ``/debug/memory``.
+
+    ``registry``/``anomaly`` are late-bindable (:meth:`attach`) so one
+    process-wide instance can adopt whichever engine/scheduler owns the
+    current registry."""
+
+    def __init__(self, registry=None, anomaly=None):
+        self.registry = registry
+        self.anomaly = anomaly
+        self._lock = threading.Lock()
+        #: op -> {ops, bytes, seconds, last_gbps}
+        self._totals: Dict[str, Dict[str, float]] = {}
+
+    def attach(self, registry=None, anomaly=None) -> "IoStat":
+        if registry is not None:
+            self.registry = registry
+        if anomaly is not None:
+            self.anomaly = anomaly
+        return self
+
+    def install(self) -> "IoStat":
+        """Become the process-wide aio observation sink."""
+        from deepspeed_tpu.ops import aio as _aio
+        _aio.set_aio_iostat(self)
+        return self
+
+    # ------------------------------------------------------------ observe
+    def observe(self, op: str, nbytes: int, duration_s: float,
+                window: str = "op"):
+        """One completed I/O window.  ``op`` is ``read``/``write``;
+        ``window`` is ``op`` (one request's backend-measured
+        submit→completion — the honest device-bandwidth sample) or
+        ``drain`` (a batched wait() spanning several requests AND any
+        caller delay since their submits).  Drain windows count bytes
+        and land in their own labeled histograms, but only true per-op
+        windows drive the achieved/floor gauges and the anomaly feed —
+        a drain that sat behind a compute step is not a collapsing
+        device."""
+        if nbytes <= 0 or duration_s <= 0:
+            return
+        n = float(nbytes)
+        dur = float(duration_s)
+        gbps = n / dur / 1e9
+        per_op = window == "op"
+        with self._lock:
+            tot = self._totals.setdefault(
+                op, {"ops": 0, "bytes": 0.0, "op_bytes": 0.0,
+                     "seconds": 0.0, "last_gbps": 0.0})
+            tot["ops"] += 1
+            tot["bytes"] += n
+            if per_op:
+                # the mean-bandwidth numerator/denominator pair covers
+                # only honest per-op windows; drain bytes still count
+                # in "bytes" (and the swap/{in,out}_bytes counters)
+                tot["op_bytes"] += n
+                tot["seconds"] += dur
+                tot["last_gbps"] = gbps
+        reg = self.registry
+        if reg is None:
+            from deepspeed_tpu.telemetry.registry import get_registry
+            reg = self.registry = get_registry()
+        if op == "read":
+            reg.inc("swap/in_bytes", n)
+        else:
+            reg.inc("swap/out_bytes", n)
+        reg.inc("swap/ops", op=op)
+        reg.histogram("swap/op_latency_s", op=op,
+                      window=window).observe(dur)
+        reg.histogram("swap/op_gbps", buckets=GBPS_BUCKETS, op=op,
+                      window=window).observe(gbps)
+        if not per_op:
+            return
+        reg.set_gauge("swap/achieved_gbps", round(gbps, 4), op=op)
+        floor = nvme_bytes_per_s()
+        if floor:
+            reg.set_gauge("swap/achieved_vs_floor",
+                          round(n / dur / floor, 4), op=op)
+        if self.anomaly is not None:
+            # inverse bandwidth: a COLLAPSING rate spikes ms-per-MB,
+            # which the one-sided-high MAD detector can see
+            ms_per_mb = dur * 1e3 / (n / 2**20)
+            if op == "read":
+                self.anomaly.observe("mem_swap_read", ms_per_mb)
+            else:
+                self.anomaly.observe("mem_swap_write", ms_per_mb)
+
+    # ------------------------------------------------------------ readers
+    def summary(self) -> Dict[str, Any]:
+        """The ``/debug/memory`` swap section / mem_report rows:
+        per-op totals with mean+last achieved bandwidth, plus the
+        declared floor when one exists (GIL-atomic copies only)."""
+        with self._lock:
+            totals = {op: dict(t) for op, t in self._totals.items()}
+        out: Dict[str, Any] = {"ops": {}}
+        for op, t in sorted(totals.items()):
+            mean = (t["op_bytes"] / t["seconds"] / 1e9
+                    if t["seconds"] > 0 else 0.0)
+            out["ops"][op] = {
+                "count": int(t["ops"]),
+                "bytes": int(t["bytes"]),
+                "mean_gbps": round(mean, 4),
+                "last_gbps": round(t["last_gbps"], 4),
+            }
+        floor = nvme_bytes_per_s()
+        if floor:
+            out["floor_gbps"] = floor / 1e9
+            for op, row in out["ops"].items():
+                if row["mean_gbps"]:
+                    row["vs_floor"] = round(row["mean_gbps"]
+                                            / (floor / 1e9), 4)
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._totals.clear()
+
+
+# ------------------------------------------------ process-wide instance
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[IoStat] = None
+
+
+def get_iostat() -> IoStat:
+    """The process-wide IoStat (created AND installed into ops/aio on
+    first use)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = IoStat().install()
+        return _GLOBAL
+
+
+def peek_iostat() -> Optional[IoStat]:
+    """The existing process-wide instance, or None — WITHOUT creating
+    one or importing/installing into ops/aio.  The read-only debug
+    surfaces use this: a debug GET must neither mutate global state
+    nor be able to fail on the aio import path."""
+    return _GLOBAL
+
+
+def reset_iostat():
+    """Tests: drop (and de-install) the process-wide instance."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        from deepspeed_tpu.ops import aio as _aio
+        _aio.set_aio_iostat(None)
+        _GLOBAL = None

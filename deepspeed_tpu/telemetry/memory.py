@@ -1,0 +1,410 @@
+"""Tiered byte ledger + OOM forensics (ISSUE 14 tentpole).
+
+The perf observatory (ISSUE 13) prices programs against *rates*
+(FLOP/s, HBM GB/s); nothing in the stack accounts for *capacity*:
+HBM/host/NVMe bytes are invisible until an allocation fails.  This
+module is the process-wide :class:`MemoryLedger` that attributes live
+bytes per **tier** (``device`` HBM via the accelerator abstraction's
+``memory_stats``, ``host`` pinned/DRAM copies, ``nvme`` swap files)
+and per **owner** within a tier (model params — split dtype/quantized
+via the costmodel ``param_stream_bytes`` walk — optimizer state, the
+KV block pool, the prefix-cache retained set, the spec draft pool,
+activation peaks from compiled-program ``memory_analysis()`` where the
+backend supports it).
+
+Three read surfaces, one source of truth:
+
+- ``mem/*`` gauges in the shared metrics registry
+  (:meth:`MemoryLedger.publish`) on BOTH /metrics front doors;
+- the lock-free ``/debug/memory`` endpoint
+  (:func:`deepspeed_tpu.telemetry.debug.memory_payload`) — answers
+  while a wedged step holds the scheduler lock, same contract as
+  ``/debug/perf``;
+- ``memory.json`` in post-mortem bundles, carrying high-watermarks and
+  the last N **allocation-failure events**: a denied ``kv.alloc`` (or
+  any OOM-shaped failure) snapshots the ledger at the moment of
+  failure into a bounded forensics ring AND the flight recorder
+  (``mem/alloc_failure``), so "where did the bytes go" has an answer
+  *after* the process is dead.
+
+Writers take the ledger's own lock (never any scheduler lock); readers
+snapshot plain dicts under the GIL — the costmodel registry idiom.
+``DS_MEM_LEDGER=0`` (or ``telemetry.memory: false``) disables the
+per-step taps.
+"""
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+MEM_ENV = "DS_MEM_LEDGER"
+#: opt-in compiled-program activation analysis (one extra XLA compile
+#: of the train step — too heavy to pay by default)
+MEM_COMPILED_ENV = "DS_MEM_COMPILED"
+
+#: the ledger's tier vocabulary; owners within a tier are free-form
+TIERS = ("device", "host", "nvme")
+
+#: bounded allocation-failure forensics ring (events, not bytes)
+DEFAULT_MAX_FAILURES = 32
+
+
+#: process-wide config default: the engine installs its
+#: ``telemetry.memory`` value here so config-less taps (the NVMe
+#: swapper has no telemetry section) honor a config-level disable
+_CONFIG_DEFAULT: Optional[bool] = None
+
+
+def set_memory_config_default(value: Optional[bool]):
+    """Install the process-level ``telemetry.memory`` resolution
+    default (engine init; None clears)."""
+    global _CONFIG_DEFAULT
+    _CONFIG_DEFAULT = None if value is None else bool(value)
+
+
+def memory_enabled(config_default: Optional[bool] = None) -> bool:
+    """Resolution order (the repo's env-wins convention):
+    ``DS_MEM_LEDGER`` env > the ``telemetry.memory`` config value the
+    caller passes > the process default an engine installed > on."""
+    env = os.environ.get(MEM_ENV, "").strip()
+    if env:
+        return env not in ("0", "false", "off")
+    if config_default is not None:
+        return bool(config_default)
+    if _CONFIG_DEFAULT is not None:
+        return _CONFIG_DEFAULT
+    return True
+
+
+def device_memory_stats(device_index: int = 0) -> Dict[str, int]:
+    """Device memory stats through the accelerator abstraction (NOT a
+    raw ``jax.devices()[0].memory_stats()`` — the CPU-degraded probe
+    must stay consistent everywhere; ISSUE 14 satellite).  ``{}`` when
+    the backend has no stats (CPU) — callers must skip fraction math
+    rather than report against made-up limits."""
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+        return dict(get_accelerator().memory_stats(device_index) or {})
+    except Exception:           # no backend at all (early import, tests)
+        return {}
+
+
+def hbm_used_fraction(stats: Optional[Dict[str, int]] = None
+                      ) -> Optional[float]:
+    """bytes_in_use / bytes_limit, or None when either is unknown —
+    no fictitious fractions on backends without memory stats."""
+    s = device_memory_stats() if stats is None else stats
+    limit = s.get("bytes_limit") or 0
+    if not limit:
+        return None
+    return float(s.get("bytes_in_use", 0)) / float(limit)
+
+
+class MemoryLedger:
+    """Per-(tier, owner) live-byte attribution with high-watermarks and
+    an allocation-failure forensics ring.
+
+    Writers (``set_bytes``/``add_bytes``/``record_alloc_failure``) take
+    the ledger lock; every read path copies dicts under the GIL — no
+    reader can deadlock on a wedged writer."""
+
+    def __init__(self, max_failures: int = DEFAULT_MAX_FAILURES):
+        self._lock = threading.Lock()
+        #: (tier, owner) -> live bytes
+        self._owners: Dict[tuple, float] = {}
+        #: (tier, owner) -> caller-supplied detail dict
+        self._detail: Dict[tuple, Dict[str, Any]] = {}
+        #: (tier, owner) -> high-watermark bytes
+        self._owner_peak: Dict[tuple, float] = {}
+        #: tier -> high-watermark of the tier TOTAL
+        self._tier_peak: Dict[str, float] = {}
+        #: device-stats watermark (bytes_in_use peak; observe_device)
+        self._hbm_peak = 0.0
+        self._failures: collections.deque = collections.deque(
+            maxlen=max(int(max_failures), 1))
+        self.alloc_failures = 0
+
+    # ------------------------------------------------------------ writers
+    def _store_locked(self, key: tuple, v: float,
+                      detail: Optional[Dict[str, Any]]):
+        """One owner write + watermark maintenance; caller holds the
+        lock."""
+        tier = key[0]
+        self._owners[key] = v
+        if detail:
+            self._detail[key] = dict(detail)
+        if v > self._owner_peak.get(key, 0.0):
+            self._owner_peak[key] = v
+        total = sum(b for (t, _), b in self._owners.items()
+                    if t == tier)
+        if total > self._tier_peak.get(tier, 0.0):
+            self._tier_peak[tier] = total
+
+    def set_bytes(self, tier: str, owner: str, nbytes,
+                  **detail) -> float:
+        """Set one owner's live bytes in a tier (absolute, idempotent —
+        per-step taps re-set rather than accumulate).  ``detail`` keys
+        ride into ``/debug/memory`` and ``memory.json`` (the params
+        owner carries its dtype/quantized split here)."""
+        if tier not in TIERS:
+            raise ValueError(f"tier={tier!r}: one of {TIERS}")
+        v = float(max(nbytes, 0))
+        with self._lock:
+            self._store_locked((tier, owner), v, detail)
+        return v
+
+    def add_bytes(self, tier: str, owner: str, delta) -> float:
+        """Relative update, atomic under the ledger lock (concurrent
+        adders must not lose increments)."""
+        if tier not in TIERS:
+            raise ValueError(f"tier={tier!r}: one of {TIERS}")
+        key = (tier, owner)
+        with self._lock:
+            v = max(self._owners.get(key, 0.0) + float(delta), 0.0)
+            self._store_locked(key, v, None)
+        return v
+
+    def observe_device(self) -> Dict[str, int]:
+        """Sample the accelerator's memory stats, tracking the
+        bytes_in_use high-watermark; returns the stats (``{}`` on
+        backends without them)."""
+        stats = device_memory_stats()
+        used = float(stats.get("bytes_in_use", 0) or 0)
+        if used:
+            with self._lock:
+                if used > self._hbm_peak:
+                    self._hbm_peak = used
+        return stats
+
+    def record_alloc_failure(self, site: str, flightrec=None,
+                             **detail) -> Dict[str, Any]:
+        """OOM forensics: one allocation failure (a denied ``kv.alloc``,
+        a compile-time OOM, a failed host pin) snapshots the ledger —
+        per-tier owner bytes at the moment of failure plus the device
+        stats — into the bounded failure ring AND the flight recorder
+        (kind ``mem/alloc_failure``), so the post-mortem bundle can
+        answer "what held the bytes when this failed"."""
+        stats = self.observe_device()
+        with self._lock:
+            owners = dict(self._owners)
+            self.alloc_failures += 1
+        event = {
+            "ts": round(time.time(), 3),
+            "site": site,
+            "detail": dict(detail),
+            "tiers": {t: int(sum(b for (tt, _), b in owners.items()
+                                 if tt == t)) for t in TIERS},
+            "owners": {f"{t}/{o}": int(b)
+                       for (t, o), b in sorted(owners.items())},
+        }
+        if stats:
+            event["device"] = {k: int(v) for k, v in stats.items()
+                               if isinstance(v, (int, float))}
+        with self._lock:
+            self._failures.append(event)
+        if flightrec is None:
+            from deepspeed_tpu.telemetry.flight_recorder import \
+                get_flight_recorder
+            flightrec = get_flight_recorder()
+        flightrec.record("mem/alloc_failure", site=site,
+                         tiers=event["tiers"], **detail)
+        return event
+
+    # ------------------------------------------------------------ readers
+    def owner_bytes(self, tier: str, owner: str) -> float:
+        return self._owners.get((tier, owner), 0.0)
+
+    def tier_bytes(self, tier: str) -> float:
+        owners = dict(self._owners)
+        return sum(b for (t, _), b in owners.items() if t == tier)
+
+    def failures(self):
+        return list(self._failures)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/memory`` / ``memory.json`` body: per-tier owner
+        tables with watermarks, device stats, and the failure ring —
+        all from GIL-atomic dict copies (lock-free read contract)."""
+        owners = dict(self._owners)
+        detail = dict(self._detail)
+        owner_peak = dict(self._owner_peak)
+        tier_peak = dict(self._tier_peak)
+        # read-only device probe: no ledger lock, no peak mutation —
+        # the /debug/memory reader must not touch ANY lock a wedged
+        # writer could be holding
+        stats = device_memory_stats()
+        tiers: Dict[str, Any] = {}
+        for t in TIERS:
+            rows = {}
+            for (tt, o), b in sorted(owners.items()):
+                if tt != t:
+                    continue
+                row = {"bytes": int(b),
+                       "watermark_bytes": int(owner_peak.get((tt, o), b))}
+                d = detail.get((tt, o))
+                if d:
+                    row["detail"] = d
+                rows[o] = row
+            total = sum(b for (tt, _), b in owners.items() if tt == t)
+            if rows or tier_peak.get(t):
+                tiers[t] = {"total_bytes": int(total),
+                            "watermark_bytes": int(tier_peak.get(t, total)),
+                            "owners": rows}
+        out: Dict[str, Any] = {
+            "ts": round(time.time(), 3),
+            "tiers": tiers,
+            "alloc_failures": self.alloc_failures,
+            "failures": list(self._failures),
+        }
+        if stats:
+            dev = {k: int(v) for k, v in stats.items()
+                   if isinstance(v, (int, float))}
+            frac = hbm_used_fraction(stats)
+            if frac is not None:
+                dev["used_fraction"] = round(frac, 4)
+            dev["watermark_bytes"] = int(max(self._hbm_peak,
+                                             dev.get("bytes_in_use", 0)))
+            out["device_stats"] = dev
+        return out
+
+    # ---------------------------------------------------------- exposition
+    def publish(self, registry) -> Dict[str, int]:
+        """``mem/*`` gauges into a metrics registry (rendered by both
+        /metrics surfaces).  Device-stat gauges appear only when the
+        backend reports them — no fictitious limits on CPU.  Returns
+        the device stats it sampled so per-step callers can derive the
+        used fraction without a second accelerator probe."""
+        owners = dict(self._owners)
+        totals: Dict[str, float] = {}
+        for (t, o), b in owners.items():
+            registry.set_gauge("mem/owner_bytes", b, tier=t, owner=o)
+            totals[t] = totals.get(t, 0.0) + b
+        for t, total in totals.items():
+            registry.set_gauge("mem/tier_bytes", total, tier=t)
+        for t, peak in dict(self._tier_peak).items():
+            registry.set_gauge("mem/tier_watermark_bytes", peak, tier=t)
+        registry.set_counter("mem/alloc_failures",
+                             float(self.alloc_failures))
+        stats = self.observe_device()
+        if stats:
+            registry.set_gauge("mem/hbm_used_bytes",
+                               float(stats.get("bytes_in_use", 0)))
+            if stats.get("bytes_limit"):
+                registry.set_gauge("mem/hbm_limit_bytes",
+                                   float(stats["bytes_limit"]))
+            frac = hbm_used_fraction(stats)
+            if frac is not None:
+                registry.set_gauge("mem/hbm_used_fraction", round(frac, 4))
+        return stats
+
+    def publish_and_feed(self, registry, anomaly=None,
+                         corr: Optional[str] = None):
+        """The per-step tap both the engine and the serving scheduler
+        run: publish the ``mem/*`` gauges and — where the backend
+        reports device stats — feed the HBM used fraction into the
+        rolling anomaly detector as ``mem_hbm`` (a leak flags as a
+        one-sided outlier BEFORE the OOM).  One accelerator probe per
+        call: the fraction derives from publish()'s own sample."""
+        stats = self.publish(registry)
+        if anomaly is None:
+            return
+        frac = hbm_used_fraction(stats) if stats else None
+        if frac is not None:
+            anomaly.observe("mem_hbm", frac, corr=corr)
+
+    def reset(self):
+        with self._lock:
+            self._owners.clear()
+            self._detail.clear()
+            self._owner_peak.clear()
+            self._tier_peak.clear()
+            self._failures.clear()
+            self._hbm_peak = 0.0
+            self.alloc_failures = 0
+
+
+# -------------------------------------------------- owner attribution
+def attribute_params(ledger: MemoryLedger, params, *,
+                     tier: str = "device", owner: str = "params",
+                     stream: Optional[Dict[str, int]] = None
+                     ) -> Dict[str, int]:
+    """Attribute a model's parameter bytes into the ledger, split
+    dtype/quantized via the costmodel ``param_stream_bytes`` walk (the
+    SAME math serve_bench/decode_profile floors use, so the ledger and
+    the perf observatory can never disagree about param bytes).
+    ``stream`` short-circuits the walk when the caller already holds a
+    ``param_stream_bytes`` result (the serving scheduler's cost
+    stream)."""
+    if stream is None:
+        from deepspeed_tpu.telemetry.costmodel import param_stream_bytes
+        stream = param_stream_bytes(params)
+    total = (stream.get("dense_int8_bytes", 0)
+             + stream.get("expert_int8_bytes", 0)
+             + stream.get("plain_bytes", 0))
+    ledger.set_bytes(
+        tier, owner, total,
+        dense_int8_bytes=int(stream.get("dense_int8_bytes", 0)),
+        expert_int8_bytes=int(stream.get("expert_int8_bytes", 0)),
+        plain_bytes=int(stream.get("plain_bytes", 0)))
+    return stream
+
+
+def tree_bytes(tree) -> int:
+    """Concrete leaf bytes of a pytree (KV pools, optimizer state):
+    ``size * itemsize`` per array leaf, non-arrays skipped."""
+    import jax
+    import numpy as np
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+        except (TypeError, AttributeError, ValueError):
+            continue
+    return total
+
+
+def compiled_memory_stats(fn, *args) -> Optional[Dict[str, int]]:
+    """Activation-peak accounting from a compiled program's
+    ``memory_analysis()`` (argument/output/temp/generated-code bytes)
+    where the backend supports it; None where it doesn't.  Costs a full
+    XLA compile — callers gate it (``DS_MEM_COMPILED=1``)."""
+    import jax
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        mem = compiled.memory_analysis()
+        if mem is None:
+            return None
+        out = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                out[k] = int(v)
+        return out or None
+    except Exception:
+        return None
+
+
+# ------------------------------------------------- process-wide ledger
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[MemoryLedger] = None
+
+
+def get_memory_ledger() -> MemoryLedger:
+    """The process-wide ledger (created on first use).  Subsystems
+    wanting isolation construct their own MemoryLedger (tests)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MemoryLedger()
+        return _GLOBAL
+
+
+def reset_memory_ledger():
+    """Tests: drop the process-wide ledger so the next get() is
+    fresh."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

@@ -40,8 +40,17 @@ ENV_VARS = {
     "DS_HBM_GBPS": "per-device HBM bandwidth (GB/s) for roofline floors "
                    "(wins over the device-kind table; how CPU tier-1 "
                    "exercises floor math)",
+    "DS_MEM_COMPILED": "1 arms the one-time compiled-program "
+                       "memory_analysis activation-peak probe (a full "
+                       "extra XLA compile of the train step)",
+    "DS_MEM_LEDGER": "0/1 disables/forces the tiered memory ledger "
+                     "taps (wins over telemetry.memory)",
     "DS_MOE_DISPATCH": "MoE expert-dispatch override: auto/einsum/"
                        "grouped (wins over config)",
+    "DS_NVME_GBPS": "declared swap-device bandwidth (GB/s) for the "
+                    "swap/achieved_vs_floor gauges (no by-kind table: "
+                    "the NVMe part is unknowable from JAX — no "
+                    "fictitious floors)",
     "DS_PEAK_FLOPS": "per-device peak FLOPs for MFU math (wins over "
                      "telemetry.peak_flops)",
     "DS_PERF_COSTMODEL": "0/1 disables/forces compiled-program cost "
@@ -104,6 +113,33 @@ METRICS = {
                         "(ms), labeled by program",
     "perf/achieved_vs_floor": "achieved/floor ratio (the live "
                               "N-x-over-floor gap), labeled by program",
+    # --- memory observatory (tiered ledger + OOM forensics, ISSUE 14)
+    "mem/owner_bytes": "live bytes per owner, labeled by tier+owner "
+                       "(params/optimizer/kv_pool/prefix_cache/...)",
+    "mem/tier_bytes": "live bytes per tier (device/host/nvme)",
+    "mem/tier_watermark_bytes": "high-watermark of a tier's total, "
+                                "labeled by tier",
+    "mem/hbm_used_bytes": "device bytes_in_use via the accelerator "
+                          "abstraction (absent on CPU)",
+    "mem/hbm_limit_bytes": "device bytes_limit (absent on CPU)",
+    "mem/hbm_used_fraction": "bytes_in_use/bytes_limit gauge (the "
+                             "anomaly/mem_hbm leak feed; absent on "
+                             "CPU)",
+    "mem/alloc_failures": "allocation failures snapshotted into the "
+                          "OOM forensics ring",
+    # --- offload I/O (swap bandwidth telemetry, ISSUE 14)
+    "swap/in_bytes": "bytes read back from swap (NVMe -> host)",
+    "swap/out_bytes": "bytes written to swap (host -> NVMe)",
+    "swap/ops": "completed swap I/O requests, labeled by op",
+    "swap/op_latency_s": "per-request submit-to-completion latency "
+                         "histogram, labeled op+window",
+    "swap/op_gbps": "per-request achieved bandwidth histogram (GB/s), "
+                    "labeled op+window",
+    "swap/achieved_gbps": "latest achieved swap bandwidth gauge, "
+                          "labeled by op",
+    "swap/achieved_vs_floor": "achieved/declared-DS_NVME_GBPS ratio "
+                              "(only when the floor is declared), "
+                              "labeled by op",
     # --- MoE routing health
     "moe/dispatch_tokens": "tokens routed into expert dispatch",
     "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "

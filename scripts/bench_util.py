@@ -127,6 +127,36 @@ def fetch(x):
     return np.asarray(x)
 
 
+def mem_peak_fields() -> dict:
+    """``mem_peak_*`` record fields from the memory observatory
+    (ISSUE 14 satellite): per-tier high-watermarks (the scheduler /
+    engine taps maintain them during the bench) plus the device HBM
+    peak where the backend reports stats — so ``bench_compare
+    --history`` gates memory regressions like latency ones.  Empty
+    when the ledger never armed (DS_MEM_LEDGER=0)."""
+    try:
+        from deepspeed_tpu.telemetry.memory import get_memory_ledger
+        led = get_memory_ledger()
+        led.observe_device()            # fold the current HBM sample in
+        out = {}
+        payload = led.snapshot()
+        for tier, t in payload["tiers"].items():
+            out[f"mem_peak_{tier}_bytes"] = int(t["watermark_bytes"])
+            for owner in ("kv_pool", "prefix_cache"):
+                row = t["owners"].get(owner)
+                if row is not None:
+                    out[f"mem_peak_{owner}_bytes"] = \
+                        int(row["watermark_bytes"])
+        dev = payload.get("device_stats")
+        if dev and dev.get("watermark_bytes"):
+            out["mem_peak_hbm_bytes"] = int(dev["watermark_bytes"])
+        if led.alloc_failures:
+            out["mem_alloc_failures"] = int(led.alloc_failures)
+        return out
+    except Exception:
+        return {}
+
+
 def timed_chain(step_fn, state0, n, warmup=2):
     """On-device loop slope: run ``m`` and ``5m`` chained ``step_fn``
     applications inside one jitted ``fori_loop`` (a data dependency

@@ -64,12 +64,14 @@ def run(async_save):
     engine.wait_pending_checkpoint()
     barrier = time.time() - t0
     mode = "async" if async_save else "sync"
+    from scripts.bench_util import mem_peak_fields
     detail = {"mode": mode,
               "model": "gpt2:smoke" if SMOKE else "gpt2:350m",
               "baseline_step_s": round(base, 3),
               "save_call_s": round(t_save_call, 3),
               "step_s_during_save": round(during, 3),
-              "commit_barrier_s": round(barrier, 3)}
+              "commit_barrier_s": round(barrier, 3),
+              **mem_peak_fields()}
     from scripts.bench_util import emit_ledger
     emit_ledger({"metric": f"ckpt_bench_{mode}",
                  "value": round(during, 4), "unit": "s_per_step",

@@ -51,10 +51,13 @@ from scripts.bench_util import timed_chain_ms as timed_chain
 def _variant_record(model: str, name: str, step_ms: float) -> dict:
     """Ledger form of one variant row (DS_BENCH_LEDGER=1, ISSUE 13):
     step_ms is the gated value; the model shape rides detail.model so
-    bench_compare's cross-model guard engages."""
+    bench_compare's cross-model guard engages.  ``mem_peak_*`` fields
+    (ISSUE 14) ride detail too, so the history can gate memory
+    regressions beside latency ones."""
+    from scripts.bench_util import mem_peak_fields
     return {"metric": f"decode_profile_{name}", "value": step_ms,
             "unit": "ms_per_step", "direction": "lower_better",
-            "detail": {"model": model}}
+            "detail": {"model": model, **mem_peak_fields()}}
 
 
 def moe_floor_main():
