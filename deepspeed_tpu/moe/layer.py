@@ -209,6 +209,41 @@ def _emit_routing_stats(dispatched, dropped):
     jax.debug.callback(_report_routing, dispatched, dropped)
 
 
+def _report_router_health(entropy, load, max_frac, dead, aux, z):
+    """Registry half of the router-health tap (ISSUE 15 satellite):
+    routing entropy, per-expert load fractions, the hottest expert's
+    share, a dead-expert counter, and the aux/z loss gauges — the
+    collapsed-router signal a loss curve can't show."""
+    import numpy as np
+    reg = _metrics_registry
+    if reg is None:
+        return
+    reg.set_gauge("moe/router_entropy", float(entropy))
+    reg.set_gauge("moe/expert_load_max_fraction", float(max_frac))
+    reg.inc("moe/dead_experts", float(dead))
+    reg.set_gauge("moe/aux_loss", float(aux))
+    reg.set_gauge("moe/z_loss", float(z))
+    for i, f in enumerate(np.asarray(load)):
+        reg.set_gauge("moe/expert_load_fraction", float(f),
+                      expert=str(i))
+
+
+def _emit_router_health(logits, routing, config: MoEConfig):
+    """Host-callback bridge for router health, armed only with the
+    registry tap (the PR 8 contract: observability overhead serving /
+    monitoring opts into).  Values derive from the SAME topk_routing
+    decision both dispatch formulations consume, so einsum and grouped
+    publish identical numbers (parity-tested)."""
+    if _metrics_registry is None:
+        return
+    from deepspeed_tpu.moe.sharded_moe import router_health
+    entropy, load, max_frac, dead = router_health(
+        logits, routing, config.num_experts)
+    jax.debug.callback(
+        _report_router_health, entropy, load, max_frac, dead,
+        routing.l_aux * config.aux_loss_coef, routing.router_z_loss)
+
+
 def _dq(w, dt):
     """Expert weight -> compute dtype.  QuantizedTensor leaves reach the
     einsum path only when a grouped-mode keep-quantized decision was
@@ -258,10 +293,12 @@ def _grouped_moe(params, xt, config: MoEConfig, train: bool, rng):
     T, D = xt.shape
     E, k = config.num_experts, config.top_k
     dt = xt.dtype
+    logits = _routing_logits(params, xt, config)
     routing = topk_routing(
-        _routing_logits(params, xt, config), config.top_k,
+        logits, config.top_k,
         rng if (train and config.noisy_gate_policy) else None,
         config.z_loss_coef)
+    _emit_router_health(logits, routing, config)
     eids = routing.expert_idx.reshape(-1)               # [T*k]
     gates = routing.gate_weights.reshape(-1)            # [T*k] fp32
     tids = jnp.arange(T * k, dtype=jnp.int32) // k
@@ -351,9 +388,15 @@ def moe_layer(params: dict, x: jnp.ndarray, config: MoEConfig,
     logits = wsc(_routing_logits(params, xt, config), tok_sh)
     cf = config.capacity_factor if train else config.eval_capacity_factor
     noise = rng if (train and config.noisy_gate_policy) else None
+    # selection runs ONCE and feeds both the capacity tensors and the
+    # router-health tap — the grouped path consumes the same decision,
+    # so the two modes publish bitwise-identical health numbers
+    routing = topk_routing(logits, config.top_k, noise,
+                           config.z_loss_coef)
+    _emit_router_health(logits, routing, config)
     gate: GateOutput = topkgating(logits, config.top_k, cf,
                                   config.min_capacity, noise,
-                                  config.z_loss_coef)
+                                  config.z_loss_coef, routing=routing)
     combine_w = wsc(gate.combine_weights, tok_sh)
     dispatch_m = wsc(gate.dispatch_mask, tok_sh)
     kept = jnp.sum(dispatch_m.astype(jnp.int32))

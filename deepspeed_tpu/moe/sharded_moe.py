@@ -75,6 +75,37 @@ def topk_routing(logits: jnp.ndarray, k: int,
     return TopKRouting(l_aux, z_loss, expert_idx, gate_weights)
 
 
+def router_health(logits: jnp.ndarray, routing: TopKRouting,
+                  num_experts: int):
+    """Router-health scalars shared BITWISE by both dispatch modes
+    (ISSUE 15 satellite): computed from the same ``topk_routing``
+    decision the einsum and grouped formulations consume, so the two
+    paths can never disagree about the numbers.
+
+    Returns ``(entropy, load_fractions [E], max_load_fraction,
+    dead_experts)``:
+
+    - **entropy** — mean per-token softmax entropy in nats (ln E =
+      uniform router; ~0 = collapsed router);
+    - **load_fractions** — fraction of the T*k routed choices landing
+      on each expert (capacity-free: what the router *asked for*, not
+      what capacity kept);
+    - **max_load_fraction** — the hottest expert's share (1/E =
+      balanced; 1.0 = total collapse);
+    - **dead_experts** — experts that received ZERO choices this step.
+    """
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [T, E]
+    plogp = jnp.where(gates > 0, gates * jnp.log(gates), 0.0)
+    entropy = -jnp.mean(jnp.sum(plogp, axis=-1))
+    flat = routing.expert_idx.reshape(-1)                          # [T*k]
+    counts = jnp.sum(jax.nn.one_hot(flat, num_experts,
+                                    dtype=jnp.float32), axis=0)    # [E]
+    total = jnp.maximum(jnp.sum(counts), 1.0)
+    load = counts / total
+    return (entropy, load, jnp.max(load),
+            jnp.sum((counts == 0).astype(jnp.int32)))
+
+
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
               min_capacity: int, top_k: int = 1) -> int:
     cap = int(num_tokens * top_k / num_experts * capacity_factor)
@@ -109,15 +140,19 @@ def _one_hot_dispatch(indices, gates_for_choice, num_experts, capacity,
 
 def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float = 1.0,
                min_capacity: int = 4, noise_rng: Optional[jax.Array] = None,
-               z_loss_coef: float = 0.0) -> GateOutput:
+               z_loss_coef: float = 0.0,
+               routing: Optional[TopKRouting] = None) -> GateOutput:
     """logits: [T, E].  Generalises top1/top2 (reference keeps them separate).
 
     Load-balancing aux loss follows the reference: E * Σ_e mean_tokens(me) ·
-    fraction_dispatched(ce), computed on the top-1 assignment.
+    fraction_dispatched(ce), computed on the top-1 assignment.  A caller
+    that already holds the :func:`topk_routing` decision (moe_layer's
+    router-health tap) passes it in so the selection runs once.
     """
     T, E = logits.shape
     capacity = _capacity(T, E, capacity_factor, min_capacity, top_k=k)
-    routing = topk_routing(logits, k, noise_rng, z_loss_coef)
+    if routing is None:
+        routing = topk_routing(logits, k, noise_rng, z_loss_coef)
 
     combine_total = jnp.zeros((T, E, capacity), jnp.float32)
     occupancy = jnp.zeros((E,), jnp.int32)

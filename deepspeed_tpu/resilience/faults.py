@@ -74,6 +74,9 @@ KNOWN_FAULT_SITES = {
     "ckpt.publish": "tmp->final atomic rename window of a tag",
     "ckpt.latest": "the 'latest' pointer write",
     "train.step": "one engine train_batch iteration",
+    "train.nonfinite": "NaN-poison one leaf group's gradient inside "
+                       "the fused step (deny; spec param = group "
+                       "index — numerics-provenance chaos)",
     "serve.step": "one serving scheduler iteration (fires outside the "
                   "scheduler lock)",
     "serve.spec": "speculative-decode verify pass (degrades to plain "

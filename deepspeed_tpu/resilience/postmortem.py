@@ -185,6 +185,18 @@ def write_postmortem(out_dir: str, reason: str, *,
         return _write_json(p, payload)
     artifact("memory.json", _memory)
 
+    def _numerics(p):
+        # the training-health snapshot (ISSUE 15): per-group grad-norm
+        # timeline, NaN provenance records, and the determinism
+        # fingerprint stream — a divergence bundle must name the first
+        # offending leaf group without the process
+        from deepspeed_tpu.telemetry.debug import numerics_payload
+        payload = numerics_payload()
+        if not payload.get("armed"):
+            return False            # no training engine — skip
+        return _write_json(p, payload)
+    artifact("numerics.json", _numerics)
+
     tracer = get_tracer()
     if getattr(tracer, "enabled", False):
         def _trace(p):

@@ -335,6 +335,47 @@ class ResilienceConfig(DeepSpeedConfigModel):
                 "choose from 'off', 'manifest', 'full'")
 
 
+class NumericsConfig(DeepSpeedConfigModel):
+    """``telemetry.numerics`` — the training-health observatory
+    (ISSUE 15): in-graph per-leaf-group grad norms + non-finite
+    provenance banked lazily beside the overflow flag, MAD anomaly
+    feeds over grad-norm/loss/update-ratio, and periodic determinism
+    fingerprints (``num/*`` gauges, ``/debug/numerics``, post-mortem
+    ``numerics.json``)."""
+    #: master switch for the in-graph stats + banking; DS_NUMERICS env
+    #: wins.  Off restores the bare grad_norm/overflow scalar pair.
+    enabled: bool = True
+    #: record a blake2 state fingerprint (sampled param leaves + rng
+    #: chain + loss) every N steps as a ``num/fingerprint`` flight
+    #: event; 0 disables the periodic stream (checkpoint manifests are
+    #: always stamped while numerics is on).  DS_FINGERPRINT_INTERVAL
+    #: env wins.
+    fingerprint_interval: int = 0
+    #: leaf-grouping depth: param-tree path components that name a
+    #: group ("blocks/attn_w"); deeper = finer provenance, more
+    #: in-graph scatter-adds
+    group_depth: int = 2
+    #: resolved per-step entries retained for the /debug/numerics
+    #: timeline (loss / grad_norm / loss_scale / update_ratio)
+    history: int = 512
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.fingerprint_interval < 0:
+            raise ValueError(
+                f"telemetry.numerics.fingerprint_interval="
+                f"{self.fingerprint_interval}: must be >= 0 (0 disables "
+                "the periodic fingerprint)")
+        if self.group_depth < 1:
+            raise ValueError(
+                f"telemetry.numerics.group_depth={self.group_depth}: "
+                "must be >= 1")
+        if self.history < 16:
+            raise ValueError(
+                f"telemetry.numerics.history={self.history}: must be "
+                ">= 16")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Unified telemetry (deepspeed_tpu/telemetry/): metrics registry +
     Prometheus exposition, Chrome-trace span tracer, MFU/goodput gauges.
@@ -374,8 +415,17 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #: tier/owner (mem/* gauges, /debug/memory, post-mortem
     #: memory.json, OOM forensics).  DS_MEM_LEDGER env wins.
     memory: bool = True
+    #: training-health observatory (ISSUE 15): in-graph grad-norm
+    #: groups, NaN provenance, determinism fingerprints (num/* gauges,
+    #: /debug/numerics, post-mortem numerics.json)
+    numerics: NumericsConfig = Field(default_factory=NumericsConfig)
 
     def __init__(self, **data):
+        if isinstance(data.get("numerics"), bool):
+            # bool shorthand, matching telemetry.memory's spelling
+            data["numerics"] = NumericsConfig(enabled=data["numerics"])
+        elif isinstance(data.get("numerics"), dict):
+            data["numerics"] = NumericsConfig(**data["numerics"])
         super().__init__(**data)
         if self.flightrec_events < 0:
             raise ValueError(

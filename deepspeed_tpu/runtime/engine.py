@@ -714,6 +714,39 @@ class DeepSpeedEngine:
                 logger.debug(f"memory ledger: attribution failed ({e})")
                 self._mem_on = False
 
+        # numerics observatory (ISSUE 15): per-leaf-group grad stats
+        # computed inside the fused step and banked lazily beside the
+        # overflow flag (NumericsState), periodic determinism
+        # fingerprints, and NaN provenance.  The leaf grouping is built
+        # once from the params template; a structure the grouping can't
+        # walk disables the tier rather than blocking init.
+        from deepspeed_tpu.telemetry.numerics import (
+            configure_numerics, leaf_groups, numerics_enabled,
+            resolve_fingerprint_interval)
+        ncfg = tcfg.numerics
+        self._num_on = tcfg.enabled and numerics_enabled(ncfg.enabled)
+        self._num_groups = None
+        self._num_leaf_group = None
+        self._nf_inject_group = None     # trace-time chaos injection
+        self._last_save_dir = None
+        self.numerics = None
+        self._fp_interval = 0
+        if self._num_on:
+            try:
+                names, index = leaf_groups(self.state["params"],
+                                           depth=ncfg.group_depth)
+                self._num_groups, self._num_leaf_group = names, index
+                self._fp_interval = resolve_fingerprint_interval(
+                    ncfg.fingerprint_interval)
+                self.numerics = configure_numerics(
+                    names, history=ncfg.history,
+                    registry=self.telemetry_registry,
+                    anomaly=self.anomaly, flightrec=self.flightrec,
+                    on_nonfinite=self._numerics_postmortem)
+            except Exception as e:  # observability must never block init
+                logger.debug(f"numerics: leaf grouping failed ({e})")
+                self._num_on = False
+
         self._ltd_keep = None
         self._last_seq_len = 0
         # ---- aux subsystems (reference engine call sites) --------------------
@@ -937,6 +970,14 @@ class DeepSpeedEngine:
             flags = jax.device_get(self._pending_overflow)
             self._skipped_steps += int(np.sum(np.asarray(flags)))
             self._pending_overflow = []
+        # the numerics bank resolves at the same boundaries the
+        # overflow bank does (report boundaries / counter access) —
+        # detection is lazy by construction, never per-step
+        if self.numerics is not None:
+            try:
+                self.numerics.resolve()
+            except Exception as e:
+                logger.debug(f"numerics: resolve failed ({e})")
 
     def _build_monitor(self):
         try:
@@ -1733,8 +1774,25 @@ class DeepSpeedEngine:
         params, opt_state, scaler = (state["params"], state["opt_state"],
                                      state["scaler"])
         scale = scaler.cur_scale if fp16 else jnp.float32(1.0)
+        if (self._nf_inject_group is not None
+                and self._num_leaf_group is not None):
+            # train.nonfinite chaos fault (ISSUE 15): NaN-poison the
+            # chosen leaf group's gradient at TRACE time — the engine
+            # compiles a dedicated step variant per injected group, so
+            # the healthy compiled step is untouched
+            from deepspeed_tpu.telemetry.numerics import inject_nonfinite
+            grads = inject_nonfinite(grads, self._num_leaf_group,
+                                     self._nf_inject_group)
         grads = jax.tree.map(lambda g: g / scale, grads)
         grad_norm = _global_norm(grads)
+        num_stats = None
+        if self._num_leaf_group is not None and self._num_groups:
+            # in-graph numerics stats (ISSUE 15): per-group grad norms
+            # + the non-finite provenance bitmap, device-resident until
+            # the bank resolves (no host sync here)
+            from deepspeed_tpu.telemetry.numerics import group_stats
+            num_stats = group_stats(grads, self._num_leaf_group,
+                                    len(self._num_groups))
         if fp16:
             overflow = has_overflow(grads)
             safe_grads = jax.tree.map(
@@ -1744,6 +1802,17 @@ class DeepSpeedEngine:
             safe_grads = grads
         updates, new_opt = self.optimizer.update(safe_grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        update_ratio = None
+        if num_stats is not None:
+            # ||update|| / ||param||: the step-size health signal (a
+            # collapsing or exploding ratio flags through the MAD
+            # detector as anomaly/num_update_ratio).  Overflow steps
+            # report 0.0 — the update was skipped.
+            unorm = _global_norm(updates)
+            pnorm = _global_norm(params)
+            update_ratio = jnp.where(
+                overflow, jnp.float32(0.0),
+                unorm / jnp.maximum(pnorm, jnp.float32(1e-12)))
         if fp16:
             new_params = jax.tree.map(
                 lambda old, new: jnp.where(overflow, old, new),
@@ -1774,6 +1843,10 @@ class DeepSpeedEngine:
             "overflow": overflow,
             "loss_scale": new_scaler.cur_scale,
         }
+        if num_stats is not None:
+            metrics["num_group_norms"] = num_stats[0]
+            metrics["num_nonfinite"] = num_stats[1]
+            metrics["num_update_ratio"] = update_ratio
         return new_state, metrics
 
     def _grad_out_shardings(self):
@@ -1815,7 +1888,10 @@ class DeepSpeedEngine:
             return self._compiled[key]
         # batch args are pre-placed by _shard_batch (per-leaf ndim-aware
         # shardings), so jit infers their shardings from the arguments.
-        if name == "train_step":
+        if name == "train_step" or name.startswith("train_step@nf"):
+            # @nf<g> variants are the train.nonfinite chaos flavors:
+            # identical build, but _apply_grads reads the trace-time
+            # injection flag the caller holds during the first call
             fn = jax.jit(
                 self._build_train_step(),
                 out_shardings=(self.state_shardings, None),
@@ -2187,17 +2263,31 @@ class DeepSpeedEngine:
             with self.tracer.span("train/optimizer_step", cat="train"):
                 metrics = self._host_apply(grads, loss)
         else:
-            fn = self._get_compiled("train_step")
+            # train.nonfinite chaos injection (ISSUE 15): a firing
+            # fault compiles/reuses a dedicated step variant that
+            # NaN-poisons the chosen leaf group's gradient; the healthy
+            # cached program is untouched and every non-firing step
+            # keeps using it
+            nf_group = self._nonfinite_fault_group()
+            fn = self._get_compiled(
+                "train_step" if nf_group is None
+                else f"train_step@nf{nf_group}")
             rng = self._next_rng()
             self._maybe_cost_report(batch, rng)
             self._maybe_memory_report(batch, rng)
             # one fused program: fwd+bwd+apply dispatch together (the
             # per-phase split lives in the fwd/bwd/step timers when the
             # micro API drives them)
-            with self.tracer.span("train/fused_step", cat="train"), \
-                    self._train_scope(), self._ltd_scope(), \
-                    self._aq_scope():
-                self.state, metrics = fn(self.state, batch, rng)
+            try:
+                # the flag is read at TRACE time (first call of the
+                # @nf variant); it must be live for the call window
+                self._nf_inject_group = nf_group
+                with self.tracer.span("train/fused_step", cat="train"), \
+                        self._train_scope(), self._ltd_scope(), \
+                        self._aq_scope():
+                    self.state, metrics = fn(self.state, batch, rng)
+            finally:
+                self._nf_inject_group = None
         self._finish_step(metrics)
         # syncing on the loss every step costs a device->host round trip
         # (~100 ms on tunneled platforms); only pay it when the user asked
@@ -2324,6 +2414,23 @@ class DeepSpeedEngine:
                                               self._next_rng())
 
     def _finish_step(self, metrics):
+        # numerics bank (ISSUE 15): pull the in-graph stats out of the
+        # metrics dict and bank them as DEVICE scalars keyed by the
+        # step id this step will carry (train-step-N corr) — the same
+        # lazy idiom as _pending_overflow, zero host syncs here
+        num_group_norms = metrics.pop("num_group_norms", None)
+        num_nonfinite = metrics.pop("num_nonfinite", None)
+        num_update_ratio = metrics.pop("num_update_ratio", None)
+        if self.numerics is not None and num_group_norms is not None:
+            self.numerics.bank(
+                self.global_steps + 1,
+                loss=metrics.get("loss"),
+                grad_norm=metrics.get("grad_norm"),
+                overflow=metrics.get("overflow", False),
+                loss_scale=metrics.get("loss_scale"),
+                group_norms=num_group_norms,
+                nonfinite=num_nonfinite,
+                update_ratio=num_update_ratio)
         if self._sanitize_gradients:
             # debug tier: sync and verify the global grad norm.  A loss-scaler
             # overflow is the *handled* non-finite path (the step was skipped
@@ -2331,12 +2438,46 @@ class DeepSpeedEngine:
             overflow = bool(np.asarray(metrics.get("overflow", False)))
             gn = float(np.asarray(metrics["grad_norm"]))
             if not overflow and not np.isfinite(gn):
+                # upgraded from a log line to a post-mortem trigger
+                # (ISSUE 15): resolve the bank so the provenance record
+                # exists, write the terminal bundle (min_interval_s=0 —
+                # the raise below may kill the run, so the flap rate
+                # limit must not suppress its only bundle), and name
+                # the first offending leaf group in the raise
+                prov = None
+                if self.numerics is not None:
+                    try:
+                        self.numerics.resolve(emit_postmortem=False)
+                        prov = self.numerics.last_nonfinite()
+                    except Exception:
+                        prov = None
+                first = prov["first_group"] if prov else "<unknown>"
+                try:
+                    from deepspeed_tpu.resilience.postmortem import \
+                        write_postmortem
+                    write_postmortem(
+                        self._postmortem_dir(),
+                        f"non-finite gradient norm {gn} at step "
+                        f"{self.global_steps + 1} (first group {first})",
+                        step=self.global_steps + 1,
+                        registry=self.telemetry_registry,
+                        flightrec=self.flightrec,
+                        min_interval_s=0.0)
+                except Exception as e:  # the raise below is the signal
+                    logger.warning(f"numerics: terminal bundle failed "
+                                   f"({e})")
                 raise FloatingPointError(
                     f"sanitize_gradients: non-finite gradient norm {gn} at "
-                    f"step {self.global_steps + 1} (loss="
+                    f"step {self.global_steps + 1} (first offending leaf "
+                    f"group: {first}; loss="
                     f"{float(np.asarray(metrics['loss']))}); enable "
                     "debug.debug_nans to locate the faulting primitive")
         self.global_steps += 1
+        if (self._fp_interval and self.numerics is not None
+                and self.global_steps % self._fp_interval == 0):
+            # determinism fingerprint (ISSUE 15): one bounded host
+            # fetch every fingerprint_interval steps, by design
+            self._record_fingerprint(loss=metrics.get("loss"))
         self.global_samples += self.train_batch_size()
         if self.progressive_layer_drop is not None:
             # reference engine.py:1755: PLD theta advances per step; models
@@ -2393,6 +2534,14 @@ class DeepSpeedEngine:
             self.monitor.write_events(events)
         if (self._config.steps_per_print and
                 self.global_steps % self._config.steps_per_print == 0):
+            if self.numerics is not None:
+                # report boundary: the print below syncs on the metrics
+                # anyway, so the banked numerics resolve here for free
+                # (non-fp16 runs have no overflow bank to ride)
+                try:
+                    self.numerics.resolve()
+                except Exception as e:
+                    logger.debug(f"numerics: resolve failed ({e})")
             loss = metrics.get("loss")
             msg = f"step={self.global_steps}"
             if loss is not None:
@@ -2455,6 +2604,71 @@ class DeepSpeedEngine:
         except Exception as e:          # noqa: BLE001 — best-effort
             from deepspeed_tpu.utils.logging import logger
             logger.debug(f"memory ledger: compiled analysis failed: {e}")
+
+    def _postmortem_dir(self) -> str:
+        """Training-side bundle placement (the preemption.py rules):
+        an explicit ``resilience.postmortem_dir`` wins ("" disables —
+        write_postmortem no-ops on a falsy dir); None means "next to
+        the checkpoints".  Before the first save there IS no "next to
+        the checkpoints": bundles stay off rather than surprising the
+        working directory (a run that never checkpoints is a run that
+        opted out of durable state)."""
+        configured = self._config.resilience_config.postmortem_dir
+        if configured is not None:
+            return configured
+        if self._last_save_dir:
+            return self._last_save_dir
+        logger.debug("numerics: no postmortem dir yet (no checkpoint "
+                     "save_dir; set resilience.postmortem_dir to "
+                     "capture bundles before the first save)")
+        return ""
+
+    def _numerics_postmortem(self, prov):
+        """NumericsState nonfinite callback: an unexpected non-finite
+        step detected at bank resolution writes a forensic bundle
+        (numerics.json carries the provenance record).  Default rate
+        limit — a diverged run resolves many non-finite steps, and one
+        bundle per window is the record that matters."""
+        from deepspeed_tpu.resilience.postmortem import write_postmortem
+        write_postmortem(
+            self._postmortem_dir(),
+            f"non-finite gradients at step {prov.get('step')} "
+            f"(first group {prov.get('first_group')})",
+            step=prov.get("step"),
+            registry=self.telemetry_registry,
+            flightrec=self.flightrec)
+
+    def _record_fingerprint(self, loss=None):
+        """Digest (sampled param leaves, rng chain, step, loss) into
+        the fingerprint stream (num/fingerprint flight event).  Costs
+        one bounded host fetch — only called at the configured
+        interval / checkpoint boundaries; never raises into the step."""
+        from deepspeed_tpu.telemetry.numerics import state_fingerprint
+        try:
+            digest = state_fingerprint(
+                self.state["params"], np.asarray(self._rng),
+                step=self.global_steps, loss=loss)
+        except Exception as e:
+            logger.debug(f"numerics: fingerprint failed ({e})")
+            return None
+        return self.numerics.record_fingerprint(self.global_steps, digest)
+
+    def _nonfinite_fault_group(self):
+        """The ``train.nonfinite`` chaos site (ISSUE 15): a ``deny``
+        fault whose param names the leaf-group index to NaN-poison this
+        step (``train.nonfinite:deny=2@4`` — inject into group 2 at the
+        5th step).  Fires only on the fused path with numerics armed
+        (the injection rides the in-graph stats' leaf grouping)."""
+        inj = self.fault_injector
+        if not inj or self._num_leaf_group is None:
+            return None
+        if not inj.deny("train.nonfinite"):
+            return None
+        spec = next((s for s in inj.specs
+                     if s.site == "train.nonfinite"), None)
+        g = int(spec.param) if spec is not None and spec.param is not None \
+            else 0
+        return g % max(len(self._num_groups), 1)
 
     def _record_step_telemetry(self, duration_s: float):
         """Per-step registry update + monitor bridge (ISSUE 4): step
@@ -2607,6 +2821,25 @@ class DeepSpeedEngine:
             import numpy as _np
             save_src = jax.tree.map(lambda a: _np.array(a, copy=True),
                                     self.state)
+        self._last_save_dir = save_dir
+        if self.numerics is not None:
+            # determinism fingerprint stamped into the manifest
+            # (ISSUE 15): load_checkpoint recomputes it from the
+            # restored state, so a perturbed/corrupted restore is
+            # flagged at restore time (num/fingerprint_mismatch)
+            try:
+                from deepspeed_tpu.telemetry.numerics import \
+                    state_fingerprint
+                extra["numerics_fingerprint"] = {
+                    "step": step,
+                    "digest": state_fingerprint(
+                        save_src["params"], np.asarray(self._rng),
+                        step=step)}
+                self.numerics.record_fingerprint(
+                    step, extra["numerics_fingerprint"]["digest"],
+                    source="checkpoint")
+            except Exception as e:
+                logger.debug(f"numerics: save fingerprint failed ({e})")
         ckpt_corr = f"ckpt-{tag}"
         ckpt_t0 = time.perf_counter()
         with self.tracer.span("ckpt/stage", cat="ckpt", corr=ckpt_corr,
@@ -2826,6 +3059,29 @@ class DeepSpeedEngine:
         if extra.get("rng_key") is not None:
             self._rng = jnp.asarray(extra["rng_key"],
                                     dtype=self._rng.dtype)
+        fp = extra.get("numerics_fingerprint")
+        if fp and self.numerics is not None and not load_module_only:
+            # fingerprint audit (ISSUE 15): recompute the digest from
+            # the restored state and compare against the manifest stamp
+            # — restore==uninterrupted becomes a checked claim, and a
+            # deliberately perturbed restore is flagged loudly
+            try:
+                from deepspeed_tpu.telemetry.numerics import \
+                    state_fingerprint
+                actual = state_fingerprint(
+                    self.state["params"], np.asarray(self._rng),
+                    step=self.global_steps)
+                ok = self.numerics.record_restore_audit(
+                    self.global_steps, fp.get("digest", ""), actual)
+                if not ok:
+                    logger.warning(
+                        f"numerics: restored state fingerprint MISMATCH "
+                        f"for tag {tag!r} at step {self.global_steps} — "
+                        f"the restored state is not the state that was "
+                        f"saved (expected {fp.get('digest')}, got "
+                        f"{actual})")
+            except Exception as e:
+                logger.debug(f"numerics: restore audit failed ({e})")
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, extra.get("client_state", {})
 

@@ -22,6 +22,9 @@ Endpoints:
   GET  /debug/perf       per-program cost table + roofline floors +
                          live achieved-vs-floor (?program= filter;
                          ISSUE 13)
+  GET  /debug/numerics   training-health bank: per-group grad norms,
+                         NaN provenance, fingerprints (?n=, ?group=;
+                         ISSUE 15)
   GET  /debug/memory     tiered byte ledger (tiers × owners with
                          watermarks), OOM forensics ring, and the
                          swap I/O summary (?tier= filter; ISSUE 14)
@@ -242,6 +245,7 @@ class _Handler(BaseHTTPRequestHandler):
         from deepspeed_tpu.telemetry.debug import (flightrec_payload,
                                                    format_thread_stacks,
                                                    memory_payload,
+                                                   numerics_payload,
                                                    parse_debug_query,
                                                    perf_payload)
         route, query = parse_debug_query(self.path)
@@ -271,6 +275,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if route == "/debug/memory":
             self._send_json(200, memory_payload(query))
+            return
+        if route == "/debug/numerics":
+            # training-health bank (ISSUE 15): answers on a serving
+            # process too ({"armed": false} without a training engine —
+            # peek, never create)
+            self._send_json(200, numerics_payload(query))
             return
         self._send_json(404, {"error": f"no route {route}"})
 

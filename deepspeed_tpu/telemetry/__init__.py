@@ -12,6 +12,10 @@ ISSUE 14 adds the memory observatory: a tiered per-owner byte ledger
 with OOM forensics (``mem/*`` gauges, ``/debug/memory``,
 ``memory.json`` in post-mortem bundles) and offload I/O bandwidth
 telemetry over the aio/swap paths (``swap/*``, ``DS_NVME_GBPS``).
+ISSUE 15 adds the numerics observatory: lazily banked in-graph
+training-health stats with NaN provenance, MoE router health, and
+determinism fingerprints (``num/*`` gauges, ``/debug/numerics``,
+``numerics.json`` in post-mortem bundles).
 """
 from deepspeed_tpu.telemetry.registry import (      # noqa: F401
     COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
@@ -40,7 +44,11 @@ from deepspeed_tpu.telemetry.memory import (        # noqa: F401
     memory_enabled, reset_memory_ledger, tree_bytes)
 from deepspeed_tpu.telemetry.iostat import (        # noqa: F401
     IoStat, NVME_GBPS_ENV, get_iostat, nvme_bytes_per_s, reset_iostat)
+from deepspeed_tpu.telemetry.numerics import (      # noqa: F401
+    FINGERPRINT_ENV, NUMERICS_ENV, NumericsState, configure_numerics,
+    group_stats, leaf_groups, numerics_enabled, peek_numerics,
+    reset_numerics, resolve_fingerprint_interval, state_fingerprint)
 from deepspeed_tpu.telemetry.debug import (         # noqa: F401
     flightrec_payload, format_thread_stacks, memory_payload,
-    parse_debug_query, perf_payload)
+    numerics_payload, parse_debug_query, perf_payload)
 from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

@@ -22,6 +22,14 @@ The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
   lock-free contract: ledger/iostat snapshots are GIL-atomic dict
   copies, never a scheduler lock — "where did the bytes go" must be
   answerable while the step that ran out of them is wedged.
+- ``numerics_payload()`` — the ``/debug/numerics`` JSON body
+  (ISSUE 15): the training-health bank (per-leaf-group grad norms,
+  loss/loss-scale/update-ratio timeline, NaN provenance records,
+  determinism fingerprint stream, restore audits).  Resolving the
+  lazily banked device records IS the read path — it takes only the
+  bank's own lock plus one device fetch, never an engine/scheduler
+  lock, and a GET on a process without an armed bank answers
+  ``{"armed": false}`` without creating one (the peek contract).
 - ``parse_debug_query()`` — tiny query-string parsing shared by both
   HTTP front doors.
 
@@ -102,6 +110,40 @@ def memory_payload(query: Optional[Dict[str, str]] = None
     if want:
         payload["tiers"] = {k: v for k, v in payload["tiers"].items()
                             if k == want}
+    return payload
+
+
+def numerics_payload(query: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, Any]:
+    """The ``/debug/numerics`` body: group-norm table + health
+    timeline + NaN provenance + fingerprints.  ``?n=<N>`` bounds the
+    history tail (default 64); ``?group=<substring>`` filters the
+    per-group norms in each returned entry."""
+    from deepspeed_tpu.telemetry.numerics import peek_numerics
+    state = peek_numerics()
+    if state is None:
+        return {"armed": False, "groups": [], "history": [],
+                "nonfinite": {"unexpected_steps": 0, "overflow_steps": 0,
+                              "records": []},
+                "fingerprints": [], "restore_audits": []}
+    payload = state.snapshot()
+    payload["armed"] = True
+    query = query or {}
+    try:
+        last_n = int(query.get("n", 64))
+    except ValueError:
+        last_n = 64
+    payload["history"] = payload["history"][-last_n:]
+    want = query.get("group")
+    if want:
+        keep = [i for i, g in enumerate(payload["groups"])
+                if want in g]
+        payload["groups"] = [payload["groups"][i] for i in keep]
+        for entry in payload["history"]:
+            norms = entry.get("group_norms")
+            if norms:
+                entry["group_norms"] = [norms[i] for i in keep
+                                        if i < len(norms)]
     return payload
 
 

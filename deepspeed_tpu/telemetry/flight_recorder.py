@@ -68,6 +68,13 @@ KNOWN_EVENT_KINDS = {
     "mem/alloc_failure": "an allocation failed (denied kv.alloc / OOM) "
                          "and the memory ledger was snapshotted into "
                          "the forensics ring (ISSUE 14)",
+    "num/nonfinite": "a train step produced non-finite gradients; the "
+                     "first offending leaf group is in the fields "
+                     "(handled=true for loss-scaler overflow skips; "
+                     "ISSUE 15)",
+    "num/fingerprint": "a determinism fingerprint was recorded "
+                       "(interval stream, checkpoint stamp, or restore "
+                       "audit — source/digest/ok in fields; ISSUE 15)",
     "postmortem": "a post-mortem bundle was written",
 }
 

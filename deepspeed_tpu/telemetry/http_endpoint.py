@@ -22,6 +22,9 @@ Routes:
   ``/debug/memory``    tiered byte ledger + OOM forensics ring + swap
                        I/O summary (``?tier=`` filter; lock-free,
                        ISSUE 14)
+  ``/debug/numerics``  training-health bank: per-group grad norms,
+                       NaN provenance, fingerprint stream (``?n=``,
+                       ``?group=`` filters; ISSUE 15)
 """
 import json
 import threading
@@ -53,7 +56,8 @@ class MetricsServer:
             def do_GET(self):
                 from deepspeed_tpu.telemetry.debug import (
                     flightrec_payload, format_thread_stacks,
-                    memory_payload, parse_debug_query, perf_payload)
+                    memory_payload, numerics_payload, parse_debug_query,
+                    perf_payload)
                 from deepspeed_tpu.telemetry.flight_recorder import \
                     get_flight_recorder
                 route, query = parse_debug_query(self.path)
@@ -76,6 +80,10 @@ class MetricsServer:
                 elif route == "/debug/memory":
                     body = json.dumps(memory_payload(query)).encode()
                     code, ctype = 200, "application/json"
+                elif route == "/debug/numerics":
+                    body = json.dumps(numerics_payload(query),
+                                      default=str).encode()
+                    code, ctype = 200, "application/json"
                 else:
                     body = f"no route {route}\n".encode()
                     code, ctype = 404, "text/plain"
@@ -93,7 +101,7 @@ class MetricsServer:
         logger.info(f"telemetry: metrics endpoint on "
                     f"http://{self.host}:{self.port}/metrics "
                     f"(+ /healthz, /debug/stacks, /debug/flightrec, "
-                    f"/debug/perf, /debug/memory)")
+                    f"/debug/perf, /debug/memory, /debug/numerics)")
         return self
 
     def stop(self):

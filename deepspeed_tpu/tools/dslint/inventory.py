@@ -50,6 +50,7 @@ SUBMODELS = {
     "serving.chunked_prefill": "ChunkedPrefillConfig",
     "serving.fleet": "FleetConfig",
     "resilience.retry": "RetryConfig",
+    "telemetry.numerics": "NumericsConfig",
 }
 DICT_SUBMODELS = {
     "serving.slo.classes": "SLOClassConfig",

@@ -47,6 +47,13 @@ ENV_VARS = {
                      "taps (wins over telemetry.memory)",
     "DS_MOE_DISPATCH": "MoE expert-dispatch override: auto/einsum/"
                        "grouped (wins over config)",
+    "DS_NUMERICS": "0/1 disables/forces the numerics observatory "
+                   "(in-graph grad stats + NaN provenance; wins over "
+                   "telemetry.numerics.enabled)",
+    "DS_FINGERPRINT_INTERVAL": "steps between determinism "
+                               "fingerprints (wins over telemetry."
+                               "numerics.fingerprint_interval; 0 "
+                               "disables the periodic stream)",
     "DS_NVME_GBPS": "declared swap-device bandwidth (GB/s) for the "
                     "swap/achieved_vs_floor gauges (no by-kind table: "
                     "the NVMe part is unknowable from JAX — no "
@@ -145,6 +152,33 @@ METRICS = {
     "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "
                           "grouped pins 0)",
     "moe_drop_fraction": "dropped/dispatched fraction gauge",
+    "moe/router_entropy": "mean per-token routing entropy in nats "
+                          "(ln E = uniform, ~0 = collapsed router)",
+    "moe/expert_load_max_fraction": "hottest expert's share of routed "
+                                    "choices (1/E = balanced)",
+    "moe/expert_load_fraction": "per-expert share of routed choices, "
+                                "labeled by expert",
+    "moe/dead_experts": "experts that received zero routed choices, "
+                        "counted per routing step",
+    "moe/aux_loss": "weighted load-balancing aux loss gauge",
+    "moe/z_loss": "router z-loss gauge",
+    # --- numerics observatory (training health, ISSUE 15)
+    "num/grad_norm": "last resolved global gradient norm (-1 = "
+                     "non-finite)",
+    "num/loss": "last resolved training loss gauge",
+    "num/loss_scale": "last resolved dynamic loss scale (the "
+                      "loss-scale timeline's live point)",
+    "num/update_ratio": "last resolved ||update||/||param|| step-size "
+                        "health gauge",
+    "num/group_grad_norm": "per-leaf-group gradient norm, labeled by "
+                           "group (-1 = non-finite)",
+    "num/nonfinite_steps": "steps with non-finite gradients, labeled "
+                           "handled (loss-scaler overflow) vs "
+                           "unexpected",
+    "num/fingerprints": "determinism fingerprints recorded (interval "
+                        "stream + checkpoint stamps)",
+    "num/fingerprint_mismatch": "restores whose recomputed fingerprint "
+                                "disagreed with the manifest stamp",
     # --- serving: request lifecycle counters
     "serving/received": "requests accepted into the queue",
     "serving/completed": "requests finished",

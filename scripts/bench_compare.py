@@ -52,7 +52,11 @@ from typing import Dict, List, Optional, Tuple
 #: name fragments implying "smaller is better" (substring match)
 LOWER_BETTER_HINTS = ("latency", "wait", "duration", "prefill_tokens",
                       "rolled_back", "evict", "miss", "violation",
-                      "recomputed", "preemption")
+                      "recomputed", "preemption",
+                      # convergence guards (ISSUE 15): a loss or
+                      # grad-norm jump in a bench detail is a
+                      # regression like a latency jump is
+                      "loss", "grad_norm")
 #: time-unit suffixes (suffix-only: "_s" mid-name would misfire on
 #: every "..._serve..." metric)
 LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_sec", "_us")

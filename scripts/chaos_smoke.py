@@ -323,6 +323,53 @@ def case_chunk_fault_resumes_from_cursor():
     sched.block_mgr.check_invariant()
 
 
+def case_nonfinite_provenance():
+    """train.nonfinite fault (ISSUE 15): a NaN injected into a chosen
+    leaf group's gradient is attributed to exactly that group by the
+    lazily banked provenance, and the detection writes a post-mortem
+    bundle whose numerics.json carries the record."""
+    import json
+    import tempfile
+    import deepspeed_tpu
+    from deepspeed_tpu.resilience.postmortem import reset_rate_limit
+    from deepspeed_tpu.telemetry.numerics import (peek_numerics,
+                                                  reset_numerics)
+    reset_numerics()
+    reset_rate_limit()
+    with tempfile.TemporaryDirectory() as tmp:
+        import os as _os
+        from deepspeed_tpu.models.gpt2 import gpt2_model
+        model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                           num_layers=2, num_heads=4, d_model=32,
+                           dtype="float32", attention_impl="xla")
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0,
+               "resilience": {"faults": "train.nonfinite:deny=3@2",
+                              "postmortem_dir": tmp}}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        for i in range(4):
+            _train(engine, seed=100 + i)
+        state = peek_numerics()
+        state.resolve()
+        recs = state.nonfinite_records()
+        assert recs, "no provenance record for the injected NaN"
+        expect = engine._num_groups[3 % len(engine._num_groups)]
+        assert recs[0]["step"] == 3, recs[0]
+        assert recs[0]["first_group"] == expect, recs[0]
+        assert list(recs[0]["groups"]) == [expect], recs[0]
+        bundles = [d for d in _os.listdir(tmp)
+                   if d.startswith("postmortem-")]
+        assert bundles, "nonfinite detection wrote no bundle"
+        with open(_os.path.join(tmp, bundles[0], "numerics.json")) as f:
+            payload = json.load(f)
+        names = [r["first_group"]
+                 for r in payload["nonfinite"]["records"]]
+        assert expect in names, names
+    reset_numerics()
+
+
 def case_fleet_replica_loss_resubmits():
     """Fleet replica loss mid-stream (ISSUE 11): two replicas behind
     the Router, a request decoding on one of them when that replica is
@@ -406,6 +453,8 @@ def main(argv=None):
                   case_chunk_fault_resumes_from_cursor))
     cases.append(("fleet replica loss resubmits mid-stream",
                   case_fleet_replica_loss_resubmits))
+    cases.append(("train.nonfinite NaN attributed to its leaf group",
+                  case_nonfinite_provenance))
 
     results = []
     for name, fn in cases:

@@ -41,6 +41,14 @@ def run(async_save):
         return {"input_ids": rng.integers(
             0, model.config.vocab_size,
             size=(1, mbs, seq), dtype=np.int32)}
+    # per-step loss/grad_norm summaries ride the record detail
+    # (ISSUE 15 satellite): bench_compare --history gates convergence
+    # regressions the same way it gates latency ones
+    losses, grad_norms = [], []
+
+    def track():
+        losses.append(engine.last_metrics.get("loss"))
+        grad_norms.append(engine.last_metrics.get("grad_norm"))
     for _ in range(warm):
         loss = engine.train_batch(batch=batch())
     float(loss)
@@ -48,6 +56,7 @@ def run(async_save):
     t0 = time.time()
     for _ in range(meas):
         loss = engine.train_batch(batch=batch())
+        track()
     float(loss); base = (time.time() - t0) / meas
 
     # save + train while in flight
@@ -57,6 +66,7 @@ def run(async_save):
     t0 = time.time()
     for _ in range(meas):
         loss = engine.train_batch(batch=batch())
+        track()
     float(loss)
     during = (time.time() - t0) / meas
     # commit barrier (async waits here; sync already durable)
@@ -65,12 +75,21 @@ def run(async_save):
     barrier = time.time() - t0
     mode = "async" if async_save else "sync"
     from scripts.bench_util import mem_peak_fields
+    # one fetch for the whole banked set (the numerics idiom)
+    import jax
+    host = jax.device_get([losses, grad_norms])
+    lvals = [float(v) for v in host[0] if v is not None]
+    gvals = [float(v) for v in host[1]
+             if v is not None and np.isfinite(np.float64(v))]
     detail = {"mode": mode,
               "model": "gpt2:smoke" if SMOKE else "gpt2:350m",
               "baseline_step_s": round(base, 3),
               "save_call_s": round(t_save_call, 3),
               "step_s_during_save": round(during, 3),
               "commit_barrier_s": round(barrier, 3),
+              "final_loss": round(lvals[-1], 5) if lvals else None,
+              "mean_grad_norm": round(float(np.mean(gvals)), 5)
+              if gvals else None,
               **mem_peak_fields()}
     from scripts.bench_util import emit_ledger
     emit_ledger({"metric": f"ckpt_bench_{mode}",

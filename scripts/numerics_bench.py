@@ -1,0 +1,92 @@
+"""Numerics-observatory overhead micro-bench (ISSUE 15 satellite).
+
+A/B of the fused train step with the in-graph numerics stats ON
+(per-leaf-group norms + non-finite bitmap + update ratio) vs OFF, on
+the ckpt_bench model shapes.  The contract is <2% step-time overhead at
+bench shapes on-chip; ``NUMERICS_BENCH_STRICT=1`` enforces it (the
+on-chip queue entry — CPU wall-clock at smoke shapes is dominated by
+dispatch noise and is reported, not gated).
+
+``NUMERICS_SMOKE=1`` runs tiny shapes/loops — the tier-1 subprocess
+smoke.  With ``DS_BENCH_LEDGER=1`` the overhead fraction lands in the
+BENCH/ ledger for ``bench_compare --history``.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMOKE = bool(int(os.environ.get("NUMERICS_SMOKE", "0")))
+STRICT = bool(int(os.environ.get("NUMERICS_BENCH_STRICT", "0")))
+
+
+def build(numerics_on: bool):
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    import jax
+    if SMOKE:
+        model = gpt2_model("custom", vocab_size=256, num_layers=2,
+                           num_heads=4, d_model=32, max_seq_len=64)
+        mbs, seq, warm, meas = max(2, len(jax.devices())), 32, 2, 8
+    else:
+        model = gpt2_model("350m", max_seq_len=1024, dtype="bfloat16",
+                           remat=True)
+        mbs, seq, warm, meas = 12, 1024, 3, 10
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": mbs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": not SMOKE},
+        "steps_per_print": 0,
+        "telemetry": {"numerics": {"enabled": numerics_on}}})
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, model.config.vocab_size,
+            size=(1, mbs, seq), dtype=np.int32)}
+    return engine, batch, warm, meas
+
+
+def time_steps(numerics_on: bool) -> float:
+    engine, batch, warm, meas = build(numerics_on)
+    for _ in range(warm):
+        loss = engine.train_batch(batch=batch())
+    float(loss)                       # close the warmup window
+    t0 = time.time()
+    for _ in range(meas):
+        loss = engine.train_batch(batch=batch())
+    float(loss)
+    return (time.time() - t0) / meas
+
+
+def main() -> int:
+    if os.environ.get("DS_NUMERICS", "").strip():
+        print("numerics_bench: unset DS_NUMERICS — the env wins over "
+              "the per-engine config this A/B flips", file=sys.stderr)
+        return 2
+    off_s = time_steps(False)
+    on_s = time_steps(True)
+    overhead = (on_s - off_s) / off_s if off_s > 0 else 0.0
+    record = {"metric": "numerics_overhead_fraction",
+              "value": round(overhead, 5), "unit": "fraction",
+              "direction": "lower_better",
+              "detail": {"model": "gpt2:smoke" if SMOKE else "gpt2:350m",
+                         "step_s_numerics_off": round(off_s, 5),
+                         "step_s_numerics_on": round(on_s, 5),
+                         "strict": STRICT}}
+    from scripts.bench_util import emit_ledger
+    emit_ledger(record)
+    print(json.dumps(record))
+    if STRICT and overhead >= 0.02:
+        print(f"numerics_bench: overhead {overhead:.2%} >= 2% contract",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""Training-health report + fingerprint-stream audit (ISSUE 15).
+
+Renders the numerics observatory's health timeline — per-step
+loss / grad_norm / loss_scale / update_ratio, the per-leaf-group norm
+table, NaN provenance records, and the determinism fingerprint
+stream — from either a live ``/debug/numerics`` endpoint or a
+post-mortem bundle's ``numerics.json``; ``--diff`` compares TWO
+fingerprint streams (the restore-vs-uninterrupted / DP-vs-TP audit)::
+
+    python scripts/numerics_report.py http://127.0.0.1:8080/debug/numerics
+    python scripts/numerics_report.py postmortems/postmortem-step12/numerics.json
+    python scripts/numerics_report.py --diff runA/numerics.json runB/numerics.json
+    python scripts/numerics_report.py --diff a/flightrec.jsonl b/flightrec.jsonl
+
+``--diff`` accepts a ``numerics.json`` payload OR a flight-recorder
+JSONL dump (it extracts the ``num/fingerprint`` events); streams match
+when every step both runs fingerprinted carries the same digest.
+
+Exit codes: 0 report rendered / streams identical, 1 fingerprint
+streams diverge, 2 unreadable or not-a-numerics source.
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_payload(source: str):
+    """URL / numerics.json path / flightrec.jsonl path -> parsed doc
+    (dict for payloads, list of events for JSONL)."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=10) as r:
+            return json.loads(r.read())
+    with open(source) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        # flight-recorder JSONL
+        return [json.loads(line) for line in text.splitlines() if line]
+
+
+def fingerprint_stream(doc) -> dict:
+    """-> {step: digest} from a numerics payload or a flightrec JSONL
+    event list.  ONLY the periodic ``interval`` entries count: a
+    checkpoint stamp at the same step digests different inputs (no
+    loss term — it must be recomputable at restore time), so mixing
+    the sources would report two identical runs as diverged whenever
+    only one of them checkpointed; restore audits re-state an existing
+    step and are reported separately too."""
+    out = {}
+    if isinstance(doc, dict):
+        for e in doc.get("fingerprints", []):
+            if e.get("source") == "interval" and "digest" in e:
+                out[int(e["step"])] = e["digest"]
+        return out
+    for e in doc or []:
+        if isinstance(e, dict) and e.get("kind") == "num/fingerprint" \
+                and e.get("source") == "interval" and "digest" in e:
+            out[int(e["step"])] = e["digest"]
+    return out
+
+
+def diff_streams(a: dict, b: dict):
+    """-> (shared steps, list of (step, digest_a, digest_b)
+    mismatches)."""
+    shared = sorted(set(a) & set(b))
+    bad = [(s, a[s], b[s]) for s in shared if a[s] != b[s]]
+    return shared, bad
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    try:
+        return f"{float(v):.{nd}g}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render(payload: dict, tail: int = 24) -> str:
+    lines = ["# numerics observatory report"]
+    if not payload.get("armed", True):
+        lines.append("(bank not armed — no training engine in this "
+                     "process; run with telemetry.numerics / "
+                     "DS_NUMERICS=1)")
+        return "\n".join(lines)
+    groups = payload.get("groups", [])
+    hist = payload.get("history", [])
+    lines.append(f"groups: {len(groups)}; resolved steps in window: "
+                 f"{len(hist)}; pending banked: "
+                 f"{payload.get('banked_pending', 0)}")
+
+    if hist:
+        lines.append("\n## health timeline (tail)")
+        lines.append(f"{'step':>6}  {'loss':>10}  {'grad_norm':>10}  "
+                     f"{'upd/param':>10}  {'loss_scale':>10}  ovf")
+        for e in hist[-tail:]:
+            lines.append(
+                f"{e.get('step', '?'):>6}  {_fmt(e.get('loss')):>10}  "
+                f"{_fmt(e.get('grad_norm')):>10}  "
+                f"{_fmt(e.get('update_ratio')):>10}  "
+                f"{_fmt(e.get('loss_scale')):>10}  "
+                f"{'Y' if e.get('overflow') else '.'}")
+        last = hist[-1]
+        norms = last.get("group_norms")
+        if norms and groups:
+            lines.append(f"\n## per-group grad norms @ step "
+                         f"{last.get('step')}")
+            w = max(len(g) for g in groups)
+            # None = a non-finite norm (mapped out of the JSON payload);
+            # sort those first — they ARE the story
+            for g, v in sorted(zip(groups, norms),
+                               key=lambda kv: (kv[1] is not None,
+                                               -abs(kv[1] or 0.0))):
+                lines.append(f"{g:<{w}}  "
+                             f"{'non-finite' if v is None else _fmt(v)}")
+
+    nf = payload.get("nonfinite", {})
+    lines.append(f"\n## non-finite steps: "
+                 f"{nf.get('unexpected_steps', 0)} unexpected, "
+                 f"{nf.get('overflow_steps', 0)} loss-scaler-handled")
+    for rec in nf.get("records", [])[:8]:
+        lines.append(f"- step {rec.get('step')}: first group "
+                     f"{rec.get('first_group')!r}"
+                     + (" (overflow-handled)" if rec.get("handled")
+                        else "")
+                     + f", {len(rec.get('groups', {}))} group(s) "
+                     f"affected, loss={_fmt(rec.get('loss'))}")
+
+    fps = payload.get("fingerprints", [])
+    if fps:
+        lines.append(f"\n## fingerprint stream ({len(fps)} entries)")
+        for e in fps[-8:]:
+            lines.append(f"- step {e.get('step')} [{e.get('source')}] "
+                         f"{e.get('digest')}")
+    audits = payload.get("restore_audits", [])
+    if audits:
+        lines.append("\n## restore audits")
+        for a in audits:
+            lines.append(
+                f"- step {a.get('step')}: "
+                + ("OK" if a.get("ok") else
+                   f"MISMATCH (expected {a.get('expected')}, got "
+                   f"{a.get('actual')})"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="numerics_report",
+        description="render the training-health timeline from "
+                    "/debug/numerics or a bundle's numerics.json; "
+                    "--diff audits two fingerprint streams")
+    p.add_argument("source", help="URL or numerics.json path (with "
+                                  "--diff: the first stream)")
+    p.add_argument("other", nargs="?", default=None,
+                   help="second stream (with --diff)")
+    p.add_argument("--diff", action="store_true",
+                   help="compare two fingerprint streams (numerics.json "
+                        "or flightrec.jsonl); exit 1 on divergence")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw JSON payload instead of the table")
+    p.add_argument("--tail", type=int, default=24,
+                   help="timeline rows to render (default 24)")
+    args = p.parse_args(argv)
+
+    if args.diff:
+        if not args.other:
+            print("numerics_report: --diff needs two sources",
+                  file=sys.stderr)
+            return 2
+        try:
+            a = fingerprint_stream(load_payload(args.source))
+            b = fingerprint_stream(load_payload(args.other))
+        except Exception as e:
+            print(f"numerics_report: cannot read streams: {e}",
+                  file=sys.stderr)
+            return 2
+        if not a or not b:
+            print("numerics_report: a source has no num/fingerprint "
+                  "entries (was the run armed with "
+                  "telemetry.numerics.fingerprint_interval / "
+                  "DS_FINGERPRINT_INTERVAL?)", file=sys.stderr)
+            return 2
+        shared, bad = diff_streams(a, b)
+        if not shared:
+            print("numerics_report: streams share no fingerprinted "
+                  "steps", file=sys.stderr)
+            return 2
+        print(f"shared fingerprinted steps: {len(shared)} "
+              f"({shared[0]}..{shared[-1]})")
+        if bad:
+            print(f"DIVERGED at {len(bad)} step(s):")
+            for s, da, db in bad[:16]:
+                print(f"- step {s}: {da} != {db}")
+            return 1
+        print("streams identical over the shared steps")
+        return 0
+
+    try:
+        payload = load_payload(args.source)
+    except Exception as e:
+        print(f"numerics_report: cannot read {args.source!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(payload, dict) or "history" not in payload:
+        print(f"numerics_report: {args.source!r} is not a "
+              "/debug/numerics payload (no 'history' key)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(render(payload, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
