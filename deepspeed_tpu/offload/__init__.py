@@ -1,0 +1,11 @@
+"""deepspeed_tpu.offload — generic async prefetch/swap engine
+(ISSUE 16): the ONE double-buffered tier pipeline ROADMAP items 2
+(params/optimizer offload) and 3 (tiered KV) share.  See
+:mod:`deepspeed_tpu.offload.engine`.
+
+Kept import-light: nothing here pulls jax or the aio extension until
+an engine actually touches the NVMe tier.
+"""
+from deepspeed_tpu.offload.engine import SwapEngine, TIERS
+
+__all__ = ["SwapEngine", "TIERS"]

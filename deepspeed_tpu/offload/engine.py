@@ -1,0 +1,396 @@
+"""Generic async prefetch/swap engine (ISSUE 16 tentpole).
+
+The reference's ZeRO-Infinity moves bytes through one shape
+(PAPER.md §1 layers 0/5, ``zero/partitioned_param_swapper.py`` over
+``csrc/aio``): a double-buffered swap pipeline that overlaps device
+compute with tier I/O.  :class:`SwapEngine` is that shape made
+model-agnostic: a key-addressed payload store with a **host-RAM tier**
+(plain pinned numpy buffers — on TPU hosts all anonymous memory is
+effectively pinned for the runtime's DMA path) in front of an **NVMe
+tier** (one payload file per key through ``ops/aio`` — io_uring queue
+depth when the kernel allows it, thread pool otherwise).
+
+Clients and contracts:
+
+- the first client is the serving side's tiered KV cache
+  (``serving/kv_tiering.py`` — refcount-0 prefix blocks demote
+  HBM→host→NVMe instead of evicting); ROADMAP item 2 points the SAME
+  engine at parameter shards next.
+- payloads are lists of numpy arrays (one per pytree leaf); NVMe
+  serialization is the raw concatenated bytes with shapes/dtypes held
+  host-side, so a swap round-trip is bit-exact by construction (int8
+  KV included) — the tier-parity guarantee rests on this.
+- reads and writes ride SEPARATE :class:`AsyncIOHandle` instances
+  (separate rings/pools) for the same reason the tensor swapper does:
+  a prefetch read must bypass the write backlog
+  (``runtime/swap_tensor/swapper.py``).
+- writes are fire-and-forget with per-key write→read ordering; reads
+  are ``prefetch`` (submit) / ``fetch`` (complete), so the caller can
+  overlap materialization with its own compute — the double-buffered
+  in-flight window is capped at ``queue_depth`` outstanding requests
+  per direction.
+- every completed request reports its BACKEND-measured
+  submit→completion window through the process-wide IoStat
+  (``swap/*`` histograms, achieved bandwidth vs the ``DS_NVME_GBPS``
+  floor) — the PR 14 observatory prices every byte this engine moves.
+- tier bytes are ledger-exact: the engine owns one memory-ledger row
+  per tier (``host``/``nvme``) under the client-chosen owner label.
+
+The engine is deliberately policy-free: no faults, no eviction
+heuristics beyond the capacity caps, no knowledge of what a key means.
+Policy (fault sites, LRU pressure, parity rules) lives in the client.
+"""
+import os
+import tempfile
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SwapEngine", "TIERS"]
+
+#: engine tiers, warm to cold (the device tier stays with the client —
+#: the engine only ever holds spilled copies)
+TIERS = ("host", "nvme")
+
+
+class _Entry:
+    """One key's residency: exactly one tier at a time."""
+    __slots__ = ("tier", "meta", "arrays", "nbytes", "disk_nbytes")
+
+    def __init__(self, tier: str, meta, arrays, nbytes: int,
+                 disk_nbytes: int = 0):
+        self.tier = tier
+        self.meta = meta          # [(shape, dtype, nbytes), ...] per leaf
+        self.arrays = arrays      # host tier: the payload; nvme: None
+        self.nbytes = nbytes      # true payload bytes
+        self.disk_nbytes = disk_nbytes   # bytes actually on disk (nvme)
+
+
+class SwapEngine:
+    """Key-addressed host-RAM + NVMe payload store with async swap I/O.
+
+    Single-threaded by contract: callers (the serving scheduler, the
+    offload runtime) already serialize access under their own lock, and
+    the aio handles below carry per-request state that must not
+    interleave.
+    """
+
+    def __init__(self, nvme_dir: Optional[str] = None, owner: str = "offload",
+                 aio_threads: int = 2, queue_depth: int = 2):
+        self._owned_dir = nvme_dir is None
+        self.nvme_dir = nvme_dir or tempfile.mkdtemp(prefix="ds_offload_")
+        os.makedirs(self.nvme_dir, exist_ok=True)
+        self.owner = owner
+        self.queue_depth = max(1, int(queue_depth))
+        self._aio_threads = max(1, int(aio_threads))
+        # lazy: host-only configurations never pay for the aio rings
+        self._aio_r = None
+        self._aio_w = None
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._inflight_reads: Dict[str, tuple] = {}   # key -> (rid, buf)
+        self._inflight_writes: Dict[str, int] = {}    # key -> write id
+        self._tier_bytes = {"host": 0, "nvme": 0}
+        self._tier_count = {"host": 0, "nvme": 0}
+        # arm the process-wide aio observation sink (idempotent)
+        try:
+            from deepspeed_tpu.telemetry.iostat import get_iostat
+            get_iostat()
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload iostat arming failed ({e}); swapping "
+                         "continues unobserved")
+
+    # ------------------------------------------------------------ plumbing
+    def _rings(self):
+        if self._aio_r is None:
+            from deepspeed_tpu.ops.aio import AsyncIOHandle
+            # separate read/write handles: the prefetch read must not
+            # queue behind a ring full of writeback-throttled writes
+            self._aio_r = AsyncIOHandle(thread_count=self._aio_threads)
+            self._aio_w = AsyncIOHandle(thread_count=self._aio_threads)
+        return self._aio_r, self._aio_w
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.nvme_dir,
+                            key.replace("/", "_") + ".pay")
+
+    def _account(self):
+        """Ledger tap: this engine's per-tier bytes under its owner row
+        (best-effort — accounting never fails a swap)."""
+        try:
+            from deepspeed_tpu.telemetry.memory import (get_memory_ledger,
+                                                        memory_enabled)
+            if memory_enabled():
+                led = get_memory_ledger()
+                led.set_bytes("host", self.owner, self._tier_bytes["host"],
+                              entries=self._tier_count["host"])
+                led.set_bytes("nvme", self.owner, self._tier_bytes["nvme"],
+                              entries=self._tier_count["nvme"],
+                              dir=self.nvme_dir)
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload ledger accounting failed ({e})")
+
+    def _add(self, key: str, entry: _Entry):
+        self._entries[key] = entry
+        self._tier_count[entry.tier] += 1
+        self._tier_bytes[entry.tier] += (entry.disk_nbytes
+                                         if entry.tier == "nvme"
+                                         else entry.nbytes)
+
+    def _remove(self, key: str) -> Optional[_Entry]:
+        entry = self._entries.pop(key, None)
+        if entry is not None:
+            self._tier_count[entry.tier] -= 1
+            self._tier_bytes[entry.tier] -= (entry.disk_nbytes
+                                             if entry.tier == "nvme"
+                                             else entry.nbytes)
+        return entry
+
+    def _wait_write(self, key: str):
+        wid = self._inflight_writes.pop(key, None)
+        if wid is not None:
+            _, aio_w = self._rings()
+            if aio_w.wait_req(wid) == -1:
+                raise IOError(f"offload write failed for {key}")
+
+    def _window_gate(self, inflight: Dict):
+        """The double-buffering window: beyond ``queue_depth``
+        outstanding requests in one direction, reap the oldest before
+        submitting another (bounds pinned buffers AND keeps the ring a
+        rolling window instead of an unbounded backlog).
+
+        Read entries carry a sentinel rid after reaping: > 0 in flight,
+        0 materialized OK (the buffer is just host cache now), -1 the
+        backend reported failure (fetch must surface it, never the
+        buffer)."""
+        if inflight is self._inflight_writes:
+            while len(inflight) >= self.queue_depth:
+                self._wait_write(next(iter(inflight)))
+            return
+        while True:
+            live = [k for k, (rid, _) in inflight.items() if rid > 0]
+            if len(live) < self.queue_depth:
+                return
+            key = live[0]
+            rid, buf = inflight.pop(key)
+            aio_r, _ = self._rings()
+            if aio_r.wait_req(rid) == -1:
+                inflight[key] = (-1, None)
+            else:
+                inflight[key] = (0, buf)
+
+    def _write_nvme(self, key: str, arrays: Sequence[np.ndarray],
+                    nbytes: int, truncate: Optional[int]) -> int:
+        """Serialize + submit the async write; returns on-disk bytes
+        (< nbytes only under an injected torn write)."""
+        self._wait_write(key)            # same-key writes must not race
+        self._window_gate(self._inflight_writes)
+        payload = b"".join(np.ascontiguousarray(a).tobytes()
+                           for a in arrays)
+        buf = np.frombuffer(payload, dtype=np.uint8)
+        disk = nbytes
+        if truncate is not None and truncate < nbytes:
+            buf = buf[:max(0, truncate)].copy()
+            disk = int(buf.nbytes)
+        path = self._path(key)
+        # a shrinking rewrite must not leave stale tail bytes that make
+        # a torn payload look whole
+        if os.path.exists(path) and os.path.getsize(path) > disk:
+            os.truncate(path, 0)
+        if disk:
+            _, aio_w = self._rings()
+            self._inflight_writes[key] = aio_w.submit_pwrite(buf, path)
+        else:
+            open(path, "wb").close()
+        return disk
+
+    # -------------------------------------------------------------- writes
+    def put(self, key: str, arrays: Sequence[np.ndarray],
+            tier: str = "host", truncate: Optional[int] = None) -> int:
+        """Store a payload (replacing any tier's prior copy).  Host puts
+        keep the arrays; nvme puts serialize and fire-and-forget the
+        write.  ``truncate`` (fault injection) caps the bytes that reach
+        disk — ``fetch`` of a torn payload fails cleanly.  Returns the
+        payload's byte size."""
+        assert tier in TIERS, tier
+        self.discard(key)
+        meta = [(a.shape, a.dtype, int(a.nbytes)) for a in arrays]
+        nbytes = sum(m[2] for m in meta)
+        if tier == "host":
+            self._add(key, _Entry("host", meta,
+                                  [np.ascontiguousarray(a) for a in arrays],
+                                  nbytes))
+        else:
+            disk = self._write_nvme(key, arrays, nbytes, truncate)
+            self._add(key, _Entry("nvme", meta, None, nbytes,
+                                  disk_nbytes=disk))
+        self._account()
+        return nbytes
+
+    def demote(self, key: str, truncate: Optional[int] = None) -> int:
+        """Move a host-tier payload to the NVMe tier (the host→NVMe leg
+        of the spill waterfall).  Returns the payload's byte size."""
+        entry = self._entries.get(key)
+        if entry is None or entry.tier != "host":
+            raise KeyError(f"{key} is not host-resident")
+        self._remove(key)
+        disk = self._write_nvme(key, entry.arrays, entry.nbytes, truncate)
+        self._add(key, _Entry("nvme", entry.meta, None, entry.nbytes,
+                              disk_nbytes=disk))
+        self._account()
+        return entry.nbytes
+
+    # --------------------------------------------------------------- reads
+    def prefetch(self, key: str):
+        """Submit the async read for an NVMe payload (no-op for host
+        payloads, unknown keys, in-flight reads, and torn payloads —
+        fetch() is where failures surface)."""
+        entry = self._entries.get(key)
+        if (entry is None or entry.tier != "nvme"
+                or key in self._inflight_reads
+                or entry.disk_nbytes != entry.nbytes):
+            return
+        self._wait_write(key)            # write→read ordering, this key only
+        self._window_gate(self._inflight_reads)
+        buf = np.empty(entry.nbytes, dtype=np.uint8)
+        aio_r, _ = self._rings()
+        rid = aio_r.submit_pread(buf, self._path(key))
+        self._inflight_reads[key] = (rid, buf)
+
+    def fetch(self, key: str) -> List[np.ndarray]:
+        """Complete the swap-in and CONSUME the entry (the caller now
+        owns the only copy — a key is never resident in two tiers).
+        Raises KeyError for unknown keys, IOError for torn payloads or
+        failed reads; the entry is dropped on failure so a degraded
+        caller cannot re-attach corrupt bytes."""
+        entry = self._entries.get(key)
+        if entry is None:
+            raise KeyError(f"{key} is not tier-resident")
+        if entry.tier == "host":
+            self._remove(key)
+            self._account()
+            return entry.arrays
+        if entry.disk_nbytes != entry.nbytes:
+            self.discard(key)
+            raise IOError(f"torn offload payload for {key} "
+                          f"({entry.disk_nbytes}/{entry.nbytes} bytes)")
+        if key not in self._inflight_reads:
+            self.prefetch(key)
+        rid, buf = self._inflight_reads.pop(key)
+        failed = rid < 0
+        if rid > 0:
+            aio_r, _ = self._rings()
+            failed = aio_r.wait_req(rid) == -1
+        if failed:
+            self.discard(key)
+            raise IOError(f"offload read failed for {key}")
+        self._remove(key)
+        self._account()
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+        out, off = [], 0
+        for shape, dtype, n in entry.meta:
+            out.append(np.frombuffer(buf[off:off + n].tobytes(),
+                                     dtype=dtype).reshape(shape))
+            off += n
+        return out
+
+    # ------------------------------------------------------------- readers
+    def tier_of(self, key: str) -> Optional[str]:
+        entry = self._entries.get(key)
+        return entry.tier if entry is not None else None
+
+    def nbytes_of(self, key: str) -> int:
+        entry = self._entries.get(key)
+        return entry.nbytes if entry is not None else 0
+
+    def keys(self, tier: Optional[str] = None):
+        """Keys in insertion (oldest-first) order, optionally one tier."""
+        if tier is None:
+            return list(self._entries)
+        return [k for k, e in self._entries.items() if e.tier == tier]
+
+    def tiers(self) -> Dict[str, str]:
+        """key -> tier snapshot (the invariant / digest view)."""
+        return {k: e.tier for k, e in self._entries.items()}
+
+    def oldest(self, tier: str) -> Optional[str]:
+        for k, e in self._entries.items():
+            if e.tier == tier:
+                return k
+        return None
+
+    def count(self, tier: str) -> int:
+        return self._tier_count[tier]
+
+    def bytes(self, tier: str) -> int:
+        return self._tier_bytes[tier]
+
+    def inflight_reads(self):
+        return set(self._inflight_reads)
+
+    def inflight(self) -> int:
+        return len(self._inflight_reads) + len(self._inflight_writes)
+
+    # ------------------------------------------------------------ lifetime
+    def discard(self, key: str):
+        """Drop a key from whichever tier holds it (true eviction)."""
+        if key in self._inflight_reads:
+            rid, _ = self._inflight_reads.pop(key)
+            if rid > 0:
+                aio_r, _ = self._rings()
+                aio_r.wait_req(rid)      # unpin; result irrelevant
+        try:
+            self._wait_write(key)
+        except IOError:
+            pass                         # discarding anyway
+        entry = self._remove(key)
+        if entry is not None:
+            if entry.tier == "nvme":
+                try:
+                    os.remove(self._path(key))
+                except OSError:
+                    pass
+            self._account()
+
+    def drain(self):
+        """Complete all in-flight I/O (one ``window=drain`` IoStat
+        sample per direction); raises if any request failed."""
+        self._inflight_reads.clear()
+        self._inflight_writes.clear()
+        errors = 0
+        if self._aio_r is not None:
+            errors = self._aio_r.wait() + self._aio_w.wait()
+        if errors:
+            raise IOError(f"{errors} offload aio requests failed")
+
+    def close(self):
+        """Drain (best-effort) and delete this engine's payload files
+        (and its temp dir when it created one)."""
+        try:
+            self.drain()
+        except IOError:
+            pass
+        for key in list(self._entries):
+            self._remove(key)
+        self._account()
+        try:
+            for name in os.listdir(self.nvme_dir):
+                if name.endswith(".pay"):
+                    os.remove(os.path.join(self.nvme_dir, name))
+            if self._owned_dir:
+                os.rmdir(self.nvme_dir)
+        except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        # dslint: disable=DSL005 -- interpreter-teardown __del__: the aio
+        # lib may already be unloaded; leaking a temp file beats raising
+        except Exception:
+            pass

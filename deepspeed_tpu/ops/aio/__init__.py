@@ -189,6 +189,11 @@ class AsyncIOHandle:
     def inflight(self) -> int:
         return int(self._lib.ds_aio_inflight(self._h))
 
+    def pending_requests(self) -> int:
+        """Per-request submits not yet reaped by ``wait_req``/``wait``
+        (the window a double-buffered caller gates on)."""
+        return len(self._io_meta)
+
     def __del__(self):
         h = getattr(self, "_h", None)
         if h:

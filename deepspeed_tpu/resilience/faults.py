@@ -86,6 +86,9 @@ KNOWN_FAULT_SITES = {
     "kv.alloc": "KV block-pool allocation (deny = pool exhausted)",
     "kv.cache": "prefix-cache match/attach (deny = cache-blind full "
                 "prefill)",
+    "kv.swap": "tiered-KV swap-out/swap-in (deny = abandon the "
+               "demotion / fail the swap-in to re-prefill; truncate = "
+               "torn NVMe payload, detected before attach — ISSUE 16)",
     "fleet.dispatch": "fleet router replica selection (raise = dispatch "
                       "failure, deny = policy-blind misroute)",
 }

@@ -532,6 +532,56 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
                 f"{self.max_cached_blocks}: must be >= 0 (0 = pool-bounded)")
 
 
+class KvTieringConfig(DeepSpeedConfigModel):
+    """``serving.kv_tiering`` — tiered KV-cache spill (ISSUE 16): LRU
+    pressure demotes refcount-0 hashed blocks HBM→host→NVMe through
+    the generic ``deepspeed_tpu/offload`` async swap engine instead of
+    dropping them, preemption parks a victim's committed KV on NVMe,
+    and a cold-tier prefix hit swaps back in asynchronously (overlapped
+    with the current decode iteration) instead of re-prefilling.
+    Requires ``serving.prefix_cache.enabled`` — tiers are keyed by the
+    prefix cache's chained block hashes.  The DS_KV_TIERING env var
+    overrides ``enabled`` either way (env-wins convention)."""
+    enabled: bool = False
+    #: host-RAM tier capacity in KV blocks; overflow spills the oldest
+    #: entries to the NVMe tier (0 = unbounded host tier, never spill)
+    host_blocks: int = 256
+    #: NVMe tier capacity in KV blocks; overflow drops the oldest
+    #: entries outright (0 = unbounded)
+    nvme_blocks: int = 0
+    #: directory for the NVMe tier's payload files; None = a fresh
+    #: process-private temp dir (removed with the engine)
+    nvme_dir: Optional[str] = None
+    #: park a preemption victim's committed KV straight on NVMe so its
+    #: resume is a swap-in instead of a re-prefill
+    park_on_preempt: bool = True
+    #: aio worker threads per direction for the tier files (io_uring
+    #: rings when the kernel allows it, thread pools otherwise)
+    aio_threads: int = 2
+    #: double-buffering depth: max in-flight async reads/writes per
+    #: direction before the engine reaps the oldest
+    queue_depth: int = 2
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.host_blocks < 0:
+            raise ValueError(
+                f"serving.kv_tiering.host_blocks={self.host_blocks}: "
+                "must be >= 0 (0 = unbounded)")
+        if self.nvme_blocks < 0:
+            raise ValueError(
+                f"serving.kv_tiering.nvme_blocks={self.nvme_blocks}: "
+                "must be >= 0 (0 = unbounded)")
+        if self.aio_threads < 1:
+            raise ValueError(
+                f"serving.kv_tiering.aio_threads={self.aio_threads}: "
+                "must be >= 1")
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"serving.kv_tiering.queue_depth={self.queue_depth}: "
+                "must be >= 1")
+
+
 class SLOClassConfig(DeepSpeedConfigModel):
     """One request class's latency targets (``serving.slo.classes``).
     0 = no target for that dimension (requests still counted)."""
@@ -664,6 +714,13 @@ class FleetConfig(DeepSpeedConfigModel):
     #: weight of the matched-prefix fraction from the replica cache
     #: digest (PR 6 chained block hashes — the routing key)
     prefix_weight: float = 1.0
+    #: prefix-score multiplier when the deepest digest hit sits in the
+    #: replica's host-RAM tier (ISSUE 16): warm beats cold, HBM beats
+    #: warm — attaching it costs a host→HBM swap-in
+    host_tier_discount: float = 0.6
+    #: same for an NVMe-cold deepest hit: still worth routing toward
+    #: for long prefixes, but the swap-in pays NVMe latency
+    nvme_tier_discount: float = 0.3
     #: router-side replica-cache digest max age before a dispatch
     #: refreshes it (0 = refresh on every scored dispatch)
     digest_refresh_s: float = 0.5
@@ -689,6 +746,11 @@ class FleetConfig(DeepSpeedConfigModel):
             if getattr(self, k) < 0:
                 raise ValueError(
                     f"serving.fleet.{k}={getattr(self, k)}: must be >= 0")
+        for k in ("host_tier_discount", "nvme_tier_discount"):
+            if not 0.0 <= getattr(self, k) <= 1.0:
+                raise ValueError(
+                    f"serving.fleet.{k}={getattr(self, k)}: must be in "
+                    "[0, 1] (a multiplier on the matched-prefix score)")
         if self.digest_refresh_s < 0:
             raise ValueError(f"serving.fleet.digest_refresh_s="
                              f"{self.digest_refresh_s}: must be >= 0")
@@ -767,6 +829,9 @@ class ServingConfig(DeepSpeedConfigModel):
     #: cross-request prefix-cache sub-section (same dict-in-JSON
     #: validation pattern as ``spec``)
     prefix_cache: Any = None
+    #: tiered KV-cache spill sub-section (same pattern; ISSUE 16 —
+    #: requires ``prefix_cache.enabled``)
+    kv_tiering: Any = None
     #: per-class SLO accounting + admission-control sub-section (same
     #: pattern; ISSUE 7 accounting, ISSUE 9 shedding)
     slo: Any = None
@@ -784,6 +849,13 @@ class ServingConfig(DeepSpeedConfigModel):
         if not isinstance(self.prefix_cache, PrefixCacheConfig):
             self.prefix_cache = PrefixCacheConfig(
                 **(self.prefix_cache or {}))
+        if not isinstance(self.kv_tiering, KvTieringConfig):
+            self.kv_tiering = KvTieringConfig(**(self.kv_tiering or {}))
+        if self.kv_tiering.enabled and not self.prefix_cache.enabled:
+            raise ValueError(
+                "serving.kv_tiering.enabled=true requires "
+                "serving.prefix_cache.enabled (cold tiers are keyed by "
+                "the prefix cache's chained block hashes)")
         if not isinstance(self.slo, SLOConfig):
             self.slo = SLOConfig(**(self.slo or {}))
         if not isinstance(self.chunked_prefill, ChunkedPrefillConfig):

@@ -74,6 +74,14 @@ class BlockManager:
         self._chains: Dict[int, List[str]] = {}
         #: cached blocks evicted to satisfy allocations (telemetry)
         self.cache_evictions = 0
+        #: cached blocks demoted to a cold tier instead of evicted
+        self.cache_demotions = 0
+        # tiered-KV spill (ISSUE 16): the scheduler arms these via
+        # attach_tiering — a KvTierStore holding cold payloads keyed by
+        # content hash, and an extractor returning a block's physical
+        # payload (this class never touches the pool itself)
+        self._tier_store = None
+        self._extract = None
 
     # -------------------------------------------------------------- sizes
     @property
@@ -117,17 +125,47 @@ class BlockManager:
         h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
         return h.hexdigest()
 
+    # ------------------------------------------------------------ tiering
+    def attach_tiering(self, store, extract_fn):
+        """Arm tiered spill (``serving.kv_tiering``): ``store`` is a
+        :class:`~deepspeed_tpu.serving.kv_tiering.KvTierStore`;
+        ``extract_fn(block) -> [np.ndarray]`` snapshots the block's
+        physical payload (the scheduler's pool slice, bit-exact)."""
+        self._tier_store = store
+        self._extract = extract_fn
+
+    def _demote_or_evict(self, b: int, tier: str = "host") -> bool:
+        """Unregister one LRU-popped cached block, demoting its payload
+        to a cold tier first when tiering is armed.  True = the payload
+        survived (demotion); False = a plain eviction (tiering off, a
+        ``kv.swap`` deny, or an unhashed block).  The caller owns the
+        block id afterwards either way."""
+        demoted = False
+        h = self._hash_of.get(b)
+        if h is not None and self._tier_store is not None \
+                and self._extract is not None:
+            if tier == "nvme":
+                demoted = self._tier_store.park(h, self._extract(b))
+            else:
+                demoted = self._tier_store.store(h, self._extract(b))
+        self._unregister(b)
+        if demoted:
+            self.cache_demotions += 1
+        else:
+            self.cache_evictions += 1
+        return demoted
+
     # ---------------------------------------------------------- allocate
     def _pop_block(self) -> Optional[int]:
-        """One block off the free list, evicting the oldest refcount-0
-        cached block when the list runs dry — the cache yields to live
-        demand, never the other way around."""
+        """One block off the free list, evicting (demoting, with
+        tiering armed) the oldest refcount-0 cached block when the list
+        runs dry — the cache yields to live demand, never the other way
+        around."""
         if self._free:
             return self._free.pop()
         if self._lru:
             b, _ = self._lru.popitem(last=False)
-            self._unregister(b)
-            self.cache_evictions += 1
+            self._demote_or_evict(b)
             return b
         return None
 
@@ -149,8 +187,7 @@ class BlockManager:
             while self.max_cached_blocks \
                     and len(self._lru) > self.max_cached_blocks:
                 old, _ = self._lru.popitem(last=False)
-                self._unregister(old)
-                self.cache_evictions += 1
+                self._demote_or_evict(old)
                 self._free.append(old)
         else:
             self._free.append(b)
@@ -231,6 +268,90 @@ class BlockManager:
                 break
             out.append(b)
         return out
+
+    def match_prefix_tiered(self, token_ids) -> List[Tuple[str, Optional[int], str]]:
+        """Tier-aware cache lookup (ISSUE 16): like :meth:`match_prefix`
+        but the walk continues through cold-tier entries.  Returns
+        ``(tier, block, hash)`` runs from token 0 — ``("hbm", b, h)``
+        for HBM-resident blocks, ``("host"|"nvme", None, h)`` for
+        payloads the tier store holds — stopping at the first block
+        cached nowhere.  The scheduler promotes the cold entries
+        (async swap-in) and re-matches; only :meth:`acquire_prefix`
+        mutates state."""
+        if not self.cache_enabled:
+            return []
+        if self.injector.deny("kv.cache"):
+            return []
+        out: List[Tuple[str, Optional[int], str]] = []
+        h: Optional[str] = None
+        bs = self.block_size
+        for i in range(len(token_ids) // bs):
+            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs])
+            b = self._by_hash.get(h)
+            if b is not None:
+                out.append(("hbm", b, h))
+                continue
+            tier = (self._tier_store.tier_of(h)
+                    if self._tier_store is not None else None)
+            if tier is None:
+                break
+            out.append((tier, None, h))
+        return out
+
+    def promote(self, h: str, protect=()) -> Optional[int]:
+        """Re-admit one swapped-in payload's hash to the HBM cache: a
+        pool block (possibly demoting another LRU entry — the cascade
+        is the point) is registered under ``h`` and parked refcount-0
+        on the LRU, ready for the normal :meth:`acquire_prefix` path.
+        The caller must have CONSUMED the cold entry already (fetch
+        pops it) and writes the physical payload into the returned
+        block; None = the pool cannot supply a block (degrade to
+        re-prefill).
+
+        ``protect``: block ids the cap trim must not touch.  A
+        multi-block materialize pass promotes a whole prefix chain
+        before the request attaches it, so earlier promotions of the
+        SAME pass sit refcount-0 on the LRU — with a small
+        ``max_cached_blocks`` an unprotected trim would demote them
+        right back and the swap-in would livelock (promote → demote →
+        re-match cold → promote …).  The cache may transiently exceed
+        the cap by the chain length; :meth:`_release_block` re-asserts
+        it at the next release."""
+        if h in self._by_hash:
+            return self._by_hash[h]
+        b = self._pop_block()
+        if b is None:
+            return None
+        self._hash_of[b] = h
+        self._by_hash[h] = b
+        self._lru[b] = None
+        while self.max_cached_blocks \
+                and len(self._lru) > self.max_cached_blocks:
+            old = next((o for o in self._lru
+                        if o != b and o not in protect), None)
+            if old is None:         # everything left was just promoted
+                break
+            self._lru.pop(old)
+            self._demote_or_evict(old)
+            self._free.append(old)
+        return b
+
+    def park_blocks(self, blocks: List[int], tier: str = "nvme") -> int:
+        """Preemption parking (ISSUE 16): push the given blocks' cached
+        payloads to ``tier`` NOW, freeing their HBM.  Only refcount-0
+        LRU residents move (shared blocks stay hot for their other
+        owners); call it with the victim's pre-``free()`` table right
+        after the free.  Returns the number of payloads parked; denied
+        swap-outs degrade to plain evictions."""
+        parked = 0
+        for b in blocks:
+            if b not in self._lru:
+                continue
+            self._lru.pop(b)
+            if self._demote_or_evict(b, tier=tier):
+                parked += 1
+            self._free.append(b)
+        return parked
 
     def acquire_prefix(self, request_id: int, matched: List[int],
                        n_fresh: int, fork_last: bool) \
@@ -318,6 +439,10 @@ class BlockManager:
                 continue
             self._hash_of[b] = h
             self._by_hash[h] = b
+            if self._tier_store is not None:
+                # a freshly-materialized HBM copy supersedes any cold
+                # copy of the same prefix — one tier per hash, ever
+                self._tier_store.discard(h)
 
     def cache_digest(self, max_entries: int = 0) -> Dict:
         """Bounded router-facing cache summary (ISSUE 11 satellite): the
@@ -334,11 +459,24 @@ class BlockManager:
         usable cache depth for that prompt.  Read-only; stable across
         ``acquire_prefix`` ref bumps and copy-on-write forks (the
         shared source block stays published) — only eviction removes
-        entries.  ``max_entries=0`` = unbounded."""
+        entries.  ``max_entries=0`` = unbounded.
+
+        With tiering armed (ISSUE 16) every entry also carries its
+        tier (``tiers`` is a parallel list: ``hbm``/``host``/``nvme``,
+        cold entries first — they were published earliest) so the
+        router can rank an HBM-hot prefix above an NVMe-cold one."""
         hashes = list(self._by_hash)
+        tiers = ["hbm"] * len(hashes)
+        total = len(self._by_hash)
+        if self._tier_store is not None:
+            cold = self._tier_store.tiers()
+            hashes = list(cold) + hashes
+            tiers = list(cold.values()) + tiers
+            total += len(cold)
         if max_entries and len(hashes) > max_entries:
             hashes = hashes[-max_entries:]
-        return {"hashes": hashes, "cached_blocks": len(self._by_hash)}
+            tiers = tiers[-max_entries:]
+        return {"hashes": hashes, "tiers": tiers, "cached_blocks": total}
 
     def check_invariant(self):
         """Allocation-accounting invariant, extended to the ref-counted
@@ -414,6 +552,34 @@ class BlockManager:
                 f"block accounting: free({len(free)}) + live({len(live)}) "
                 f"+ cached({len(cached)}) != {self.num_blocks - 1} "
                 "(leak or double-free)")
+        if self._tier_store is not None:
+            # cross-tier accounting (ISSUE 16): the free + |unique(live
+            # ∪ cached_hbm)| identity above covers HBM; cold tiers are
+            # hash-keyed (their HBM blocks were recycled), so the
+            # cross-tier law is hash-level — one tier per prefix, ever
+            cold = self._tier_store.tiers()
+            dual = set(cold) & set(self._by_hash)
+            if dual:
+                raise AssertionError(
+                    f"tier accounting: hashes resident in HBM and a "
+                    f"cold tier: {sorted(dual)[:4]}")
+            bad_tier = {h: t for h, t in cold.items()
+                        if t not in ("host", "nvme")}
+            if bad_tier:
+                raise AssertionError(
+                    f"tier accounting: unknown tiers {bad_tier}")
+            inflight = set(self._tier_store.inflight())
+            if inflight - set(cold):
+                raise AssertionError(
+                    "tier accounting: in-flight swaps for non-resident "
+                    f"hashes: {sorted(inflight - set(cold))[:4]}")
+            table_hashes = {self._hash_of[b]
+                            for t in self._tables.values() for b in t
+                            if b in self._hash_of}
+            if inflight & table_hashes:
+                raise AssertionError(
+                    "tier accounting: in-flight swap set intersects the "
+                    f"block tables: {sorted(inflight & table_hashes)[:4]}")
         return True
 
     # ---------------------------------------------------------- addressing

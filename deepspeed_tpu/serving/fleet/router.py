@@ -164,8 +164,8 @@ class Router:
         self._by_replica_req: Dict[Tuple[int, int], int] = {}
         #: session -> replica_id, LRU-bounded at session_capacity
         self._sessions: "OrderedDict[str, int]" = OrderedDict()
-        #: replica_id -> (frozenset of digest hashes, refreshed_at)
-        self._digests: Dict[int, Tuple[frozenset, float]] = {}
+        #: replica_id -> ({digest hash -> tier}, refreshed_at)
+        self._digests: Dict[int, Tuple[Dict[str, str], float]] = {}
         self._block_size = self.replicas[0].scheduler.cfg.block_size
 
     # ------------------------------------------------------------ submit
@@ -270,22 +270,31 @@ class Router:
         with self._lock:
             sticky = (self._sessions.get(session_id)
                       if session_id is not None else None)
+        tier_w = {"hbm": 1.0, "host": self.cfg.host_tier_discount,
+                  "nvme": self.cfg.nvme_tier_discount}
         scored = []
         for r in candidates:
-            matched = self._digest_match(r, prompt_hashes)
+            matched, tier = self._digest_match(r, prompt_hashes)
             frac = matched / len(prompt_hashes) if prompt_hashes else 0.0
+            # tier-aware scoring (ISSUE 16): a prefix parked on a cold
+            # tier still beats a miss (swap-in < re-prefill) but loses
+            # to the same depth HBM-hot on another replica — the
+            # discount of the DEEPEST matched hash scales the whole
+            # matched fraction (a chain hash pins its prefix, and the
+            # coldest link bounds the attach latency)
+            frac *= tier_w.get(tier, 1.0)
             affine = sticky == r.replica_id
             score = (self.cfg.prefix_weight * frac
                      + (self.cfg.affinity_weight if affine else 0.0)
                      - self.cfg.least_loaded_weight
                      * loads[r.replica_id] / max_load)
             scored.append((score, -loads[r.replica_id], -r.replica_id,
-                           r, matched, affine))
+                           r, matched, affine, tier))
         scored.sort(reverse=True)       # ties: least loaded, lowest id
-        _, _, _, best, matched, affine = scored[0]
+        _, _, _, best, matched, affine, tier = scored[0]
         return ([s[3] for s in scored],
                 {"policy": "scored", "prefix_blocks": matched,
-                 "affinity": bool(affine),
+                 "prefix_tier": tier, "affinity": bool(affine),
                  "load": loads[best.replica_id]})
 
     def _prompt_hashes(self, prompt_ids: np.ndarray) -> List[str]:
@@ -301,19 +310,22 @@ class Router:
             out.append(h)
         return out
 
-    def _digest_match(self, rep: Replica, hashes: List[str]) -> int:
-        """Longest cached prefix (in blocks) the replica's digest claims
-        for this prompt.  Scans longest-first: a chain hash pins its
-        whole prefix, so the FIRST membership hit is the answer."""
+    def _digest_match(self, rep: Replica,
+                      hashes: List[str]) -> Tuple[int, str]:
+        """(Longest cached prefix in blocks, tier of the deepest matched
+        hash) the replica's digest claims for this prompt.  Scans
+        longest-first: a chain hash pins its whole prefix, so the FIRST
+        membership hit is the answer."""
         if not hashes:
-            return 0
+            return 0, "hbm"
         digest = self._replica_digest(rep)
         for i in range(len(hashes), 0, -1):
-            if hashes[i - 1] in digest:
-                return i
-        return 0
+            tier = digest.get(hashes[i - 1])
+            if tier is not None:
+                return i, tier
+        return 0, "hbm"
 
-    def _replica_digest(self, rep: Replica) -> frozenset:
+    def _replica_digest(self, rep: Replica) -> Dict[str, str]:
         now = time.monotonic()
         with self._lock:
             cached = self._digests.get(rep.replica_id)
@@ -324,8 +336,10 @@ class Router:
             # the replica's step holds its lock right now — score on
             # the stale digest (or none) rather than stall EVERY
             # dispatch behind one busy/wedged member
-            return cached[0] if cached is not None else frozenset()
-        fresh = frozenset(dg["hashes"])
+            return cached[0] if cached is not None else {}
+        # hash -> tier (pre-16 digests carry no tier list: all hbm)
+        tiers = dg.get("tiers") or ["hbm"] * len(dg["hashes"])
+        fresh = dict(zip(dg["hashes"], tiers))
         with self._lock:
             self._digests[rep.replica_id] = (fresh, now)
         self.registry.inc("fleet/digest_refreshes")

@@ -1,0 +1,207 @@
+"""Tiered KV-cache spill — the offload engine's first client
+(ISSUE 16).
+
+:class:`KvTierStore` is the policy layer between the BlockManager's
+hash-addressed prefix cache and the generic
+:class:`~deepspeed_tpu.offload.engine.SwapEngine`: LRU pressure
+*demotes* a refcount-0 hashed block's payload HBM→host instead of
+dropping it, host-tier overflow spills oldest-first host→NVMe,
+preemption parks a victim's committed KV straight on NVMe, and a
+cold-tier prefix hit swaps back in asynchronously.  Keys are the
+prefix cache's chained block hashes (PR 6) — content-addressed, so a
+parked payload is valid for ANY request whose prompt walks the same
+chain.
+
+Policy contracts owned here (not by the engine):
+
+- the ``kv.swap`` fault site fires on every swap-out AND swap-in
+  (deny = abandon the demotion / fail the swap-in; stall = delayed
+  I/O; truncate = a torn NVMe payload).  A failed swap-in degrades to
+  re-prefill — the store drops the entry so corrupt bytes can never
+  attach.
+- one copy per hash, ever: promote-to-HBM consumes the tier entry,
+  and :meth:`discard` runs whenever the BlockManager re-registers a
+  hash (a re-prefilled HBM copy wins over a stale cold one).
+- parity: payloads are bit-exact device snapshots (the engine
+  round-trips raw bytes), so a tier hit is token-identical to the
+  HBM-hot hit by construction.
+
+Flight-recorder kinds (the ``kv/`` family): ``kv/demote``,
+``kv/spill``, ``kv/park``, ``kv/prefetch``, ``kv/swap_in``,
+``kv/swap_fail``.
+"""
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.resilience.faults import NULL_INJECTOR
+
+KV_TIERING_ENV = "DS_KV_TIERING"
+
+
+def tiering_enabled(cfg, env: Optional[dict] = None) -> bool:
+    """``serving.kv_tiering.enabled`` with the ``DS_KV_TIERING`` env
+    override applied (the repo's env-wins convention: any non-empty
+    value decides, "0"/"false"/"off"/"no" disable)."""
+    env = os.environ if env is None else env
+    override = str(env.get(KV_TIERING_ENV, "") or "").strip().lower()
+    if override:
+        return override not in ("0", "false", "off", "no")
+    return bool(getattr(cfg, "enabled", False))
+
+
+class KvTierStore:
+    """Hash-keyed cold-tier store for KV block payloads.
+
+    Used under the scheduler lock only (same discipline as the
+    BlockManager it extends)."""
+
+    def __init__(self, cfg, injector=None, flightrec=None):
+        from deepspeed_tpu.offload import SwapEngine
+        self.cfg = cfg
+        self.injector = injector or NULL_INJECTOR
+        self.flightrec = flightrec
+        self._engine = SwapEngine(
+            nvme_dir=getattr(cfg, "nvme_dir", None), owner="kv_cache",
+            aio_threads=getattr(cfg, "aio_threads", 2),
+            queue_depth=getattr(cfg, "queue_depth", 2))
+        # monotonic policy counters, mirrored into serving/* metrics by
+        # the scheduler's gauge pass
+        self.demotions = 0       # HBM→host demotes
+        self.spills = 0          # host→NVMe overflow spills
+        self.parks = 0           # HBM→NVMe preemption parks
+        self.swapins = 0         # cold-tier payloads materialized back
+        self.failures = 0        # kv.swap faults / IO errors (degraded)
+        self.dropped = 0         # NVMe-capacity evictions (truly gone)
+
+    # ------------------------------------------------------------ helpers
+    def _flight(self, kind: str, corr=None, **fields):
+        if self.flightrec is not None:
+            self.flightrec.record(kind, corr=corr, **fields)
+
+    def _swap_out(self, h: str, arrays: List[np.ndarray], tier: str,
+                  kind: str) -> bool:
+        """One fault-gated swap-out (put or park).  False = denied —
+        the caller falls back to a plain eviction."""
+        if self.injector.deny("kv.swap"):
+            self.failures += 1
+            self._flight("kv/swap_fail", corr=h[:12], dir="out", tier=tier)
+            return False
+        nbytes = int(sum(a.nbytes for a in arrays))
+        keep = self.injector.truncate_bytes("kv.swap", nbytes)
+        self._engine.put(h, arrays, tier=tier, truncate=keep)
+        self._flight(kind, corr=h[:12], tier=tier, bytes=nbytes)
+        self._spill_overflow()
+        return True
+
+    def _spill_overflow(self):
+        """The capacity waterfall: host overflow spills oldest-first to
+        NVMe (each spill is itself a fault-gated swap-out); NVMe
+        overflow drops oldest-first outright."""
+        cap = getattr(self.cfg, "host_blocks", 0)
+        while cap and self._engine.count("host") > cap:
+            h = self._engine.oldest("host")
+            if self.injector.deny("kv.swap"):
+                self.failures += 1
+                self._flight("kv/swap_fail", corr=h[:12], dir="out",
+                             tier="nvme")
+                self._engine.discard(h)
+                continue
+            keep = self.injector.truncate_bytes(
+                "kv.swap", self._engine.nbytes_of(h))
+            nbytes = self._engine.demote(h, truncate=keep)
+            self.spills += 1
+            self._flight("kv/spill", corr=h[:12], bytes=nbytes)
+        cap = getattr(self.cfg, "nvme_blocks", 0)
+        while cap and self._engine.count("nvme") > cap:
+            self._engine.discard(self._engine.oldest("nvme"))
+            self.dropped += 1
+
+    # ------------------------------------------------------------- policy
+    def store(self, h: str, arrays: List[np.ndarray]) -> bool:
+        """Demote one evicted cached block's payload HBM→host."""
+        ok = self._swap_out(h, arrays, "host", "kv/demote")
+        if ok:
+            self.demotions += 1
+        return ok
+
+    def park(self, h: str, arrays: List[np.ndarray]) -> bool:
+        """Park one preemption victim's committed block straight on
+        NVMe (resume is then a swap-in, not a re-prefill)."""
+        ok = self._swap_out(h, arrays, "nvme", "kv/park")
+        if ok:
+            self.parks += 1
+        return ok
+
+    def prefetch(self, h: str, corr=None):
+        """Schedule the async swap-in (NVMe reads overlap the current
+        decode iteration; host entries are already materialized)."""
+        tier = self._engine.tier_of(h)
+        if tier is None:
+            return
+        self._flight("kv/prefetch", corr=corr, tier=tier)
+        if tier == "nvme":
+            self._engine.prefetch(h)
+
+    def fetch(self, h: str, corr=None) -> Optional[Tuple[str, List[np.ndarray]]]:
+        """Materialize one cold payload; (tier, arrays) or None on a
+        fault/IO failure (entry dropped — the caller re-prefills)."""
+        tier = self._engine.tier_of(h)
+        if tier is None:
+            return None
+        if self.injector.deny("kv.swap"):
+            self.failures += 1
+            self._flight("kv/swap_fail", corr=corr, dir="in", tier=tier)
+            self._engine.discard(h)
+            return None
+        try:
+            arrays = self._engine.fetch(h)
+        except (IOError, OSError, KeyError):
+            self.failures += 1
+            self._flight("kv/swap_fail", corr=corr, dir="in", tier=tier)
+            self._engine.discard(h)
+            return None
+        self.swapins += 1
+        self._flight("kv/swap_in", corr=corr, tier=tier,
+                     bytes=int(sum(a.nbytes for a in arrays)))
+        return tier, arrays
+
+    # ------------------------------------------------------------ readers
+    def tier_of(self, h: str) -> Optional[str]:
+        return self._engine.tier_of(h)
+
+    def tiers(self) -> Dict[str, str]:
+        """hash -> tier snapshot (check_invariant / cache_digest)."""
+        return self._engine.tiers()
+
+    def counts(self) -> Dict[str, int]:
+        return {"host": self._engine.count("host"),
+                "nvme": self._engine.count("nvme")}
+
+    def bytes(self) -> Dict[str, int]:
+        return {"host": self._engine.bytes("host"),
+                "nvme": self._engine.bytes("nvme")}
+
+    def inflight(self):
+        """Hashes with swap-ins in flight (must stay disjoint from the
+        BlockManager's tables AND resident in the store)."""
+        return self._engine.inflight_reads()
+
+    def summary(self) -> Dict[str, int]:
+        c = self.counts()
+        b = self.bytes()
+        return {"host_blocks": c["host"], "nvme_blocks": c["nvme"],
+                "host_bytes": b["host"], "nvme_bytes": b["nvme"],
+                "inflight": len(self.inflight()),
+                "demotions": self.demotions, "spills": self.spills,
+                "parks": self.parks, "swap_ins": self.swapins,
+                "failures": self.failures, "dropped": self.dropped,
+                "nvme_dir": self._engine.nvme_dir}
+
+    # ------------------------------------------------------------ lifetime
+    def discard(self, h: str):
+        self._engine.discard(h)
+
+    def close(self):
+        self._engine.close()

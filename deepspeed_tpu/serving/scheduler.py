@@ -396,6 +396,27 @@ class ContinuousBatchingScheduler:
                         "device", "spec_draft", tree_bytes(draft_pool))
             except Exception:   # byte accounting must never block serving
                 self._mem_on = False
+        # tiered KV spill (ISSUE 16): LRU pressure demotes refcount-0
+        # hashed blocks HBM→host→NVMe through the offload engine
+        # instead of evicting; cold prefix hits swap back in async
+        # (overlapped with the decode iteration) and preemption parks
+        # committed KV on NVMe.  Needs the prefix cache — cold tiers
+        # are keyed by its chain hashes.
+        from deepspeed_tpu.serving.kv_tiering import tiering_enabled
+        kt = getattr(config, "kv_tiering", None)
+        self._tier_store = None
+        self._park_on_preempt = bool(getattr(kt, "park_on_preempt", True))
+        #: request_id -> cold chain hashes whose swap-in is in flight
+        #: (the request sits out admission until they materialize)
+        self._swap_pending = collections.OrderedDict()
+        self._swapin_fn = None          # tier swap-in scatter (lazy jit)
+        self._pool_treedef = jax.tree_util.tree_structure(self.pool)
+        if tiering_enabled(kt) and self._prefix_cache_on:
+            from deepspeed_tpu.serving.kv_tiering import KvTierStore
+            self._tier_store = KvTierStore(
+                kt, injector=self.injector, flightrec=self.flightrec)
+            self.block_mgr.attach_tiering(self._tier_store,
+                                          self._extract_block)
 
     def _resolve_proposer(self, proposer):
         spec = getattr(self.cfg, "spec", None)
@@ -687,6 +708,89 @@ class ContinuousBatchingScheduler:
             jnp.arange(src * bs, (src + 1) * bs, dtype=jnp.int32),
             jnp.arange(dst * bs, (dst + 1) * bs, dtype=jnp.int32))
 
+    # ----------------------------------------------------- tiered KV (16)
+    def _extract_block(self, block: int):
+        """Snapshot one block's physical payload as host numpy leaves
+        (the BlockManager's demotion extractor).  device_get of a pool
+        slice per leaf — bit-exact, dtype-preserving (int8 KV
+        included), so a later swap-in reproduces the block verbatim
+        and tier hits stay token-identical."""
+        bs = self.block_mgr.block_size
+        lo, hi = block * bs, (block + 1) * bs
+        return [np.asarray(leaf[:, lo:hi])
+                for leaf in jax.tree_util.tree_leaves(self.pool)]
+
+    def _write_block(self, block: int, arrays):
+        """Scatter one swapped-in payload into its promoted pool block
+        (the inverse of _extract_block): one jitted scatter, compiled
+        once — same shape every time, the _cow_copy discipline."""
+        if self._swapin_fn is None:
+            self._swapin_fn = _jit_device_local(
+                lambda pool, dst, vals: jax.tree.map(
+                    lambda p, v: p.at[:, dst].set(v), pool, vals))
+        bs = self.block_mgr.block_size
+        vals = jax.tree_util.tree_unflatten(
+            self._pool_treedef, [jnp.asarray(a) for a in arrays])
+        self.pool = self._swapin_fn(
+            self.pool,
+            jnp.arange(block * bs, (block + 1) * bs, dtype=jnp.int32),
+            vals)
+
+    def _schedule_swapins(self, req, entries) -> bool:
+        """Queue the async swap-in for a tier-matched prompt's cold
+        entries; the request sits out admission (still QUEUED) until
+        the next step materializes them — the reads overlap THIS
+        step's decode instead of blocking it.  True = scheduled."""
+        cold = [h for tier, _, h in entries if tier != "hbm"]
+        if not cold or req.request_id in self._swap_pending:
+            return False
+        for h in cold:
+            self._tier_store.prefetch(h, corr=f"req-{req.request_id}")
+        # pend the WHOLE chain, hot entries included: materialization
+        # must pin the already-hot blocks against its own promote-cap
+        # trim, or a small max_cached_blocks demotes block k while
+        # promoting block k+1 of the same prefix and the request
+        # re-matches cold forever (swap-in livelock)
+        self._swap_pending[req.request_id] = [h for _, _, h in entries]
+        return True
+
+    def _materialize_swapins(self):
+        """Complete pending swap-ins (scheduled on an earlier step, so
+        the I/O has already overlapped at least one decode iteration):
+        fetch each payload, re-register its hash as an HBM cache entry
+        (BlockManager.promote), and scatter the bytes into the promoted
+        block — the normal prefix-cache admission path then attaches it
+        like any hot hit.  A failed fetch (kv.swap fault, torn NVMe
+        payload, I/O error) drops the rest of the chain: those blocks
+        simply re-prefill — degraded, never corrupt."""
+        if self._tier_store is None or not self._swap_pending:
+            return
+        queued = {r.request_id for r in self._queue}
+        c = self.metrics.counters
+        promoted = set()        # this pass's blocks: cap-trim exempt
+        for rid in list(self._swap_pending):
+            hashes = self._swap_pending.pop(rid)
+            if rid not in queued:
+                continue        # expired/extracted; entries stay cached
+            for h in hashes:
+                hot = self.block_mgr._by_hash.get(h)
+                if hot is not None:
+                    promoted.add(hot)   # pin the chain's hot prefix
+                    continue
+                got = self._tier_store.fetch(h, corr=f"req-{rid}")
+                if got is None:
+                    break       # degrade: the remainder re-prefills
+                tier, arrays = got
+                b = self.block_mgr.promote(h, protect=promoted)
+                if b is None:   # pool exhausted mid-promotion
+                    break
+                promoted.add(b)
+                self._write_block(b, arrays)
+                if tier == "host":
+                    c["kv_tier_hit_host"] += 1
+                else:
+                    c["kv_tier_hit_nvme"] += 1
+
     # ----------------------------------------------------------- submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
                timeout_s: float = 0.0,
@@ -955,6 +1059,13 @@ class ContinuousBatchingScheduler:
                     for r in list(self._slots) if r is not None
                     and r.state == RequestState.PREFILLING],
             },
+            "kv_tiering": ({"enabled": False}
+                           if self._tier_store is None else dict(
+                               {"enabled": True,
+                                "park_on_preempt": self._park_on_preempt,
+                                "demoted_not_evicted": bm.cache_demotions,
+                                "pending_swapins": len(self._swap_pending)},
+                               **self._tier_store.summary())),
         }
         return out
 
@@ -1023,7 +1134,19 @@ class ContinuousBatchingScheduler:
         self.block_mgr.register_committed(
             victim.request_id, victim.all_token_ids,
             materialized=self._committed_tokens(victim))
+        victim_table = list(self.block_mgr.block_table(victim.request_id))
         self.block_mgr.free(victim.request_id)
+        if self._tier_store is not None and self._park_on_preempt:
+            # park the victim's whole committed KV on NVMe NOW (ISSUE
+            # 16): preemption means pool pressure, so freeing the HBM
+            # beats LRU retention — and resume becomes a swap-in, not a
+            # re-prefill.  Only exclusively-owned hashed blocks move;
+            # shared ones stay hot for their other owners.
+            parked = self.block_mgr.park_blocks(victim_table)
+            if parked:
+                self.flightrec.record("kv/park",
+                                      corr=f"req-{victim.request_id}",
+                                      blocks=parked)
         victim.prefill_inputs = None
         victim.prefill_pos = 0
         if victim.slot >= 0:
@@ -1112,11 +1235,22 @@ class ContinuousBatchingScheduler:
         allow = self._prefill_allowance() if chunked else budget
         bm = self.block_mgr
         spent = 0
+        # tiered KV (ISSUE 16): swap-ins scheduled on an earlier step
+        # materialize first — their hashes re-enter the HBM cache and
+        # the owning requests re-enter the admission line below
+        self._materialize_swapins()
         while self._queue:
             free_slots = [i for i, r in enumerate(self._slots) if r is None]
             if not free_slots:
                 break
-            req = max(self._queue, key=self._qos_key)
+            # a request waiting on an in-flight swap-in sits out this
+            # round (its prefix materializes next step); others admit
+            cands = ([r for r in self._queue
+                      if r.request_id not in self._swap_pending]
+                     if self._swap_pending else self._queue)
+            if not cands:
+                break
+            req = max(cands, key=self._qos_key)
             resumed = req.state == RequestState.EVICTED
             tokens = req.all_token_ids
             # resume re-prefills everything but the last generated token —
@@ -1129,6 +1263,17 @@ class ContinuousBatchingScheduler:
             matched, start = ([], 0)
             if self._prefix_cache_on:
                 matched, start = self._match_prefix(req, inputs, resumed)
+                # tiered KV (ISSUE 16): a prompt whose prefix extends
+                # into a cold tier schedules the async swap-in and sits
+                # out this round — next step the promoted blocks are
+                # ordinary HBM hits and the request pays a swap-in
+                # instead of a re-prefill
+                if self._tier_store is not None:
+                    entries = bm.match_prefix_tiered(inputs)
+                    if (len(entries) > len(matched)
+                            and len(entries) >= self._prefix_min_blocks
+                            and self._schedule_swapins(req, entries)):
+                        continue
             # the budget meters PREFILL COMPUTE: cached tokens are free
             need = n_in - start
             if not chunked and spent and spent + need > budget:
@@ -1972,6 +2117,25 @@ class ContinuousBatchingScheduler:
             if lookups:
                 self.metrics.gauges["prefix_cache_hit_rate"] = round(
                     c["prefix_cache_hit"] / lookups, 4)
+        ts = self._tier_store
+        if ts is not None:
+            # tiered KV (ISSUE 16): policy counters mirror in as
+            # serving/* counters (the cache_evictions idiom above);
+            # occupancy + in-flight + hit-rate ride as gauges
+            c["kv_demotions"] = ts.demotions
+            c["kv_spills"] = ts.spills
+            c["kv_parked_blocks"] = ts.parks
+            c["kv_swap_in_blocks"] = ts.swapins
+            c["kv_swap_failures"] = ts.failures
+            counts = ts.counts()
+            self.metrics.gauges.update(
+                kv_host_blocks=counts["host"],
+                kv_nvme_blocks=counts["nvme"],
+                kv_inflight_swaps=len(ts.inflight()))
+            attempts = ts.swapins + ts.failures
+            if attempts:
+                self.metrics.gauges["kv_tier_hit_rate"] = round(
+                    ts.swapins / attempts, 4)
         if elapsed > 0 and c["generated_tokens"]:
             self.metrics.gauges["tokens_per_s"] = round(
                 c["generated_tokens"] / elapsed, 3)

@@ -68,6 +68,12 @@ KNOWN_EVENT_KINDS = {
     "mem/alloc_failure": "an allocation failed (denied kv.alloc / OOM) "
                          "and the memory ledger was snapshotted into "
                          "the forensics ring (ISSUE 14)",
+    "kv/": "prefix family: tiered-KV spill lifecycle (ISSUE 16) — "
+           "kv/demote (HBM→host), kv/spill (host→NVMe overflow), "
+           "kv/park (preemption parked committed KV on NVMe), "
+           "kv/prefetch (async swap-in scheduled), kv/swap_in "
+           "(cold payload materialized and re-attached), kv/swap_fail "
+           "(kv.swap fault or I/O error; degraded to evict/re-prefill)",
     "num/nonfinite": "a train step produced non-finite gradients; the "
                      "first offending leaf group is in the fields "
                      "(handled=true for loss-scaler overflow skips; "

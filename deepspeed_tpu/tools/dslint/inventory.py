@@ -49,6 +49,7 @@ SUBMODELS = {
     "serving.slo": "SLOConfig",
     "serving.chunked_prefill": "ChunkedPrefillConfig",
     "serving.fleet": "FleetConfig",
+    "serving.kv_tiering": "KvTieringConfig",
     "resilience.retry": "RetryConfig",
     "telemetry.numerics": "NumericsConfig",
 }

@@ -40,6 +40,9 @@ ENV_VARS = {
     "DS_HBM_GBPS": "per-device HBM bandwidth (GB/s) for roofline floors "
                    "(wins over the device-kind table; how CPU tier-1 "
                    "exercises floor math)",
+    "DS_KV_TIERING": "0/1 disables/forces tiered KV spill "
+                     "(host-RAM/NVMe cold tiers; wins over "
+                     "serving.kv_tiering.enabled)",
     "DS_MEM_COMPILED": "1 arms the one-time compiled-program "
                        "memory_analysis activation-peak probe (a full "
                        "extra XLA compile of the train step)",
@@ -238,6 +241,24 @@ METRICS = {
                                       "block",
     "serving/prefix_cache_hit_rate": "hit/(hit+miss) gauge",
     "serving/cached_blocks": "refcount-0 blocks retained in the cache",
+    # --- serving: tiered KV (host/NVMe spill, ISSUE 16)
+    "serving/kv_demotions": "HBM cache blocks demoted to the host tier "
+                            "instead of evicted",
+    "serving/kv_spills": "host-tier blocks spilled onward to NVMe under "
+                         "host_blocks pressure",
+    "serving/kv_parked_blocks": "committed KV blocks parked on NVMe at "
+                                "preemption",
+    "serving/kv_swap_in_blocks": "cold-tier blocks materialized back "
+                                 "into HBM",
+    "serving/kv_swap_failures": "swap-outs/swap-ins abandoned (kv.swap "
+                                "fault or I/O error; degraded to "
+                                "evict/re-prefill)",
+    "serving/kv_tier_hit_host": "swap-ins satisfied from the host tier",
+    "serving/kv_tier_hit_nvme": "swap-ins satisfied from the NVMe tier",
+    "serving/kv_host_blocks": "blocks resident in the host tier gauge",
+    "serving/kv_nvme_blocks": "blocks resident in the NVMe tier gauge",
+    "serving/kv_inflight_swaps": "async swap-in reads in flight gauge",
+    "serving/kv_tier_hit_rate": "swap_ins/(swap_ins+failures) gauge",
     # --- serving: speculative decoding
     "serving/spec_drafted_tokens": "draft tokens proposed",
     "serving/spec_accepted_tokens": "draft tokens accepted by verify",

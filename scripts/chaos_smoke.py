@@ -277,6 +277,53 @@ def case_prefix_cache_fault_degrades():
     sched.block_mgr.check_invariant()
 
 
+def case_kv_swap_fault_degrades():
+    """kv.swap deny under tiered KV (ISSUE 16): every swap-out abandons
+    the demotion (plain eviction) and every swap-in fails back to a full
+    re-prefill — never a corrupt attach.  A deliberately tiny hot cache
+    forces demotion pressure across two request waves; exact greedy
+    outputs, pool fully drained, cross-tier invariant intact."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        prefix_cache={"enabled": True,
+                                      "max_cached_blocks": 2},
+                        kv_tiering={"enabled": True, "host_blocks": 2})
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("kv.swap:deny@*"))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 128, (24,)).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(1, 128, (3 + i,)).astype(
+                                   np.int32)]) for i in range(3)]
+    for _ in range(2):
+        reqs = [sched.submit(p, SamplingParams(max_new_tokens=6))
+                for p in prompts]
+        sched.run_until_idle()
+        for p, req in zip(prompts, reqs):
+            ref = np.asarray(eng.generate(p[None], max_new_tokens=6,
+                                          do_sample=False))[0, p.size:]
+            assert req.state == RequestState.FINISHED
+            assert np.array_equal(np.asarray(req.output_ids), ref)
+    assert sched.injector.fired.get("kv.swap", 0) >= 1, \
+        "the tiny hot cache never generated swap pressure"
+    assert sched.metrics.counters["kv_swap_in_blocks"] == 0, \
+        "a denied swap still materialized blocks"
+    assert sched.block_mgr.num_allocated_blocks == 0
+    sched.block_mgr.check_invariant()
+
+
 def case_chunk_fault_resumes_from_cursor():
     """serve.chunk raise mid-chunked-prefill (ISSUE 9): the step fails
     between committed chunks, the cursor and block table stay
@@ -451,6 +498,8 @@ def main(argv=None):
                   case_prefix_cache_fault_degrades))
     cases.append(("serve.chunk fault resumes from committed cursor",
                   case_chunk_fault_resumes_from_cursor))
+    cases.append(("kv.swap fault degrades to evict/re-prefill",
+                  case_kv_swap_fault_degrades))
     cases.append(("fleet replica loss resubmits mid-stream",
                   case_fleet_replica_loss_resubmits))
     cases.append(("train.nonfinite NaN attributed to its leaf group",

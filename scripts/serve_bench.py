@@ -13,6 +13,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=cb SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
+    SERVE_MODE=tier SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=moe python scripts/serve_bench.py            # mixtral A/B
     SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
     SERVE_MODE=slo SERVE_LONG_LEN=8192 python scripts/serve_bench.py
@@ -50,6 +51,12 @@ reporting p50/p99 TPOT and TTFT per SLO class.  The acceptance shape:
 with chunking OFF the chat class's p99 TPOT spikes at each long-prompt
 arrival (the whole prefill runs in one scheduler iteration); with
 chunking ON it stays bounded near p50.
+Tier mode (ISSUE 16) runs a shared-prefix workload under a deliberately
+small hot cache (LRU pressure demotes released prefixes HBM→host→NVMe)
+with tiered KV ON vs OFF — token-identical greedy outputs asserted —
+and reports prefill tokens saved by cold-tier swap-ins vs the
+evict-and-re-prefill baseline, per-tier hit counts, and the
+swap/achieved_vs_floor bandwidth rows when DS_NVME_GBPS is declared.
 Fleet mode (ISSUE 11) routes a shared-prefix workload across N replica
 schedulers (each with its own prefix cache) through the fleet Router,
 A/B'ing the prefix-aware scored policy vs round-robin — token-identical
@@ -157,7 +164,8 @@ def main(argv=None):
         size = size or "tiny"
         kwargs = {}
     elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe",
-                                          "slo", "fleet", "fused"):
+                                          "slo", "fleet", "fused",
+                                          "tier"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -170,7 +178,7 @@ def main(argv=None):
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
     if _mode not in ("cb", "spec", "prefix", "moe", "slo", "fleet",
-                     "fused"):
+                     "fused", "tier"):
         cb_ctx = 0
     elif _mode == "slo":
         # headroom for the adversarial long prompts (heavy-prefill
@@ -183,6 +191,11 @@ def main(argv=None):
         # headroom for the shared system prompts — the long-shared-head
         # short-tail regime is the whole point of these modes
         cb_ctx = int(os.environ.get("SERVE_SYS_LEN", 512)) + 128
+    elif _mode == "tier":
+        # same shared-head regime, but the CPU smoke keeps the heads
+        # short: the point is demote/swap-in plumbing, not prefill mass
+        cb_ctx = int(os.environ.get("SERVE_SYS_LEN",
+                                    512 if on_tpu else 64)) + 128
     else:
         cb_ctx = 96 if _mode in ("cb", "moe") else 128
     model = registry[arch](size or "custom", dtype="bfloat16" if on_tpu
@@ -217,6 +230,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "prefix":
         return bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
                                   json_path)
+    if os.environ.get("SERVE_MODE") == "tier":
+        return bench_kv_tiering(model, eng, spec, kv_dtype, on_tpu,
+                                json_path)
     if os.environ.get("SERVE_MODE") == "moe":
         return bench_moe_dispatch(model, eng, spec, kv_dtype, quant,
                                   on_tpu, json_path)
@@ -637,6 +653,123 @@ def bench_prefix_cache(model, eng, spec, kv_dtype, on_tpu,
             "ttft_off_p99_ms": pct(off_ttft, 99),
             "goodput_on": on_m.gauges.get("goodput"),
             "goodput_off": off_m.gauges.get("goodput"),
+        },
+    }, json_path)
+
+
+def bench_kv_tiering(model, eng, spec, kv_dtype, on_tpu,
+                     json_path=None):
+    """Tiered-KV on/off A/B (ISSUE 16): the shared-prefix workload runs
+    twice under a deliberately SMALL hot cache (``max_cached_blocks``
+    sized below the working set, so wave-1 prefixes are pushed off the
+    LRU before wave 2 re-requests them).  With tiering ON the push is a
+    demotion (HBM→host, spilling host→NVMe under ``host_blocks``
+    pressure) and wave 2's cold hits pay an async swap-in; with tiering
+    OFF the push is an eviction and wave 2 re-prefills.  Token-identical
+    greedy outputs are ASSERTED across the two runs; the record carries
+    prefill tokens saved, per-tier hit counts, demote/spill/swap-in
+    counters, and — when ``DS_NVME_GBPS`` declares a floor — the
+    ``swap/achieved_vs_floor`` bandwidth rows (``bench_compare.py``
+    gates on the ``*_tok_s`` / ``prefill_*`` keys)."""
+    import time as _time
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+    from deepspeed_tpu.telemetry.iostat import peek_iostat
+
+    n_reqs = int(os.environ.get("SERVE_REQS", 24 if on_tpu else 8))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    n_sys = int(os.environ.get("SERVE_SYS_PROMPTS", 4 if on_tpu else 3))
+    sys_len = int(os.environ.get("SERVE_SYS_LEN", 512 if on_tpu else 64))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    t_lo, t_hi = ((16, 96) if on_tpu else (4, 12))
+    n_lo, n_hi = ((32, 128) if on_tpu else (4, 10))
+    systems = [rng.integers(1, V, (sys_len,)).astype(np.int32)
+               for _ in range(n_sys)]
+    workload = []
+    for i in range(n_reqs):
+        tail = rng.integers(1, V, (int(rng.integers(t_lo, t_hi)),))
+        prompt = np.concatenate([systems[i % n_sys], tail])
+        workload.append((prompt.astype(np.int32),
+                         int(rng.integers(n_lo, n_hi))))
+    useful = sum(nn for _, nn in workload)
+    max_len = max(p.size + nn for p, nn in workload)
+    bs = 16 if on_tpu else 8
+    need = -(-max_len // bs) + 1
+    sys_blocks = sys_len // bs
+    # hot cache holds ONE system prompt's chain (plus change): the
+    # others demote/evict between waves — the spill regime on purpose
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * (max_seqs + n_sys + 1),
+                max_num_batched_tokens=1 << 30)
+
+    def run(enabled):
+        cfg = ServingConfig(
+            **base,
+            prefix_cache={"enabled": True,
+                          "max_cached_blocks": sys_blocks + 1},
+            kv_tiering={"enabled": enabled,
+                        # host holds one more system's worth; the rest
+                        # spills onward to NVMe
+                        "host_blocks": sys_blocks,
+                        "nvme_blocks": 0})
+        sched = ContinuousBatchingScheduler(
+            model, eng.params, cfg, kv_cache_dtype=kv_dtype)
+        outs = None
+        for _ in range(2):
+            reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn))
+                    for p, nn in workload]
+            t0 = _time.time()
+            sched.run_until_idle()
+            dt = _time.time() - t0
+            assert all(len(r.output_ids) == nn
+                       for r, (_, nn) in zip(reqs, workload))
+            outs = [list(r.output_ids) for r in reqs]
+        return dt, sched.metrics, outs
+
+    on_s, on_m, on_out = run(True)
+    off_s, off_m, off_out = run(False)
+    assert on_out == off_out, \
+        "tiered KV changed greedy output (parity violation)"
+    c = on_m.counters
+    swapped = int(c["kv_swap_in_blocks"])
+    io = peek_iostat()
+    io_rows = io.summary() if io is not None else {}
+    emit({
+        "metric": f"{spec}_serve_tier"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / on_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "requests": n_reqs, "system_prompts": n_sys,
+            "system_len": sys_len, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "block_size": bs,
+            "hot_cache_blocks": sys_blocks + 1,
+            "host_tier_blocks": sys_blocks,
+            "tier_on_tok_s": round(useful / on_s, 1),
+            "tier_off_tok_s": round(useful / off_s, 1),
+            "prefill_tokens_on": int(c["prefill_tokens"]),
+            "prefill_tokens_off": int(
+                off_m.counters["prefill_tokens"]),
+            "prefill_tokens_saved": int(
+                off_m.counters["prefill_tokens"]
+                - c["prefill_tokens"]),
+            "swap_in_blocks": swapped,
+            "swap_in_tokens": swapped * bs,
+            "tier_hits_host": int(c["kv_tier_hit_host"]),
+            "tier_hits_nvme": int(c["kv_tier_hit_nvme"]),
+            "demotions": int(c["kv_demotions"]),
+            "spills": int(c["kv_spills"]),
+            "swap_failures": int(c["kv_swap_failures"]),
+            "tier_hit_rate": on_m.gauges.get("kv_tier_hit_rate"),
+            "evictions_off": int(
+                off_m.counters["prefix_cache_evict"]),
+            "swap_io": io_rows,
+            "swap_read_vs_floor": (io_rows.get("ops", {})
+                                   .get("read", {}).get("vs_floor")),
+            "swap_write_vs_floor": (io_rows.get("ops", {})
+                                    .get("write", {}).get("vs_floor")),
         },
     }, json_path)
 

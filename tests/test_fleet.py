@@ -139,7 +139,8 @@ def test_cache_digest_stable_across_acquire_evict_cow():
     bm.free(2)
     assert bm.cache_digest()["hashes"] == before     # retained on LRU
     assert bm.allocate(3, bm.num_usable_blocks) is not None
-    assert bm.cache_digest() == {"hashes": [], "cached_blocks": 0}
+    assert bm.cache_digest() == {"hashes": [], "tiers": [],
+                                 "cached_blocks": 0}
     bm.check_invariant()
 
 
