@@ -7,5 +7,6 @@ Kept import-light: nothing here pulls jax or the aio extension until
 an engine actually touches the NVMe tier.
 """
 from deepspeed_tpu.offload.engine import SwapEngine, TIERS
+from deepspeed_tpu.offload.param_store import ParamStore, SwapTensorClient
 
-__all__ = ["SwapEngine", "TIERS"]
+__all__ = ["SwapEngine", "TIERS", "ParamStore", "SwapTensorClient"]

@@ -34,7 +34,13 @@ Clients and contracts:
   (``swap/*`` histograms, achieved bandwidth vs the ``DS_NVME_GBPS``
   floor) — the PR 14 observatory prices every byte this engine moves.
 - tier bytes are ledger-exact: the engine owns one memory-ledger row
-  per tier (``host``/``nvme``) under the client-chosen owner label.
+  per tier (``host``/``nvme``) and per owner label — ``put`` takes a
+  per-key ``owner`` so a SHARED engine (param shards + optimizer
+  state on one queue-depth budget, ISSUE 17) attributes each client's
+  bytes separately.
+- ``fetch(key, keep=True)`` is the read-only mode: the entry and its
+  payload file stay valid, so a client holding a resident working set
+  (the ParamStore's K layers) evicts clean copies for free.
 
 The engine is deliberately policy-free: no faults, no eviction
 heuristics beyond the capacity caps, no knowledge of what a key means.
@@ -56,15 +62,17 @@ TIERS = ("host", "nvme")
 
 class _Entry:
     """One key's residency: exactly one tier at a time."""
-    __slots__ = ("tier", "meta", "arrays", "nbytes", "disk_nbytes")
+    __slots__ = ("tier", "meta", "arrays", "nbytes", "disk_nbytes",
+                 "owner")
 
     def __init__(self, tier: str, meta, arrays, nbytes: int,
-                 disk_nbytes: int = 0):
+                 disk_nbytes: int = 0, owner: Optional[str] = None):
         self.tier = tier
         self.meta = meta          # [(shape, dtype, nbytes), ...] per leaf
         self.arrays = arrays      # host tier: the payload; nvme: None
         self.nbytes = nbytes      # true payload bytes
         self.disk_nbytes = disk_nbytes   # bytes actually on disk (nvme)
+        self.owner = owner        # ledger attribution for this key
 
 
 class SwapEngine:
@@ -92,6 +100,13 @@ class SwapEngine:
         self._inflight_writes: Dict[str, int] = {}    # key -> write id
         self._tier_bytes = {"host": 0, "nvme": 0}
         self._tier_count = {"host": 0, "nvme": 0}
+        # per-(tier, owner) attribution: one SHARED engine can serve
+        # several clients (param shards + optimizer state on one
+        # queue-depth budget) with each client's bytes on its own
+        # ledger row (the ISSUE 17 ``params_nvme`` contract)
+        self._owner_bytes: Dict[tuple, int] = {}
+        self._owner_count: Dict[tuple, int] = {}
+        self._owners = {self.owner}
         # arm the process-wide aio observation sink (idempotent)
         try:
             from deepspeed_tpu.telemetry.iostat import get_iostat
@@ -116,36 +131,49 @@ class SwapEngine:
                             key.replace("/", "_") + ".pay")
 
     def _account(self):
-        """Ledger tap: this engine's per-tier bytes under its owner row
-        (best-effort — accounting never fails a swap)."""
+        """Ledger tap: this engine's per-tier bytes, one row per owner
+        label (best-effort — accounting never fails a swap)."""
         try:
             from deepspeed_tpu.telemetry.memory import (get_memory_ledger,
                                                         memory_enabled)
             if memory_enabled():
                 led = get_memory_ledger()
-                led.set_bytes("host", self.owner, self._tier_bytes["host"],
-                              entries=self._tier_count["host"])
-                led.set_bytes("nvme", self.owner, self._tier_bytes["nvme"],
-                              entries=self._tier_count["nvme"],
-                              dir=self.nvme_dir)
+                for owner in self._owners:
+                    led.set_bytes(
+                        "host", owner,
+                        self._owner_bytes.get(("host", owner), 0),
+                        entries=self._owner_count.get(("host", owner), 0))
+                    led.set_bytes(
+                        "nvme", owner,
+                        self._owner_bytes.get(("nvme", owner), 0),
+                        entries=self._owner_count.get(("nvme", owner), 0),
+                        dir=self.nvme_dir)
         except Exception as e:
             from deepspeed_tpu.utils.logging import logger
             logger.debug(f"offload ledger accounting failed ({e})")
 
     def _add(self, key: str, entry: _Entry):
         self._entries[key] = entry
+        nbytes = (entry.disk_nbytes if entry.tier == "nvme"
+                  else entry.nbytes)
         self._tier_count[entry.tier] += 1
-        self._tier_bytes[entry.tier] += (entry.disk_nbytes
-                                         if entry.tier == "nvme"
-                                         else entry.nbytes)
+        self._tier_bytes[entry.tier] += nbytes
+        owner = entry.owner or self.owner
+        self._owners.add(owner)
+        ok = (entry.tier, owner)
+        self._owner_count[ok] = self._owner_count.get(ok, 0) + 1
+        self._owner_bytes[ok] = self._owner_bytes.get(ok, 0) + nbytes
 
     def _remove(self, key: str) -> Optional[_Entry]:
         entry = self._entries.pop(key, None)
         if entry is not None:
+            nbytes = (entry.disk_nbytes if entry.tier == "nvme"
+                      else entry.nbytes)
             self._tier_count[entry.tier] -= 1
-            self._tier_bytes[entry.tier] -= (entry.disk_nbytes
-                                             if entry.tier == "nvme"
-                                             else entry.nbytes)
+            self._tier_bytes[entry.tier] -= nbytes
+            ok = (entry.tier, entry.owner or self.owner)
+            self._owner_count[ok] = self._owner_count.get(ok, 0) - 1
+            self._owner_bytes[ok] = self._owner_bytes.get(ok, 0) - nbytes
         return entry
 
     def _wait_write(self, key: str):
@@ -208,12 +236,15 @@ class SwapEngine:
 
     # -------------------------------------------------------------- writes
     def put(self, key: str, arrays: Sequence[np.ndarray],
-            tier: str = "host", truncate: Optional[int] = None) -> int:
+            tier: str = "host", truncate: Optional[int] = None,
+            owner: Optional[str] = None) -> int:
         """Store a payload (replacing any tier's prior copy).  Host puts
         keep the arrays; nvme puts serialize and fire-and-forget the
         write.  ``truncate`` (fault injection) caps the bytes that reach
-        disk — ``fetch`` of a torn payload fails cleanly.  Returns the
-        payload's byte size."""
+        disk — ``fetch`` of a torn payload fails cleanly.  ``owner``
+        attributes THIS key's bytes to a ledger row other than the
+        engine default (shared-engine clients).  Returns the payload's
+        byte size."""
         assert tier in TIERS, tier
         self.discard(key)
         meta = [(a.shape, a.dtype, int(a.nbytes)) for a in arrays]
@@ -221,11 +252,11 @@ class SwapEngine:
         if tier == "host":
             self._add(key, _Entry("host", meta,
                                   [np.ascontiguousarray(a) for a in arrays],
-                                  nbytes))
+                                  nbytes, owner=owner))
         else:
             disk = self._write_nvme(key, arrays, nbytes, truncate)
             self._add(key, _Entry("nvme", meta, None, nbytes,
-                                  disk_nbytes=disk))
+                                  disk_nbytes=disk, owner=owner))
         self._account()
         return nbytes
 
@@ -238,7 +269,7 @@ class SwapEngine:
         self._remove(key)
         disk = self._write_nvme(key, entry.arrays, entry.nbytes, truncate)
         self._add(key, _Entry("nvme", entry.meta, None, entry.nbytes,
-                              disk_nbytes=disk))
+                              disk_nbytes=disk, owner=entry.owner))
         self._account()
         return entry.nbytes
 
@@ -259,16 +290,21 @@ class SwapEngine:
         rid = aio_r.submit_pread(buf, self._path(key))
         self._inflight_reads[key] = (rid, buf)
 
-    def fetch(self, key: str) -> List[np.ndarray]:
-        """Complete the swap-in and CONSUME the entry (the caller now
-        owns the only copy — a key is never resident in two tiers).
-        Raises KeyError for unknown keys, IOError for torn payloads or
-        failed reads; the entry is dropped on failure so a degraded
+    def fetch(self, key: str, keep: bool = False) -> List[np.ndarray]:
+        """Complete the swap-in.  By default the entry is CONSUMED (the
+        caller now owns the only copy — a key is never resident in two
+        tiers); with ``keep=True`` the entry AND its payload file stay
+        valid, so a read-only caller (param shards, fp32 masters) can
+        drop its copy later without a write-back.  Raises KeyError for
+        unknown keys, IOError for torn payloads or failed reads; the
+        entry is dropped on failure even under ``keep`` so a degraded
         caller cannot re-attach corrupt bytes."""
         entry = self._entries.get(key)
         if entry is None:
             raise KeyError(f"{key} is not tier-resident")
         if entry.tier == "host":
+            if keep:
+                return [np.array(a, copy=True) for a in entry.arrays]
             self._remove(key)
             self._account()
             return entry.arrays
@@ -286,16 +322,18 @@ class SwapEngine:
         if failed:
             self.discard(key)
             raise IOError(f"offload read failed for {key}")
-        self._remove(key)
-        self._account()
-        try:
-            os.remove(self._path(key))
-        except OSError:
-            pass
+        if not keep:
+            self._remove(key)
+            self._account()
+            try:
+                os.remove(self._path(key))
+            except OSError:
+                pass
         out, off = [], 0
         for shape, dtype, n in entry.meta:
-            out.append(np.frombuffer(buf[off:off + n].tobytes(),
-                                     dtype=dtype).reshape(shape))
+            # writable zero-copy views of the read buffer (the buffer is
+            # not retained): the host optimizer steps these in place
+            out.append(buf[off:off + n].view(dtype).reshape(shape))
             off += n
         return out
 

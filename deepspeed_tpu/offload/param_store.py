@@ -1,0 +1,346 @@
+"""Streamed parameter shards over the SwapEngine (ISSUE 17 tentpole).
+
+:class:`ParamStore` is the policy client that completes the
+reference's ``zero/partitioned_param_swapper.py`` design on the TPU
+stack: the model's per-layer param shards live as SwapEngine keys
+(``param/L0007`` — bf16/fp16 payloads, quantized leaves kept
+quantized), only a **K-layer working set** stays materialized in host
+RAM, and the weight pass runs through a double-buffered prefetch
+pipeline — :meth:`get_layer` submits the *next* layer's NVMe read
+before completing the current one, in either direction (forward pass
+prefetches ``k+1``, the backward sweep prefetches ``k-1``).
+
+Policy contracts owned here (mirroring the KV-tiering client,
+``serving/kv_tiering.py``):
+
+- the ``param.swap`` fault site fires on every shard read and
+  write-back (deny = failed I/O; stall = delayed I/O; truncate = a
+  torn NVMe shard).  A failed or torn read NEVER reaches a matmul: it
+  degrades to a synchronous rebuild through ``reload_fn`` (the host
+  optimizer's fp32 masters are the authoritative copy) and heals the
+  on-disk shard, or raises loudly when no rebuild source exists.
+- pin/protect semantics (the KV livelock fixes): the current compute
+  layer and the prefetch target are never evicted from the working
+  set, and a layer whose write-back was denied stays resident
+  (``dirty``) until a later write-back succeeds — capacity pressure
+  can overshoot K, it cannot corrupt or lose a shard.
+- clean evictions are free: shards are read with
+  ``fetch(keep=True)``, so dropping a resident copy needs no
+  write-back (the payload file is still valid).
+- the tiered ledger prices both sides: the engine attributes shard
+  bytes on NVMe/host under the ``params_nvme`` owner row (per-key
+  ``owner=``), and the store accounts its resident working-set copies
+  under ``params_resident``.  Allocation failures in this path call
+  ``record_alloc_failure`` so a too-big model produces a
+  ``memory.json`` bundle naming the tier/owner, not a bare traceback.
+
+Flight-recorder kinds (the ``param/`` family): ``param/swap_fail``
+(a param.swap fault or I/O error on a shard), ``param/degraded`` (a
+shard was rebuilt synchronously from the fp32 masters).
+
+Prefetch overlap is *measured*, not asserted: the store counts reads
+satisfied by an already-in-flight prefetch vs synchronous misses and
+the wall-clock it spent blocked in ``fetch`` —
+:meth:`overlap_fraction` feeds the ``offload/param_prefetch_overlap``
+gauge and the ``scripts/offload_bench.py`` ledger record.
+"""
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.resilience.faults import NULL_INJECTOR
+
+__all__ = ["ParamStore", "SwapTensorClient"]
+
+
+def _ledger_set(tier: str, owner: str, nbytes: int, **detail):
+    """Best-effort ledger row update (never fails a param access)."""
+    try:
+        from deepspeed_tpu.telemetry.memory import (get_memory_ledger,
+                                                    memory_enabled)
+        if memory_enabled():
+            get_memory_ledger().set_bytes(tier, owner, nbytes, **detail)
+    except Exception:  # dslint: disable=DSL005 -- best-effort telemetry tap; a ledger hiccup must never fail a param access
+        pass
+
+
+def _record_alloc_failure(site: str, flightrec=None, **detail):
+    """OOM forensics tap (ISSUE 17 satellite): a MemoryError in the
+    param/offload path snapshots the ledger into the forensics ring so
+    the post-mortem ``memory.json`` names the tier/owner at failure."""
+    try:
+        from deepspeed_tpu.telemetry.memory import get_memory_ledger
+        get_memory_ledger().record_alloc_failure(
+            site, flightrec=flightrec, **detail)
+    except Exception:  # dslint: disable=DSL005 -- forensics are best-effort; the original MemoryError is re-raised by the caller
+        pass
+
+
+class SwapTensorClient:
+    """AsyncTensorSwapper-compatible view of a SwapEngine.
+
+    The HostOffloadOptimizer's hand-rolled ``swap_tensor`` prefetch
+    loop (``runtime/zero/offload.py``) migrates onto the SwapEngine
+    through this duck-typed adapter — same ``swap_out`` / ``prefetch``
+    / ``swap_in`` / ``drain`` surface, but the I/O rides the SAME
+    read/write rings (and queue-depth window) as the param shards, so
+    one budget governs both streams.  ``swap_in`` reads with
+    ``keep=True``: the payload file stays valid on disk, preserving
+    the optimizer's read-only ``_get_master`` contract."""
+
+    def __init__(self, engine, owner: str = "optim_nvme"):
+        self.engine = engine
+        self.owner = owner
+        self.swap_dir = engine.nvme_dir
+
+    def swap_out(self, name: str, arr: np.ndarray):
+        self.engine.put(name, [np.ascontiguousarray(arr)], tier="nvme",
+                        owner=self.owner)
+
+    def prefetch(self, name: str):
+        self.engine.prefetch(name)
+
+    def swap_in(self, name: str) -> np.ndarray:
+        return self.engine.fetch(name, keep=True)[0]
+
+    def drain(self):
+        self.engine.drain()
+
+
+class ParamStore:
+    """K-layer resident working set over SwapEngine-held layer shards.
+
+    Single-threaded by contract (the train loop / serving scheduler
+    already serializes access), like the engine beneath it."""
+
+    def __init__(self, engine, num_layers: int, resident_layers: int = 2,
+                 injector=None, flightrec=None, owner: str = "params_nvme",
+                 reload_fn: Optional[Callable] = None):
+        self.engine = engine
+        self.num_layers = int(num_layers)
+        self.resident_layers = max(1, int(resident_layers))
+        self.injector = injector or NULL_INJECTOR
+        self.flightrec = flightrec
+        self.owner = owner
+        self.resident_owner = "params_resident"
+        #: i -> layer pytree rebuilt from masters when a read fails
+        self.reload_fn = reload_fn
+        self.treedef = None
+        #: working set: layer index -> list of leaf arrays (LRU order)
+        self._resident: "OrderedDict[int, List[np.ndarray]]" = OrderedDict()
+        self._resident_bytes = 0
+        #: layers whose write-back was denied — never evicted until a
+        #: later write-back succeeds
+        self._dirty = set()
+        #: client pins (protect semantics beyond the per-call window)
+        self._pinned = set()
+        # --- measured pipeline counters (gauges/bench, never asserted)
+        self.resident_hits = 0     # get_layer satisfied from the working set
+        self.prefetch_hits = 0     # engine read was already in flight
+        self.sync_misses = 0       # fetch had to submit + block
+        self.failures = 0          # param.swap faults / I/O errors
+        self.degraded = 0          # shards rebuilt from the fp32 masters
+        self.fetch_block_s = 0.0   # wall-clock blocked inside fetch
+        self.put_bytes = 0
+        self.fetch_bytes = 0
+
+    # ------------------------------------------------------------ helpers
+    def _key(self, i: int) -> str:
+        return f"param/L{i:04d}"
+
+    def _flatten(self, tree):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if self.treedef is None:
+            self.treedef = treedef
+        return [np.asarray(a) for a in leaves]
+
+    def _unflatten(self, leaves):
+        import jax
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def _account_resident(self):
+        _ledger_set("host", self.resident_owner, self._resident_bytes,
+                    layers=len(self._resident),
+                    budget_layers=self.resident_layers)
+
+    def _insert_resident(self, i: int, leaves: List[np.ndarray],
+                         protect=()):
+        if i in self._resident:
+            old = self._resident.pop(i)
+            self._resident_bytes -= sum(int(a.nbytes) for a in old)
+        self._resident[i] = leaves
+        self._resident_bytes += sum(int(a.nbytes) for a in leaves)
+        self._evict(protect=set(protect) | {i})
+        self._account_resident()
+
+    def _evict(self, protect=frozenset()):
+        """Shrink the working set back to K.  Pinned, protected and
+        dirty layers are skipped — over-budget beats a lost shard or
+        the prefetch-target livelock the KV tier hit."""
+        candidates = [j for j in self._resident
+                      if j not in protect and j not in self._pinned]
+        for j in candidates:
+            if len(self._resident) <= self.resident_layers:
+                return
+            if j in self._dirty and not self._writeback(j):
+                continue                      # still dirty: keep resident
+            dropped = self._resident.pop(j)
+            self._resident_bytes -= sum(int(a.nbytes) for a in dropped)
+
+    def _writeback(self, i: int) -> bool:
+        """Fault-gated shard write (put or heal).  False = denied; the
+        caller keeps the resident copy dirty."""
+        leaves = self._resident[i]
+        if self.injector.deny("param.swap"):
+            self.failures += 1
+            if self.flightrec is not None:
+                self.flightrec.record("param/swap_fail", layer=i, dir="out")
+            self._dirty.add(i)
+            return False
+        nbytes = int(sum(a.nbytes for a in leaves))
+        keep = self.injector.truncate_bytes("param.swap", nbytes)
+        try:
+            self.engine.put(self._key(i), leaves, tier="nvme",
+                            truncate=keep, owner=self.owner)
+        except MemoryError:
+            _record_alloc_failure("param.swap", flightrec=self.flightrec,
+                                  layer=i, owner=self.owner, nbytes=nbytes)
+            raise
+        self.put_bytes += nbytes
+        self._dirty.discard(i)
+        return True
+
+    # ------------------------------------------------------------- writes
+    def put_layer(self, i: int, tree):
+        """Store layer ``i``'s shard: resident copy + fire-and-forget
+        engine write on the write ring.  ``tree`` may be a pytree or an
+        already-flat leaf list in treedef order (the optimizer sink)."""
+        if isinstance(tree, list):
+            leaves = [np.asarray(a) for a in tree]
+        else:
+            leaves = self._flatten(tree)
+        try:
+            leaves = [np.ascontiguousarray(a) for a in leaves]
+        except MemoryError:
+            _record_alloc_failure("param.store", flightrec=self.flightrec,
+                                  layer=i, owner=self.owner)
+            raise
+        self._resident.pop(i, None)
+        self._insert_resident(i, leaves)
+        self._writeback(i)
+
+    # -------------------------------------------------------------- reads
+    def prefetch_layer(self, i: int):
+        """Submit the async read for layer ``i`` (no-op when resident,
+        out of range, or host-tier)."""
+        if 0 <= i < self.num_layers and i not in self._resident:
+            self.engine.prefetch(self._key(i))
+
+    def get_layer(self, i: int, direction: int = 1):
+        """Layer ``i``'s shard as a pytree, double-buffered: the read
+        for ``i + direction`` is submitted before this one completes,
+        so layer-k compute overlaps the layer-k±1 NVMe read."""
+        if not 0 <= i < self.num_layers:
+            raise IndexError(f"layer {i} out of range 0..{self.num_layers - 1}")
+        nxt = i + direction
+        self.prefetch_layer(nxt)
+        if i in self._resident:
+            self.resident_hits += 1
+            self._resident.move_to_end(i)
+            return self._unflatten(self._resident[i])
+        leaves = self._fetch(i)
+        self._insert_resident(i, leaves,
+                              protect={nxt} if 0 <= nxt < self.num_layers
+                              else ())
+        return self._unflatten(leaves)
+
+    def _fetch(self, i: int) -> List[np.ndarray]:
+        """One fault-gated shard read; degrades to the synchronous
+        master rebuild — torn bytes never reach a matmul."""
+        key = self._key(i)
+        overlapped = key in self.engine.inflight_reads()
+        denied = self.injector.deny("param.swap")
+        t0 = time.perf_counter()
+        leaves = None
+        if not denied:
+            try:
+                leaves = self.engine.fetch(key, keep=True)
+            except MemoryError:
+                _record_alloc_failure("param.swap",
+                                      flightrec=self.flightrec, layer=i,
+                                      owner=self.owner, dir="in")
+                raise
+            except (IOError, OSError, KeyError) as e:
+                self.failures += 1
+                if self.flightrec is not None:
+                    self.flightrec.record("param/swap_fail", layer=i,
+                                          dir="in",
+                                          error=f"{type(e).__name__}: {e}")
+        else:
+            self.failures += 1
+            if self.flightrec is not None:
+                self.flightrec.record("param/swap_fail", layer=i, dir="in",
+                                      error="param.swap deny")
+        self.fetch_block_s += time.perf_counter() - t0
+        if leaves is not None:
+            if overlapped:
+                self.prefetch_hits += 1
+            else:
+                self.sync_misses += 1
+            self.fetch_bytes += int(sum(a.nbytes for a in leaves))
+            return leaves
+        # degrade: rebuild from the authoritative fp32 masters and heal
+        # the on-disk shard; loud failure when no rebuild source exists
+        if self.reload_fn is None:
+            raise IOError(
+                f"param shard {key} unreadable and no reload source — "
+                "refusing to step against missing/torn weights")
+        leaves = self._flatten(self.reload_fn(i))
+        self.degraded += 1
+        self.sync_misses += 1
+        if self.flightrec is not None:
+            self.flightrec.record("param/degraded", layer=i)
+        self._resident[i] = leaves       # transient; _insert accounts
+        self._resident_bytes += sum(int(a.nbytes) for a in leaves)
+        self._writeback(i)
+        dropped = self._resident.pop(i)
+        self._resident_bytes -= sum(int(a.nbytes) for a in dropped)
+        return leaves
+
+    # ------------------------------------------------------------ control
+    def pin(self, i: int):
+        self._pinned.add(i)
+
+    def unpin(self, i: int):
+        self._pinned.discard(i)
+
+    def flush(self):
+        """Re-attempt dirty write-backs and drain the rings (checkpoint
+        / shutdown barrier).  Layers still denied stay resident+dirty."""
+        for i in list(self._dirty):
+            self._writeback(i)
+        self.engine.drain()
+
+    # ------------------------------------------------------------- gauges
+    def overlap_fraction(self) -> float:
+        """Fraction of I/O reads satisfied by an in-flight prefetch
+        (resident hits excluded — they moved no bytes)."""
+        io = self.prefetch_hits + self.sync_misses
+        return self.prefetch_hits / io if io else 0.0
+
+    def publish(self, registry):
+        """Mirror the pipeline counters into the shared metrics
+        registry (the engine's per-step gauge pass)."""
+        registry.set_gauge("offload/param_prefetch_overlap",
+                           self.overlap_fraction())
+        registry.set_gauge("offload/param_resident_layers",
+                           float(len(self._resident)))
+        registry.set_counter("offload/param_swap_failures",
+                             float(self.failures))
+        registry.set_counter("offload/param_degraded_reads",
+                             float(self.degraded))
+        registry.set_counter("offload/param_fetch_block_s",
+                             float(self.fetch_block_s))

@@ -89,6 +89,11 @@ KNOWN_FAULT_SITES = {
     "kv.swap": "tiered-KV swap-out/swap-in (deny = abandon the "
                "demotion / fail the swap-in to re-prefill; truncate = "
                "torn NVMe payload, detected before attach — ISSUE 16)",
+    "param.swap": "streamed-param shard swap-out/swap-in (deny = fail "
+                  "the layer read to a synchronous master rebuild / "
+                  "defer the write-back; stall = delayed I/O; truncate "
+                  "= torn NVMe shard, detected before the matmul — "
+                  "ISSUE 17)",
     "fleet.dispatch": "fleet router replica selection (raise = dispatch "
                       "failure, deny = policy-blind misroute)",
 }

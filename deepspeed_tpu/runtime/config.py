@@ -51,6 +51,10 @@ class OffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = 100_000_000
     max_in_cpu: int = 1_000_000_000
     pin_memory: bool = False
+    # nvme tier only (ISSUE 17): K-layer resident working set for the
+    # streamed-param pipeline (double buffer needs >= 2: compute layer +
+    # prefetch target).  DS_PARAM_RESIDENT_LAYERS overrides at runtime.
+    resident_layers: int = 2
 
 
 class OffloadOptimizerConfig(DeepSpeedConfigModel):

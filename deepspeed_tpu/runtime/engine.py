@@ -227,7 +227,23 @@ class DeepSpeedEngine:
             raise ValueError(
                 "offload_param requires offload_optimizer (the ZeRO-Infinity "
                 "tier pairs parameter offload with the host optimizer)")
+        # ZeRO-Infinity completion (ISSUE 17): offload_param.device=nvme
+        # streams per-layer param shards through the SwapEngine — only a
+        # K-layer working set is ever materialized; the weight pass runs
+        # layer-sliced (runtime/zero/param_stream.py)
+        self._param_nvme = (self._offload_param
+                            and self._offload_param_device == "nvme")
         self._multi_device = len(list(self.mesh.devices.flat)) > 1
+        if self._param_nvme:
+            if self._multi_device:
+                raise ValueError(
+                    "offload_param.device=nvme streams layers on a single "
+                    "host; shard the mesh down to one device or use "
+                    "device=cpu for multi-device pinned-host streaming")
+            if self._config.fp16.enabled:
+                raise ValueError(
+                    "offload_param.device=nvme does not support fp16 "
+                    "dynamic loss scaling; use bf16 or fp32 compute")
         if self._offload_param and self._multi_device and zc.stage < 3:
             # multi-device ZeRO-Infinity (reference partitioned_param_swapper
             # .py:36 + parameter_offload.py:201): each device owns a
@@ -258,6 +274,7 @@ class DeepSpeedEngine:
         self._use_streamed = (
             self._offload and self._offload_param
             and self._offload_device == "cpu"
+            and not self._param_nvme
             and opt_name in ("adam", "adamw"))
         storage_dtype = (self.compute_dtype
                          if (self._offload or self._bf16_master)
@@ -308,16 +325,26 @@ class DeepSpeedEngine:
             # stack): they are ~99.9% of block params, and libtpu cannot
             # compile dynamic-slice on packed bf16 2-D host buffers (biases /
             # norm scales stay device-resident, like the reference's
-            # persistent small params)
-            self.param_shardings[bk] = jax.tree.map(
-                lambda sh, s: (sh.with_memory_kind("pinned_host")
-                               if len(s.shape) >= 3 else sh),
-                self.param_shardings[bk], shapes[bk])
-            if not getattr(getattr(model, "config", None), "remat", False):
+            # persistent small params).  The nvme tier skips pinned-host
+            # entirely: blocks live in the SwapEngine, not on any device,
+            # so the shardings for the blocks subtree are never used.
+            if not self._param_nvme:
+                self.param_shardings[bk] = jax.tree.map(
+                    lambda sh, s: (sh.with_memory_kind("pinned_host")
+                                   if len(s.shape) >= 3 else sh),
+                    self.param_shardings[bk], shapes[bk])
+            if not self._param_nvme and not getattr(
+                    getattr(model, "config", None), "remat", False):
                 logger.warning(
                     "offload_param without per-layer remat keeps every "
                     "streamed layer's device copy alive for backward — set "
                     "the model's remat=True to bound HBM at O(1 layer)")
+        # device-side params tree: the nvme tier uploads only the nonblock
+        # leaves (blocks stream from the ParamStore); everything else keeps
+        # the full tree
+        self._nonblock_shardings = (
+            {k: v for k, v in self.param_shardings.items() if k != bk_}
+            if self._param_nvme else self.param_shardings)
         if model_parameters is None:
             if self._offload_param:
                 # host-side init: params are *stored* in pinned host memory,
@@ -330,7 +357,8 @@ class DeepSpeedEngine:
                           and getattr(model, "nonblock_init_fn", None)
                           is not None)
                 on_tpu = list(self.mesh.devices.flat)[0].platform == "tpu"
-                if n_params >= 1e8 and sliced and on_tpu:
+                if n_params >= 1e8 and sliced and on_tpu \
+                        and not self._param_nvme:
                     # per-layer device init, assembled IN PLACE in the
                     # pinned-host stacked buffers: the TPU RNG generates one
                     # layer's slice (sub-GB HBM) and a donated
@@ -376,17 +404,34 @@ class DeepSpeedEngine:
                     with jax.default_device(jax.devices("cpu")[0]):
                         params = _tree_cast(model.init(init_rng),
                                             storage_dtype)
-                params = jax.device_put(params, self.param_shardings)
+                if self._param_nvme:
+                    # blocks never reach a device: stash the host stack for
+                    # the ParamStore fill + host optimizer construction and
+                    # upload only the nonblock leaves
+                    self._nvme_blocks_host = jax.tree.map(
+                        np.asarray, params[bk_])
+                    params = {k: v for k, v in params.items() if k != bk_}
+                params = jax.device_put(params, self._nonblock_shardings)
             else:
                 params = jax.jit(
                     lambda r: _tree_cast(model.init(r), storage_dtype),
                     out_shardings=self.param_shardings)(init_rng)
         else:
-            params = jax.device_put(_tree_cast(model_parameters, storage_dtype),
-                                    self.param_shardings)
+            params = _tree_cast(model_parameters, storage_dtype)
+            if self._param_nvme:
+                params = jax.tree.map(
+                    lambda a: np.asarray(jax.device_get(a)), params)
+                self._nvme_blocks_host = params[bk_]
+                params = {k: v for k, v in params.items() if k != bk_}
+            params = jax.device_put(params, self._nonblock_shardings)
         self._param_shapes = shapes
         self._qgz_plan = "unbuilt"
-        self.grad_specs = self.zero_policy.grad_specs(params, logical)
+        # nvme tier: grads/optimizer specs follow the device-side tree
+        # (nonblock only), so the logical specs must be filtered to match
+        logical_eff = ({k: v for k, v in logical.items() if k != bk_}
+                       if self._param_nvme and isinstance(logical, dict)
+                       else logical)
+        self.grad_specs = self.zero_policy.grad_specs(params, logical_eff)
         if self._offload_param and self._multi_device and isinstance(
                 self.grad_specs, dict) and bk_ in self.grad_specs:
             # grads DMA out per layer slice in the backward scan — same
@@ -398,7 +443,8 @@ class DeepSpeedEngine:
                 self.zero_policy.zero_axes)
         self.grad_shardings = self.zero_policy.shardings(self.grad_specs)
         devices_flat = list(self.mesh.devices.flat)
-        if self._offload_param and devices_flat[0].platform == "tpu":
+        if self._offload_param and not self._param_nvme \
+                and devices_flat[0].platform == "tpu":
             # block grads land in pinned host too: the backward scan DMAs each
             # layer's grad slice out as it is produced, so the full fp32 grad
             # never resides in HBM.  TPU only: the CPU runtime has no
@@ -409,7 +455,8 @@ class DeepSpeedEngine:
                 lambda s, shp: (s.with_memory_kind("pinned_host")
                                 if len(shp.shape) >= 3 else s),
                 self.grad_shardings[bk], shapes[bk])
-        opt_param_specs = self.zero_policy.optimizer_specs_for_params(params, logical)
+        opt_param_specs = self.zero_policy.optimizer_specs_for_params(
+            params, logical_eff)
 
         # ---- optimizer -------------------------------------------------------
         self.lr_schedule = None
@@ -424,6 +471,9 @@ class DeepSpeedEngine:
 
         self.host_optimizer = None
         self.streamed_optimizer = None
+        self.param_store = None          # nvme param tier (ISSUE 17)
+        self.param_runner = None
+        self._swap_engine = None
         if self._use_streamed:
             # TPU-native ZeRO-Infinity tier: optimizer state in pinned host
             # DRAM, update streamed on device — no Python/host round trips
@@ -453,16 +503,50 @@ class DeepSpeedEngine:
                     "small; drop offload_optimizer for LoRA runs")
             from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
             nvme_swapper = None
-            if self._offload_device == "nvme":
+            if self._offload_device == "nvme" or self._param_nvme:
+                # ONE SwapEngine for every NVMe byte (ISSUE 17): param
+                # shards and optimizer state share the read/write aio
+                # rings and the queue-depth budget, attributed to separate
+                # ledger owner rows (params_nvme / optim_nvme).  The
+                # hand-rolled AsyncTensorSwapper remains only as a
+                # standalone utility; the engine path rides the
+                # SwapTensorClient adapter.
                 import tempfile
-                from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
-                swap_dir = (self._config.zero_config.offload_optimizer.nvme_path
+                from deepspeed_tpu.offload import SwapEngine, SwapTensorClient
+                offo_cfg = self._config.zero_config.offload_optimizer
+                offp_cfg = self._config.zero_config.offload_param
+                swap_dir = ((offo_cfg.nvme_path if offo_cfg is not None
+                             else None)
+                            or (offp_cfg.nvme_path if offp_cfg is not None
+                                else None)
                             or tempfile.mkdtemp(prefix="ds_nvme_"))
-                nvme_swapper = AsyncTensorSwapper(
-                    os.path.join(str(swap_dir), "zero_stage_offload"),
-                    aio_config=self._config.aio_config)
+                aio = self._config.aio_config
+                self._swap_engine = SwapEngine(
+                    nvme_dir=os.path.join(str(swap_dir),
+                                          "zero_stage_offload"),
+                    owner=("params_nvme" if self._param_nvme
+                           else "optim_nvme"),
+                    aio_threads=aio.thread_count,
+                    queue_depth=aio.queue_depth)
+                if self._offload_device == "nvme":
+                    nvme_swapper = SwapTensorClient(self._swap_engine,
+                                                    owner="optim_nvme")
+            opt_params = params
+            if self._param_nvme:
+                # per-layer keyed optimizer tree: dict-sorted flatten puts
+                # each layer's leaves contiguously, so the optimizer's
+                # pipelined prefetch loop walks the step layer by layer
+                blocks_host = self._nvme_blocks_host
+                self._num_layers = int(
+                    jax.tree.leaves(blocks_host)[0].shape[0])
+                layer_trees = {
+                    f"L{i:04d}": jax.tree.map(
+                        lambda a, i=i: np.asarray(a[i]), blocks_host)
+                    for i in range(self._num_layers)}
+                opt_params = dict(params)
+                opt_params[bk_] = layer_trees
             self.host_optimizer = HostOffloadOptimizer(
-                params, self._config.optimizer_name,
+                opt_params, self._config.optimizer_name,
                 self._config.optimizer_params,
                 gradient_clipping=self._config.gradient_clipping,
                 lr_schedule=self.lr_schedule,
@@ -472,6 +556,29 @@ class DeepSpeedEngine:
             opt_state = ()
             self.opt_specs = ()
             self.opt_shardings = ()
+            if self._param_nvme:
+                from deepspeed_tpu.offload import ParamStore
+                from deepspeed_tpu.runtime.zero.param_stream import (
+                    StreamedParamRunner, uses_default_lm_loss)
+                if not uses_default_lm_loss(model):
+                    raise ValueError(
+                        "offload_param.device=nvme requires the default "
+                        "causal-LM loss (the streamed head VJP reproduces "
+                        "it exactly); custom loss_fn models must use "
+                        "device=cpu")
+                resident = int(os.environ.get("DS_PARAM_RESIDENT_LAYERS")
+                               or offp_cfg.resident_layers)
+                self.param_store = ParamStore(
+                    self._swap_engine, self._num_layers,
+                    resident_layers=resident,
+                    injector=self.fault_injector,
+                    reload_fn=self._reload_layer)
+                for i in range(self._num_layers):
+                    self.param_store.put_layer(i, layer_trees[f"L{i:04d}"])
+                self.param_store.flush()
+                self._nvme_blocks_host = None    # full stack goes cold
+                self.param_runner = StreamedParamRunner(
+                    model, self._num_layers, self.param_store)
         else:
             if optimizer is not None and isinstance(
                     optimizer, optax.GradientTransformation):
@@ -555,7 +662,7 @@ class DeepSpeedEngine:
             "scaler": scaler,
         }
         self.state_shardings = {
-            "params": self.param_shardings,
+            "params": self._nonblock_shardings,
             "opt_state": self.opt_shardings,
             "step": NamedSharding(self.mesh, P()),
             "scaler": jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
@@ -662,6 +769,10 @@ class DeepSpeedEngine:
         self.anomaly = AnomalyMonitor(
             registry=self.telemetry_registry, flightrec=self.flightrec,
             window=tcfg.anomaly_window, threshold=tcfg.anomaly_threshold)
+        if self.param_store is not None:
+            # constructed before the recorder existed: late-bind so
+            # param/swap_fail + param/degraded events land in the ring
+            self.param_store.flightrec = self.flightrec
         # perf observatory (ISSUE 13): one-time cost analysis of the
         # fused train-step program (perf/* gauges + span annotation).
         # _step_cost_ok flips only when a report actually registered —
@@ -2226,7 +2337,37 @@ class DeepSpeedEngine:
                 self._config.flops_profiler_config.profile_step):
             self.flops_profiler.start_profile()
         batch = self._shard_batch(batch, stacked=True)
-        if self._offload_param:
+        if self._param_nvme:
+            # streamed-param tier (ISSUE 17): the weight pass runs layer by
+            # layer out of the ParamStore — no compiled full-model step
+            # exists because the full param tree never materializes
+            gas = self.gradient_accumulation_steps()
+            losses = []
+            acc_nb = None
+            acc_layers = None
+            with self.tracer.span("train/fwd_bwd", cat="train",
+                                  args={"micro_batches": gas}):
+                for i in range(gas):
+                    mb = jax.tree.map(lambda x: x[i], batch)
+                    loss, g_nb, g_layers = \
+                        self.param_runner.loss_and_grads(
+                            self.state["params"], mb, self._next_rng())
+                    losses.append(float(loss))
+                    if acc_nb is None:
+                        acc_nb, acc_layers = g_nb, g_layers
+                    else:
+                        acc_nb = jax.tree.map(np.add, acc_nb, g_nb)
+                        acc_layers = [jax.tree.map(np.add, a, g)
+                                      for a, g in zip(acc_layers, g_layers)]
+            if gas > 1:
+                inv = np.float32(1.0 / gas)
+                acc_nb = jax.tree.map(lambda g: g * inv, acc_nb)
+                acc_layers = [jax.tree.map(lambda g: g * inv, t)
+                              for t in acc_layers]
+            mean_loss = jnp.float32(sum(losses) / gas)
+            with self.tracer.span("train/optimizer_step", cat="train"):
+                metrics = self._nvme_apply(acc_nb, acc_layers, mean_loss)
+        elif self._offload_param:
             fn = self._get_compiled("grad_micro")
             gas = self.gradient_accumulation_steps()
             acc = None
@@ -2303,6 +2444,11 @@ class DeepSpeedEngine:
         ``value_and_grad`` once — the loss returned here and the gradients
         ``backward()`` accumulates come from the same evaluation (same RNG,
         no double forward cost)."""
+        if self._param_nvme:
+            raise NotImplementedError(
+                "the forward/backward/step micro API is not available with "
+                "offload_param.device=nvme — use train_batch (the streamed "
+                "weight pass owns the layer schedule)")
         if self._micro_grads is None and self._pending_grads is None:
             # fresh accumulation window: advance the schedules (reference
             # triggers curriculum/LTD in forward, engine.py:1722/:1761)
@@ -2380,6 +2526,85 @@ class DeepSpeedEngine:
             "loss_scale": self.state["scaler"].cur_scale,
         }
 
+    def _reload_layer(self, i: int):
+        """Authoritative rebuild of layer ``i``'s compute-dtype shard from
+        the host optimizer's fp32 masters — the param.swap degrade path.
+        Bit-identical to the streamed payload: the stored shard IS
+        ``master.astype(compute_dtype)`` (written by the optimizer sink)."""
+        bk = getattr(self.model, "blocks_key", "blocks")
+        prefix = f"{bk}/L{i:04d}/"
+        ho = self.host_optimizer
+        out = {}
+        for path in ho.paths:
+            if not path.startswith(prefix):
+                continue
+            parts = path[len(prefix):].split("/")
+            node = out
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = np.asarray(
+                ho._get_master(path).reshape(ho.shapes[path])
+                .astype(self.compute_dtype))
+        return out
+
+    def _nvme_apply(self, g_nonblock, g_layers, loss):
+        """Streamed-param epilogue (ISSUE 17): the host optimizer walks the
+        per-layer grads in path order; a sink hands each finished layer's
+        updated compute-dtype leaves straight to the ParamStore (demoted
+        layers ride the fire-and-forget write ring) instead of
+        materializing the full tree.  Nonblock leaves upload as usual."""
+        bk = getattr(self.model, "blocks_key", "blocks")
+        grads_tree = dict(g_nonblock)
+        grads_tree[bk] = {f"L{i:04d}": g_layers[i]
+                          for i in range(self._num_layers)}
+        step_index = int(self.state["step"])
+        store = self.param_store
+        prefix = f"{bk}/"
+        pend = {"layer": None, "leaves": {}}
+
+        def _flush_pending():
+            if pend["layer"] is None:
+                return
+            nest = {}
+            for lpath, arr in pend["leaves"].items():
+                parts = lpath.split("/")
+                node = nest
+                for part in parts[:-1]:
+                    node = node.setdefault(part, {})
+                node[parts[-1]] = arr
+            store.put_layer(pend["layer"], nest)
+            pend["layer"] = None
+            pend["leaves"] = {}
+
+        def sink(path, arr):
+            if not path.startswith(prefix):
+                return False
+            lname, _, leafpath = path[len(prefix):].partition("/")
+            i = int(lname[1:])
+            if pend["layer"] is not None and pend["layer"] != i:
+                # path order groups layers contiguously: a new layer name
+                # means the previous one is complete — write it back
+                _flush_pending()
+            pend["layer"] = i
+            pend["leaves"][leafpath] = arr
+            return True
+
+        new_tree, grad_norm, overflow = self.host_optimizer.step(
+            grads_tree, step_index, self.compute_dtype, sink=sink)
+        if not overflow:
+            _flush_pending()
+            nonblock_new = {k: v for k, v in new_tree.items() if k != bk}
+            self.state["params"] = jax.device_put(nonblock_new,
+                                                  self._nonblock_shardings)
+            self.state["step"] = self.state["step"] + 1
+        store.publish(self.telemetry_registry)
+        return {
+            "loss": loss if loss is not None else jnp.float32(0.0),
+            "grad_norm": jnp.float32(grad_norm),
+            "overflow": jnp.bool_(overflow),
+            "loss_scale": self.state["scaler"].cur_scale,
+        }
+
     def _host_apply(self, grads, loss):
         """Offload epilogue: unscale on host, C++ optimizer step in host DRAM
         (or NVMe-streamed moments), upload compute-dtype working params."""
@@ -2409,6 +2634,10 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch, stacked=False)
+        if self._param_nvme:
+            # forward-only streamed weight pass (same double-buffered
+            # layer pipeline as training)
+            return self.param_runner.loss(self.state["params"], batch)
         with self._stream_scope(), self._aq_scope():
             return self._get_compiled("loss")(self.state, batch,
                                               self._next_rng())
@@ -3052,6 +3281,13 @@ class DeepSpeedEngine:
             sd["moments"] = {p: [d[j] for j in sorted(d)]
                              for p, d in sd["moments"].items()}
             self.host_optimizer.load_state_dict(sd)
+            if self._param_nvme:
+                # rebuild the NVMe shard store from the restored fp32
+                # masters — bit-identical to the saved payloads (stored
+                # shards are master.astype(compute_dtype))
+                for i in range(self._num_layers):
+                    self.param_store.put_layer(i, self._reload_layer(i))
+                self.param_store.flush()
         self.global_steps = extra.get("global_steps", 0)
         self.global_samples = extra.get("global_samples", 0)
         self.skipped_steps = extra.get("skipped_steps", 0)

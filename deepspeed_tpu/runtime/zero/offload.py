@@ -91,10 +91,15 @@ class HostOffloadOptimizer:
             if self.nvme is not None:
                 for j, b in enumerate(bufs):
                     self.nvme.swap_out(f"{path}.m{j}", b)
-                self.nvme.drain()
                 self.moments[path] = None
             else:
                 self.moments[path] = bufs
+        if self.nvme is not None:
+            # one drain for the whole moment tier: each path's buffers are
+            # freshly allocated and never touched again here, so the writes
+            # can all ride the same queue-depth window instead of init
+            # running at single-request depth (ISSUE 17 small fix)
+            self.nvme.drain()
         master_bytes = sum(4 * int(np.prod(s) if s else 1)
                            for s in self.shapes.values())
         dram_copies = ((0 if self.masters_on_nvme else 1) +
@@ -119,10 +124,18 @@ class HostOffloadOptimizer:
             return float(self.lr_schedule(step))
         return self.base_lr
 
-    def step(self, grads_tree, step_index: int, compute_dtype) -> tuple:
+    def step(self, grads_tree, step_index: int, compute_dtype,
+             sink=None) -> tuple:
         """grads_tree: device (or host) pytree of fp32 grads.
         Returns (new_params_tree as numpy in compute_dtype, grad_norm,
-        overflow: bool)."""
+        overflow: bool).
+
+        ``sink(path, arr) -> bool`` optionally consumes updated leaves as
+        they are produced (the streamed-param tier hands block leaves to
+        the ParamStore instead of materializing the full tree); a consumed
+        leaf becomes ``None`` in the returned tree.  On overflow with a
+        sink armed the tree is ``None`` — the caller keeps its current
+        params rather than paying a full master rebuild."""
         grads = [np.asarray(jax.device_get(g)).astype(np.float32).ravel()
                  for g in jax.tree_util.tree_leaves(grads_tree)]
         # overflow check (reference has_overflow_serial)
@@ -130,6 +143,8 @@ class HostOffloadOptimizer:
         gn_sq = sum(float(np.dot(g, g)) for g in grads) if not overflow else 0.0
         grad_norm = float(np.sqrt(gn_sq))
         if overflow:
+            if sink is not None:
+                return (None, grad_norm, True)
             new_leaves = [self._get_master(p).reshape(self.shapes[p])
                           .astype(compute_dtype) for p in self.paths]
             return (jax.tree_util.tree_unflatten(self.treedef, new_leaves),
@@ -177,7 +192,10 @@ class HostOffloadOptimizer:
                     self.nvme.swap_out(nm, mbuf)
                 if self.masters_on_nvme:
                     self.nvme.swap_out(f"{path}.w", p)
-            new_leaves.append(p.reshape(self.shapes[path]).astype(compute_dtype))
+            new_leaf = p.reshape(self.shapes[path]).astype(compute_dtype)
+            if sink is not None and sink(path, new_leaf):
+                new_leaf = None
+            new_leaves.append(new_leaf)
         if self.nvme is not None:
             self.nvme.drain()
         return (jax.tree_util.tree_unflatten(self.treedef, new_leaves),

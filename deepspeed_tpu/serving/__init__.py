@@ -42,8 +42,10 @@ from deepspeed_tpu.serving.spec import (DraftModelProposer, NgramProposer,
 from deepspeed_tpu.serving.fleet import (FleetRequest,
                                          FleetUnavailableError, Replica,
                                          Router)
+from deepspeed_tpu.serving.cold_params import ColdParamSource
 
 __all__ = [
+    "ColdParamSource",
     "RequestState", "SamplingParams", "ServeRequest",
     "AdmissionError", "QueueFullError", "RequestShedError",
     "RequestTooLongError",

@@ -1,0 +1,76 @@
+"""Cold-layer param source for oversized serving models (ISSUE 17).
+
+The decode weight pass normally assumes the whole param tree is
+resident.  :class:`ColdParamSource` lifts that assumption the same way
+training does: the stacked block subtree is split into per-layer
+SwapEngine shards behind a :class:`~deepspeed_tpu.offload.ParamStore`,
+and the forward streams layers through the double-buffered prefetch
+pipeline — a model whose full params exceed host RAM can still serve,
+trading decode latency for the NVMe read stream (size ``resident_layers``
+and ``aio.queue_depth`` per docs/tutorials/offload.md).
+
+Parity contract: ``forward_logits`` is the same embed → L× block → head
+op sequence as ``model.apply`` for pipeline-decomposed models, so its
+logits match the all-resident forward bit-for-bit at CPU-suite shapes
+(the train-side parity test pins the shared runner; the serving test
+pins this wrapper).
+"""
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["ColdParamSource"]
+
+
+class ColdParamSource:
+    """Streamed block params + resident nonblock leaves for serving."""
+
+    def __init__(self, model, store, nonblock, num_layers: int):
+        from deepspeed_tpu.runtime.zero.param_stream import \
+            StreamedParamRunner
+        self.model = model
+        self.store = store
+        self.nonblock = nonblock
+        self.num_layers = int(num_layers)
+        self.runner = StreamedParamRunner(model, num_layers, store)
+
+    @classmethod
+    def from_params(cls, model, params, engine,
+                    resident_layers: int = 2, injector=None,
+                    flightrec=None, owner: str = "params_nvme"
+                    ) -> "ColdParamSource":
+        """Split a resident param tree into SwapEngine layer shards.
+
+        ``engine`` is a :class:`~deepspeed_tpu.offload.SwapEngine`; the
+        blocks go cold (NVMe payloads, ``owner`` ledger row), everything
+        else stays resident.  After this returns, the caller may drop its
+        reference to the full ``params`` tree."""
+        import jax
+        from deepspeed_tpu.offload import ParamStore
+        bk = getattr(model, "blocks_key", "blocks")
+        if bk not in params:
+            raise ValueError(
+                f"model params have no stacked '{bk}' subtree to stream")
+        blocks = params[bk]
+        num_layers = int(jax.tree_util.tree_leaves(blocks)[0].shape[0])
+        store = ParamStore(engine, num_layers,
+                           resident_layers=resident_layers,
+                           injector=injector, flightrec=flightrec,
+                           owner=owner)
+        for i in range(num_layers):
+            store.put_layer(i, jax.tree_util.tree_map(
+                lambda a, i=i: np.asarray(a[i]), blocks))
+        store.flush()
+        nonblock = {k: v for k, v in params.items() if k != bk}
+        return cls(model, store, nonblock, num_layers)
+
+    def layer(self, i: int, direction: int = 1):
+        """One layer's param shard (double-buffered read of ``i ± 1``)."""
+        return self.store.get_layer(i, direction)
+
+    def forward_logits(self, batch):
+        """Full-sequence logits through the streamed weight pass."""
+        return self.runner.logits(self.nonblock, batch)
+
+    def overlap_fraction(self) -> float:
+        return self.store.overlap_fraction()

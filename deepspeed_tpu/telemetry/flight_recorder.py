@@ -74,6 +74,10 @@ KNOWN_EVENT_KINDS = {
            "kv/prefetch (async swap-in scheduled), kv/swap_in "
            "(cold payload materialized and re-attached), kv/swap_fail "
            "(kv.swap fault or I/O error; degraded to evict/re-prefill)",
+    "param/": "prefix family: NVMe-streamed param shards (ISSUE 17) — "
+              "param/swap_fail (param.swap fault or I/O error on a "
+              "shard), param/degraded (shard rebuilt synchronously "
+              "from the fp32 masters and healed on disk)",
     "num/nonfinite": "a train step produced non-finite gradients; the "
                      "first offending leaf group is in the fields "
                      "(handled=true for loss-scaler overflow skips; "

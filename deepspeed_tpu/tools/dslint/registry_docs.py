@@ -61,6 +61,9 @@ ENV_VARS = {
                     "swap/achieved_vs_floor gauges (no by-kind table: "
                     "the NVMe part is unknowable from JAX — no "
                     "fictitious floors)",
+    "DS_PARAM_RESIDENT_LAYERS": "NVMe param streaming working-set depth "
+                                "override (wins over offload_param."
+                                "resident_layers; ISSUE 17)",
     "DS_PEAK_FLOPS": "per-device peak FLOPs for MFU math (wins over "
                      "telemetry.peak_flops)",
     "DS_PERF_COSTMODEL": "0/1 disables/forces compiled-program cost "
@@ -150,6 +153,17 @@ METRICS = {
     "swap/achieved_vs_floor": "achieved/declared-DS_NVME_GBPS ratio "
                               "(only when the floor is declared), "
                               "labeled by op",
+    # --- NVMe param streaming (ISSUE 17)
+    "offload/param_prefetch_overlap": "fraction of shard reads satisfied "
+                                      "by an in-flight prefetch "
+                                      "(measured, never asserted)",
+    "offload/param_resident_layers": "layers currently materialized in "
+                                     "the host working set",
+    "offload/param_swap_failures": "param.swap faults / shard I/O errors",
+    "offload/param_degraded_reads": "shards rebuilt synchronously from "
+                                    "the fp32 masters (torn/failed read)",
+    "offload/param_fetch_block_s": "wall-clock the weight pass spent "
+                                   "blocked in shard fetch",
     # --- MoE routing health
     "moe/dispatch_tokens": "tokens routed into expert dispatch",
     "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "

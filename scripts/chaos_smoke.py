@@ -417,6 +417,53 @@ def case_nonfinite_provenance():
     reset_numerics()
 
 
+def case_param_swap_fault_degrades():
+    """param.swap stall + truncate mid-step under NVMe-streamed params
+    (ISSUE 17): delayed I/O is absorbed by the pipeline and every torn
+    shard degrades to a synchronous rebuild from the fp32 masters — the
+    loss trajectory is IDENTICAL to the fault-free run; a torn payload
+    never reaches a matmul."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+
+    def run(tmp, faults=None):
+        model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                           num_layers=3, num_heads=4, d_model=32,
+                           dtype="float32", attention_impl="xla")
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0,
+               "zero_optimization": {
+                   "stage": 0,
+                   "offload_optimizer": {"device": "cpu"},
+                   "offload_param": {"device": "nvme", "nvme_path": tmp,
+                                     "resident_layers": 1}}}
+        if faults:
+            cfg["resilience"] = {"faults": faults}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(3):
+            batch = {"input_ids": rng.integers(0, 128, size=(1, 4, 16),
+                                               dtype=np.int32)}
+            losses.append(float(engine.train_batch(batch=batch)))
+        return losses, engine
+
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        clean, _ = run(t1)
+        faulty, engine = run(
+            t2, faults="param.swap:stall=0.01@2;param.swap:truncate@6+")
+        assert engine.fault_injector.fired.get("param.swap", 0) >= 2, \
+            "armed param.swap faults never fired"
+        assert engine.param_store.degraded > 0, \
+            "torn shards never degraded to the master rebuild"
+        assert np.array_equal(np.float32(faulty), np.float32(clean)), \
+            f"faulted run diverged: {faulty} vs {clean}"
+
+
 def case_fleet_replica_loss_resubmits():
     """Fleet replica loss mid-stream (ISSUE 11): two replicas behind
     the Router, a request decoding on one of them when that replica is
@@ -500,6 +547,8 @@ def main(argv=None):
                   case_chunk_fault_resumes_from_cursor))
     cases.append(("kv.swap fault degrades to evict/re-prefill",
                   case_kv_swap_fault_degrades))
+    cases.append(("param.swap fault degrades to master rebuild",
+                  case_param_swap_fault_degrades))
     cases.append(("fleet replica loss resubmits mid-stream",
                   case_fleet_replica_loss_resubmits))
     cases.append(("train.nonfinite NaN attributed to its leaf group",

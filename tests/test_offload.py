@@ -75,7 +75,7 @@ def test_nvme_offload_trains(devices8, tmp_path):
                                    "nvme_path": str(tmp_path)}}))
     losses = _train(engine, steps=3, seed=5)
     assert np.isfinite(losses).all()
-    swap_files = list((tmp_path / "zero_stage_offload").glob("*.swp"))
+    swap_files = list((tmp_path / "zero_stage_offload").glob("*.pay"))
     assert len(swap_files) > 0
 
 
@@ -284,26 +284,36 @@ def test_offload_param_with_gas(mesh1):
         assert np.isfinite(loss)
 
 
-@requires_pinned_host
+def _param_nvme_cfg(tmp_path, opt_device="nvme", **overrides):
+    zo = {"stage": 0,
+          "offload_optimizer": {"device": opt_device,
+                                **({"nvme_path": str(tmp_path)}
+                                   if opt_device == "nvme" else {})},
+          "offload_param": {"device": "nvme",
+                            "nvme_path": str(tmp_path)}}
+    zo["offload_param"].update(overrides.pop("offload_param", {}))
+    return base_config(zero_optimization=zo, **overrides)
+
+
 def test_offload_param_nvme_masters(mesh1, tmp_path):
-    """device=nvme: fp32 masters AND moments stream through the aio op;
-    only the compute-dtype working copy stays in host DRAM."""
+    """device=nvme for BOTH tiers: fp32 masters, moments AND the
+    per-layer param shards all stream through one shared SwapEngine
+    (ISSUE 17 — no pinned_host needed: blocks never touch the device,
+    the streamed weight pass materializes a K-layer working set)."""
     engine, *_ = deepspeed_tpu.initialize(
-        model=tiny_gpt2(remat=True), mesh=mesh1, config=base_config(
-            zero_optimization={
-                "stage": 0,
-                "offload_optimizer": {"device": "nvme",
-                                      "nvme_path": str(tmp_path)},
-                "offload_param": {"device": "nvme",
-                                  "nvme_path": str(tmp_path)}}))
+        model=tiny_gpt2(), mesh=mesh1, config=_param_nvme_cfg(tmp_path))
     ho = engine.host_optimizer
     assert ho.masters_on_nvme
     assert all(v is None for v in ho.master.values())
+    assert engine.param_store is not None
+    # nonblock-only device params: the stacked blocks are never resident
+    assert "blocks" not in engine.state["params"]
     losses = _train(engine, steps=3, seed=3)
     assert np.isfinite(losses).all()
-    names = {f.name for f in (tmp_path / "zero_stage_offload").glob("*.swp")}
-    assert any(n.endswith(".w.swp") for n in names), names   # masters on disk
+    names = {f.name for f in (tmp_path / "zero_stage_offload").glob("*.pay")}
+    assert any(n.endswith(".w.pay") for n in names), names   # masters on disk
     assert any(".m0" in n for n in names), names             # moments on disk
+    assert any(n.startswith("param_L") for n in names), names  # layer shards
 
 
 @requires_pinned_host
@@ -322,3 +332,185 @@ def test_offload_param_checkpoint_roundtrip(mesh1, tmp_path):
     l1 = _train(e1, steps=2, seed=13)
     l2 = _train(e2, steps=2, seed=13)
     np.testing.assert_allclose(l2, l1, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------- ISSUE 17: NVMe-streamed params
+
+def test_offload_param_nvme_matches_resident_bitwise(mesh1, tmp_path):
+    """THE acceptance bar: a model whose full param stack exceeds the
+    resident budget (4 layers, K=1) trains with losses BITWISE-identical
+    to the all-resident host-offload baseline (same C++ Adam, same grad
+    math — the streamed VJP chain is the same op sequence), and the
+    tiered ledger prices the shard bytes under the params_nvme owner."""
+    ref, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(num_layers=4), mesh=mesh1, config=base_config(
+            zero_optimization={"stage": 0,
+                               "offload_optimizer": {"device": "cpu"}}))
+    nv, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(num_layers=4), mesh=mesh1, config=_param_nvme_cfg(
+            tmp_path, opt_device="cpu",
+            offload_param={"resident_layers": 1}))
+    l_ref = _train(ref, steps=4, seed=17)
+    l_nv = _train(nv, steps=4, seed=17)
+    np.testing.assert_array_equal(np.float32(l_nv), np.float32(l_ref))
+    # the working set really is smaller than the model
+    assert nv.param_store.resident_layers == 1
+    assert nv.param_store.sync_misses + nv.param_store.prefetch_hits > 0
+    # overlap is MEASURED, never asserted — just a well-formed fraction
+    assert 0.0 <= nv.param_store.overlap_fraction() <= 1.0
+    from deepspeed_tpu.telemetry.memory import get_memory_ledger
+    assert get_memory_ledger().owner_bytes("nvme", "params_nvme") > 0
+    assert nv.param_store.failures == 0 and nv.param_store.degraded == 0
+
+
+@pytest.mark.parametrize("spec", [
+    "param.swap:stall=0.01@2",     # delayed I/O: pipeline absorbs it
+    "param.swap:truncate@6+",      # torn shards: every read degrades to
+                                   # the synchronous fp32-master rebuild
+    "param.swap:deny@*",           # failed I/O on BOTH directions
+])
+def test_offload_param_nvme_faults_never_corrupt(mesh1, tmp_path, spec):
+    """param.swap stall/truncate/deny mid-step must degrade to a
+    synchronous re-read (fp32 masters are authoritative) — the loss
+    trajectory stays bitwise-identical to the fault-free run; a torn
+    shard never reaches a matmul."""
+    clean, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(num_layers=3), mesh=mesh1, config=_param_nvme_cfg(
+            tmp_path / "clean", opt_device="cpu",
+            offload_param={"resident_layers": 1}))
+    faulty, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(num_layers=3), mesh=mesh1, config=_param_nvme_cfg(
+            tmp_path / "fault", opt_device="cpu",
+            offload_param={"resident_layers": 1},
+            resilience={"faults": spec}))
+    l_clean = _train(clean, steps=3, seed=23)
+    l_fault = _train(faulty, steps=3, seed=23)
+    np.testing.assert_array_equal(np.float32(l_fault), np.float32(l_clean))
+    assert faulty.fault_injector.fired.get("param.swap", 0) > 0
+    if "truncate" in spec:
+        assert faulty.param_store.degraded > 0
+
+
+def test_offload_param_nvme_deny_without_masters_is_loud(tmp_path):
+    """A failed shard read with NO rebuild source must raise, never
+    step against missing weights (ParamStore without reload_fn)."""
+    import os
+    from deepspeed_tpu.offload import ParamStore, SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    store = ParamStore(eng, num_layers=2, resident_layers=1)
+    store.put_layer(0, {"w": np.ones((4, 4), np.float32)})
+    store.put_layer(1, {"w": np.zeros((4, 4), np.float32)})  # evicts L0
+    store.flush()
+    os.remove(eng._path("param/L0000"))      # the shard is gone
+    with pytest.raises(IOError, match="no reload source"):
+        store.get_layer(0)
+
+
+def test_offload_param_nvme_checkpoint_roundtrip(mesh1, tmp_path):
+    cfg = _param_nvme_cfg(tmp_path / "swap")
+    e1, *_ = deepspeed_tpu.initialize(model=tiny_gpt2(), mesh=mesh1,
+                                      config=cfg)
+    _train(e1, steps=2, seed=9)
+    e1.save_checkpoint(str(tmp_path / "ck"))
+    e2, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), mesh=mesh1,
+        config=_param_nvme_cfg(tmp_path / "swap2"))
+    e2.load_checkpoint(str(tmp_path / "ck"))
+    l1 = _train(e1, steps=2, seed=13)
+    l2 = _train(e2, steps=2, seed=13)
+    np.testing.assert_array_equal(np.float32(l2), np.float32(l1))
+
+
+def test_offload_param_nvme_eval_batch(mesh1, tmp_path):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), mesh=mesh1, config=_param_nvme_cfg(tmp_path))
+    b = random_batches(1, batch_size=4, seed=50)[0]
+    loss = float(engine.eval_batch(b))
+    assert np.isfinite(loss)
+
+
+def test_offload_param_nvme_rejects_multidevice_and_fp16(devices8, tmp_path):
+    with pytest.raises(ValueError, match="single"):
+        deepspeed_tpu.initialize(
+            model=tiny_gpt2(), config=_param_nvme_cfg(tmp_path))
+    mesh1 = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    with pytest.raises(ValueError, match="fp16"):
+        deepspeed_tpu.initialize(
+            model=tiny_gpt2(), mesh=mesh1,
+            config=_param_nvme_cfg(tmp_path, fp16={"enabled": True}))
+
+
+def test_cold_param_source_serving_logits(mesh1, tmp_path):
+    """Serving-side cold layers (ColdParamSource): streamed logits match
+    the all-resident forward bitwise at CPU-suite shapes."""
+    import jax as _jax
+    from deepspeed_tpu.serving import ColdParamSource
+    from deepspeed_tpu.offload import SwapEngine
+    model = tiny_gpt2(num_layers=3)
+    params = model.init(_jax.random.PRNGKey(0))
+    batch = random_batches(1, batch_size=2, seed=77)[0]
+    ref = np.asarray(model.apply(params, batch, None))
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    src = ColdParamSource.from_params(model, params, eng,
+                                      resident_layers=1)
+    got = np.asarray(src.forward_logits(batch))
+    np.testing.assert_array_equal(got, ref)
+    assert eng.count("nvme") == 3          # every layer shard went cold
+    assert 0.0 <= src.overlap_fraction() <= 1.0
+
+
+# ------------------------------------------ SwapEngine edge cases (ISSUE 17)
+
+def test_swap_engine_prefetch_host_tier_noop(tmp_path):
+    """prefetch() of a host-tier key is a no-op — no read ring entry,
+    and fetch still returns the payload."""
+    from deepspeed_tpu.offload import SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    arr = np.arange(16, dtype=np.float32)
+    eng.put("k", [arr], tier="host")
+    eng.prefetch("k")
+    assert eng.inflight_reads() == set()
+    out = eng.fetch("k")
+    np.testing.assert_array_equal(out[0], arr)
+
+
+def test_swap_engine_discard_with_inflight_read(tmp_path):
+    """discard() while a prefetch read is in flight reaps the request
+    and drops the key — a later fetch is a clean KeyError, and the
+    engine's rings stay consistent (drain sees nothing pending)."""
+    from deepspeed_tpu.offload import SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    eng.put("k", [np.arange(1 << 16, dtype=np.float32)], tier="nvme")
+    eng.prefetch("k")
+    assert "k" in eng.inflight_reads()
+    eng.discard("k")
+    assert eng.inflight_reads() == set()
+    assert eng.tier_of("k") is None
+    with pytest.raises(KeyError):
+        eng.fetch("k")
+    eng.drain()                              # nothing left to fail
+
+
+def test_swap_engine_failed_read_sentinel_surfaces(tmp_path):
+    """A read reaped as failed by the queue-depth window gate leaves the
+    -1 sentinel; fetch must surface IOError — never the junk buffer.
+    (The file is truncated BEHIND the engine, so its torn-payload
+    bookkeeping can't catch it first: this exercises the backend
+    short-read failure path.)"""
+    import os
+    from deepspeed_tpu.offload import SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path), queue_depth=1)
+    a = np.arange(1 << 14, dtype=np.float32)
+    eng.put("a", [a], tier="nvme")
+    eng.put("b", [a], tier="nvme")
+    eng.drain()
+    os.truncate(eng._path("a"), a.nbytes // 2)   # fail behind its back
+    eng.prefetch("a")
+    # queue_depth=1: submitting b's read forces the gate to reap a's
+    eng.prefetch("b")
+    rid, buf = eng._inflight_reads["a"]
+    assert rid == -1 and buf is None             # the sentinel
+    with pytest.raises(IOError, match="read failed"):
+        eng.fetch("a")
+    out = eng.fetch("b")                         # neighbor unaffected
+    np.testing.assert_array_equal(out[0], a)
