@@ -6,7 +6,10 @@
 Kept import-light: nothing here pulls jax or the aio extension until
 an engine actually touches the NVMe tier.
 """
-from deepspeed_tpu.offload.engine import SwapEngine, TIERS
+from deepspeed_tpu.offload.breaker import TierBreaker
+from deepspeed_tpu.offload.engine import (CorruptPayloadError, SwapEngine,
+                                          TIERS, live_engines)
 from deepspeed_tpu.offload.param_store import ParamStore, SwapTensorClient
 
-__all__ = ["SwapEngine", "TIERS", "ParamStore", "SwapTensorClient"]
+__all__ = ["SwapEngine", "TIERS", "ParamStore", "SwapTensorClient",
+           "CorruptPayloadError", "TierBreaker", "live_engines"]

@@ -1,0 +1,159 @@
+"""Per-tier circuit breaker for the offload substrate (ISSUE 18).
+
+ZeRO-Infinity treats NVMe as fallible media; a drive that starts
+failing every request must not turn each swap into a retry storm that
+stalls the train/serve loop.  :class:`TierBreaker` is the classic
+three-state machine over a rolling window of terminal I/O outcomes
+(retries already happened — only post-retry verdicts feed it):
+
+- **CLOSED** — healthy; every op admitted, outcomes recorded.
+- **OPEN** — the rolling error rate crossed ``error_rate`` over at
+  least ``min_ops`` outcomes: ops are refused (``allow()`` is False)
+  so clients degrade *by policy* — NVMe demotions stop (host-only /
+  evict waterfall), reads fail fast to the per-client degrade path
+  (KV → re-prefill, params → master rebuild) — instead of timing out
+  one at a time.  After ``cooldown_s`` the breaker moves to HALF_OPEN.
+- **HALF_OPEN** — up to ``probes`` REAL ops are admitted; the first
+  recorded failure reopens (fresh cooldown), ``probes`` consecutive
+  successes close and reset the window.
+
+Every transition sets the ``offload/breaker_state`` gauge (0=closed,
+1=half_open, 2=open, labeled by tier) and records an
+``offload/breaker`` flight event, so the CLOSED→OPEN→HALF_OPEN→CLOSED
+lifecycle is observable end-to-end (``/debug/offload`` serves the live
+snapshot; post-mortem bundles embed it).
+
+Single-threaded by contract, like the SwapEngine that owns it.  The
+clock is injectable for deterministic cooldown tests.
+"""
+import collections
+import time
+from typing import Callable, Optional
+
+__all__ = ["TierBreaker", "STATE_CLOSED", "STATE_HALF_OPEN", "STATE_OPEN"]
+
+STATE_CLOSED = "closed"
+STATE_HALF_OPEN = "half_open"
+STATE_OPEN = "open"
+
+#: gauge encoding (docs/reference/registries.md): healthy sorts lowest
+_STATE_GAUGE = {STATE_CLOSED: 0.0, STATE_HALF_OPEN: 1.0, STATE_OPEN: 2.0}
+
+
+class TierBreaker:
+    """Rolling error-rate circuit breaker for one storage tier."""
+
+    def __init__(self, tier: str = "nvme", window: int = 16,
+                 error_rate: float = 0.5, min_ops: int = 4,
+                 cooldown_s: float = 30.0, probes: int = 1,
+                 _now: Callable[[], float] = time.monotonic):
+        self.tier = tier
+        self.window = max(1, int(window))
+        self.error_rate = float(error_rate)
+        self.min_ops = max(1, int(min_ops))
+        self.cooldown_s = float(cooldown_s)
+        self.probes = max(1, int(probes))
+        self._now = _now
+        self.state = STATE_CLOSED
+        self._outcomes = collections.deque(maxlen=self.window)  # True = ok
+        self._opened_at: Optional[float] = None
+        self._probes_admitted = 0
+        self._probe_successes = 0
+        # monotonic lifecycle counters (debug/postmortem snapshots)
+        self.opens = 0
+        self.closes = 0
+        self.refused = 0
+        self._publish_gauge()
+
+    def _publish_gauge(self):
+        """A breaker that never trips must still be scrapeable: publish
+        the state gauge at construction, not only on transitions."""
+        try:
+            from deepspeed_tpu.telemetry import get_registry
+            get_registry().set_gauge("offload/breaker_state",
+                                     _STATE_GAUGE[self.state],
+                                     tier=self.tier)
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"breaker gauge publish failed ({e})")
+
+    # ------------------------------------------------------------ plumbing
+    def _transition(self, new: str):
+        if new == self.state:
+            return
+        old, self.state = self.state, new
+        if new == STATE_OPEN:
+            self.opens += 1
+            self._opened_at = self._now()
+        elif new == STATE_CLOSED:
+            self.closes += 1
+            self._outcomes.clear()
+        if new != STATE_HALF_OPEN:
+            self._probes_admitted = 0
+            self._probe_successes = 0
+        self._publish_gauge()
+        try:
+            from deepspeed_tpu.telemetry.flight_recorder import \
+                get_flight_recorder
+            get_flight_recorder().record("offload/breaker", tier=self.tier,
+                                         **{"from": old, "to": new})
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"breaker telemetry failed ({e}); state machine "
+                         "continues unobserved")
+
+    def _error_fraction(self) -> float:
+        if not self._outcomes:
+            return 0.0
+        return self._outcomes.count(False) / len(self._outcomes)
+
+    # ------------------------------------------------------------- surface
+    def allow(self) -> bool:
+        """Gate one tier op.  CLOSED admits; OPEN refuses until the
+        cooldown elapses (then flips to HALF_OPEN); HALF_OPEN admits up
+        to ``probes`` in-flight probe ops — the probes ARE real traffic,
+        their outcomes decide the next state."""
+        if self.state == STATE_OPEN:
+            if (self._opened_at is not None
+                    and self._now() - self._opened_at >= self.cooldown_s):
+                self._transition(STATE_HALF_OPEN)
+            else:
+                self.refused += 1
+                return False
+        if self.state == STATE_HALF_OPEN:
+            if self._probes_admitted >= self.probes:
+                self.refused += 1
+                return False
+            self._probes_admitted += 1
+        return True
+
+    def record(self, ok: bool):
+        """Feed one TERMINAL op outcome (post-retry verdict)."""
+        if self.state == STATE_HALF_OPEN:
+            if not ok:
+                self._transition(STATE_OPEN)
+                return
+            self._probe_successes += 1
+            if self._probe_successes >= self.probes:
+                self._transition(STATE_CLOSED)
+            return
+        self._outcomes.append(ok)
+        if (self.state == STATE_CLOSED
+                and len(self._outcomes) >= self.min_ops
+                and self._error_fraction() >= self.error_rate):
+            self._transition(STATE_OPEN)
+
+    def snapshot(self) -> dict:
+        """Live state for ``/debug/offload`` and post-mortem bundles."""
+        return {"tier": self.tier, "state": self.state,
+                "window": self.window, "error_rate": self.error_rate,
+                "recent_error_fraction": round(self._error_fraction(), 4),
+                "recent_ops": len(self._outcomes),
+                "opens": self.opens, "closes": self.closes,
+                "refused": self.refused,
+                "cooldown_s": self.cooldown_s,
+                "probes": self.probes,
+                "probes_admitted": self._probes_admitted,
+                "open_for_s": (round(self._now() - self._opened_at, 3)
+                               if self.state == STATE_OPEN
+                               and self._opened_at is not None else None)}

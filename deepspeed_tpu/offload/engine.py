@@ -1,4 +1,5 @@
-"""Generic async prefetch/swap engine (ISSUE 16 tentpole).
+"""Generic async prefetch/swap engine (ISSUE 16 tentpole; ISSUE 18
+storage integrity).
 
 The reference's ZeRO-Infinity moves bytes through one shape
 (PAPER.md §1 layers 0/5, ``zero/partitioned_param_swapper.py`` over
@@ -14,8 +15,8 @@ Clients and contracts:
 
 - the first client is the serving side's tiered KV cache
   (``serving/kv_tiering.py`` — refcount-0 prefix blocks demote
-  HBM→host→NVMe instead of evicting); ROADMAP item 2 points the SAME
-  engine at parameter shards next.
+  HBM→host→NVMe instead of evicting); param shards and optimizer state
+  ride the SAME engine (``offload/param_store.py``).
 - payloads are lists of numpy arrays (one per pytree leaf); NVMe
   serialization is the raw concatenated bytes with shapes/dtypes held
   host-side, so a swap round-trip is bit-exact by construction (int8
@@ -42,37 +43,110 @@ Clients and contracts:
   payload file stay valid, so a client holding a resident working set
   (the ParamStore's K layers) evicts clean copies for free.
 
-The engine is deliberately policy-free: no faults, no eviction
-heuristics beyond the capacity caps, no knowledge of what a key means.
-Policy (fault sites, LRU pressure, parity rules) lives in the client.
+Storage integrity (ISSUE 18) — NVMe is fallible media, and a
+same-size bit-flip sails through the byte-count torn check:
+
+- **checksums**: every payload's crc32 is computed at swap-out and
+  stored host-side; ``fetch`` verifies it on BOTH tiers before any
+  byte can reach a consumer.  A mismatch raises the typed
+  :class:`CorruptPayloadError`, quarantines the key (the corrupt copy
+  is dropped and can never re-attach; a fresh ``put`` of the key —
+  e.g. the ParamStore's heal-back — clears the quarantine record),
+  counts in ``offload/integrity_fail``, and records an
+  ``offload/corrupt`` flight event.  ``verify_fetch=False`` is the
+  hot-path escape hatch (checksums still stored, verification
+  skipped) if the measured tax matters.
+- **retry/backoff**: aio submission and reaping route through
+  ``resilience/retry.retry_call`` — a transient backend error
+  resubmits synchronously from a retained source with bounded
+  backoff; only post-retry verdicts count as failures.
+- **tier circuit breaker**: terminal I/O outcomes feed a per-tier
+  :class:`~deepspeed_tpu.offload.breaker.TierBreaker`.  OPEN refuses
+  new NVMe reads fast (the entry is RETAINED — the media may heal)
+  and lets write-side clients stop demoting (``nvme_allowed()``);
+  HALF_OPEN probes with real traffic.
+- **retain-until-durable**: a fire-and-forget write's pristine
+  serialized source is retained until the write reaps OK; a terminal
+  write failure REVERTS the entry to the host tier from that source
+  (``offload/write_reverts``) — a failed demotion can never have
+  consumed the only copy.
+- the ``swap.io`` fault site fires in the submit/reap paths (deny =
+  backend I/O failure; corrupt = bit-flip between checksum and disk),
+  so the whole ladder is chaos-testable without a failing drive.
+
+The engine remains policy-free about *meaning* (no eviction
+heuristics, no knowledge of what a key holds); integrity is mechanism,
+and the per-client degrade policy (re-prefill vs master rebuild)
+stays in the clients.
 """
 import os
 import tempfile
+import time
+import weakref
+import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["SwapEngine", "TIERS"]
+from deepspeed_tpu.offload.breaker import STATE_OPEN, TierBreaker
+from deepspeed_tpu.resilience.faults import NULL_INJECTOR, flip_bytes
+
+__all__ = ["SwapEngine", "TIERS", "CorruptPayloadError", "live_engines"]
 
 #: engine tiers, warm to cold (the device tier stays with the client —
 #: the engine only ever holds spilled copies)
 TIERS = ("host", "nvme")
 
+#: quarantine ring bound: corrupt-key forensics, not a second cache
+_QUARANTINE_CAP = 64
+
+#: live engines for the ``/debug/offload`` surface (weak: an engine
+#: that closes or goes out of scope drops off the view)
+_LIVE_ENGINES = weakref.WeakSet()
+
+
+def live_engines() -> list:
+    """Engines alive in this process, oldest construction first
+    (best-effort ordering: WeakSet iteration order is arbitrary, so
+    sort by the monotonic construction stamp)."""
+    return sorted(_LIVE_ENGINES, key=lambda e: e._born)
+
+
+class CorruptPayloadError(IOError):
+    """A payload's stored checksum did not match the fetched bytes.
+
+    Subclasses IOError so every existing client degrade path (KV →
+    discard + re-prefill, params → synchronous master rebuild) already
+    catches it; typed so tests and chaos cases can assert corruption
+    was *detected*, not absorbed."""
+
+    def __init__(self, key: str, tier: str, expected: int, actual: int):
+        super().__init__(
+            f"corrupt offload payload for {key} ({tier} tier): "
+            f"crc32 {actual:#010x} != stored {expected:#010x} — "
+            "quarantined, never attached")
+        self.key = key
+        self.tier = tier
+        self.expected = expected
+        self.actual = actual
+
 
 class _Entry:
     """One key's residency: exactly one tier at a time."""
     __slots__ = ("tier", "meta", "arrays", "nbytes", "disk_nbytes",
-                 "owner")
+                 "owner", "crc")
 
     def __init__(self, tier: str, meta, arrays, nbytes: int,
-                 disk_nbytes: int = 0, owner: Optional[str] = None):
+                 disk_nbytes: int = 0, owner: Optional[str] = None,
+                 crc: Optional[int] = None):
         self.tier = tier
         self.meta = meta          # [(shape, dtype, nbytes), ...] per leaf
         self.arrays = arrays      # host tier: the payload; nvme: None
         self.nbytes = nbytes      # true payload bytes
         self.disk_nbytes = disk_nbytes   # bytes actually on disk (nvme)
         self.owner = owner        # ledger attribution for this key
+        self.crc = crc            # crc32 of the true payload (or None)
 
 
 class SwapEngine:
@@ -82,22 +156,60 @@ class SwapEngine:
     offload runtime) already serialize access under their own lock, and
     the aio handles below carry per-request state that must not
     interleave.
+
+    ``integrity`` is any object carrying the ``resilience.offload``
+    config fields (``runtime/config.py OffloadIntegrityConfig``);
+    ``None`` takes every default.  ``injector`` arms the ``swap.io``
+    fault site inside the submit/reap paths.
     """
 
     def __init__(self, nvme_dir: Optional[str] = None, owner: str = "offload",
-                 aio_threads: int = 2, queue_depth: int = 2):
+                 aio_threads: int = 2, queue_depth: int = 2,
+                 injector=None, integrity=None):
         self._owned_dir = nvme_dir is None
         self.nvme_dir = nvme_dir or tempfile.mkdtemp(prefix="ds_offload_")
         os.makedirs(self.nvme_dir, exist_ok=True)
         self.owner = owner
         self.queue_depth = max(1, int(queue_depth))
         self._aio_threads = max(1, int(aio_threads))
+        self.injector = injector or NULL_INJECTOR
+        # --- integrity policy (ISSUE 18): checksum + retry + breaker
+        self.checksums = bool(getattr(integrity, "checksums", True))
+        self.verify_fetch = bool(getattr(integrity, "verify_fetch", True))
+        self._retry_kw = dict(
+            attempts=int(getattr(integrity, "retry_attempts", 3)),
+            base_delay_s=float(getattr(integrity, "retry_base_delay_s",
+                                       0.002)),
+            max_delay_s=float(getattr(integrity, "retry_max_delay_s",
+                                      0.05)),
+            deadline_s=getattr(integrity, "retry_deadline_s", None))
+        self._breaker = TierBreaker(
+            "nvme",
+            window=int(getattr(integrity, "breaker_window", 16)),
+            error_rate=float(getattr(integrity, "breaker_error_rate", 0.5)),
+            min_ops=int(getattr(integrity, "breaker_min_ops", 4)),
+            cooldown_s=float(getattr(integrity, "breaker_cooldown_s",
+                                     30.0)),
+            probes=int(getattr(integrity, "breaker_probes", 1)))
         # lazy: host-only configurations never pay for the aio rings
         self._aio_r = None
         self._aio_w = None
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._inflight_reads: Dict[str, tuple] = {}   # key -> (rid, buf)
         self._inflight_writes: Dict[str, int] = {}    # key -> write id
+        #: retain-until-durable (ISSUE 18): key -> the PRISTINE
+        #: serialized payload of an in-flight write.  Released only
+        #: when the write reaps OK; a terminal write failure reverts
+        #: the entry to the host tier from this copy, so a failed
+        #: fire-and-forget demotion never consumed the only copy.
+        self._pending_writes: Dict[str, np.ndarray] = {}
+        #: corrupt-key forensics ring: key -> {tier, reason, unix}.
+        #: A quarantined key's payload was dropped before any consumer
+        #: saw it; a fresh put() of the key (heal-back) clears the row.
+        self._quarantine: "OrderedDict[str, dict]" = OrderedDict()
+        self.integrity_failures = 0   # checksum mismatches detected
+        self.write_reverts = 0        # failed writes reverted to host
+        self.io_failures = 0          # terminal (post-retry) aio failures
         self._tier_bytes = {"host": 0, "nvme": 0}
         self._tier_count = {"host": 0, "nvme": 0}
         # per-(tier, owner) attribution: one SHARED engine can serve
@@ -107,6 +219,8 @@ class SwapEngine:
         self._owner_bytes: Dict[tuple, int] = {}
         self._owner_count: Dict[tuple, int] = {}
         self._owners = {self.owner}
+        self._born = time.monotonic()
+        _LIVE_ENGINES.add(self)
         # arm the process-wide aio observation sink (idempotent)
         try:
             from deepspeed_tpu.telemetry.iostat import get_iostat
@@ -152,6 +266,17 @@ class SwapEngine:
             from deepspeed_tpu.utils.logging import logger
             logger.debug(f"offload ledger accounting failed ({e})")
 
+    def _flight(self, kind: str, **fields):
+        """Best-effort flight event through the process-wide recorder
+        (the engine sits below the clients that carry one)."""
+        try:
+            from deepspeed_tpu.telemetry.flight_recorder import \
+                get_flight_recorder
+            get_flight_recorder().record(kind, **fields)
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload flight event failed ({e})")
+
     def _add(self, key: str, entry: _Entry):
         self._entries[key] = entry
         nbytes = (entry.disk_nbytes if entry.tier == "nvme"
@@ -176,12 +301,134 @@ class SwapEngine:
             self._owner_bytes[ok] = self._owner_bytes.get(ok, 0) - nbytes
         return entry
 
-    def _wait_write(self, key: str):
+    # ----------------------------------------------------- integrity core
+    def _record_io_failure(self, key: str, direction: str):
+        self.io_failures += 1
+        self._breaker.record(False)
+        try:
+            from deepspeed_tpu.telemetry import get_registry
+            get_registry().inc("offload/io_failures", dir=direction)
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload io-failure telemetry failed ({e})")
+
+    def _quarantine_key(self, key: str, entry: _Entry, actual: int):
+        """Checksum mismatch: drop the corrupt copy (it can never
+        re-attach), record the key in the bounded quarantine ring, and
+        surface the typed error to the caller's degrade path."""
+        self._remove(key)
+        if entry.tier == "nvme":
+            try:
+                os.remove(self._path(key))
+            except OSError:
+                pass
+        self._quarantine[key] = {"tier": entry.tier,
+                                 "reason": "crc_mismatch",
+                                 "unix": round(time.time(), 3)}
+        while len(self._quarantine) > _QUARANTINE_CAP:
+            self._quarantine.popitem(last=False)
+        self.integrity_failures += 1
+        try:
+            from deepspeed_tpu.telemetry import get_registry
+            get_registry().inc("offload/integrity_fail", tier=entry.tier)
+            get_registry().set_gauge("offload/quarantined",
+                                     float(len(self._quarantine)))
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload integrity telemetry failed ({e})")
+        self._flight("offload/corrupt", key=key, tier=entry.tier,
+                     owner=entry.owner or self.owner)
+        self._account()
+        raise CorruptPayloadError(key, entry.tier, entry.crc or 0, actual)
+
+    @staticmethod
+    def _crc_arrays(arrays: Sequence[np.ndarray]) -> int:
+        crc = 0
+        for a in arrays:
+            crc = zlib.crc32(np.ascontiguousarray(a).view(np.uint8)
+                             .reshape(-1), crc)
+        return crc
+
+    def _sync_write(self, buf: np.ndarray, path: str, key: str):
+        """One synchronous write attempt (the retry body): submit +
+        reap; an injected swap.io deny models a backend failure."""
+        _, aio_w = self._rings()
+        rid = aio_w.submit_pwrite(buf, path)
+        if aio_w.wait_req(rid) == -1 or self.injector.deny("swap.io"):
+            raise IOError(f"offload write retry failed for {key}")
+
+    def _sync_read(self, buf: np.ndarray, key: str):
+        """One synchronous read attempt (the retry body)."""
+        aio_r, _ = self._rings()
+        rid = aio_r.submit_pread(buf, self._path(key))
+        if aio_r.wait_req(rid) == -1 or self.injector.deny("swap.io"):
+            raise IOError(f"offload read retry failed for {key}")
+
+    def _retry(self, fn, *args, describe: str):
+        from deepspeed_tpu.resilience.retry import retry_call
+        retry_call(fn, *args, retry_on=(OSError,), describe=describe,
+                   **self._retry_kw)
+
+    def _revert_to_host(self, key: str, entry: _Entry, src: np.ndarray):
+        """Durability ordering: the write never became durable, but the
+        pristine serialized source was retained — rebuild the host-tier
+        entry from it.  The key survives; only the demotion failed."""
+        self._remove(key)
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+        buf = src.copy()         # writable: host arrays may be stepped
+        arrays, off = [], 0
+        for shape, dtype, n in entry.meta:
+            arrays.append(buf[off:off + n].view(dtype).reshape(shape))
+            off += n
+        self._add(key, _Entry("host", entry.meta, arrays, entry.nbytes,
+                              owner=entry.owner, crc=entry.crc))
+        self.write_reverts += 1
+        try:
+            from deepspeed_tpu.telemetry import get_registry
+            get_registry().inc("offload/write_reverts")
+        except Exception as e:
+            from deepspeed_tpu.utils.logging import logger
+            logger.debug(f"offload revert telemetry failed ({e})")
+        self._flight("offload/write_revert", key=key,
+                     owner=entry.owner or self.owner, bytes=entry.nbytes)
+        self._account()
+
+    # ------------------------------------------------------------- windows
+    def _wait_write(self, key: str, revert: bool = True):
+        """Reap one in-flight write.  A backend failure retries from
+        the retained pristine source; a terminal failure feeds the
+        breaker and — when the entry is still NVMe-resident — reverts
+        it to the host tier instead of raising (the bytes survive).
+        Raises IOError only when no source remains to recover from."""
         wid = self._inflight_writes.pop(key, None)
-        if wid is not None:
-            _, aio_w = self._rings()
-            if aio_w.wait_req(wid) == -1:
-                raise IOError(f"offload write failed for {key}")
+        if wid is None:
+            return
+        src = self._pending_writes.pop(key, None)
+        _, aio_w = self._rings()
+        failed = aio_w.wait_req(wid) == -1
+        if self.injector.deny("swap.io"):
+            failed = True
+        if not failed:
+            self._breaker.record(True)
+            return
+        if src is not None:
+            try:
+                self._retry(self._sync_write, src, self._path(key), key,
+                            describe=f"offload write {key}")
+                self._breaker.record(True)
+                return
+            except OSError:
+                pass
+        self._record_io_failure(key, "write")
+        entry = self._entries.get(key)
+        if revert and src is not None and entry is not None \
+                and entry.tier == "nvme":
+            self._revert_to_host(key, entry, src)
+            return
+        raise IOError(f"offload write failed for {key}")
 
     def _window_gate(self, inflight: Dict):
         """The double-buffering window: beyond ``queue_depth``
@@ -204,24 +451,36 @@ class SwapEngine:
             key = live[0]
             rid, buf = inflight.pop(key)
             aio_r, _ = self._rings()
-            if aio_r.wait_req(rid) == -1:
-                inflight[key] = (-1, None)
-            else:
-                inflight[key] = (0, buf)
+            failed = aio_r.wait_req(rid) == -1
+            if self.injector.deny("swap.io"):
+                failed = True
+            inflight[key] = (-1, None) if failed else (0, buf)
 
     def _write_nvme(self, key: str, arrays: Sequence[np.ndarray],
-                    nbytes: int, truncate: Optional[int]) -> int:
-        """Serialize + submit the async write; returns on-disk bytes
-        (< nbytes only under an injected torn write)."""
+                    nbytes: int, truncate: Optional[int],
+                    corrupt: Optional[int] = None,
+                    crc: Optional[int] = None) -> tuple:
+        """Serialize + submit the async write; returns (on-disk bytes,
+        payload crc).  The crc is computed (or carried through on a
+        tier move) BEFORE any injected damage: ``truncate``/``corrupt``
+        model what bad media does to bytes already checksummed."""
         self._wait_write(key)            # same-key writes must not race
         self._window_gate(self._inflight_writes)
         payload = b"".join(np.ascontiguousarray(a).tobytes()
                            for a in arrays)
-        buf = np.frombuffer(payload, dtype=np.uint8)
+        if crc is None and self.checksums:
+            crc = zlib.crc32(payload)
+        src = np.frombuffer(payload, dtype=np.uint8)
+        wbuf = src
+        flips = max(corrupt or 0,
+                    self.injector.corrupt_bytes("swap.io", nbytes) or 0)
+        if flips:
+            wbuf = src.copy()
+            flip_bytes(wbuf, flips)
         disk = nbytes
         if truncate is not None and truncate < nbytes:
-            buf = buf[:max(0, truncate)].copy()
-            disk = int(buf.nbytes)
+            wbuf = wbuf[:max(0, truncate)].copy()
+            disk = int(wbuf.nbytes)
         path = self._path(key)
         # a shrinking rewrite must not leave stale tail bytes that make
         # a torn payload look whole
@@ -229,66 +488,114 @@ class SwapEngine:
             os.truncate(path, 0)
         if disk:
             _, aio_w = self._rings()
-            self._inflight_writes[key] = aio_w.submit_pwrite(buf, path)
+            from deepspeed_tpu.resilience.retry import retry_call
+            self._inflight_writes[key] = retry_call(
+                aio_w.submit_pwrite, wbuf, path, retry_on=(OSError,),
+                describe=f"offload submit {key}", **self._retry_kw)
+            # retained until the write reaps OK (pristine, full-length:
+            # the revert source even under an injected torn write)
+            self._pending_writes[key] = src
         else:
             open(path, "wb").close()
-        return disk
+        return disk, crc
 
     # -------------------------------------------------------------- writes
     def put(self, key: str, arrays: Sequence[np.ndarray],
             tier: str = "host", truncate: Optional[int] = None,
-            owner: Optional[str] = None) -> int:
+            owner: Optional[str] = None,
+            corrupt: Optional[int] = None) -> int:
         """Store a payload (replacing any tier's prior copy).  Host puts
         keep the arrays; nvme puts serialize and fire-and-forget the
         write.  ``truncate`` (fault injection) caps the bytes that reach
-        disk — ``fetch`` of a torn payload fails cleanly.  ``owner``
-        attributes THIS key's bytes to a ledger row other than the
-        engine default (shared-engine clients).  Returns the payload's
-        byte size."""
+        disk — ``fetch`` of a torn payload fails cleanly.  ``corrupt``
+        (fault injection) bit-flips that many payload bytes AFTER the
+        checksum is computed — size-preserving damage only the checksum
+        can see.  ``owner`` attributes THIS key's bytes to a ledger row
+        other than the engine default (shared-engine clients).  A fresh
+        put clears the key's quarantine record (the heal-back path
+        stores known-good bytes).  Returns the payload's byte size."""
         assert tier in TIERS, tier
         self.discard(key)
+        if self._quarantine.pop(key, None) is not None:
+            try:
+                from deepspeed_tpu.telemetry import get_registry
+                get_registry().set_gauge("offload/quarantined",
+                                         float(len(self._quarantine)))
+            except Exception as e:
+                from deepspeed_tpu.utils.logging import logger
+                logger.debug(f"offload quarantine gauge failed ({e})")
         meta = [(a.shape, a.dtype, int(a.nbytes)) for a in arrays]
         nbytes = sum(m[2] for m in meta)
         if tier == "host":
-            self._add(key, _Entry("host", meta,
-                                  [np.ascontiguousarray(a) for a in arrays],
-                                  nbytes, owner=owner))
+            host = [np.ascontiguousarray(a) for a in arrays]
+            crc = self._crc_arrays(host) if self.checksums else None
+            if corrupt:
+                # flip IN the stored copy (post-checksum, like media
+                # damage): the host-tier fetch verify must catch it.
+                # Callers hand live (often read-only) KV views — damage
+                # a private copy, never the caller's buffer.
+                for i, a in enumerate(host):
+                    if a.nbytes:
+                        damaged = a.copy()
+                        flip_bytes(damaged.view(np.uint8).reshape(-1),
+                                   corrupt)
+                        host[i] = damaged
+                        break
+            self._add(key, _Entry("host", meta, host, nbytes,
+                                  owner=owner, crc=crc))
         else:
-            disk = self._write_nvme(key, arrays, nbytes, truncate)
+            disk, crc = self._write_nvme(key, arrays, nbytes, truncate,
+                                         corrupt=corrupt)
             self._add(key, _Entry("nvme", meta, None, nbytes,
-                                  disk_nbytes=disk, owner=owner))
+                                  disk_nbytes=disk, owner=owner, crc=crc))
         self._account()
         return nbytes
 
-    def demote(self, key: str, truncate: Optional[int] = None) -> int:
+    def demote(self, key: str, truncate: Optional[int] = None,
+               corrupt: Optional[int] = None) -> int:
         """Move a host-tier payload to the NVMe tier (the host→NVMe leg
-        of the spill waterfall).  Returns the payload's byte size."""
+        of the spill waterfall).  The entry's stored crc travels with it
+        (NOT recomputed: corruption picked up while host-resident must
+        stay detectable after the tier move).  Returns the payload's
+        byte size."""
         entry = self._entries.get(key)
         if entry is None or entry.tier != "host":
             raise KeyError(f"{key} is not host-resident")
         self._remove(key)
-        disk = self._write_nvme(key, entry.arrays, entry.nbytes, truncate)
+        disk, crc = self._write_nvme(key, entry.arrays, entry.nbytes,
+                                     truncate, corrupt=corrupt,
+                                     crc=entry.crc)
         self._add(key, _Entry("nvme", entry.meta, None, entry.nbytes,
-                              disk_nbytes=disk, owner=entry.owner))
+                              disk_nbytes=disk, owner=entry.owner,
+                              crc=crc))
         self._account()
         return entry.nbytes
 
     # --------------------------------------------------------------- reads
-    def prefetch(self, key: str):
-        """Submit the async read for an NVMe payload (no-op for host
-        payloads, unknown keys, in-flight reads, and torn payloads —
-        fetch() is where failures surface)."""
-        entry = self._entries.get(key)
-        if (entry is None or entry.tier != "nvme"
-                or key in self._inflight_reads
-                or entry.disk_nbytes != entry.nbytes):
-            return
+    def _submit_read(self, key: str, entry: _Entry):
         self._wait_write(key)            # write→read ordering, this key only
         self._window_gate(self._inflight_reads)
         buf = np.empty(entry.nbytes, dtype=np.uint8)
         aio_r, _ = self._rings()
-        rid = aio_r.submit_pread(buf, self._path(key))
+        from deepspeed_tpu.resilience.retry import retry_call
+        rid = retry_call(aio_r.submit_pread, buf, self._path(key),
+                         retry_on=(OSError,),
+                         describe=f"offload submit {key}",
+                         **self._retry_kw)
         self._inflight_reads[key] = (rid, buf)
+
+    def prefetch(self, key: str):
+        """Submit the async read for an NVMe payload (no-op for host
+        payloads, unknown keys, in-flight reads, torn payloads, and
+        while the tier breaker is OPEN — fetch() is where failures and
+        half-open probes surface)."""
+        entry = self._entries.get(key)
+        if (entry is None or entry.tier != "nvme"
+                or key in self._inflight_reads
+                or entry.disk_nbytes != entry.nbytes
+                or self._breaker.state == STATE_OPEN):
+            return
+        self._submit_read(key, entry)
 
     def fetch(self, key: str, keep: bool = False) -> List[np.ndarray]:
         """Complete the swap-in.  By default the entry is CONSUMED (the
@@ -296,13 +603,21 @@ class SwapEngine:
         tiers); with ``keep=True`` the entry AND its payload file stay
         valid, so a read-only caller (param shards, fp32 masters) can
         drop its copy later without a write-back.  Raises KeyError for
-        unknown keys, IOError for torn payloads or failed reads; the
-        entry is dropped on failure even under ``keep`` so a degraded
-        caller cannot re-attach corrupt bytes."""
+        unknown keys, IOError for torn payloads, failed reads, or a
+        breaker-refused NVMe read (entry RETAINED — the media may
+        heal), and :class:`CorruptPayloadError` for checksum
+        mismatches (entry quarantined); on torn/failed/corrupt the
+        entry is dropped even under ``keep`` so a degraded caller
+        cannot re-attach bad bytes."""
         entry = self._entries.get(key)
         if entry is None:
             raise KeyError(f"{key} is not tier-resident")
         if entry.tier == "host":
+            if self.checksums and self.verify_fetch \
+                    and entry.crc is not None:
+                actual = self._crc_arrays(entry.arrays)
+                if actual != entry.crc:
+                    self._quarantine_key(key, entry, actual)
             if keep:
                 return [np.array(a, copy=True) for a in entry.arrays]
             self._remove(key)
@@ -313,15 +628,44 @@ class SwapEngine:
             raise IOError(f"torn offload payload for {key} "
                           f"({entry.disk_nbytes}/{entry.nbytes} bytes)")
         if key not in self._inflight_reads:
-            self.prefetch(key)
+            # new read traffic consults the breaker: OPEN fails fast
+            # WITHOUT discarding (the on-disk bytes may be fine — the
+            # tier is sick, not the payload); HALF_OPEN admits this
+            # fetch as a real-traffic probe
+            if not self._breaker.allow():
+                raise IOError(f"nvme tier circuit {self._breaker.state}; "
+                              f"offload read refused for {key}")
+            self._submit_read(key, entry)
         rid, buf = self._inflight_reads.pop(key)
         failed = rid < 0
         if rid > 0:
             aio_r, _ = self._rings()
             failed = aio_r.wait_req(rid) == -1
+            if self.injector.deny("swap.io"):
+                failed = True
         if failed:
+            if buf is None:
+                buf = np.empty(entry.nbytes, dtype=np.uint8)
+            try:
+                self._retry(self._sync_read, buf, key,
+                            describe=f"offload read {key}")
+                failed = False
+            except OSError:
+                pass
+        if failed:
+            self._record_io_failure(key, "read")
             self.discard(key)
             raise IOError(f"offload read failed for {key}")
+        self._breaker.record(True)
+        flips = self.injector.corrupt_bytes("swap.io", entry.nbytes)
+        if flips:
+            # phase 1: a write+read corrupt storm must damage DIFFERENT
+            # bytes, not XOR the write-side flips back off
+            flip_bytes(buf, flips, phase=1)
+        if self.checksums and self.verify_fetch and entry.crc is not None:
+            actual = zlib.crc32(buf)
+            if actual != entry.crc:
+                self._quarantine_key(key, entry, actual)
         if not keep:
             self._remove(key)
             self._account()
@@ -374,6 +718,41 @@ class SwapEngine:
     def inflight(self) -> int:
         return len(self._inflight_reads) + len(self._inflight_writes)
 
+    # --------------------------------------------------- integrity readers
+    def nvme_allowed(self) -> bool:
+        """Write-side breaker gate for policy clients: False while the
+        NVMe tier's breaker refuses traffic — demotions should fall
+        back to the host-only/evict waterfall.  In HALF_OPEN each True
+        admits one real-traffic probe."""
+        return self._breaker.allow()
+
+    def breaker(self) -> TierBreaker:
+        return self._breaker
+
+    def quarantined(self) -> Dict[str, dict]:
+        """Quarantine ring snapshot (key -> tier/reason/unix)."""
+        return dict(self._quarantine)
+
+    def snapshot(self) -> dict:
+        """Live integrity + occupancy state for ``/debug/offload`` and
+        post-mortem bundles (dict reads only — safe while wedged)."""
+        return {
+            "owner": self.owner,
+            "nvme_dir": self.nvme_dir,
+            "checksums": self.checksums,
+            "verify_fetch": self.verify_fetch,
+            "tiers": {t: {"entries": self._tier_count[t],
+                          "bytes": self._tier_bytes[t]} for t in TIERS},
+            "inflight_reads": len(self._inflight_reads),
+            "inflight_writes": len(self._inflight_writes),
+            "retained_write_sources": len(self._pending_writes),
+            "integrity_failures": self.integrity_failures,
+            "write_reverts": self.write_reverts,
+            "io_failures": self.io_failures,
+            "quarantine": dict(self._quarantine),
+            "breaker": self._breaker.snapshot(),
+        }
+
     # ------------------------------------------------------------ lifetime
     def discard(self, key: str):
         """Drop a key from whichever tier holds it (true eviction)."""
@@ -383,9 +762,11 @@ class SwapEngine:
                 aio_r, _ = self._rings()
                 aio_r.wait_req(rid)      # unpin; result irrelevant
         try:
-            self._wait_write(key)
+            # no revert: the caller is dropping the key either way
+            self._wait_write(key, revert=False)
         except IOError:
             pass                         # discarding anyway
+        self._pending_writes.pop(key, None)
         entry = self._remove(key)
         if entry is not None:
             if entry.tier == "nvme":
@@ -397,9 +778,13 @@ class SwapEngine:
 
     def drain(self):
         """Complete all in-flight I/O (one ``window=drain`` IoStat
-        sample per direction); raises if any request failed."""
+        sample per direction); raises if any READ request failed.
+        Writes reap individually first so a failed one still reverts
+        its entry to the host tier instead of losing the only copy."""
+        for key in list(self._inflight_writes):
+            self._wait_write(key)
         self._inflight_reads.clear()
-        self._inflight_writes.clear()
+        self._pending_writes.clear()
         errors = 0
         if self._aio_r is not None:
             errors = self._aio_r.wait() + self._aio_w.wait()
@@ -416,6 +801,7 @@ class SwapEngine:
         for key in list(self._entries):
             self._remove(key)
         self._account()
+        _LIVE_ENGINES.discard(self)
         try:
             for name in os.listdir(self.nvme_dir):
                 if name.endswith(".pay"):

@@ -15,10 +15,17 @@ Policy contracts owned here (mirroring the KV-tiering client,
 
 - the ``param.swap`` fault site fires on every shard read and
   write-back (deny = failed I/O; stall = delayed I/O; truncate = a
-  torn NVMe shard).  A failed or torn read NEVER reaches a matmul: it
-  degrades to a synchronous rebuild through ``reload_fn`` (the host
-  optimizer's fp32 masters are the authoritative copy) and heals the
-  on-disk shard, or raises loudly when no rebuild source exists.
+  torn NVMe shard; corrupt = a size-preserving bit-flip only the
+  engine's payload checksum can see — ISSUE 18).  A failed, torn, or
+  corrupt read NEVER reaches a matmul: it degrades to a synchronous
+  rebuild through ``reload_fn`` (the host optimizer's fp32 masters
+  are the authoritative copy) and heals the on-disk shard — the heal
+  ``put`` clears the engine's quarantine record — or raises loudly
+  when no rebuild source exists.
+- the engine's NVMe circuit breaker (ISSUE 18) gates write-backs by
+  policy: while it refuses traffic the shard stays resident+dirty
+  (the same retention deny uses), so training continues host-only
+  until the tier heals.
 - pin/protect semantics (the KV livelock fixes): the current compute
   layer and the prefetch target are never evicted from the working
   set, and a layer whose write-back was denied stays resident
@@ -202,9 +209,16 @@ class ParamStore:
             return False
         nbytes = int(sum(a.nbytes for a in leaves))
         keep = self.injector.truncate_bytes("param.swap", nbytes)
+        corrupt = self.injector.corrupt_bytes("param.swap", nbytes)
+        if not self.engine.nvme_allowed():
+            # breaker refuses the tier: retain resident+dirty (the deny
+            # retention) — a later write-back probes/heals
+            self._dirty.add(i)
+            return False
         try:
             self.engine.put(self._key(i), leaves, tier="nvme",
-                            truncate=keep, owner=self.owner)
+                            truncate=keep, owner=self.owner,
+                            corrupt=corrupt)
         except MemoryError:
             _record_alloc_failure("param.swap", flightrec=self.flightrec,
                                   layer=i, owner=self.owner, nbytes=nbytes)

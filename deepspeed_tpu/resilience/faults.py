@@ -24,6 +24,9 @@ key; specs separated by ``;`` or whitespace)::
             deny       site-specific refusal (kv.alloc returns no blocks)
             truncate   site-specific torn write (keep first param bytes,
                        default half)
+            corrupt    size-preserving bit-flip of param payload bytes
+                       (default 8) — the torn-size check CANNOT see this;
+                       only a checksum can (ISSUE 18)
     when    K          the K-th invocation of the site (0-based)
             K+         every invocation from the K-th on
             *          every invocation
@@ -59,7 +62,8 @@ from typing import Dict, List, Optional, Sequence, Union
 from deepspeed_tpu.utils.logging import logger
 
 ENV_VAR = "DS_FAULTS"
-ACTIONS = ("raise", "kill", "sigterm", "stall", "deny", "truncate")
+ACTIONS = ("raise", "kill", "sigterm", "stall", "deny", "truncate",
+           "corrupt")
 
 #: THE fault-site registry (dslint DSL004): every site fired through
 #: ``check``/``deny``/``truncate_bytes`` anywhere in the tree must be
@@ -88,12 +92,21 @@ KNOWN_FAULT_SITES = {
                 "prefill)",
     "kv.swap": "tiered-KV swap-out/swap-in (deny = abandon the "
                "demotion / fail the swap-in to re-prefill; truncate = "
-               "torn NVMe payload, detected before attach — ISSUE 16)",
+               "torn NVMe payload, detected before attach — ISSUE 16; "
+               "corrupt = size-preserving bit-flip, caught by the "
+               "payload checksum — ISSUE 18)",
     "param.swap": "streamed-param shard swap-out/swap-in (deny = fail "
                   "the layer read to a synchronous master rebuild / "
                   "defer the write-back; stall = delayed I/O; truncate "
                   "= torn NVMe shard, detected before the matmul — "
-                  "ISSUE 17)",
+                  "ISSUE 17; corrupt = size-preserving bit-flip, caught "
+                  "by the payload checksum — ISSUE 18)",
+    "swap.io": "offload-engine aio submit/reap (deny = the backend "
+               "reports I/O failure: transient reaps retry with "
+               "backoff, terminal failures feed the tier circuit "
+               "breaker; corrupt = size-preserving bit-flip of the "
+               "payload between checksum and disk, caught on fetch — "
+               "ISSUE 18)",
     "fleet.dispatch": "fleet router replica selection (raise = dispatch "
                       "failure, deny = policy-blind misroute)",
 }
@@ -255,6 +268,53 @@ class FaultInjector:
             keep = int(spec.param) if spec.param is not None else total // 2
             return max(0, min(keep, total))
         return None
+
+    def corrupt_bytes(self, site: str, total: int) -> Optional[int]:
+        """For silent-corruption simulation: None = payload intact; an
+        int = bit-flip that many payload bytes IN PLACE (size-preserving
+        — exactly the damage a byte-count check cannot see; only the
+        per-payload checksum catches it).  The caller applies the flip
+        with :func:`flip_bytes` AFTER the checksum is computed, modeling
+        post-write media corruption."""
+        spec = self._fire(site)
+        if spec is None:
+            return None
+        if spec.action == "raise":
+            raise FaultInjected(site, self.invocations[site] - 1)
+        if spec.action == "stall":
+            # a stall spec landing on this helper still delays the I/O
+            time.sleep(spec.param if spec.param is not None else 1.0)
+            return None
+        if spec.action == "corrupt":
+            n = int(spec.param) if spec.param is not None else 8
+            return max(0, min(n, total)) or None
+        return None
+
+
+def flip_bytes(buf, n: int, phase: int = 0) -> int:
+    """XOR ``0xFF`` into ``n`` bytes of ``buf`` (a mutable uint8 view:
+    numpy array, bytearray, memoryview), spread evenly across the
+    payload so a flip lands in more than one leaf.  Size-preserving by
+    construction — ``len(buf)`` never changes — and an involution at a
+    fixed ``phase`` (applying it twice restores the payload), which the
+    corruption tests use to prove the flip itself was the only
+    difference.  ``phase`` shifts the flip offsets so two DIFFERENT
+    fault windows (e.g. the engine's write path and read path under a
+    ``corrupt@*`` storm) damage different bytes instead of silently
+    undoing each other.  Returns the number of bytes actually
+    flipped."""
+    total = len(buf)
+    if total == 0 or n <= 0:
+        return 0
+    n = min(n, total)
+    stride = max(1, total // n)
+    flipped = 0
+    for off in range(min(phase, stride - 1), total, stride):
+        if flipped >= n:
+            break
+        buf[off] ^= 0xFF
+        flipped += 1
+    return flipped
 
 
 #: shared no-op injector (every hook is a cheap early-out through it)

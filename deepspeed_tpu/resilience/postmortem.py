@@ -185,6 +185,18 @@ def write_postmortem(out_dir: str, reason: str, *,
         return _write_json(p, payload)
     artifact("memory.json", _memory)
 
+    def _offload(p):
+        # the offload-integrity snapshot (ISSUE 18): per-engine tier
+        # occupancy, checksum-failure counters, the quarantine ring,
+        # and breaker state — a sick-NVMe bundle must answer "which
+        # tier, how sick, what was quarantined" without the process
+        from deepspeed_tpu.telemetry.debug import offload_payload
+        payload = offload_payload()
+        if not payload["engines"]:
+            return False            # no live engines — skip
+        return _write_json(p, payload)
+    artifact("offload.json", _offload)
+
     def _numerics(p):
         # the training-health snapshot (ISSUE 15): per-group grad-norm
         # timeline, NaN provenance records, and the determinism

@@ -294,6 +294,55 @@ class RetryConfig(DeepSpeedConfigModel):
                 "> 0 (omit for no deadline)")
 
 
+class OffloadIntegrityConfig(DeepSpeedConfigModel):
+    """``resilience.offload`` — storage integrity for the offload
+    substrate (ISSUE 18): payload checksums, aio retry policy, and the
+    per-tier circuit breaker the SwapEngine runs (offload/engine.py,
+    offload/breaker.py)."""
+    #: compute + store a crc32 per payload at swap-out (both tiers)
+    checksums: bool = True
+    #: verify the stored crc32 on every fetch; False is the hot-path
+    #: escape hatch (checksums still stored) if the measured tax on the
+    #: prefetch path matters — see PERF.md PR 18
+    verify_fetch: bool = True
+    #: bounded-backoff resubmission of failed aio submits/reaps
+    #: (resilience/retry.retry_call); only post-retry verdicts feed the
+    #: breaker.  Delays are aio-scale, not checkpoint-scale.
+    retry_attempts: int = 3
+    retry_base_delay_s: float = 0.002
+    retry_max_delay_s: float = 0.05
+    retry_deadline_s: Optional[float] = None
+    #: rolling-window breaker: OPEN when >= error_rate of the last
+    #: `window` terminal outcomes failed (after at least min_ops);
+    #: HALF_OPEN after cooldown_s admits `probes` real ops
+    breaker_window: int = 16
+    breaker_error_rate: float = 0.5
+    breaker_min_ops: int = 4
+    breaker_cooldown_s: float = 30.0
+    breaker_probes: int = 1
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.retry_attempts < 1:
+            raise ValueError(
+                f"resilience.offload.retry_attempts={self.retry_attempts}: "
+                "must be >= 1")
+        if self.retry_base_delay_s < 0 or self.retry_max_delay_s < 0:
+            raise ValueError("resilience.offload retry delays must be >= 0")
+        if not 0.0 < self.breaker_error_rate <= 1.0:
+            raise ValueError(
+                f"resilience.offload.breaker_error_rate="
+                f"{self.breaker_error_rate}: must be in (0, 1]")
+        if self.breaker_window < 1 or self.breaker_min_ops < 1 \
+                or self.breaker_probes < 1:
+            raise ValueError("resilience.offload breaker window/min_ops/"
+                             "probes must be >= 1")
+        if self.breaker_cooldown_s < 0:
+            raise ValueError(
+                f"resilience.offload.breaker_cooldown_s="
+                f"{self.breaker_cooldown_s}: must be >= 0")
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """Fault tolerance (deepspeed_tpu/resilience/): crash-safe
     checkpoint protocol knobs + deterministic fault injection.  TPU-
@@ -320,10 +369,16 @@ class ResilienceConfig(DeepSpeedConfigModel):
     #: "full" (also re-checksums every restored leaf)
     verify_checkpoint: str = "manifest"
     retry: RetryConfig = Field(default_factory=RetryConfig)
+    #: offload-substrate integrity (checksums / aio retry / tier
+    #: breaker) — consumed by the SwapEngine (ISSUE 18)
+    offload: OffloadIntegrityConfig = Field(
+        default_factory=OffloadIntegrityConfig)
 
     def __init__(self, **data):
         if isinstance(data.get("retry"), dict):
             data["retry"] = RetryConfig(**data["retry"])
+        if isinstance(data.get("offload"), dict):
+            data["offload"] = OffloadIntegrityConfig(**data["offload"])
         super().__init__(**data)
         # parse eagerly so a typo'd spec fails at config time, not at the
         # fault site mid-run

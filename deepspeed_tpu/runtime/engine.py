@@ -527,7 +527,9 @@ class DeepSpeedEngine:
                     owner=("params_nvme" if self._param_nvme
                            else "optim_nvme"),
                     aio_threads=aio.thread_count,
-                    queue_depth=aio.queue_depth)
+                    queue_depth=aio.queue_depth,
+                    injector=self.fault_injector,
+                    integrity=self._config.resilience_config.offload)
                 if self._offload_device == "nvme":
                     nvme_swapper = SwapTensorClient(self._swap_engine,
                                                     owner="optim_nvme")

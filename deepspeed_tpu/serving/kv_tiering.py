@@ -16,9 +16,16 @@ Policy contracts owned here (not by the engine):
 
 - the ``kv.swap`` fault site fires on every swap-out AND swap-in
   (deny = abandon the demotion / fail the swap-in; stall = delayed
-  I/O; truncate = a torn NVMe payload).  A failed swap-in degrades to
-  re-prefill — the store drops the entry so corrupt bytes can never
-  attach.
+  I/O; truncate = a torn NVMe payload; corrupt = a size-preserving
+  bit-flip only the engine's payload checksum can see — ISSUE 18).  A
+  failed, torn, or corrupt swap-in degrades to re-prefill — the store
+  drops the entry so corrupt bytes can never attach
+  (:class:`~deepspeed_tpu.offload.engine.CorruptPayloadError` is an
+  IOError; the quarantine lives in the engine).
+- the engine's NVMe circuit breaker (ISSUE 18) gates the write side
+  by policy: while it refuses traffic, parks fall back to the host
+  tier and host-overflow spills become drops — forward progress
+  continues host-only instead of hammering a sick drive.
 - one copy per hash, ever: promote-to-HBM consumes the tier entry,
   and :meth:`discard` runs whenever the BlockManager re-registers a
   hash (a re-prefilled HBM copy wins over a stale cold one).
@@ -65,7 +72,8 @@ class KvTierStore:
         self._engine = SwapEngine(
             nvme_dir=getattr(cfg, "nvme_dir", None), owner="kv_cache",
             aio_threads=getattr(cfg, "aio_threads", 2),
-            queue_depth=getattr(cfg, "queue_depth", 2))
+            queue_depth=getattr(cfg, "queue_depth", 2),
+            injector=self.injector)
         # monotonic policy counters, mirrored into serving/* metrics by
         # the scheduler's gauge pass
         self.demotions = 0       # HBM→host demotes
@@ -88,9 +96,15 @@ class KvTierStore:
             self.failures += 1
             self._flight("kv/swap_fail", corr=h[:12], dir="out", tier=tier)
             return False
+        if tier == "nvme" and not self._engine.nvme_allowed():
+            # breaker refuses the cold tier: park on host instead —
+            # capacity pressure then resolves through the waterfall
+            tier = "host"
         nbytes = int(sum(a.nbytes for a in arrays))
         keep = self.injector.truncate_bytes("kv.swap", nbytes)
-        self._engine.put(h, arrays, tier=tier, truncate=keep)
+        corrupt = self.injector.corrupt_bytes("kv.swap", nbytes)
+        self._engine.put(h, arrays, tier=tier, truncate=keep,
+                         corrupt=corrupt)
         self._flight(kind, corr=h[:12], tier=tier, bytes=nbytes)
         self._spill_overflow()
         return True
@@ -108,9 +122,17 @@ class KvTierStore:
                              tier="nvme")
                 self._engine.discard(h)
                 continue
+            if not self._engine.nvme_allowed():
+                # breaker-OPEN degrade: host overflow drops instead of
+                # demoting onto a sick tier (blocks are re-prefillable)
+                self._engine.discard(h)
+                self.dropped += 1
+                continue
             keep = self.injector.truncate_bytes(
                 "kv.swap", self._engine.nbytes_of(h))
-            nbytes = self._engine.demote(h, truncate=keep)
+            corrupt = self.injector.corrupt_bytes(
+                "kv.swap", self._engine.nbytes_of(h))
+            nbytes = self._engine.demote(h, truncate=keep, corrupt=corrupt)
             self.spills += 1
             self._flight("kv/spill", corr=h[:12], bytes=nbytes)
         cap = getattr(self.cfg, "nvme_blocks", 0)
@@ -197,6 +219,9 @@ class KvTierStore:
                 "demotions": self.demotions, "spills": self.spills,
                 "parks": self.parks, "swap_ins": self.swapins,
                 "failures": self.failures, "dropped": self.dropped,
+                "integrity_failures": self._engine.integrity_failures,
+                "quarantined": len(self._engine.quarantined()),
+                "breaker_state": self._engine.breaker().state,
                 "nvme_dir": self._engine.nvme_dir}
 
     # ------------------------------------------------------------ lifetime

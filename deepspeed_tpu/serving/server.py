@@ -28,6 +28,10 @@ Endpoints:
   GET  /debug/memory     tiered byte ledger (tiers × owners with
                          watermarks), OOM forensics ring, and the
                          swap I/O summary (?tier= filter; ISSUE 14)
+  GET  /debug/offload    live SwapEngine integrity snapshots: tier
+                         occupancy, checksum failures, quarantine
+                         ring, circuit-breaker state (?owner= filter;
+                         ISSUE 18)
 
 The ``/debug/*`` surface (ISSUE 7) is read-only and never takes the
 scheduler lock — it exists precisely for the moments the lock is stuck.
@@ -246,6 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
                                                    format_thread_stacks,
                                                    memory_payload,
                                                    numerics_payload,
+                                                   offload_payload,
                                                    parse_debug_query,
                                                    perf_payload)
         route, query = parse_debug_query(self.path)
@@ -275,6 +280,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if route == "/debug/memory":
             self._send_json(200, memory_payload(query))
+            return
+        if route == "/debug/offload":
+            # offload integrity (ISSUE 18): weakref peek over live
+            # engines — lock-free, answers while a swap is wedged
+            self._send_json(200, offload_payload(query))
             return
         if route == "/debug/numerics":
             # training-health bank (ISSUE 15): answers on a serving

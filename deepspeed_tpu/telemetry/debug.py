@@ -30,6 +30,13 @@ The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
   bank's own lock plus one device fetch, never an engine/scheduler
   lock, and a GET on a process without an armed bank answers
   ``{"armed": false}`` without creating one (the peek contract).
+- ``offload_payload()`` — the ``/debug/offload`` JSON body
+  (ISSUE 18): every live SwapEngine's integrity + occupancy snapshot
+  (tier bytes, checksum failures, quarantine ring, retained write
+  sources, circuit-breaker state/counters).  Reads dict snapshots
+  through a weakref registry only — never an engine or scheduler
+  lock — so "is the NVMe tier sick" is answerable while the step that
+  hit it is wedged.
 - ``parse_debug_query()`` — tiny query-string parsing shared by both
   HTTP front doors.
 
@@ -145,6 +152,21 @@ def numerics_payload(query: Optional[Dict[str, str]] = None
                 entry["group_norms"] = [norms[i] for i in keep
                                         if i < len(norms)]
     return payload
+
+
+def offload_payload(query: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, Any]:
+    """The ``/debug/offload`` body: one snapshot per live SwapEngine
+    (owner, tier occupancy, integrity counters, quarantine ring,
+    breaker state).  ``?owner=<substring>`` filters engines.  Peek
+    contract: the weakref registry is read as-is — a GET never creates
+    or retains an engine."""
+    from deepspeed_tpu.offload.engine import live_engines
+    engines = [e.snapshot() for e in live_engines()]
+    want = (query or {}).get("owner")
+    if want:
+        engines = [s for s in engines if want in s.get("owner", "")]
+    return {"engines": engines, "count": len(engines)}
 
 
 def perf_payload(query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:

@@ -78,6 +78,15 @@ KNOWN_EVENT_KINDS = {
               "param/swap_fail (param.swap fault or I/O error on a "
               "shard), param/degraded (shard rebuilt synchronously "
               "from the fp32 masters and healed on disk)",
+    "offload/": "prefix family: offload-substrate storage integrity "
+                "(ISSUE 18) — offload/corrupt (payload checksum "
+                "mismatch on fetch; key quarantined, typed "
+                "CorruptPayloadError to the client degrade path), "
+                "offload/breaker (tier circuit-breaker state "
+                "transition, from/to in fields), offload/write_revert "
+                "(a fire-and-forget NVMe write failed terminally and "
+                "the entry was rebuilt on the host tier from the "
+                "retained source — durability ordering)",
     "num/nonfinite": "a train step produced non-finite gradients; the "
                      "first offending leaf group is in the fields "
                      "(handled=true for loss-scaler overflow skips; "

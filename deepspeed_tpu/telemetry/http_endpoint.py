@@ -25,6 +25,10 @@ Routes:
   ``/debug/numerics``  training-health bank: per-group grad norms,
                        NaN provenance, fingerprint stream (``?n=``,
                        ``?group=`` filters; ISSUE 15)
+  ``/debug/offload``   live SwapEngine integrity snapshots: tier
+                       occupancy, checksum failures, quarantine ring,
+                       circuit-breaker state (``?owner=`` filter;
+                       lock-free, ISSUE 18)
 """
 import json
 import threading
@@ -56,8 +60,8 @@ class MetricsServer:
             def do_GET(self):
                 from deepspeed_tpu.telemetry.debug import (
                     flightrec_payload, format_thread_stacks,
-                    memory_payload, numerics_payload, parse_debug_query,
-                    perf_payload)
+                    memory_payload, numerics_payload, offload_payload,
+                    parse_debug_query, perf_payload)
                 from deepspeed_tpu.telemetry.flight_recorder import \
                     get_flight_recorder
                 route, query = parse_debug_query(self.path)
@@ -84,6 +88,9 @@ class MetricsServer:
                     body = json.dumps(numerics_payload(query),
                                       default=str).encode()
                     code, ctype = 200, "application/json"
+                elif route == "/debug/offload":
+                    body = json.dumps(offload_payload(query)).encode()
+                    code, ctype = 200, "application/json"
                 else:
                     body = f"no route {route}\n".encode()
                     code, ctype = 404, "text/plain"
@@ -101,7 +108,8 @@ class MetricsServer:
         logger.info(f"telemetry: metrics endpoint on "
                     f"http://{self.host}:{self.port}/metrics "
                     f"(+ /healthz, /debug/stacks, /debug/flightrec, "
-                    f"/debug/perf, /debug/memory, /debug/numerics)")
+                    f"/debug/perf, /debug/memory, /debug/numerics, "
+                    f"/debug/offload)")
         return self
 
     def stop(self):

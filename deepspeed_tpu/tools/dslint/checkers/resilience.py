@@ -1,6 +1,6 @@
 """DSL005 — resilience hygiene.
 
-Three patterns that rot crash-safety:
+Four patterns that rot crash-safety:
 
 1. **bare ``except:``** — catches ``KeyboardInterrupt``/``SystemExit``
    and hides the injected faults the chaos harness relies on; name the
@@ -16,6 +16,14 @@ Three patterns that rot crash-safety:
    torn content after a crash (the resilience/ckpt.py protocol exists
    because of this).  Scoped to checkpoint-ish files
    (``*ckpt*``/``*checkpoint*`` paths).
+4. **fire-and-forget write without a retained source** — a function
+   that submits an async write (``submit_pwrite``) but neither reaps
+   it in-scope (``wait_req``/``wait``) nor retains the source buffer
+   on ``self`` has released the only copy before the write is known
+   durable: a terminal write failure then loses the payload (the
+   ISSUE 18 lost-only-copy window).  Retention means assigning a bare
+   name into ``self.<something>`` (``self._pending[key] = src``);
+   storing only the request id (a call result) does not count.
 """
 import ast
 import re
@@ -80,12 +88,50 @@ def _has_fsync(fn) -> bool:
     return False
 
 
+def _submits_async_write(fn) -> Optional[ast.Attribute]:
+    """The first ``<handle>.submit_pwrite`` reference in the fn's own
+    scope (direct call or passed to a retry wrapper); None when the fn
+    doesn't touch the async write path."""
+    for node in iter_scope(fn):
+        if (isinstance(node, ast.Attribute)
+                and node.attr == "submit_pwrite"):
+            return node
+    return None
+
+
+def _reaps_in_scope(fn) -> bool:
+    for node in iter_scope(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("wait_req", "wait")):
+            return True
+    return False
+
+
+def _retains_source(fn) -> bool:
+    """True when the fn assigns a bare name into ``self.<attr>`` or
+    ``self.<attr>[...]`` — the retain-until-durable handoff.  A call
+    result (the request id) as the value does not count: retaining the
+    id is not retaining the bytes."""
+    for node in iter_scope(fn):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Name):
+            continue
+        for tgt in node.targets:
+            base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+            d = _dotted(base)
+            if d and d.startswith("self."):
+                return True
+    return False
+
+
 @register
 class ResilienceHygieneChecker(Checker):
     rule = "DSL005"
     name = "resilience-hygiene"
     doc = ("no bare excepts or swallowed broad exceptions; checkpoint "
-           "renames must fsync what they publish")
+           "renames must fsync what they publish; async writes must "
+           "retain their source until reaped")
 
     def check(self, mod: ModuleFile, inv) -> Iterable[Finding]:
         findings: List[Finding] = []
@@ -94,7 +140,21 @@ class ResilienceHygieneChecker(Checker):
                 self._check_handler(mod, node, findings)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_rename_fsync(mod, node, findings)
+                self._check_write_retention(mod, node, findings)
         return findings
+
+    def _check_write_retention(self, mod, fn, findings: List[Finding]):
+        submit = _submits_async_write(fn)
+        if submit is None:
+            return
+        if _reaps_in_scope(fn) or _retains_source(fn):
+            return
+        findings.append(self.finding(
+            mod, submit,
+            f"'{fn.name}' submits an async write but neither reaps it "
+            "in-scope nor retains the source buffer on self — a "
+            "terminal write failure loses the only copy (retain the "
+            "source until the write reaps OK, then revert on failure)"))
 
     def _check_handler(self, mod, node: ast.ExceptHandler,
                        findings: List[Finding]):

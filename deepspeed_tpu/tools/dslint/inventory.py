@@ -51,6 +51,7 @@ SUBMODELS = {
     "serving.fleet": "FleetConfig",
     "serving.kv_tiering": "KvTieringConfig",
     "resilience.retry": "RetryConfig",
+    "resilience.offload": "OffloadIntegrityConfig",
     "telemetry.numerics": "NumericsConfig",
 }
 DICT_SUBMODELS = {
@@ -78,7 +79,7 @@ _REGISTRY_RE = re.compile(r"reg|metrics", re.IGNORECASE)
 #: merely *ending* in "fault" (self.default) don't match
 _INJECTOR_RE = re.compile(
     r"(?:^|[._])(?:(?:fault_)?inj(?:ector)?|faults?)$", re.IGNORECASE)
-_FAULT_METHODS = {"check", "deny", "truncate_bytes"}
+_FAULT_METHODS = {"check", "deny", "truncate_bytes", "corrupt_bytes"}
 
 _FLIGHT_RE = re.compile(r"flightrec|flight_recorder|recorder|(?:^|\.)rec$",
                         re.IGNORECASE)

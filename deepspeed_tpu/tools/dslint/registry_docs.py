@@ -164,6 +164,18 @@ METRICS = {
                                     "the fp32 masters (torn/failed read)",
     "offload/param_fetch_block_s": "wall-clock the weight pass spent "
                                    "blocked in shard fetch",
+    # --- offload storage integrity (ISSUE 18)
+    "offload/integrity_fail": "payload checksum mismatches detected on "
+                              "fetch (key quarantined), labeled by tier",
+    "offload/quarantined": "keys currently in the engine's quarantine "
+                           "ring (a fresh put of the key clears it)",
+    "offload/io_failures": "terminal (post-retry) aio failures, labeled "
+                           "by direction; these feed the tier breaker",
+    "offload/write_reverts": "failed fire-and-forget NVMe writes whose "
+                             "entries were rebuilt on the host tier "
+                             "from the retained source",
+    "offload/breaker_state": "tier circuit-breaker state (0=closed, "
+                             "1=half_open, 2=open), labeled by tier",
     # --- MoE routing health
     "moe/dispatch_tokens": "tokens routed into expert dispatch",
     "moe/dropped_tokens": "tokens dropped at capacity (einsum mode; "

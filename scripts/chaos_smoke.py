@@ -15,7 +15,12 @@ kills the process:
 - serve.chunk raise mid-chunked-prefill resuming from the committed
   cursor (ISSUE 9);
 - fleet replica loss mid-stream: the router resubmits the committed
-  stream to a surviving replica, token-identical (ISSUE 11).
+  stream to a surviving replica, token-identical (ISSUE 11);
+- offload corruption storms (ISSUE 18): flipped KV payloads degrade to
+  re-prefill (token-identical serving), flipped param shards rebuild
+  from the fp32 masters (bitwise-identical losses), and a sustained
+  swap.io outage trips the NVMe circuit breaker into host-only
+  degradation with every reverted entry still serving clean bytes.
 
 Usage::
 
@@ -464,6 +469,143 @@ def case_param_swap_fault_degrades():
             f"faulted run diverged: {faulty} vs {clean}"
 
 
+def case_kv_corrupt_storm_token_identical():
+    """kv.swap:corrupt storm under tiered KV (ISSUE 18): every parked
+    payload is bit-flipped after its checksum, so every swap-in hits a
+    crc mismatch, quarantines the key, and degrades to a full
+    re-prefill — flipped KV never attaches and the greedy outputs stay
+    token-identical across two request waves."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        prefix_cache={"enabled": True,
+                                      "max_cached_blocks": 2},
+                        kv_tiering={"enabled": True, "host_blocks": 2})
+    sched = ContinuousBatchingScheduler(
+        model, eng.params, cfg,
+        injector=FaultInjector("kv.swap:corrupt@*"))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, 128, (24,)).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(1, 128, (3 + i,)).astype(
+                                   np.int32)]) for i in range(3)]
+    for _ in range(2):
+        reqs = [sched.submit(p, SamplingParams(max_new_tokens=6))
+                for p in prompts]
+        sched.run_until_idle()
+        for p, req in zip(prompts, reqs):
+            ref = np.asarray(eng.generate(p[None], max_new_tokens=6,
+                                          do_sample=False))[0, p.size:]
+            assert req.state == RequestState.FINISHED
+            assert np.array_equal(np.asarray(req.output_ids), ref)
+    assert sched.injector.fired.get("kv.swap", 0) >= 1, \
+        "the tiny hot cache never generated swap pressure"
+    s = sched._tier_store.summary()
+    assert s["integrity_failures"] >= 1, \
+        "flipped payloads were never caught by the checksum"
+    assert sched.metrics.counters["kv_swap_in_blocks"] == 0, \
+        "a corrupt swap-in still materialized blocks"
+    assert sched.block_mgr.num_allocated_blocks == 0
+    sched.block_mgr.check_invariant()
+
+
+def case_param_corrupt_storm_bitwise_identical():
+    """param.swap + swap.io corrupt storm under NVMe-streamed params
+    (ISSUE 18): flipped shard bytes are caught by the per-payload crc
+    on fetch; every corrupt shard is quarantined and rebuilt from the
+    fp32 masters (then healed back) — the loss trajectory stays
+    BITWISE-identical to the fault-free run."""
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+
+    def run(tmp, faults=None):
+        model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                           num_layers=3, num_heads=4, d_model=32,
+                           dtype="float32", attention_impl="xla")
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0,
+               "zero_optimization": {
+                   "stage": 0,
+                   "offload_optimizer": {"device": "cpu"},
+                   "offload_param": {"device": "nvme", "nvme_path": tmp,
+                                     "resident_layers": 1}}}
+        if faults:
+            cfg["resilience"] = {"faults": faults}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(3):
+            batch = {"input_ids": rng.integers(0, 128, size=(1, 4, 16),
+                                               dtype=np.int32)}
+            losses.append(float(engine.train_batch(batch=batch)))
+        return losses, engine
+
+    with tempfile.TemporaryDirectory() as t1, \
+            tempfile.TemporaryDirectory() as t2:
+        clean, _ = run(t1)
+        faulty, engine = run(
+            t2, faults="param.swap:corrupt@6+;swap.io:corrupt=8@p0.4s18")
+        assert engine.fault_injector.fired.get("param.swap", 0) >= 1, \
+            "armed param.swap corruption never fired"
+        assert engine.param_store.engine.integrity_failures > 0, \
+            "flipped shards were never caught by the checksum"
+        assert engine.param_store.degraded > 0, \
+            "corrupt shards never degraded to the master rebuild"
+        assert np.array_equal(np.float32(faulty), np.float32(clean)), \
+            f"corrupted run diverged: {faulty} vs {clean}"
+
+
+def case_offload_breaker_opens_host_only():
+    """Sustained swap.io deny (ISSUE 18): every NVMe write reap fails
+    terminally, the retained source reverts each entry to host, the
+    terminal failures trip the tier circuit breaker OPEN, and from then
+    on the store degrades host-only — parks land on host, overflow
+    drops instead of demoting, and fetches still serve clean bytes."""
+    import types
+
+    import numpy as np
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.serving.kv_tiering import KvTierStore
+
+    def payload(i):
+        return [np.full((64,), float(i), np.float32)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = types.SimpleNamespace(host_blocks=2, nvme_blocks=8,
+                                    nvme_dir=tmp, aio_threads=2,
+                                    queue_depth=2)
+        st = KvTierStore(cfg, injector=FaultInjector("swap.io:deny@*"))
+        for i in range(6):
+            st.park(f"p{i}", payload(i))
+            st._engine.drain()               # reap: terminal -> revert
+        s = st.summary()
+        assert s["breaker_state"] == "open", s
+        assert st._engine.write_reverts >= 4, \
+            "terminal write failures never reverted to host"
+        assert st._engine.io_failures >= 4
+        assert s["nvme_blocks"] == 0, "a demotion landed on the sick tier"
+        assert s["host_blocks"] == 2 and s["dropped"] >= 1, \
+            "host overflow should drop, not demote, while OPEN"
+        # forward progress host-only: newest parks are clean on host
+        got = st.fetch("p5")
+        assert got is not None and got[0] == "host"
+        np.testing.assert_array_equal(got[1][0], payload(5)[0])
+        st.close()
+
+
 def case_fleet_replica_loss_resubmits():
     """Fleet replica loss mid-stream (ISSUE 11): two replicas behind
     the Router, a request decoding on one of them when that replica is
@@ -549,6 +691,12 @@ def main(argv=None):
                   case_kv_swap_fault_degrades))
     cases.append(("param.swap fault degrades to master rebuild",
                   case_param_swap_fault_degrades))
+    cases.append(("kv.swap corrupt storm stays token-identical",
+                  case_kv_corrupt_storm_token_identical))
+    cases.append(("param corrupt storm stays bitwise-identical",
+                  case_param_corrupt_storm_bitwise_identical))
+    cases.append(("swap.io outage trips breaker, degrades host-only",
+                  case_offload_breaker_opens_host_only))
     cases.append(("fleet replica loss resubmits mid-stream",
                   case_fleet_replica_loss_resubmits))
     cases.append(("train.nonfinite NaN attributed to its leaf group",

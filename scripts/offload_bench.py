@@ -13,8 +13,10 @@ reports in production.
     DS_BENCH_LEDGER=1 python scripts/offload_bench.py  # append BENCH/ledger
 
 Emits one ds-bench record per run: swap_out/in GB/s, overlap fraction,
-pipelined-vs-serialized sweep times, and the memory observatory's peak
-bytes (``mem_peak_*``) so ``bench_compare --history`` gates all three.
+pipelined-vs-serialized sweep times, a checksums-on/off A/B (the ISSUE
+18 per-payload crc32 cost on both directions), and the memory
+observatory's peak bytes (``mem_peak_*``) so ``bench_compare
+--history`` gates all three.
 """
 import json
 import os
@@ -49,9 +51,10 @@ def main():
 
     total_gb = n * mb / 1024
 
-    def build(resident, tag="pipe"):
+    def build(resident, tag="pipe", integrity=None):
         eng = SwapEngine(nvme_dir=os.path.join(root, f"{tag}_k{resident}"),
-                         owner="params_nvme", aio_threads=4, queue_depth=2)
+                         owner="params_nvme", aio_threads=4, queue_depth=2,
+                         integrity=integrity)
         store = ParamStore(eng, n, resident_layers=resident)
         rng = np.random.default_rng(0)
         t0 = time.perf_counter()
@@ -95,6 +98,19 @@ def main():
     store2.fetch_block_s = 0.0
     serial_s = sweep(store2, +1) + sweep(store2, -1)
 
+    # ---- integrity A/B (ISSUE 18): the same write-out + streamed epoch
+    # with checksums off — what the per-payload crc32 costs on both
+    # directions (the ``resilience.offload.verify_fetch`` knob trades
+    # this read-side cost against silent-corruption detection)
+    from types import SimpleNamespace
+    eng3, store3, w_nc_s = build(
+        k, tag="nocrc", integrity=SimpleNamespace(checksums=False))
+    sweep(store3, +1)
+    store3.fetch_block_s = 0.0
+    fetched3 = store3.fetch_bytes
+    nocrc_s = sweep(store3, +1) + sweep(store3, -1)
+    read_nc_gb = (store3.fetch_bytes - fetched3) / (1 << 30)
+
     import multiprocessing
     cores = multiprocessing.cpu_count()
     detail = {
@@ -108,6 +124,14 @@ def main():
         "sweep_pipelined_s": round(pipe_s, 3),
         "sweep_serialized_s": round(serial_s, 3),
         "pipeline_speedup": round(serial_s / pipe_s, 2) if pipe_s else 0.0,
+        "swap_out_GBps_nocrc": round(total_gb / w_nc_s, 2) if w_nc_s else 0.0,
+        "swap_in_GBps_nocrc": round(read_nc_gb / nocrc_s, 2)
+        if nocrc_s else 0.0,
+        "checksum_write_overhead_pct": round(100 * (w_s - w_nc_s) / w_nc_s, 1)
+        if w_nc_s else 0.0,
+        "checksum_read_overhead_pct": round(100 * (pipe_s - nocrc_s)
+                                            / nocrc_s, 1)
+        if nocrc_s else 0.0,
         "cores": cores,
         "dir": root,
     }
@@ -118,6 +142,7 @@ def main():
     print(json.dumps(emit_ledger(rec)))
     eng.close()
     eng2.close()
+    eng3.close()
 
 
 if __name__ == "__main__":

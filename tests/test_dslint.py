@@ -436,6 +436,46 @@ def test_dsl005_rename_rule_scoped_to_checkpoint_files():
     assert all("fsync" not in f.message for f in findings)
 
 
+_DSL005_WRITE_BAD = '''
+class Eng:
+    def demote(self, key, buf, path):
+        # only the request id survives the call — a terminal write
+        # failure has nothing left to revert from
+        self._writes[key] = self.aio.submit_pwrite(buf, path)
+'''
+
+_DSL005_WRITE_RETAINS = '''
+class Eng:
+    def demote(self, key, buf, path):
+        self._writes[key] = self.aio.submit_pwrite(buf, path)
+        self._pending[key] = buf          # source retained until reap
+'''
+
+_DSL005_WRITE_REAPS = '''
+class Eng:
+    def swap_out(self, key, buf, path):
+        rid = self.aio.submit_pwrite(buf, path)
+        if self.aio.wait_req(rid) != 0:   # reaped in-scope
+            raise IOError(key)
+'''
+
+
+def test_dsl005_flags_release_before_reap_write():
+    findings = lint_source(_DSL005_WRITE_BAD,
+                           relpath="deepspeed_tpu/offload/x.py",
+                           rules=["DSL005"])
+    assert len(findings) == 1
+    assert "retains the source buffer" in findings[0].message
+
+
+def test_dsl005_write_retention_good_twins_pass():
+    # retaining the bytes on self OR reaping in-scope both satisfy the
+    # durability-ordering contract
+    for src in (_DSL005_WRITE_RETAINS, _DSL005_WRITE_REAPS):
+        assert lint_source(src, relpath="deepspeed_tpu/offload/x.py",
+                           rules=["DSL005"]) == []
+
+
 # =====================================================================
 # suppressions + baseline machinery
 # =====================================================================

@@ -201,6 +201,55 @@ def test_tier_store_swap_faults_degrade(tmp_path):
     st.close()
 
 
+def test_tier_store_corrupt_swap_degrades_to_reprefill(tmp_path):
+    """kv.swap:corrupt flips parked bytes after the checksum; fetch
+    detects the mismatch, quarantines the key, and returns None — the
+    caller re-prefills, corrupt KV never attaches.  A fresh store of
+    the same hash clears the quarantine and serves clean bytes."""
+    cfg = types.SimpleNamespace(host_blocks=0, nvme_blocks=0,
+                                nvme_dir=str(tmp_path), aio_threads=2,
+                                queue_depth=2)
+    st = KvTierStore(cfg, injector=FaultInjector("kv.swap:corrupt=4@*"))
+    assert st.park("h0", _payload(0))            # corrupt bytes hit NVMe
+    assert st.fetch("h0") is None                # detected, not attached
+    assert st.failures == 1 and st.tier_of("h0") is None
+    s = st.summary()
+    assert s["integrity_failures"] == 1 and s["quarantined"] == 1
+    st.injector = FaultInjector([])              # storm over
+    assert st.store("h0", _payload(0))           # fresh put heals
+    assert st.summary()["quarantined"] == 0
+    tier, arrays = st.fetch("h0")
+    assert tier == "host"
+    np.testing.assert_array_equal(arrays[0], _payload(0)[0])
+    st.close()
+
+
+def test_tier_store_breaker_open_degrades_host_only(tmp_path):
+    """With the NVMe circuit OPEN, parks land on host instead of the
+    sick tier and host overflow drops (re-prefillable) rather than
+    demoting — serving makes forward progress host-only."""
+    cfg = types.SimpleNamespace(host_blocks=1, nvme_blocks=2,
+                                nvme_dir=str(tmp_path), aio_threads=2,
+                                queue_depth=2)
+    st = KvTierStore(cfg)
+    br = st._engine.breaker()
+    for _ in range(4):                           # min_ops terminal errors
+        br.record(False)
+    assert br.state == "open"
+    assert not st._engine.nvme_allowed()
+    assert st.park("p0", _payload(0))            # breaker fallback: host
+    assert st.tier_of("p0") == "host" and st.parks == 1
+    assert st.store("h1", _payload(1))           # overflow drops oldest
+    assert st.store("h2", _payload(2))
+    assert st.counts() == {"host": 1, "nvme": 0}
+    assert st.spills == 0 and st.dropped == 2
+    assert st.summary()["breaker_state"] == "open"
+    tier, arrays = st.fetch("h2")                # host stays serviceable
+    assert tier == "host"
+    np.testing.assert_array_equal(arrays[0], _payload(2)[0])
+    st.close()
+
+
 # ------------------------------------------------------- config plumbing
 def test_kv_tiering_config_validation():
     cfg = ServingConfig(prefix_cache={"enabled": True},

@@ -368,12 +368,16 @@ def test_offload_param_nvme_matches_resident_bitwise(mesh1, tmp_path):
     "param.swap:truncate@6+",      # torn shards: every read degrades to
                                    # the synchronous fp32-master rebuild
     "param.swap:deny@*",           # failed I/O on BOTH directions
+    "param.swap:corrupt@6+",       # flipped shards: the checksum catches
+                                   # them and masters rebuild + heal back
+    "param.swap:corrupt=32@p0.4s18",   # seeded corruption storm
+    "swap.io:corrupt=8@p0.4s18",   # media-level damage inside the engine
 ])
 def test_offload_param_nvme_faults_never_corrupt(mesh1, tmp_path, spec):
-    """param.swap stall/truncate/deny mid-step must degrade to a
-    synchronous re-read (fp32 masters are authoritative) — the loss
-    trajectory stays bitwise-identical to the fault-free run; a torn
-    shard never reaches a matmul."""
+    """param.swap/swap.io stall/truncate/deny/corrupt mid-step must
+    degrade to a synchronous re-read (fp32 masters are authoritative) —
+    the loss trajectory stays bitwise-identical to the fault-free run; a
+    torn or flipped shard never reaches a matmul."""
     clean, *_ = deepspeed_tpu.initialize(
         model=tiny_gpt2(num_layers=3), mesh=mesh1, config=_param_nvme_cfg(
             tmp_path / "clean", opt_device="cpu",
@@ -386,9 +390,12 @@ def test_offload_param_nvme_faults_never_corrupt(mesh1, tmp_path, spec):
     l_clean = _train(clean, steps=3, seed=23)
     l_fault = _train(faulty, steps=3, seed=23)
     np.testing.assert_array_equal(np.float32(l_fault), np.float32(l_clean))
-    assert faulty.fault_injector.fired.get("param.swap", 0) > 0
-    if "truncate" in spec:
+    site = spec.split(":", 1)[0]
+    assert faulty.fault_injector.fired.get(site, 0) > 0
+    if "truncate" in spec or "corrupt" in spec:
         assert faulty.param_store.degraded > 0
+    if "corrupt" in spec:
+        assert faulty.param_store.engine.integrity_failures > 0
 
 
 def test_offload_param_nvme_deny_without_masters_is_loud(tmp_path):
@@ -514,3 +521,228 @@ def test_swap_engine_failed_read_sentinel_surfaces(tmp_path):
         eng.fetch("a")
     out = eng.fetch("b")                         # neighbor unaffected
     np.testing.assert_array_equal(out[0], a)
+
+
+# ---------------------------------------- ISSUE 18: storage integrity
+
+def _storm_payload(seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((32, 8)).astype(np.float32),
+            rng.integers(-128, 127, (64,), dtype=np.int8)]
+
+
+def test_swap_engine_checksum_roundtrip_both_tiers(tmp_path):
+    """Checksums are computed at swap-out and verified on fetch across
+    BOTH tiers; clean payloads round-trip bit-exact with zero
+    integrity noise."""
+    from deepspeed_tpu.offload import SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    arrs = _storm_payload(1)
+    eng.put("h", arrs, tier="host")
+    eng.put("n", arrs, tier="nvme")
+    assert eng._entries["h"].crc is not None
+    assert eng._entries["h"].crc == eng._entries["n"].crc
+    for key in ("h", "n"):
+        back = eng.fetch(key)
+        for a, b in zip(arrs, back):
+            np.testing.assert_array_equal(a, b)
+    assert eng.integrity_failures == 0 and eng.quarantined() == {}
+    eng.close()
+
+
+def test_swap_engine_on_disk_flip_detected_and_quarantined(tmp_path):
+    """THE gap this PR closes: a size-preserving bit-flip on the NVMe
+    payload (flipped behind the engine's back — byte count unchanged,
+    so the torn check at fetch cannot see it) raises the typed
+    CorruptPayloadError, quarantines the key, and a fresh put of the
+    key (the heal-back contract) clears the quarantine."""
+    import os
+    from deepspeed_tpu.offload import CorruptPayloadError, SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    arrs = _storm_payload(2)
+    nbytes = eng.put("k", arrs, tier="nvme")
+    eng.drain()
+    path = eng._path("k")
+    assert os.path.getsize(path) == nbytes
+    with open(path, "r+b") as f:                 # media damage, same size
+        f.seek(7)
+        orig = f.read(1)[0]
+        f.seek(7)
+        f.write(bytes([orig ^ 0xFF]))
+    assert os.path.getsize(path) == nbytes       # size-preserving
+    with pytest.raises(CorruptPayloadError) as ei:
+        eng.fetch("k")
+    assert ei.value.key == "k" and ei.value.tier == "nvme"
+    assert eng.tier_of("k") is None              # never re-attached
+    assert "k" in eng.quarantined()
+    assert eng.integrity_failures == 1
+    with pytest.raises(KeyError):
+        eng.fetch("k")                           # gone, not resurrected
+    eng.put("k", arrs, tier="nvme")              # heal-back re-put
+    assert "k" not in eng.quarantined()          # quarantine cleared
+    back = eng.fetch("k")
+    np.testing.assert_array_equal(arrs[0], back[0])
+    eng.close()
+
+
+def test_swap_engine_verify_off_reproduces_pre_pr_silent_corruption(tmp_path):
+    """The documented pre-PR repro (acceptance criterion): with fetch
+    verification disabled — exactly the pre-ISSUE-18 engine behavior —
+    the same on-disk bit-flip sails through fetch and the flipped
+    float reaches the consumer (a matmul, in a real step) silently.
+    The default config catches it (previous test)."""
+    import os
+    import types
+    from deepspeed_tpu.offload import SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path),
+                     integrity=types.SimpleNamespace(verify_fetch=False))
+    arrs = [np.ones((16,), np.float32)]
+    eng.put("k", arrs, tier="nvme")
+    eng.drain()
+    with open(eng._path("k"), "r+b") as f:
+        f.seek(3)
+        orig = f.read(1)[0]
+        f.seek(3)
+        f.write(bytes([orig ^ 0xFF]))            # flip inside float 0
+    back = eng.fetch("k")                        # attaches silently
+    assert not np.array_equal(back[0], arrs[0])  # wrong bytes, no error
+    assert eng.integrity_failures == 0           # nothing noticed
+    eng.close()
+
+
+def test_swap_engine_swap_io_corrupt_storm_detected(tmp_path):
+    """swap.io:corrupt flips payload bytes between checksum and disk
+    inside the engine's own write path; every fetch detects it —
+    corruption degrades, it is never absorbed."""
+    from deepspeed_tpu.offload import CorruptPayloadError, SwapEngine
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    eng = SwapEngine(nvme_dir=str(tmp_path),
+                     injector=FaultInjector("swap.io:corrupt=4@*"))
+    arrs = _storm_payload(3)
+    eng.put("k", arrs, tier="nvme")
+    with pytest.raises(CorruptPayloadError):
+        eng.fetch("k")
+    assert eng.integrity_failures == 1 and "k" in eng.quarantined()
+    assert eng.injector.fired.get("swap.io", 0) > 0
+    eng.close()
+
+
+def test_swap_engine_host_tier_corrupt_detected(tmp_path):
+    """The corrupt= injection hook on put() damages the HOST-tier copy
+    post-checksum; the host-side fetch verify catches it — integrity
+    is not an NVMe-only property."""
+    from deepspeed_tpu.offload import CorruptPayloadError, SwapEngine
+    eng = SwapEngine(nvme_dir=str(tmp_path))
+    eng.put("k", _storm_payload(4), tier="host", corrupt=4)
+    with pytest.raises(CorruptPayloadError) as ei:
+        eng.fetch("k")
+    assert ei.value.tier == "host"
+    assert "k" in eng.quarantined()
+    eng.close()
+
+
+def test_swap_engine_transient_deny_retries_to_success(tmp_path):
+    """A single transient backend failure at the write reap resubmits
+    synchronously through retry_call and succeeds — no terminal
+    failure, no breaker movement, bytes intact."""
+    from deepspeed_tpu.offload import SwapEngine
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    # swap.io invocation 0 is the write-path corrupt probe; invocation 1
+    # is the write-reap deny — exactly one transient failure
+    eng = SwapEngine(nvme_dir=str(tmp_path),
+                     injector=FaultInjector("swap.io:deny@1"))
+    arrs = _storm_payload(5)
+    eng.put("k", arrs, tier="nvme")
+    eng.drain()                                  # reap retries + succeeds
+    assert eng.io_failures == 0 and eng.write_reverts == 0
+    assert eng.breaker().state == "closed"
+    back = eng.fetch("k")
+    np.testing.assert_array_equal(arrs[0], back[0])
+    eng.close()
+
+
+def test_swap_engine_write_failure_reverts_to_host(tmp_path):
+    """THE lost-only-copy regression (ISSUE 18 satellite): a
+    fire-and-forget NVMe write that fails terminally must NOT have
+    consumed the only copy — the retained pristine source rebuilds the
+    entry on the host tier, bit-exact, and the failure feeds the
+    breaker instead of raising into the caller's put()."""
+    from deepspeed_tpu.offload import SwapEngine
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    eng = SwapEngine(nvme_dir=str(tmp_path),
+                     injector=FaultInjector("swap.io:deny@*"))
+    arrs = _storm_payload(6)
+    eng.put("k", arrs, tier="nvme")              # submit looks fine
+    eng.drain()                                  # reap fails terminally
+    assert eng.tier_of("k") == "host"            # survived, demotion undone
+    assert eng.write_reverts == 1 and eng.io_failures == 1
+    eng.injector = FaultInjector([])             # tier heals
+    back = eng.fetch("k")                        # host fetch: no swap.io
+    for a, b in zip(arrs, back):
+        np.testing.assert_array_equal(a, b)      # pristine, not the torn
+    eng.close()
+
+
+def test_swap_engine_breaker_lifecycle(tmp_path):
+    """CLOSED -> OPEN (sustained terminal read failures) -> refused
+    fast-fail with the entry RETAINED -> HALF_OPEN after cooldown ->
+    CLOSED on a successful real-traffic probe; transitions are
+    observable in the snapshot and the flight recorder."""
+    from deepspeed_tpu.offload import SwapEngine
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    from deepspeed_tpu.telemetry.flight_recorder import get_flight_recorder
+    import types
+    clock = [0.0]
+    eng = SwapEngine(
+        nvme_dir=str(tmp_path),
+        integrity=types.SimpleNamespace(breaker_window=4,
+                                        breaker_min_ops=2,
+                                        breaker_cooldown_s=10.0))
+    eng._breaker._now = lambda: clock[0]
+    arrs = _storm_payload(7)
+    for k in ("a", "b", "c"):
+        eng.put(k, arrs, tier="nvme")
+    eng.drain()
+    eng.injector = FaultInjector("swap.io:deny@*")   # the drive goes bad
+    for k in ("a", "b"):
+        with pytest.raises(IOError):
+            eng.fetch(k)                         # terminal after retries
+    assert eng.breaker().state == "open"
+    with pytest.raises(IOError, match="circuit open"):
+        eng.fetch("c")                           # fast-fail, no submit
+    assert eng.tier_of("c") == "nvme"            # RETAINED: media may heal
+    assert eng.breaker().snapshot()["refused"] >= 1
+    eng.prefetch("c")                            # OPEN: peek, no submit
+    assert eng.inflight_reads() == set()
+    clock[0] += 11.0                             # cooldown elapses
+    eng.injector = FaultInjector([])             # ...and the tier healed
+    back = eng.fetch("c")                        # the HALF_OPEN probe
+    np.testing.assert_array_equal(arrs[0], back[0])
+    snap = eng.breaker().snapshot()
+    assert snap["state"] == "closed"
+    assert snap["opens"] == 1 and snap["closes"] == 1
+    kinds = [e["kind"] for e in get_flight_recorder().events(
+        kind_prefix="offload/breaker")]
+    assert len(kinds) >= 3                       # open, half_open, closed
+    eng.close()
+
+
+def test_swap_engine_snapshot_and_debug_payload(tmp_path):
+    """/debug/offload: the weakref live-engine registry serves each
+    engine's integrity + occupancy snapshot, filterable by owner."""
+    from deepspeed_tpu.offload import SwapEngine, live_engines
+    from deepspeed_tpu.telemetry.debug import offload_payload
+    eng = SwapEngine(nvme_dir=str(tmp_path), owner="snap_test")
+    eng.put("k", _storm_payload(8), tier="nvme")
+    assert eng in live_engines()
+    payload = offload_payload({"owner": "snap_test"})
+    assert payload["count"] >= 1
+    snap = [s for s in payload["engines"] if s["owner"] == "snap_test"][0]
+    assert snap["tiers"]["nvme"]["entries"] == 1
+    assert snap["breaker"]["state"] == "closed"
+    assert snap["checksums"] and snap["verify_fetch"]
+    assert snap["retained_write_sources"] == 1   # write not yet reaped
+    eng.drain()
+    assert eng.snapshot()["retained_write_sources"] == 0
+    eng.close()
+    assert eng not in live_engines()

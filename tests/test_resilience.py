@@ -86,6 +86,52 @@ def test_fault_injector_actions():
     NULL_INJECTOR.check("anything")       # no-op, no state explosion
 
 
+def test_corrupt_action_grammar_and_injector():
+    """ISSUE 18: the corrupt action — spec grammar, default/clamped
+    byte counts, one-shot firing, and NULL_INJECTOR passthrough."""
+    s = FaultSpec.parse("kv.swap:corrupt=16@2")
+    assert (s.site, s.action, s.param, s.start) == \
+        ("kv.swap", "corrupt", 16, 2)
+    inj = FaultInjector("s.k:corrupt@0; s.m:corrupt=4@*")
+    assert inj.corrupt_bytes("s.k", 100) == 8      # default: 8 bytes
+    assert inj.corrupt_bytes("s.k", 100) is None   # one-shot: done
+    assert inj.corrupt_bytes("s.m", 2) == 2        # clamped to payload
+    assert inj.corrupt_bytes("s.m", 0) is None     # empty payload
+    assert inj.fired == {"s.k": 1, "s.m": 2}
+    assert NULL_INJECTOR.corrupt_bytes("s.m", 100) is None
+    # raise specs still raise through the corrupt hook
+    with pytest.raises(FaultInjected):
+        FaultInjector("s.r:raise@0").corrupt_bytes("s.r", 10)
+
+
+def test_corrupt_seeded_probabilistic():
+    """pPsS mode is deterministic per (seed, invocation) for corrupt
+    like every other action — a corruption storm is replayable."""
+    inj = FaultInjector("s.p:corrupt=2@p0.5s7")
+    hits = [inj.corrupt_bytes("s.p", 64) for _ in range(200)]
+    fired = [h for h in hits if h]
+    assert fired and len(fired) < 200 and all(h == 2 for h in fired)
+    inj2 = FaultInjector("s.p:corrupt=2@p0.5s7")
+    assert hits == [inj2.corrupt_bytes("s.p", 64) for _ in range(200)]
+
+
+def test_flip_bytes_size_preserving_involution():
+    """The flip itself: size-preserving by construction, exact flip
+    count, and an involution (two applications restore the payload)."""
+    from deepspeed_tpu.resilience.faults import flip_bytes
+    rng = np.random.default_rng(0)
+    buf = rng.integers(0, 256, size=257, dtype=np.uint8)
+    orig = buf.copy()
+    assert flip_bytes(buf, 16) == 16
+    assert buf.shape == orig.shape                  # size-preserving
+    assert int(np.count_nonzero(buf != orig)) == 16
+    flip_bytes(buf, 16)
+    assert np.array_equal(buf, orig)                # involution
+    assert flip_bytes(buf[:0], 4) == 0              # empty payload
+    small = orig[:3].copy()
+    assert flip_bytes(small, 100) == 3              # clamped to len
+
+
 def test_resolve_injector_merges_env(monkeypatch):
     monkeypatch.setenv("DS_FAULTS", "env.site:deny@0")
     inj = resolve_injector("cfg.site:raise@0")
