@@ -126,7 +126,12 @@ def get_local_rank() -> int:
 
 
 def barrier(group=None, name: str = "ds_barrier"):
-    """Cross-process barrier (reference: torch.distributed.barrier)."""
+    """Cross-process barrier (reference: torch.distributed.barrier).
+    Host-timed into the process-wide CommStat (ISSUE 19) — the barrier
+    is the one collective the host can always time end-to-end."""
+    import time as _time
+    from deepspeed_tpu.telemetry.commstat import peek_commstat
+    t0 = _time.perf_counter()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
@@ -134,6 +139,9 @@ def barrier(group=None, name: str = "ds_barrier"):
         # single process: fence locally-dispatched work
         for d in jax.local_devices():
             jax.device_put(0.0, d).block_until_ready()
+    cs = peek_commstat()
+    if cs is not None:
+        cs.observe("barrier", 0, _time.perf_counter() - t0)
 
 
 def _axis(group):
@@ -143,9 +151,31 @@ def _axis(group):
     return group
 
 
+def _axis_label(ax) -> str:
+    """A mesh-axis key for CommStat rows ("data", "expert+data", ...)."""
+    if isinstance(ax, str):
+        return ax
+    try:
+        return "+".join(str(a) for a in ax)
+    except TypeError:
+        return str(ax)
+
+
 def _log_op(name, tensor, group):
+    ax = None
     if _COMMS_LOGGER is not None and _COMMS_LOGGER.enabled:
-        _COMMS_LOGGER.append_inside_jit(name, tensor, _axis(group))
+        ax = _axis(group)
+        _COMMS_LOGGER.append_inside_jit(name, tensor, ax)
+    from deepspeed_tpu.telemetry.commstat import peek_commstat
+    cs = peek_commstat()
+    if cs is not None:
+        if ax is None:
+            ax = _axis(group)
+        try:
+            nbytes = int(tensor.size) * tensor.dtype.itemsize
+        except (AttributeError, TypeError):
+            nbytes = 0
+        cs.record_traced(name, _axis_label(ax), nbytes)
 
 
 # --------------------------------------------------------------------------

@@ -109,6 +109,12 @@ KNOWN_FAULT_SITES = {
                "ISSUE 18)",
     "fleet.dispatch": "fleet router replica selection (raise = dispatch "
                       "failure, deny = policy-blind misroute)",
+    "comm.collective": "the engine's per-step collective window "
+                       "(ISSUE 19): stall = a straggling/collapsing "
+                       "interconnect link wedges the step inside its "
+                       "comm window (the anomaly/comm_* drill), deny = "
+                       "skip the window (recorded as a comm/denied "
+                       "flight event)",
 }
 
 _SPEC_RE = re.compile(

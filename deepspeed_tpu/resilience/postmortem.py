@@ -23,6 +23,9 @@ that moment:
 - ``memory.json``    — the memory observatory snapshot (ISSUE 14):
   tiers × owners with high-watermarks, the allocation-failure
   forensics ring, and the swap I/O summary
+- ``comm.json``      — the comm observatory snapshot (ISSUE 19):
+  per-op latency/GB-s stats, per-program per-axis collective bytes
+  with comm floors, and the overlap meter
 - ``trace.json``     — the flushed Perfetto trace, when a tracer is
   armed
 
@@ -208,6 +211,18 @@ def write_postmortem(out_dir: str, reason: str, *,
             return False            # no training engine — skip
         return _write_json(p, payload)
     artifact("numerics.json", _numerics)
+
+    def _comm(p):
+        # the comm observatory snapshot (ISSUE 19): per-op latency /
+        # achieved-GB/s stats, per-program per-axis collective bytes
+        # with comm floors, and the overlap meter — a DEGRADED bundle
+        # must answer "was it the interconnect" without the process
+        from deepspeed_tpu.telemetry.debug import comm_payload
+        payload = comm_payload()
+        if not payload.get("armed") and not payload.get("programs"):
+            return False            # commstat never armed, no comm rows
+        return _write_json(p, payload)
+    artifact("comm.json", _comm)
 
     tracer = get_tracer()
     if getattr(tracer, "enabled", False):

@@ -435,6 +435,20 @@ class NumericsConfig(DeepSpeedConfigModel):
                 ">= 16")
 
 
+class CommConfig(DeepSpeedConfigModel):
+    """``telemetry.comm`` — the communication observatory (ISSUE 19):
+    process-wide CommStat (per-op latency/GB-s accounting, MAD anomaly
+    feed ``anomaly/comm_*``), the engine's per-step collective window
+    with comm/compute overlap attribution, ``/debug/comm``, and the
+    post-mortem ``comm.json``.  ``DS_COMMSTAT`` env wins."""
+    #: master switch for the CommStat accounting + the comm debug
+    #: surfaces; off leaves only the CommsLogger summary path
+    enabled: bool = True
+    #: per-train-step collective window (overlap meter + the
+    #: ``comm.collective`` fault gate); requires ``enabled``
+    step_window: bool = True
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Unified telemetry (deepspeed_tpu/telemetry/): metrics registry +
     Prometheus exposition, Chrome-trace span tracer, MFU/goodput gauges.
@@ -478,6 +492,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
     #: groups, NaN provenance, determinism fingerprints (num/* gauges,
     #: /debug/numerics, post-mortem numerics.json)
     numerics: NumericsConfig = Field(default_factory=NumericsConfig)
+    #: communication observatory (ISSUE 19): CommStat per-op stats,
+    #: per-step overlap window, /debug/comm, post-mortem comm.json.
+    #: DS_COMMSTAT env wins.
+    comm: CommConfig = Field(default_factory=CommConfig)
 
     def __init__(self, **data):
         if isinstance(data.get("numerics"), bool):
@@ -485,6 +503,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
             data["numerics"] = NumericsConfig(enabled=data["numerics"])
         elif isinstance(data.get("numerics"), dict):
             data["numerics"] = NumericsConfig(**data["numerics"])
+        if isinstance(data.get("comm"), bool):
+            data["comm"] = CommConfig(enabled=data["comm"])
+        elif isinstance(data.get("comm"), dict):
+            data["comm"] = CommConfig(**data["comm"])
         super().__init__(**data)
         if self.flightrec_events < 0:
             raise ValueError(

@@ -874,11 +874,32 @@ class DeepSpeedEngine:
                 logger.warning(
                     "flops_profiler: model.flops_per_token is unset — the "
                     "profile will report 0 FLOPS")
-        # comms logger wiring (reference comm.configure(comms_logger=...))
+        # comms logger wiring (reference comm.configure(comms_logger=...));
+        # the registry hookup makes the per-op totals live labeled
+        # counters on /metrics (ISSUE 19 satellite), not just summary
+        # events at log_comms_summary time
         if self._config.comms_config.enabled:
             from deepspeed_tpu import comm as _comm
             from deepspeed_tpu.utils.comms_logging import CommsLogger
-            _comm.configure(comms_logger=CommsLogger(self._config.comms_config))
+            _comm.configure(comms_logger=CommsLogger(
+                self._config.comms_config,
+                registry=self.telemetry_registry))
+        # comm observatory (ISSUE 19 tentpole): the process-wide
+        # CommStat feeds comm/* histograms, the anomaly/comm_* MAD
+        # detectors, the per-step overlap window, and /debug/comm
+        self._commstat = None
+        ccfg = self._config.telemetry_config.comm
+        from deepspeed_tpu.telemetry.commstat import (
+            commstat_enabled, get_commstat)
+        if commstat_enabled(ccfg.enabled):
+            self._commstat = get_commstat()
+            self._commstat.attach(registry=self.telemetry_registry,
+                                  anomaly=self.anomaly,
+                                  flightrec=self.flightrec,
+                                  injector=self.fault_injector)
+            self._comm_step_window = bool(ccfg.step_window)
+        else:
+            self._comm_step_window = False
         # compression-aware training (reference engine.py:2044 drives the
         # compression scheduler every step; here the compiled step applies
         # the plans with traced schedule gates — see compression/compress.py)
@@ -2289,6 +2310,27 @@ class DeepSpeedEngine:
 
     def _train_batch_impl(self, data_iter=None, batch=None):
         self.fault_injector.check("train.step")
+        if self._commstat is not None and self._comm_step_window:
+            # per-step collective window (ISSUE 19): opens the overlap
+            # meter and runs the comm.collective drill gate — an
+            # injected stall wedges THIS step exactly where a
+            # straggling link would, while /debug/comm keeps answering
+            comm_corr = f"train-step-{self.global_steps + 1}"
+            self._commstat.step_begin()
+            wire = 0
+            if self._step_cost_ok:
+                from deepspeed_tpu.telemetry.costmodel import get_report
+                rep = get_report("train/step")
+                if rep is not None:
+                    wire = rep.comm_wire_bytes()
+            with self.tracer.span("comm/step_window", cat="comm",
+                                  corr=comm_corr,
+                                  args={"wire_bytes": wire}):
+                t0c = time.perf_counter()
+                self._commstat.fault_gate()
+                gate_s = time.perf_counter() - t0c
+            self._commstat.observe("step_gate", wire, gate_s,
+                                   axis="step", corr=comm_corr)
         self.timers(TRAIN_BATCH_TIMER).start()
         self.tput_timer.start()
         if batch is None:
@@ -2923,6 +2965,10 @@ class DeepSpeedEngine:
                               step=self.global_steps,
                               dur_ms=round(duration_s * 1e3, 3))
         self.anomaly.observe("train.step", duration_s, corr=corr)
+        if self._commstat is not None and self._comm_step_window:
+            # close the per-step collective window (ISSUE 19): publishes
+            # comm/overlap_fraction and the comm/step flight event
+            self._commstat.step_end(duration_s, corr=corr)
         if self._step_cost_ok:
             # achieved-vs-floor for the fused step program (ISSUE 13);
             # floors only resolve where the device rate tables do

@@ -369,6 +369,17 @@ class ContinuousBatchingScheduler:
                     num_experts=getattr(mcfg, "num_experts", None))
             except Exception:       # cost accounting must never block serving
                 self._costmodel_on = False
+        # comm observatory (ISSUE 19): attach the process-wide CommStat
+        # to THIS scheduler's telemetry spine so serve-side collective
+        # windows (barriers, eager collectives) publish into the same
+        # registry /debug/comm renders
+        from deepspeed_tpu.telemetry.commstat import (commstat_enabled,
+                                                      get_commstat)
+        if commstat_enabled():
+            get_commstat().attach(registry=self.metrics.registry,
+                                  anomaly=self.anomaly,
+                                  flightrec=self.flightrec,
+                                  injector=self.injector)
         self.pool = self._init_pool()
         # memory observatory (ISSUE 14): per-step byte attribution of
         # the KV pool (allocated / prefix-cache retained / free), the

@@ -246,7 +246,8 @@ class _Handler(BaseHTTPRequestHandler):
         scheduler lock (the watchdog can say DEGRADED; /debug/stacks
         says where, /debug/requests and /debug/scheduler say what was
         in flight)."""
-        from deepspeed_tpu.telemetry.debug import (flightrec_payload,
+        from deepspeed_tpu.telemetry.debug import (comm_payload,
+                                                   flightrec_payload,
                                                    format_thread_stacks,
                                                    memory_payload,
                                                    numerics_payload,
@@ -291,6 +292,12 @@ class _Handler(BaseHTTPRequestHandler):
             # process too ({"armed": false} without a training engine —
             # peek, never create)
             self._send_json(200, numerics_payload(query))
+            return
+        if route == "/debug/comm":
+            # comm observatory (ISSUE 19): CommStat + per-program
+            # collective attribution — peek, lock-free, answers while a
+            # collective (or an injected stall) has the step wedged
+            self._send_json(200, comm_payload(query))
             return
         self._send_json(404, {"error": f"no route {route}"})
 

@@ -15,7 +15,11 @@ telemetry over the aio/swap paths (``swap/*``, ``DS_NVME_GBPS``).
 ISSUE 15 adds the numerics observatory: lazily banked in-graph
 training-health stats with NaN provenance, MoE router health, and
 determinism fingerprints (``num/*`` gauges, ``/debug/numerics``,
-``numerics.json`` in post-mortem bundles).
+``numerics.json`` in post-mortem bundles).  ISSUE 19 adds the
+communication observatory: per-collective cost attribution with an
+interconnect roofline (``DS_ICI_GBPS``), the process-wide CommStat
+runtime stats with a comm/compute overlap meter, and ``/debug/comm`` +
+``comm.json`` surfaces.
 """
 from deepspeed_tpu.telemetry.registry import (      # noqa: F401
     COUNT_BUCKETS, DEFAULT_LATENCY_BUCKETS_S, Histogram, MetricsRegistry,
@@ -36,8 +40,10 @@ from deepspeed_tpu.telemetry.costmodel import (     # noqa: F401
     costmodel_enabled, count_pallas_launches, get_reports,
     param_stream_bytes, register_report)
 from deepspeed_tpu.telemetry.roofline import (      # noqa: F401
-    HBM_GBPS_BY_KIND, HBM_GBPS_ENV, classify, floor_seconds,
-    hbm_bytes_per_s, observe_achieved, perf_table, publish_report)
+    HBM_GBPS_BY_KIND, HBM_GBPS_ENV, ICI_GBPS_BY_KIND, ICI_GBPS_ENV,
+    classify, comm_floor_seconds, dcn_bytes_per_s, floor_seconds,
+    hbm_bytes_per_s, ici_bytes_per_s, observe_achieved, perf_table,
+    publish_report)
 from deepspeed_tpu.telemetry.memory import (        # noqa: F401
     MEM_ENV, MemoryLedger, attribute_params, compiled_memory_stats,
     device_memory_stats, get_memory_ledger, hbm_used_fraction,
@@ -48,7 +54,10 @@ from deepspeed_tpu.telemetry.numerics import (      # noqa: F401
     FINGERPRINT_ENV, NUMERICS_ENV, NumericsState, configure_numerics,
     group_stats, leaf_groups, numerics_enabled, peek_numerics,
     reset_numerics, resolve_fingerprint_interval, state_fingerprint)
+from deepspeed_tpu.telemetry.commstat import (      # noqa: F401
+    COMMSTAT_ENV, CommStat, commstat_enabled, get_commstat,
+    peek_commstat, reset_commstat, timed_collective)
 from deepspeed_tpu.telemetry.debug import (         # noqa: F401
-    flightrec_payload, format_thread_stacks, memory_payload,
-    numerics_payload, parse_debug_query, perf_payload)
+    comm_payload, flightrec_payload, format_thread_stacks,
+    memory_payload, numerics_payload, parse_debug_query, perf_payload)
 from deepspeed_tpu.telemetry.http_endpoint import MetricsServer  # noqa: F401

@@ -1,0 +1,302 @@
+"""Process-wide communication statistics (ISSUE 19 tentpole).
+
+The IoStat idiom (``telemetry/iostat.py``) applied to the collective
+layer: every host-observable communication event — an eager collective,
+a barrier fence, the engine's per-step collective window — lands in one
+process-wide :class:`CommStat` that feeds four surfaces at once:
+
+- **histograms + gauges** — ``comm/op_latency_s`` and ``comm/op_gbps``
+  per op, plus ``comm/achieved_gbps`` (last sample) — the live view of
+  what each collective family actually sustains;
+- **MAD anomaly feed** — per-op latency (ms-per-MB when the payload is
+  known, raw ms otherwise — one unit per run, never mixed) through the
+  shared :class:`~deepspeed_tpu.telemetry.anomaly.AnomalyMonitor` as
+  ``anomaly/comm_<op>`` — a collapsing ICI link shows up as a score
+  spike carrying the wedged step's correlation id;
+- **overlap meter** — a per-step window (``step_begin``/``step_end``)
+  classifies observed comm time into *exposed* (on the step's critical
+  thread, serializing with compute) vs *overlapped* (any other thread)
+  and publishes ``comm/overlap_fraction``;
+- **trace-time totals** — ``record_traced`` accumulates the per-axis
+  byte counts the jit-traced wrappers in ``deepspeed_tpu.comm`` see,
+  so ``/debug/comm`` can show where the bytes go even when the runtime
+  samples are sparse.
+
+The ``comm.collective`` fault site (stall/deny) gates the engine's
+step window through :meth:`fault_gate`, so a straggling link is a
+drill: ``comm.collective:stall=1.5@20`` wedges step 20 exactly where a
+sick interconnect would.
+
+Arming follows the repo's env-wins convention: ``DS_COMMSTAT`` beats
+the ``telemetry.comm`` config block.  Readers (``summary`` →
+``/debug/comm`` and ``comm.json``) are lock-free per the debug
+contract: GIL-atomic dict snapshots, no subsystem locks.
+"""
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+COMMSTAT_ENV = "DS_COMMSTAT"
+
+#: achieved-GB/s histogram buckets — the ICI regime reaches far above
+#: the NVMe swap buckets (v5p declares 600 GB/s per chip)
+GBPS_BUCKETS = (0.05, 0.25, 1.0, 4.0, 16.0, 64.0, 128.0, 256.0, 512.0,
+                1024.0)
+
+#: per-op latency buckets (seconds) — collectives span µs fences to
+#: multi-second stalls
+LATENCY_BUCKETS_S = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1.0, 5.0)
+
+
+def commstat_enabled(config_default: Optional[bool] = None) -> bool:
+    """Resolution order (env wins): ``DS_COMMSTAT`` > the
+    ``telemetry.comm.enabled`` value the caller passes > on."""
+    env = os.environ.get(COMMSTAT_ENV, "").strip()
+    if env:
+        return env not in ("0", "false", "off")
+    if config_default is not None:
+        return bool(config_default)
+    return True
+
+
+class CommStat:
+    """Per-op communication accounting with a step-window overlap
+    meter.  Writers take ``_lock``; every reader path snapshots dicts
+    under the GIL only — ``summary()`` is safe to call from the debug
+    HTTP thread while a step (or an injected stall) is wedged."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: (op, axis) -> [calls, bytes, time_s, last_gbps, gbps_sum,
+        #:               timed_calls]
+        self._ops: Dict[tuple, list] = {}
+        #: (op, axis) -> [calls, bytes] — trace-time accounting from
+        #: the jit wrappers (sizes only; no host timing exists there)
+        self._traced: Dict[tuple, list] = {}
+        self.registry = None
+        self.anomaly = None
+        self.flightrec = None
+        self.injector = None
+        # ---- step window (overlap meter) ----
+        self._step_active = False
+        self._step_thread_id: Optional[int] = None
+        self._step_exposed_s = 0.0
+        self._step_overlapped_s = 0.0
+        self._overlap_fraction: Optional[float] = None
+        self._denied = 0
+
+    # ------------------------------------------------------------ wiring
+    def attach(self, registry=None, anomaly=None, flightrec=None,
+               injector=None):
+        """Late-bind the telemetry spine (engine/scheduler construction
+        order varies); any argument left None keeps the current sink."""
+        if registry is not None:
+            self.registry = registry
+        if anomaly is not None:
+            self.anomaly = anomaly
+        if flightrec is not None:
+            self.flightrec = flightrec
+        if injector is not None:
+            self.injector = injector
+
+    # ------------------------------------------------------- fault drill
+    def fault_gate(self) -> bool:
+        """The ``comm.collective`` fault site: a ``stall`` wedges the
+        caller exactly where a straggling link would (inside the step's
+        collective window); ``deny`` skips the collective and returns
+        True.  No-op without an attached injector."""
+        inj = self.injector
+        if inj is None:
+            return False
+        if inj.deny("comm.collective"):
+            self._denied += 1
+            rec = self.flightrec
+            if rec is not None:
+                rec.record("comm/denied", site="comm.collective")
+            return True
+        return False
+
+    # --------------------------------------------------------- recording
+    def record_traced(self, op: str, axis: str, nbytes: int):
+        """One collective as seen at TRACE time by the
+        ``deepspeed_tpu.comm`` wrappers — byte/call totals only (the
+        traced program runs later, on the device, where the host can't
+        time it)."""
+        key = (op, axis or "?")
+        with self._lock:
+            row = self._traced.get(key)
+            if row is None:
+                self._traced[key] = [1, int(nbytes)]
+            else:
+                row[0] += 1
+                row[1] += int(nbytes)
+
+    def observe(self, op: str, nbytes: int, duration_s: float,
+                axis: str = "?", corr: Optional[str] = None):
+        """One host-timed communication event.  Updates the per-op
+        stats, the registry histograms/gauges, the overlap window when
+        a step is open, and the MAD anomaly feed."""
+        duration_s = max(float(duration_s), 0.0)
+        nbytes = int(nbytes)
+        gbps = (nbytes / duration_s / 1e9) if (duration_s > 0
+                                               and nbytes > 0) else 0.0
+        key = (op, axis or "?")
+        with self._lock:
+            row = self._ops.get(key)
+            if row is None:
+                self._ops[key] = [1, nbytes, duration_s, gbps, gbps,
+                                  1 if gbps > 0 else 0]
+            else:
+                row[0] += 1
+                row[1] += nbytes
+                row[2] += duration_s
+                if gbps > 0:
+                    row[3] = gbps
+                    row[4] += gbps
+                    row[5] += 1
+            if self._step_active:
+                if threading.get_ident() == self._step_thread_id:
+                    self._step_exposed_s += duration_s
+                else:
+                    self._step_overlapped_s += duration_s
+        reg = self.registry
+        if reg is not None:
+            reg.histogram("comm/op_latency_s", buckets=LATENCY_BUCKETS_S,
+                          op=op).observe(duration_s)
+            if gbps > 0:
+                reg.histogram("comm/op_gbps", buckets=GBPS_BUCKETS,
+                              op=op).observe(gbps)
+                reg.set_gauge("comm/achieved_gbps", gbps, op=op)
+        mon = self.anomaly
+        if mon is not None:
+            # ms-per-MB (inverse bandwidth) when the payload is known —
+            # a collapsing link raises it regardless of message size;
+            # raw ms otherwise (byte-less fences/barriers): each op key
+            # sees ONE unit per run, so the MAD baseline stays coherent
+            if nbytes > 0:
+                value = duration_s * 1e3 / (nbytes / 2**20)
+            else:
+                value = duration_s * 1e3
+            mon.observe(f"comm_{op}", value, corr=corr)
+
+    # ------------------------------------------------------- step window
+    def step_begin(self):
+        """Open the overlap window: comm observed on THIS thread until
+        ``step_end`` is *exposed* (serializes with the step); comm on
+        any other thread is *overlapped*."""
+        with self._lock:
+            self._step_active = True
+            self._step_thread_id = threading.get_ident()
+            self._step_exposed_s = 0.0
+            self._step_overlapped_s = 0.0
+
+    def step_end(self, step_duration_s: float,
+                 corr: Optional[str] = None) -> Optional[float]:
+        """Close the window and publish ``comm/overlap_fraction`` —
+        the share of the step's observed comm time that ran OFF the
+        critical thread (1.0 = fully hidden behind compute).  Returns
+        the fraction, or None when the step observed no comm at all
+        (publishing 0/0 as "no overlap" would smear honest steps)."""
+        with self._lock:
+            if not self._step_active:
+                return None
+            self._step_active = False
+            exposed = self._step_exposed_s
+            overlapped = self._step_overlapped_s
+        total = exposed + overlapped
+        if total <= 0:
+            return None
+        fraction = overlapped / total
+        self._overlap_fraction = fraction
+        reg = self.registry
+        if reg is not None:
+            reg.set_gauge("comm/overlap_fraction", fraction)
+        rec = self.flightrec
+        if rec is not None:
+            rec.record("comm/step", corr=corr,
+                       exposed_ms=round(exposed * 1e3, 3),
+                       overlapped_ms=round(overlapped * 1e3, 3),
+                       step_ms=round(float(step_duration_s) * 1e3, 3))
+        return fraction
+
+    # ----------------------------------------------------------- reading
+    def summary(self) -> Dict[str, Any]:
+        """Lock-free snapshot for ``/debug/comm`` / ``comm.json``:
+        per-op runtime stats, trace-time byte totals, the overlap
+        meter, and the deny count.  GIL-atomic dict copies only."""
+        ops: Dict[str, Any] = {}
+        for (op, axis), row in dict(self._ops).items():
+            calls, nbytes, time_s, last_gbps, gbps_sum, timed = row
+            ops[f"{op}|{axis}"] = {
+                "op": op, "axis": axis, "calls": int(calls),
+                "bytes": int(nbytes),
+                "total_time_ms": round(time_s * 1e3, 3),
+                "last_gbps": round(last_gbps, 4),
+                "mean_gbps": round(gbps_sum / timed, 4) if timed else 0.0,
+            }
+        traced: Dict[str, Any] = {}
+        for (op, axis), row in dict(self._traced).items():
+            traced[f"{op}|{axis}"] = {"op": op, "axis": axis,
+                                      "calls": int(row[0]),
+                                      "bytes": int(row[1])}
+        return {
+            "ops": ops,
+            "traced": traced,
+            "overlap_fraction": self._overlap_fraction,
+            "denied": self._denied,
+        }
+
+
+# ------------------------------------------------- process-wide instance
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[CommStat] = None
+
+
+def get_commstat() -> CommStat:
+    """The process-wide CommStat (created on first use)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = CommStat()
+    return _GLOBAL
+
+
+def peek_commstat() -> Optional[CommStat]:
+    """The instance if one exists — debug surfaces must never ARM the
+    subsystem as a side effect of being scraped."""
+    return _GLOBAL
+
+
+def reset_commstat():
+    """Tests: drop the process-wide instance."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None
+
+
+def timed_collective(op: str, nbytes: int, axis: str = "?",
+                     corr: Optional[str] = None):
+    """Context manager: host-time one eager collective into the
+    process-wide CommStat (no-op-cheap when nothing is attached)."""
+    return _TimedCollective(op, nbytes, axis, corr)
+
+
+class _TimedCollective:
+    __slots__ = ("op", "nbytes", "axis", "corr", "_t0")
+
+    def __init__(self, op, nbytes, axis, corr):
+        self.op, self.nbytes, self.axis, self.corr = op, nbytes, axis, corr
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            get_commstat().observe(self.op, self.nbytes,
+                                   time.perf_counter() - self._t0,
+                                   axis=self.axis, corr=self.corr)
+        return False

@@ -15,7 +15,12 @@ program (jaxpr) and produces a :class:`CostReport`:
   execution.  This is the PR 12 fused-decode L-vs-4L assertion
   generalized into a library (:func:`count_pallas_launches`);
 - **collective bytes** — operand bytes of psum/all_gather/etc.
-  equations, execution-weighted;
+  equations, execution-weighted — plus a per-collective breakdown
+  keyed ``op|mesh-axis|dtype`` (ISSUE 19): call counts, logical
+  payload bytes, and ring-algorithm WIRE bytes (``2·(N−1)/N`` for
+  all-reduce, ``(N−1)/N`` for all-gather / reduce-scatter /
+  all-to-all, ``1`` for ppermute), with axis sizes read from the
+  enclosing ``shard_map``/``pmap`` equation's mesh;
 - **HBM bytes** — the dtype-aware weight stream the program must pull
   per execution.  For the decode regime this IS the floor, and the
   math is the existing ``split_quantized_bytes`` accounting
@@ -43,6 +48,34 @@ COLLECTIVE_PRIMITIVES = frozenset({
     "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
     "pgather", "reduce_scatter", "pmax", "pmin", "allreduce"})
 
+#: primitive name -> the canonical collective family it performs on
+#: the wire (pmax/pmin are all-reduces with a different combiner;
+#: ``psum_scatter`` traces as primitive ``reduce_scatter``)
+CANONICAL_COLLECTIVE = {
+    "psum": "all_reduce", "allreduce": "all_reduce",
+    "pmax": "all_reduce", "pmin": "all_reduce",
+    "psum_scatter": "reduce_scatter", "reduce_scatter": "reduce_scatter",
+    "all_gather": "all_gather", "pgather": "all_gather",
+    "all_to_all": "all_to_all", "ppermute": "ppermute",
+}
+
+
+def ring_wire_factor(op: str, n: Optional[int]) -> float:
+    """Bytes each participant puts on the wire per logical payload
+    byte under the standard ring algorithms (the ``calc_bw_log``
+    busbw convention): ``2·(N−1)/N`` for all-reduce,
+    ``(N−1)/N`` for all-gather / reduce-scatter / all-to-all,
+    ``1`` for ppermute.  ``n=None`` (axis size unknown) returns 1.0 —
+    never an inflated guess."""
+    if n is None:
+        return 1.0
+    n = max(int(n), 1)
+    if op == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0
+
 
 def costmodel_enabled(config_default: Optional[bool] = None) -> bool:
     """Resolution order (the repo's env-wins convention):
@@ -64,6 +97,10 @@ class CostReport:
     hbm_bytes: int = 0             #: weight-stream bytes per execution
     pallas_launches: int = 0       #: kernel-launch sites in the program
     collective_bytes: int = 0      #: interconnect payload per execution
+    #: per-collective breakdown keyed ``"op|axis|dtype"`` (e.g.
+    #: ``"all_reduce|data|float32"``) -> {calls, payload_bytes,
+    #: wire_bytes, axis_size}, execution-weighted like flops
+    collectives: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     detail: Dict[str, Any] = field(default_factory=dict)
 
     def arithmetic_intensity(self) -> Optional[float]:
@@ -72,11 +109,21 @@ class CostReport:
             return None
         return self.flops / self.hbm_bytes
 
+    def comm_wire_bytes(self) -> int:
+        """Total ring-algorithm wire bytes per execution — the quantity
+        an interconnect-bandwidth floor divides (0 when the program has
+        no per-axis collective attribution)."""
+        return int(sum(row.get("wire_bytes", 0)
+                       for row in self.collectives.values()))
+
     def to_dict(self) -> Dict[str, Any]:
         return {"name": self.name, "flops": int(self.flops),
                 "hbm_bytes": int(self.hbm_bytes),
                 "pallas_launches": int(self.pallas_launches),
                 "collective_bytes": int(self.collective_bytes),
+                "comm_wire_bytes": self.comm_wire_bytes(),
+                "collectives": {k: dict(v)
+                                for k, v in self.collectives.items()},
                 "detail": dict(self.detail)}
 
 
@@ -142,7 +189,103 @@ def _grid_size(eqn) -> int:
     return max(n, 1)
 
 
-def _walk(jaxpr, mult: int, acc: Dict[str, int]):
+def _collective_axes(eqn):
+    """The mesh-axis NAMES a collective equation spans (psum carries
+    ``axes``, the others ``axis_name``; all_to_all's is a bare string).
+    Positional (int) axes are dropped — they never cross a device."""
+    names = eqn.params.get("axes")
+    if names is None:
+        names = eqn.params.get("axis_name")
+    if names is None:
+        return ()
+    if isinstance(names, str):
+        return (names,)
+    return tuple(n for n in names if isinstance(n, str))
+
+
+def _axis_product(names, axis_sizes: Dict[str, int]) -> Optional[int]:
+    n = 1
+    for nm in names:
+        size = axis_sizes.get(nm)
+        if size is None:
+            return None
+        n *= int(size)
+    return n
+
+
+def _account_collective(eqn, prim: str, mult: int,
+                        collectives: Dict[str, Dict[str, Any]],
+                        axis_sizes: Dict[str, int]):
+    op = CANONICAL_COLLECTIVE.get(prim, prim)
+    names = _collective_axes(eqn)
+    axis = "+".join(names) if names else "?"
+    # the equation's own axis_size param (all_gather / reduce_scatter
+    # carry the participant-count product) beats the mesh lookup
+    n = eqn.params.get("axis_size")
+    n = int(n) if n is not None else _axis_product(names, axis_sizes)
+    for v in eqn.invars:
+        nbytes = _aval_bytes(v.aval)
+        if nbytes <= 0:
+            continue
+        try:
+            import numpy as np
+            dtype = str(np.dtype(v.aval.dtype))
+        except Exception:
+            dtype = "?"
+        # the logical payload is the FULL tensor: an all_gather operand
+        # is one shard, so scale it back up by the participant count
+        payload = nbytes * n if (op == "all_gather" and n) else nbytes
+        key = f"{op}|{axis}|{dtype}"
+        row = collectives.setdefault(
+            key, {"calls": 0, "payload_bytes": 0, "wire_bytes": 0,
+                  "axis_size": n})
+        row["calls"] += mult
+        row["payload_bytes"] += mult * payload
+        row["wire_bytes"] += int(round(
+            mult * payload * ring_wire_factor(op, n)))
+        row["axis_size"] = n
+
+
+def _mesh_axis_sizes(eqn) -> Dict[str, int]:
+    """Axis name -> size bindings an equation establishes for its body
+    (``shard_map`` carries a Mesh param; ``pmap`` carries
+    axis_name/axis_size)."""
+    out: Dict[str, int] = {}
+    mesh = eqn.params.get("mesh")
+    shape = getattr(mesh, "shape", None)
+    if shape:
+        try:
+            out.update({str(k): int(v) for k, v in dict(shape).items()})
+        except (TypeError, ValueError):     # exotic mesh shape object
+            out.clear()
+    name = eqn.params.get("axis_name")
+    size = eqn.params.get("axis_size")
+    if isinstance(name, str) and size is not None and \
+            eqn.primitive.name not in COLLECTIVE_PRIMITIVES:
+        out[name] = int(size)
+    return out
+
+
+def _new_acc() -> Dict[str, Any]:
+    return {"flops": 0, "collective_bytes": 0, "launches": 0,
+            "collectives": {}}
+
+
+def _merge_collectives(dst: Dict[str, Dict[str, Any]],
+                       src: Dict[str, Dict[str, Any]]):
+    for key, row in src.items():
+        cur = dst.setdefault(
+            key, {"calls": 0, "payload_bytes": 0, "wire_bytes": 0,
+                  "axis_size": row.get("axis_size")})
+        cur["calls"] += row["calls"]
+        cur["payload_bytes"] += row["payload_bytes"]
+        cur["wire_bytes"] += row["wire_bytes"]
+        cur["axis_size"] = row.get("axis_size")
+
+
+def _walk(jaxpr, mult: int, acc: Dict[str, Any],
+          axis_sizes: Optional[Dict[str, int]] = None):
+    axis_sizes = axis_sizes or {}
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim == "dot_general":
@@ -150,6 +293,8 @@ def _walk(jaxpr, mult: int, acc: Dict[str, int]):
         elif prim in COLLECTIVE_PRIMITIVES:
             acc["collective_bytes"] += mult * sum(
                 _aval_bytes(v.aval) for v in eqn.invars)
+            _account_collective(eqn, prim, mult, acc["collectives"],
+                                axis_sizes)
         if prim == "pallas_call":
             acc["launches"] += 1
         if prim == "cond":
@@ -157,22 +302,28 @@ def _walk(jaxpr, mult: int, acc: Dict[str, int]):
             branches = eqn.params.get("branches", ())
             best = None
             for br in branches:
-                sub_acc = {"flops": 0, "collective_bytes": 0, "launches": 0}
-                _walk(getattr(br, "jaxpr", br), mult, sub_acc)
+                sub_acc = _new_acc()
+                _walk(getattr(br, "jaxpr", br), mult, sub_acc, axis_sizes)
                 if best is None or sub_acc["flops"] > best["flops"]:
                     best = sub_acc
             if best is not None:
                 acc["flops"] += best["flops"]
                 acc["collective_bytes"] += best["collective_bytes"]
                 acc["launches"] += best["launches"]
+                _merge_collectives(acc["collectives"], best["collectives"])
             continue
         sub_mult = mult
         if prim == "scan":
             sub_mult = mult * int(eqn.params.get("length", 1))
         elif prim == "pallas_call":
             sub_mult = mult * _grid_size(eqn)
+        sub_axes = axis_sizes
+        bound = _mesh_axis_sizes(eqn)
+        if bound:
+            sub_axes = dict(axis_sizes)
+            sub_axes.update(bound)
         for sub in _sub_jaxprs(eqn):
-            _walk(sub, sub_mult, acc)
+            _walk(sub, sub_mult, acc, sub_axes)
 
 
 def analyze_jaxpr(closed_jaxpr, name: str = "program",
@@ -182,7 +333,7 @@ def analyze_jaxpr(closed_jaxpr, name: str = "program",
     absent, the program-boundary bytes (inputs + outputs) stand in as
     an upper bound and are flagged in the detail dict."""
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
-    acc = {"flops": 0, "collective_bytes": 0, "launches": 0}
+    acc = _new_acc()
     _walk(jaxpr, 1, acc)
     detail: Dict[str, Any] = {}
     if hbm_bytes is None:
@@ -195,6 +346,7 @@ def analyze_jaxpr(closed_jaxpr, name: str = "program",
                       hbm_bytes=int(hbm_bytes),
                       pallas_launches=acc["launches"],
                       collective_bytes=acc["collective_bytes"],
+                      collectives=acc["collectives"],
                       detail=detail)
 
 

@@ -37,6 +37,11 @@ The ``/debug/*`` surface shared by ``bin/ds_serve`` and the training
   through a weakref registry only — never an engine or scheduler
   lock — so "is the NVMe tier sick" is answerable while the step that
   hit it is wedged.
+- ``comm_payload()`` — the ``/debug/comm`` JSON body (ISSUE 19): the
+  CommStat per-op runtime stats, the per-program per-axis collective
+  attribution with comm floors, and the overlap meter.  Peek contract
+  (an unarmed process answers ``{"armed": false}``) and lock-free like
+  the rest — a wedged collective must not block its own diagnosis.
 - ``parse_debug_query()`` — tiny query-string parsing shared by both
   HTTP front doors.
 
@@ -180,4 +185,64 @@ def perf_payload(query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         payload["programs"] = {k: v for k, v
                                in payload["programs"].items()
                                if want in k}
+    return payload
+
+
+def comm_payload(query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """The ``/debug/comm`` body (ISSUE 19): the CommStat runtime
+    summary (per-op latency/GB-s, trace-time byte totals, the overlap
+    meter), the per-program per-axis collective attribution with comm
+    floors, and the resolved interconnect rates.  Peek contract: an
+    unarmed process answers ``{"armed": false}`` without creating the
+    CommStat; lock-free throughout (dict snapshots only), so it
+    answers while a collective — or an injected ``comm.collective``
+    stall — has the step wedged.  ``?op=<substring>`` filters the op
+    rows, ``?program=<substring>`` the program rows."""
+    from deepspeed_tpu.telemetry import costmodel as _cm
+    from deepspeed_tpu.telemetry.commstat import peek_commstat
+    from deepspeed_tpu.telemetry.roofline import (comm_floor_seconds,
+                                                  device_rates)
+    cs = peek_commstat()
+    payload: Dict[str, Any] = {"armed": cs is not None}
+    if cs is not None:
+        payload.update(cs.summary())
+    else:
+        payload.update({"ops": {}, "traced": {},
+                        "overlap_fraction": None, "denied": 0})
+    rates = device_rates()
+    ici = rates.get("ici_bytes_per_s")
+    payload["ici_gbps"] = None if ici is None else ici / 1e9
+    dcn = rates.get("dcn_bytes_per_s")
+    payload["dcn_gbps"] = None if dcn is None else dcn / 1e9
+    programs: Dict[str, Any] = {}
+    achieved = _cm.get_achieved()
+    for name, report in sorted(_cm.get_reports().items()):
+        wire = report.comm_wire_bytes()
+        if not report.collectives and wire <= 0:
+            continue                    # compute-only program: no comm row
+        row: Dict[str, Any] = {
+            "collectives": {k: dict(v)
+                            for k, v in report.collectives.items()},
+            "comm_wire_bytes": wire,
+        }
+        floor = comm_floor_seconds(report, ici)
+        row["comm_floor_ms"] = None if floor is None else round(
+            floor * 1e3, 6)
+        a = achieved.get(name)
+        if a is not None and floor and floor > 0:
+            row["comm_achieved_vs_floor"] = round((a[0] / 1e3) / floor, 4)
+        programs[name] = row
+    payload["programs"] = programs
+    query = query or {}
+    want_op = query.get("op")
+    if want_op:
+        payload["ops"] = {k: v for k, v in payload["ops"].items()
+                          if want_op in k}
+        payload["traced"] = {k: v for k, v in payload["traced"].items()
+                             if want_op in k}
+    want_prog = query.get("program")
+    if want_prog:
+        payload["programs"] = {k: v for k, v
+                               in payload["programs"].items()
+                               if want_prog in k}
     return payload

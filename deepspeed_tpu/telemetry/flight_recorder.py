@@ -94,6 +94,10 @@ KNOWN_EVENT_KINDS = {
     "num/fingerprint": "a determinism fingerprint was recorded "
                        "(interval stream, checkpoint stamp, or restore "
                        "audit — source/digest/ok in fields; ISSUE 15)",
+    "comm/": "prefix family: comm observatory events (ISSUE 19) — "
+             "comm/step (the per-train-step collective window closed: "
+             "exposed/overlapped ms in fields), comm/denied (a denied "
+             "comm.collective fault skipped the window)",
     "postmortem": "a post-mortem bundle was written",
 }
 

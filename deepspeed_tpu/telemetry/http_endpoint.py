@@ -59,7 +59,7 @@ class MetricsServer:
 
             def do_GET(self):
                 from deepspeed_tpu.telemetry.debug import (
-                    flightrec_payload, format_thread_stacks,
+                    comm_payload, flightrec_payload, format_thread_stacks,
                     memory_payload, numerics_payload, offload_payload,
                     parse_debug_query, perf_payload)
                 from deepspeed_tpu.telemetry.flight_recorder import \
@@ -91,6 +91,9 @@ class MetricsServer:
                 elif route == "/debug/offload":
                     body = json.dumps(offload_payload(query)).encode()
                     code, ctype = 200, "application/json"
+                elif route == "/debug/comm":
+                    body = json.dumps(comm_payload(query)).encode()
+                    code, ctype = 200, "application/json"
                 else:
                     body = f"no route {route}\n".encode()
                     code, ctype = 404, "text/plain"
@@ -109,7 +112,7 @@ class MetricsServer:
                     f"http://{self.host}:{self.port}/metrics "
                     f"(+ /healthz, /debug/stacks, /debug/flightrec, "
                     f"/debug/perf, /debug/memory, /debug/numerics, "
-                    f"/debug/offload)")
+                    f"/debug/offload, /debug/comm)")
         return self
 
     def stop(self):

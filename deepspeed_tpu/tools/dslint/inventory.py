@@ -53,6 +53,7 @@ SUBMODELS = {
     "resilience.retry": "RetryConfig",
     "resilience.offload": "OffloadIntegrityConfig",
     "telemetry.numerics": "NumericsConfig",
+    "telemetry.comm": "CommConfig",
 }
 DICT_SUBMODELS = {
     "serving.slo.classes": "SLOClassConfig",

@@ -40,6 +40,16 @@ ENV_VARS = {
     "DS_HBM_GBPS": "per-device HBM bandwidth (GB/s) for roofline floors "
                    "(wins over the device-kind table; how CPU tier-1 "
                    "exercises floor math)",
+    "DS_ICI_GBPS": "per-device interconnect (ICI) bandwidth (GB/s) for "
+                   "comm roofline floors and comm/achieved_vs_floor "
+                   "(wins over the device-kind table; None on CPU — no "
+                   "fictitious floors; ISSUE 19)",
+    "DS_DCN_GBPS": "declared data-center-network bandwidth (GB/s) for "
+                   "cross-host comm accounting (declaration-only: no "
+                   "by-kind table exists for the DCN fabric; ISSUE 19)",
+    "DS_COMMSTAT": "0/1 disables/forces the comm observatory CommStat "
+                   "(per-op stats, step collective window, /debug/comm; "
+                   "wins over telemetry.comm.enabled; ISSUE 19)",
     "DS_KV_TIERING": "0/1 disables/forces tiered KV spill "
                      "(host-RAM/NVMe cold tiers; wins over "
                      "serving.kv_tiering.enabled)",
@@ -126,6 +136,33 @@ METRICS = {
                         "(ms), labeled by program",
     "perf/achieved_vs_floor": "achieved/floor ratio (the live "
                               "N-x-over-floor gap), labeled by program",
+    # --- comm observatory (per-collective telemetry + interconnect
+    # roofline + overlap attribution, ISSUE 19)
+    "comm/calls": "CommsLogger per-op call count as a live labeled "
+                  "counter, labeled by op",
+    "comm/total_bytes": "CommsLogger per-op message-byte total, "
+                        "labeled by op",
+    "comm/total_time_ms": "CommsLogger per-op eager-timed total (ms), "
+                          "labeled by op",
+    "comm/wire_bytes": "ring-algorithm interconnect wire bytes per "
+                       "execution (2(N-1)/N all-reduce etc.), labeled "
+                       "by program",
+    "comm/floor_ms": "interconnect comm floor per execution (ms; only "
+                     "where an ICI rate resolves — never fictitious "
+                     "on CPU), labeled by program",
+    "comm/achieved_vs_floor": "achieved/comm-floor ratio (the "
+                              "collapsing-link gauge; publishes ONLY "
+                              "under a declared/known ICI rate), "
+                              "labeled by program",
+    "comm/op_latency_s": "host-timed per-collective latency histogram, "
+                         "labeled by op",
+    "comm/op_gbps": "host-timed achieved collective bandwidth "
+                    "histogram (GB/s), labeled by op",
+    "comm/achieved_gbps": "latest achieved collective bandwidth gauge, "
+                          "labeled by op",
+    "comm/overlap_fraction": "share of the step's observed comm time "
+                             "that ran off the critical thread (1.0 = "
+                             "fully hidden behind compute)",
     # --- memory observatory (tiered ledger + OOM forensics, ISSUE 14)
     "mem/owner_bytes": "live bytes per owner, labeled by tier+owner "
                        "(params/optimizer/kv_pool/prefix_cache/...)",

@@ -48,11 +48,15 @@ def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float,
 
 
 class CommsLogger:
-    def __init__(self, config=None):
+    def __init__(self, config=None, registry=None):
         self.enabled = bool(getattr(config, "enabled", True))
         self.verbose = bool(getattr(config, "verbose", False))
         self.prof_all = bool(getattr(config, "prof_all", True))
         self.prof_ops = list(getattr(config, "prof_ops", []) or [])
+        #: optional MetricsRegistry (ISSUE 19 satellite): per-op totals
+        #: sync as labeled counters on every append, so /metrics shows
+        #: comm traffic live instead of only at log_summary time
+        self.registry = registry
         self.comms_dict: Dict[str, Dict[int, list]] = defaultdict(
             lambda: defaultdict(lambda: [0, 0.0]))  # op -> size -> [count, time]
 
@@ -65,6 +69,21 @@ class CommsLogger:
         rec = self.comms_dict[op_name][int(size_bytes)]
         rec[0] += 1
         rec[1] += duration_s
+        reg = self.registry
+        if reg is not None:
+            # absolute sync (set_counter) — comms_dict is the source of
+            # truth and appends can carry zero duration, so deltas
+            # would drift on re-configure
+            sizes = self.comms_dict[op_name]
+            reg.set_counter("comm/calls",
+                            float(sum(r[0] for r in sizes.values())),
+                            op=op_name)
+            reg.set_counter("comm/total_bytes",
+                            float(sum(s * r[0] for s, r in sizes.items())),
+                            op=op_name)
+            reg.set_counter("comm/total_time_ms",
+                            round(sum(r[1] for r in sizes.values()) * 1e3,
+                                  3), op=op_name)
         if self.verbose:
             log_dist(f"comm op: {op_name} | size: {convert_size(size_bytes)} "
                      f"| time: {duration_s * 1e3:.3f} ms", ranks=[0])

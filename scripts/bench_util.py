@@ -157,6 +157,41 @@ def mem_peak_fields() -> dict:
         return {}
 
 
+def comm_fields() -> dict:
+    """``comm_*`` record fields from the communication observatory
+    (ISSUE 19 satellite): per-mesh-axis collective wire bytes summed
+    over every registered cost-model program, the achieved GB/s per
+    timed collective op, and the overlap fraction — so
+    ``bench_compare --history`` gates a bench that silently started
+    moving more bytes (or moving them slower) over the interconnect.
+    Empty when neither the cost model nor CommStat ever armed."""
+    try:
+        from deepspeed_tpu.telemetry import costmodel as _cm
+        from deepspeed_tpu.telemetry.commstat import peek_commstat
+        out = {}
+        per_axis = {}
+        for report in _cm.get_reports().values():
+            for key, row in report.collectives.items():
+                axis = key.split("|")[1] if key.count("|") >= 1 else "?"
+                per_axis[axis] = per_axis.get(axis, 0) \
+                    + int(row.get("wire_bytes", 0))
+        for axis, wire in sorted(per_axis.items()):
+            if wire > 0:
+                out[f"comm_wire_{axis}_bytes"] = wire
+        cs = peek_commstat()
+        if cs is not None:
+            summ = cs.summary()
+            for row in summ["ops"].values():
+                if row.get("mean_gbps"):
+                    out[f"comm_{row['op']}_gbps"] = row["mean_gbps"]
+            if summ.get("overlap_fraction") is not None:
+                out["comm_overlap_fraction"] = round(
+                    summ["overlap_fraction"], 4)
+        return out
+    except Exception:
+        return {}
+
+
 def timed_chain(step_fn, state0, n, warmup=2):
     """On-device loop slope: run ``m`` and ``5m`` chained ``step_fn``
     applications inside one jitted ``fori_loop`` (a data dependency

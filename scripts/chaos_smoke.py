@@ -16,6 +16,9 @@ kills the process:
   cursor (ISSUE 9);
 - fleet replica loss mid-stream: the router resubmits the committed
   stream to a surviving replica, token-identical (ISSUE 11);
+- a comm.collective stall (ISSUE 19): the wedged step's collective
+  window raises anomaly/comm_* with the step's corr id and the bundle
+  carries comm.json;
 - offload corruption storms (ISSUE 18): flipped KV payloads degrade to
   re-prefill (token-identical serving), flipped param shards rebuild
   from the fp32 masters (bitwise-identical losses), and a sustained
@@ -422,6 +425,49 @@ def case_nonfinite_provenance():
     reset_numerics()
 
 
+def case_comm_stall_anomaly():
+    """comm.collective stall (ISSUE 19): a wedged collective window is
+    flagged as anomaly/comm_* carrying the wedged step's corr id, the
+    lock-free /debug/comm payload answers mid-run, and the post-mortem
+    bundle carries comm.json."""
+    import json
+    import tempfile
+    import deepspeed_tpu
+    from deepspeed_tpu.resilience.postmortem import (reset_rate_limit,
+                                                     write_postmortem)
+    from deepspeed_tpu.telemetry.commstat import reset_commstat
+    from deepspeed_tpu.telemetry.debug import comm_payload
+    reset_commstat()
+    reset_rate_limit()
+    with tempfile.TemporaryDirectory() as tmp:
+        import os as _os
+        from deepspeed_tpu.models.gpt2 import gpt2_model
+        model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                           num_layers=2, num_heads=4, d_model=32,
+                           dtype="float32", attention_impl="xla")
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 0,
+               "resilience": {"faults": "comm.collective:stall=0.5@18"}}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        for i in range(19):        # 18 warm the MAD baseline; 19 stalls
+            _train(engine, seed=200 + i)
+        anomalies = engine.flightrec.events(kind_prefix="anomaly/comm_")
+        assert any(e.get("corr") == "train-step-19" for e in anomalies), \
+            "stalled collective window raised no anomaly/comm_*"
+        payload = comm_payload()
+        assert payload["armed"] and "step_gate|step" in payload["ops"]
+        bundle = write_postmortem(
+            tmp, "degraded: comm stall drill", step=19,
+            registry=engine.telemetry_registry,
+            flightrec=engine.flightrec)
+        assert bundle, "post-mortem bundle not written"
+        with open(_os.path.join(bundle, "comm.json")) as f:
+            assert json.load(f)["armed"] is True
+    reset_commstat()
+
+
 def case_param_swap_fault_degrades():
     """param.swap stall + truncate mid-step under NVMe-streamed params
     (ISSUE 17): delayed I/O is absorbed by the pipeline and every torn
@@ -701,6 +747,8 @@ def main(argv=None):
                   case_fleet_replica_loss_resubmits))
     cases.append(("train.nonfinite NaN attributed to its leaf group",
                   case_nonfinite_provenance))
+    cases.append(("comm.collective stall raises anomaly/comm_*",
+                  case_comm_stall_anomaly))
 
     results = []
     for name, fn in cases:

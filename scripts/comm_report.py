@@ -1,0 +1,146 @@
+"""Where-did-the-collectives-go report (ISSUE 19 satellite).
+
+Renders the communication observatory — per-op runtime latency and
+achieved GB/s, trace-time byte attribution, the comm/compute overlap
+meter, and each program's per-axis collective rows with their
+interconnect-roofline floor — from either a live ``/debug/comm``
+endpoint or a post-mortem bundle's ``comm.json``:
+
+    python scripts/comm_report.py http://127.0.0.1:8080/debug/comm
+    python scripts/comm_report.py postmortems/postmortem-step12/comm.json
+    python scripts/comm_report.py comm.json --json   # re-emit raw JSON
+
+Exit 0 on a rendered report, 2 on an unreadable/unparseable source.
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_payload(source: str) -> dict:
+    """A /debug/comm URL or a comm.json path -> parsed payload."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=10) as r:
+            return json.loads(r.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n:.2f} {unit}")
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def render(payload: dict) -> str:
+    lines = ["# communication observatory report"]
+    if not payload.get("armed"):
+        lines.append("(CommStat not armed — was the run configured with "
+                     "DS_COMMSTAT / telemetry.comm?)")
+    ici = payload.get("ici_gbps")
+    dcn = payload.get("dcn_gbps")
+    lines.append(
+        "interconnect: "
+        + (f"ICI {ici:g} GB/s" if ici is not None
+           else "no ICI bandwidth (CPU, no DS_ICI_GBPS declared — "
+                "comm floors unpriced)")
+        + (f", DCN {dcn:g} GB/s" if dcn is not None else ""))
+    overlap = payload.get("overlap_fraction")
+    if overlap is not None:
+        lines.append(f"comm/compute overlap: {overlap:.1%} of in-window "
+                     "collective time overlapped the step")
+    denied = payload.get("denied", 0)
+    if denied:
+        lines.append(f"denied collectives (comm.collective fault): "
+                     f"{denied}")
+
+    ops = payload.get("ops", {})
+    lines.append(f"\n## runtime collectives ({len(ops)} op rows)")
+    if ops:
+        rows = sorted(ops.values(),
+                      key=lambda r: -r.get("total_time_ms", 0))
+        w = max([len(f"{r['op']}|{r['axis']}") for r in rows] + [8])
+        lines.append(f"{'op|axis':<{w}}  {'calls':>7}  {'bytes':>12}  "
+                     f"{'total ms':>10}  {'mean GB/s':>9}  "
+                     f"{'last GB/s':>9}")
+        for r in rows:
+            key = f"{r['op']}|{r['axis']}"
+            lines.append(
+                f"{key:<{w}}  {r['calls']:>7}  "
+                f"{fmt_bytes(r['bytes']):>12}  "
+                f"{r['total_time_ms']:>10.3f}  {r['mean_gbps']:>9g}  "
+                f"{r['last_gbps']:>9g}")
+    else:
+        lines.append("(no timed collectives observed)")
+
+    traced = payload.get("traced", {})
+    if traced:
+        lines.append(f"\n## trace-time attribution ({len(traced)} rows, "
+                     "from comm-log hooks)")
+        for key, r in sorted(traced.items(),
+                             key=lambda kv: -kv[1]["bytes"]):
+            lines.append(f"{key}: {r['calls']} calls, "
+                         f"{fmt_bytes(r['bytes'])}")
+
+    programs = payload.get("programs", {})
+    if programs:
+        lines.append(f"\n## program collective attribution "
+                     f"({len(programs)} programs)")
+    for name, row in sorted(programs.items()):
+        floor = row.get("comm_floor_ms")
+        vs = row.get("comm_achieved_vs_floor")
+        lines.append(
+            f"\n### {name} — wire "
+            f"{fmt_bytes(row.get('comm_wire_bytes', 0))}"
+            + (f", comm floor {floor:g} ms" if floor is not None
+               else ", comm floor unpriced (no interconnect bandwidth)")
+            + (f", {vs:g}x of floor" if vs is not None else ""))
+        colls = row.get("collectives", {})
+        for key, c in sorted(colls.items(),
+                             key=lambda kv: -kv[1]["wire_bytes"]):
+            lines.append(
+                f"  {key}: {c['calls']} calls, payload "
+                f"{fmt_bytes(c['payload_bytes'])}, wire "
+                f"{fmt_bytes(c['wire_bytes'])}"
+                + (f" (axis size {c['axis_size']})"
+                   if c.get("axis_size") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="comm_report",
+        description="render the per-collective telemetry table from "
+                    "/debug/comm or a post-mortem comm.json")
+    p.add_argument("source", help="URL (http://host:port/debug/comm) "
+                                  "or path to comm.json")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw JSON payload instead of the table")
+    args = p.parse_args(argv)
+    try:
+        payload = load_payload(args.source)
+    except Exception as e:
+        print(f"comm_report: cannot read {args.source!r}: {e}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(payload, dict) or "ops" not in payload:
+        print(f"comm_report: {args.source!r} is not a /debug/comm "
+              "payload (no 'ops' key)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

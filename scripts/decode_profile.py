@@ -52,12 +52,14 @@ def _variant_record(model: str, name: str, step_ms: float) -> dict:
     """Ledger form of one variant row (DS_BENCH_LEDGER=1, ISSUE 13):
     step_ms is the gated value; the model shape rides detail.model so
     bench_compare's cross-model guard engages.  ``mem_peak_*`` fields
-    (ISSUE 14) ride detail too, so the history can gate memory
-    regressions beside latency ones."""
-    from scripts.bench_util import mem_peak_fields
+    (ISSUE 14) and ``comm_*`` fields (ISSUE 19) ride detail too, so
+    the history can gate memory and interconnect regressions beside
+    latency ones."""
+    from scripts.bench_util import comm_fields, mem_peak_fields
     return {"metric": f"decode_profile_{name}", "value": step_ms,
             "unit": "ms_per_step", "direction": "lower_better",
-            "detail": {"model": model, **mem_peak_fields()}}
+            "detail": {"model": model, **mem_peak_fields(),
+                       **comm_fields()}}
 
 
 def moe_floor_main():

@@ -12,7 +12,8 @@ Inputs:
 
 Output: one row per span name — count, total ms, mean ms, % of the
 trace's wall span — then, for rows whose name matches a registered
-cost-model program, the floor columns.  The table PERF.md used to
+cost-model program, the floor columns (including, when an interconnect
+bandwidth was declared, the program's comm floor — ISSUE 19).  The table PERF.md used to
 hand-compute, from artifacts the running system already emits::
 
     python scripts/perf_report.py trace.json
@@ -95,6 +96,13 @@ def join_cost(stats: Dict[str, dict], perf: Optional[dict]):
         if exact.get("floor_ms"):
             row["mean_vs_floor"] = round(
                 row["mean_ms"] / exact["floor_ms"], 2)
+        # comm columns (ISSUE 19): only present when the program carries
+        # collectives AND an interconnect bandwidth was declared — never
+        # invent a comm floor the roofline itself refused to price
+        if exact.get("comm_floor_ms") is not None:
+            row["comm_floor_ms"] = exact.get("comm_floor_ms")
+            row["comm_achieved_vs_floor"] = exact.get(
+                "comm_achieved_vs_floor")
 
 
 def render(stats: Dict[str, dict], wall: float, top: int) -> str:
@@ -102,18 +110,20 @@ def render(stats: Dict[str, dict], wall: float, top: int) -> str:
     width = max([len(n) for n, _ in rows] + [4])
     lines = [f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
              f"{'mean ms':>9}  {'% wall':>6}  {'floor ms':>9}  "
-             f"{'x floor':>7}  bound"]
+             f"{'x floor':>7}  {'comm ms':>8}  bound"]
     for name, r in rows:
         pct = 100.0 * r["total_ms"] / wall if wall > 0 else 0.0
         floor = r.get("floor_ms")
         floor_cell = f"{floor:>9.4f}" if floor is not None else f"{'-':>9}"
         ratio_cell = f"{r.get('mean_vs_floor', '-'):>7}" \
             if floor is not None else f"{'-':>7}"
+        comm = r.get("comm_floor_ms")
+        comm_cell = f"{comm:>8.4f}" if comm is not None else f"{'-':>8}"
         bound = (r.get("bound") or "-") if floor is not None else "-"
         lines.append(
             f"{name:<{width}}  {r['count']:>7}  {r['total_ms']:>10.3f}  "
             f"{r['mean_ms']:>9.4f}  {pct:>5.1f}%  {floor_cell}  "
-            f"{ratio_cell}  {bound}")
+            f"{ratio_cell}  {comm_cell}  {bound}")
     return "\n".join(lines)
 
 

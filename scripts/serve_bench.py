@@ -86,13 +86,17 @@ def emit(result: dict, json_path=None) -> dict:
     DS_BENCH_LEDGER is armed — append it (BenchRecord meta envelope
     attached) to the BENCH/ ledger history (ISSUE 13).  Every record
     gains the memory observatory's ``mem_peak_*`` watermarks
-    (ISSUE 14) INSIDE ``detail`` — that is the half of a record
-    ``bench_compare`` lifts into comparable metrics, so the history
-    gates memory regressions like latency ones."""
-    from scripts.bench_util import mem_peak_fields
+    (ISSUE 14) and the communication observatory's ``comm_*``
+    per-axis wire bytes / achieved GB/s (ISSUE 19) INSIDE ``detail``
+    — that is the half of a record ``bench_compare`` lifts into
+    comparable metrics, so the history gates memory and interconnect
+    regressions like latency ones."""
+    from scripts.bench_util import comm_fields, mem_peak_fields
     detail = result.setdefault("detail", {})
     if isinstance(detail, dict):
         for k, v in mem_peak_fields().items():
+            detail.setdefault(k, v)
+        for k, v in comm_fields().items():
             detail.setdefault(k, v)
     print(json.dumps(result))
     if json_path:

@@ -20,6 +20,10 @@ tied back to the step that spiked is forensic noise.  The check always
 runs when anomaly events are present; ``--check-anomalies`` also fails
 when the trace contains none at all (chaos-session acceptance).
 
+Comm spans (ISSUE 19): ``comm/*`` events — the engine's per-step
+collective-window span and comm instants — must carry ``cat: "comm"``
+and, when correlated at all, the enclosing step's id.
+
 Usage::
 
     python scripts/trace_validate.py /tmp/ds_trace.json
@@ -145,6 +149,31 @@ def validate_anomalies(events: List[Dict],
     return errors
 
 
+def validate_comm(events: List[Dict]) -> List[str]:
+    """ISSUE 19: ``comm/*`` events (the engine's per-step collective
+    window span, comm instants) must carry ``cat: "comm"`` and — when
+    they carry a correlation id at all — the enclosing step's id, so
+    the overlap meter's spans join the step timeline they price."""
+    errors: List[str] = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or \
+                not str(ev.get("name", "")).startswith("comm/"):
+            continue
+        name = ev["name"]
+        if ev.get("ph") in ("B", "X") and ev.get("cat") != "comm":
+            errors.append(f"event {i} ({name!r}): comm spans must carry "
+                          f"cat='comm', got {ev.get('cat')!r}")
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        corr = args.get("corr")
+        if corr is not None and not (isinstance(corr, str)
+                                     and _STEP_CORR.match(corr)):
+            errors.append(
+                f"event {i} ({name!r}): comm event corr must be the "
+                f"enclosing step's id (train-step-N / serve-step-N), "
+                f"got {corr!r}")
+    return errors
+
+
 def validate(path: str, require_corr: bool = False,
              check_anomalies: bool = False) -> List[str]:
     try:
@@ -154,6 +183,7 @@ def validate(path: str, require_corr: bool = False,
     errors = validate_events(events)
     errors.extend(validate_anomalies(events,
                                      require_present=check_anomalies))
+    errors.extend(validate_comm(events))
     if require_corr and not errors:
         corrs = {ev.get("args", {}).get("corr") for ev in events
                  if isinstance(ev, dict) and isinstance(ev.get("args"),
