@@ -222,27 +222,41 @@ def _layer_norm(x, scale, bias, eps):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block_qkv(x, layer, config: GPT2Config):
-    """LN1 + QKV projection; x [B, S, D] -> q/k/v [B, S, H, hd]."""
+def _lora_add(y, lora, name, h):
+    """Adapter delta on a projection output (see ``lora_add`` in
+    models/serving.py)."""
+    from deepspeed_tpu.models.serving import lora_add
+    return lora_add(y, lora, name, h)
+
+
+def _block_qkv(x, layer, config: GPT2Config, lora=None):
+    """LN1 + QKV projection; x [B, S, D] -> q/k/v [B, S, H, hd].
+    ``lora(name, h)`` is the per-layer gather-LoRA callback (ISSUE 20)."""
     B, S, D = x.shape
     H, hd = config.num_heads, config.head_dim
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], config.layer_norm_eps)
     qkv = qdot(h, layer["qkv_w"]) + layer["qkv_b"].astype(h.dtype)
+    qkv = _lora_add(qkv, lora, "qkv_w", h)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
     return (q.reshape(B, S, H, hd), kk.reshape(B, S, H, hd),
             v.reshape(B, S, H, hd))
 
 
-def _block_finish(x, attn, layer, config: GPT2Config):
+def _block_finish(x, attn, layer, config: GPT2Config, lora=None):
     """Post-attention half: proj + residual + MLP; x/attn [B, S, D]."""
-    x = x + qdot(attn, layer["proj_w"]) + layer["proj_b"].astype(x.dtype)
+    proj = qdot(attn, layer["proj_w"]) + layer["proj_b"].astype(x.dtype)
+    x = x + _lora_add(proj, lora, "proj_w", attn)
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], config.layer_norm_eps)
-    h = qdot(h, layer["mlp_in_w"]) + layer["mlp_in_b"].astype(h.dtype)
+    h = _lora_add(qdot(h, layer["mlp_in_w"])
+                  + layer["mlp_in_b"].astype(h.dtype),
+                  lora, "mlp_in_w", h)
     if config.activation == "relu":
         h = jax.nn.relu(h)
     else:
         h = jax.nn.gelu(h, approximate=config.activation != "gelu_exact")
-    x = x + qdot(h, layer["mlp_out_w"]) + layer["mlp_out_b"].astype(x.dtype)
+    x = x + _lora_add(qdot(h, layer["mlp_out_w"])
+                      + layer["mlp_out_b"].astype(x.dtype),
+                      lora, "mlp_out_w", h)
     return x
 
 
@@ -339,11 +353,15 @@ def init_cache(config: GPT2Config, batch_size: int, max_len: int, dtype=None):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def prefill(params, batch, cache, config: GPT2Config, attn_fn=None):
+def prefill(params, batch, cache, config: GPT2Config, attn_fn=None,
+            lora=None):
     """Run the causal forward over (right-padded) prompts, filling the cache.
     Returns (logits [B, S, V], cache).  ``attn_fn(q, k, v, layer_idx)``
     overrides the attention product (GPT-Neo's banded/unscaled form rides
-    this hook)."""
+    this hook).  ``lora`` (ISSUE 20): gather-LoRA batch — prompt KV
+    depends on the adapter, so prefill applies it too; the layer-major
+    stacks ride the scan as xs."""
+    from deepspeed_tpu.models.serving import lora_layer_fn
     tokens = batch["input_ids"]
     B, S = tokens.shape
     dtype = jnp.dtype(config.dtype)
@@ -352,16 +370,24 @@ def prefill(params, batch, cache, config: GPT2Config, attn_fn=None):
         attn_fn = lambda q, k, v, idx: causal_attention(
             q, k, v, impl=config.attention_impl)
 
-    def body(carry, layer_idx):
-        layer, idx = layer_idx
+    def body(carry, xs):
+        if lora is None:
+            layer, idx = xs
+            lfn = None
+        else:
+            layer, idx, ls = xs
+            lfn = lora_layer_fn(lora, ls)
         layer = maybe_stream(layer)      # dequant / host-stream per layer
-        q, kk, v = _block_qkv(carry, layer, config)
+        q, kk, v = _block_qkv(carry, layer, config, lora=lfn)
         attn = attn_fn(q, kk, v, idx)
-        out = _block_finish(carry, attn.reshape(B, S, -1), layer, config)
+        out = _block_finish(carry, attn.reshape(B, S, -1), layer, config,
+                            lora=lfn)
         return out, (kk, v)
 
     idxs = jnp.arange(config.num_layers)
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], idxs))
+    xs = (params["blocks"], idxs) if lora is None \
+        else (params["blocks"], idxs, lora["stacks"])
+    x, (ks, vs) = lax.scan(body, x, xs)
     if "k_s" in cache:      # int8 cache: quantize the prefill block
         from deepspeed_tpu.ops.pallas.decode_attention import (
             quantize_prefill_into_cache)
@@ -378,7 +404,7 @@ def prefill(params, batch, cache, config: GPT2Config, attn_fn=None):
 
 
 def decode_step(params, tokens, cache, lengths, config: GPT2Config,
-                sm_scale=None, min_pos_fn=None):
+                sm_scale=None, min_pos_fn=None, lora=None):
     """One decode step.  tokens [B] int32, lengths [B] = current cache fill
     per row (the new token's position).  Returns (logits [B, V], cache).
 
@@ -398,11 +424,14 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
     from deepspeed_tpu.models import serving as _sv
-    fused = (min_pos_fn is None
+    # per-row gather-LoRA keeps the unrolled composition (ISSUE 20):
+    # neither the fused megakernel nor the scan form expresses the
+    # per-layer stack slices
+    fused = (min_pos_fn is None and lora is None
              and _sv.fused_decode_active(params["blocks"],
                                          _fused_spec(config, sm_scale)))
     if (use_scan_decode(params["blocks"], fused=fused)
-            and sm_scale is None and min_pos_fn is None):
+            and sm_scale is None and min_pos_fn is None and lora is None):
         # large int8 models: scan serializes the per-layer dequant (the
         # unrolled loop lets XLA materialize every layer's bf16 weights
         # at once — see serving.quantized_layer_bytes).  The GPT-Neo
@@ -435,7 +464,8 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
     for l in range(config.num_layers):
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
                              keep_quantized=keep_q)
-        q, kk, v = _block_qkv(x[:, None, :], layer, config)
+        lfn = _sv.lora_at_layer(lora, l)
+        q, kk, v = _block_qkv(x[:, None, :], layer, config, lora=lfn)
         if quantized:
             kq, ks1 = quantize_kv(kk[:, 0])
             vq, vs1 = quantize_kv(v[:, 0])
@@ -453,7 +483,7 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
             min_pos=(min_pos_fn(jnp.int32(l), lengths)
                      if min_pos_fn is not None else None))
         x = _block_finish(x, attn.reshape(B, D).astype(x.dtype),
-                          layer, config)
+                          layer, config, lora=lfn)
     logits = head(params, x[:, None, :], config)[:, 0]
     if quantized:
         return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
@@ -461,7 +491,7 @@ def decode_step(params, tokens, cache, lengths, config: GPT2Config,
 
 
 def verify_window(params, tokens, cache, lengths, config: GPT2Config,
-                  sm_scale=None, min_pos_fn=None):
+                  sm_scale=None, min_pos_fn=None, lora=None):
     """Speculative-decoding verification (serving/spec): score a W-token
     window at positions ``lengths .. lengths+W-1`` with ONE weight pass
     per layer — the QKV/MLP/head projections run once over all W
@@ -479,7 +509,7 @@ def verify_window(params, tokens, cache, lengths, config: GPT2Config,
     x = (params["wte"].astype(dtype)[tokens] +
          params["wpe"].astype(dtype)[positions])            # [B, W, D]
     from deepspeed_tpu.models import serving as _sv
-    if min_pos_fn is None and _sv.fused_decode_active(
+    if min_pos_fn is None and lora is None and _sv.fused_decode_active(
             params["blocks"], _fused_spec(config, sm_scale)):
         # the whole window per layer in ONE Pallas call (ISSUE 12)
         x, cache = _sv._fused_layer_pass(
@@ -493,7 +523,8 @@ def verify_window(params, tokens, cache, lengths, config: GPT2Config,
     for l in range(config.num_layers):
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
                              keep_quantized=keep_q)
-        q, kk, v = _block_qkv(x, layer, config)
+        lfn = _sv.lora_at_layer(lora, l)
+        q, kk, v = _block_qkv(x, layer, config, lora=lfn)
         attn_cols = []
         for j in range(W):
             if quantized:
@@ -514,7 +545,7 @@ def verify_window(params, tokens, cache, lengths, config: GPT2Config,
                          if min_pos_fn is not None else None)))
         attn = jnp.stack(attn_cols, axis=1)                 # [B, W, H, hd]
         x = _block_finish(x, attn.reshape(B, W, -1).astype(x.dtype),
-                          layer, config)
+                          layer, config, lora=lfn)
     logits = head(params, x, config)                        # [B, W, V]
     if quantized:
         return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
@@ -557,12 +588,16 @@ def gpt2_model(size: str = "125m", **overrides) -> Model:
         logical_specs=logical_specs(config),
         flops_per_token=6.0 * n_params,
         meta={"name": f"gpt2-{size}", "n_params": n_params,
-              "supports_random_ltd": True, "supports_pld": True},
+              "supports_random_ltd": True, "supports_pld": True,
+              "lora_serving": True},
         embed_fn=lambda p, b: embed(p, b, config),
         block_fn=lambda lp, x: _block(x, lp, config),
         head_fn=lambda p, x: head(p, x, config),
         init_cache_fn=lambda bs, ml, dtype=None: init_cache(config, bs, ml, dtype),
-        prefill_fn=lambda p, b, c: prefill(p, b, c, config),
-        decode_fn=lambda p, t, c, l: decode_step(p, t, c, l, config),
-        verify_fn=lambda p, t, c, l: verify_window(p, t, c, l, config),
+        prefill_fn=lambda p, b, c, lora=None: prefill(p, b, c, config,
+                                                      lora=lora),
+        decode_fn=lambda p, t, c, l, lora=None: decode_step(
+            p, t, c, l, config, lora=lora),
+        verify_fn=lambda p, t, c, l, lora=None: verify_window(
+            p, t, c, l, config, lora=lora),
     )

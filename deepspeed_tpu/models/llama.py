@@ -185,16 +185,21 @@ def rope(x, theta: float, positions=None, interleaved: bool = False):
     return out.astype(x.dtype)
 
 
-def _block_qkv(x, layer, config: LlamaConfig, positions=None):
+def _block_qkv(x, layer, config: LlamaConfig, positions=None, lora=None):
     """RMSNorm + QKV + rotary; x [B, S, D] -> q [B,S,H,hd], k/v [B,S,KV,hd]
-    (kv heads NOT repeated — the caller decides, so caches stay compact)."""
+    (kv heads NOT repeated — the caller decides, so caches stay compact).
+    ``lora(name, h)`` adds per-row adapter deltas on the projection
+    outputs BEFORE rope — rope is a position-dependent linear map on the
+    projected vectors, so this is where the offline merge lands too
+    (ISSUE 20)."""
+    from deepspeed_tpu.models.serving import lora_add
     B, S, D = x.shape
     H, KV, hd = config.num_heads, config.num_kv_heads, config.head_dim
     h = _rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     dt = h.dtype
-    q = qdot(h, layer["wq"])
-    kk = qdot(h, layer["wk"])
-    v = qdot(h, layer["wv"])
+    q = lora_add(qdot(h, layer["wq"]), lora, "wq", h)
+    kk = lora_add(qdot(h, layer["wk"]), lora, "wk", h)
+    v = lora_add(qdot(h, layer["wv"]), lora, "wv", h)
     if config.attn_bias:
         q = q + layer["wq_b"].astype(dt)
         kk = kk + layer["wk_b"].astype(dt)
@@ -207,15 +212,18 @@ def _block_qkv(x, layer, config: LlamaConfig, positions=None):
     return q, kk, v
 
 
-def _block_finish(x, attn, layer, config: LlamaConfig):
+def _block_finish(x, attn, layer, config: LlamaConfig, lora=None):
+    from deepspeed_tpu.models.serving import lora_add
     dt = x.dtype
-    attn_out = qdot(attn, layer["wo"])
+    attn_out = lora_add(qdot(attn, layer["wo"]), lora, "wo", attn)
     if config.attn_bias:
         attn_out = attn_out + layer["wo_b"].astype(dt)
     x = x + attn_out
     h = _rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    gated = jax.nn.silu(qdot(h, layer["w_gate"])) * qdot(h, layer["w_up"])
-    x = x + qdot(gated, layer["w_down"])
+    gated = jax.nn.silu(lora_add(qdot(h, layer["w_gate"]), lora,
+                                 "w_gate", h)) \
+        * lora_add(qdot(h, layer["w_up"]), lora, "w_up", h)
+    x = x + lora_add(qdot(gated, layer["w_down"]), lora, "w_down", gated)
     return x
 
 
@@ -267,11 +275,11 @@ def _serving_fns(config: LlamaConfig):
     def embed_fn(params, tokens):
         return params["wte"].astype(jnp.dtype(config.dtype))[tokens]
 
-    def qkv_fn(x, layer, positions):
-        return _block_qkv(x, layer, config, positions)
+    def qkv_fn(x, layer, positions, lora=None):
+        return _block_qkv(x, layer, config, positions, lora=lora)
 
-    def finish_fn(x, attn_flat, layer):
-        return _block_finish(x, attn_flat, layer, config)
+    def finish_fn(x, attn_flat, layer, lora=None):
+        return _block_finish(x, attn_flat, layer, config, lora=lora)
 
     def head_fn(params, x):
         return head(params, x, config)
@@ -302,26 +310,28 @@ def _serving_fns(config: LlamaConfig):
                                   config.head_dim, bs, max_len, dtype,
                                   config.dtype)
 
-    def prefill_fn(p, b, c):
+    def prefill_fn(p, b, c, lora=None):
         return serving.prefill(
             p, b, c, embed_fn=embed_fn, qkv_fn=qkv_fn, finish_fn=finish_fn,
             head_fn=head_fn, num_heads=config.num_heads,
             num_kv_heads=config.num_kv_heads,
-            attention_impl=config.attention_impl)
+            attention_impl=config.attention_impl, lora=lora)
 
-    def decode_fn(p, t, c, l):
+    def decode_fn(p, t, c, l, lora=None):
         return serving.decode_step(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads,
-            fused_spec=fused_spec, fused_weights_fn=fused_weights)
+            fused_spec=fused_spec, fused_weights_fn=fused_weights,
+            lora=lora)
 
-    def verify_fn(p, t, c, l):
+    def verify_fn(p, t, c, l, lora=None):
         return serving.verify_window(
             p, t, c, l, embed_fn=embed_fn, qkv_fn=qkv_fn,
             finish_fn=finish_fn, head_fn=head_fn,
             num_heads=config.num_heads,
-            fused_spec=fused_spec, fused_weights_fn=fused_weights)
+            fused_spec=fused_spec, fused_weights_fn=fused_weights,
+            lora=lora)
 
     return init_cache_fn, prefill_fn, decode_fn, verify_fn
 
@@ -358,6 +368,7 @@ def llama_model(size: str = "7b", **overrides) -> Model:
         flops_per_token=6.0 * n_params,
         meta={"name": f"llama-{size}", "n_params": n_params,
               "supports_random_ltd": True, "supports_pld": True,
+              "lora_serving": True,
               # wte grads come solely from input_ids lookups (untied
               # lm_head): eligible for the sparse_gradients exchange
               "sparse_grad_params": {"wte": "input_ids"}},

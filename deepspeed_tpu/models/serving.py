@@ -301,6 +301,79 @@ def use_scan_decode(blocks, moe_grouped: bool = False,
     return residual > get_quant_scan_threshold()
 
 
+# ----------------------------------------------------- batched gather-LoRA
+def gather_lora_delta(h, a, b, groups, scale):
+    """Batched multi-adapter LoRA delta (ISSUE 20) — the jnp reference
+    for the grouped-GEMM slot-kernel idiom: every row gathers ITS
+    adapter's factors from the store's stacked HBM slots and applies
+    ``(h @ A_g @ B_g) * scale_g`` alongside the base projection.
+
+    ``h`` [B, W, d_in] activations; ``a`` [S, d_in, r] / ``b``
+    [S, r, d_out] one layer's slot stacks (S = resident-adapter slots,
+    r = max rank — lower-rank adapters are zero-padded, which is exact:
+    padded A columns meet padded B rows and contribute nothing);
+    ``groups`` int32 [B] row → slot, -1 = no adapter; ``scale`` f32 [S].
+    Rows with ``groups < 0`` gather slot 0 (shape safety) but the final
+    mask forces their delta to an exact 0.0 — adapter-less rows skip
+    exactly.  Distinct adapters stream once per step: the gather reads
+    each resident slot at most once per layer regardless of how many
+    rows share it.  A 2-D ``h`` [B, d_in] (gpt2's decode residual runs
+    without the window axis) is treated as W = 1."""
+    if h.ndim == 2:
+        return gather_lora_delta(h[:, None], a, b, groups, scale)[:, 0]
+    g = jnp.maximum(groups, 0)
+    ag = jnp.take(a, g, axis=0)                     # [B, d_in, r]
+    bg = jnp.take(b, g, axis=0)                     # [B, r, d_out]
+    t = jnp.einsum("bwd,bdr->bwr", h.astype(ag.dtype), ag)
+    d = jnp.einsum("bwr,bro->bwo", t, bg)
+    d = d * jnp.take(scale, g)[:, None, None]
+    d = jnp.where((groups >= 0)[:, None, None], d, 0.0)
+    return d.astype(h.dtype)
+
+
+def lora_add(y, lora, name, h):
+    """Add the adapter delta for projection ``name`` to its output
+    ``y = h @ W``.  The delta lands on the PROJECTION OUTPUT, before any
+    split/reshape/rope — those are linear (position-dependent for rope,
+    but still linear) maps applied after the projection, so adding here
+    is exactly the offline merge ``h @ (W + scale·A@B)`` up to float
+    associativity.  ``lora`` may be None (base-only program) and the
+    callback may return None (layer/target not adapted) — both leave
+    ``y`` untouched, bit-for-bit."""
+    if lora is None:
+        return y
+    d = lora(name, h)
+    return y if d is None else y + d
+
+
+def lora_layer_fn(lora, sliced):
+    """Build one layer's ``lora(name, h) -> delta | None`` callback from
+    already-layer-sliced stacks ``sliced = {target: {"a": [S, d_in, r],
+    "b": [S, r, d_out]}}`` — the form a ``lax.scan`` body receives when
+    the layer-major stacks ride as scan xs."""
+    if lora is None:
+        return None
+    groups, scale = lora["groups"], lora["scale"]
+
+    def delta(name, h):
+        t = sliced.get(name)
+        if t is None:
+            return None
+        return gather_lora_delta(h, t["a"], t["b"], groups, scale)
+    return delta
+
+
+def lora_at_layer(lora, l):
+    """Layer ``l``'s delta callback from the full layer-major batch
+    ``lora = {"groups": [B], "scale": [S], "stacks": {target: {"a":
+    [L, S, d_in, r], "b": [L, S, r, d_out]}}}`` (unrolled decode/verify
+    loops slice per layer)."""
+    if lora is None:
+        return None
+    return lora_layer_fn(lora, {n: {"a": t["a"][l], "b": t["b"][l]}
+                                for n, t in lora["stacks"].items()})
+
+
 def write_token(c, l, new, lengths):
     """Write one decode step's vectors ``new`` [B, ...] at per-row fill
     positions ``lengths`` [B] into layer ``l`` of the stacked cache
@@ -341,10 +414,15 @@ def init_cache(num_layers, num_kv_heads, head_dim, batch_size, max_len,
 
 
 def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
-            num_heads, num_kv_heads, attention_impl, attn_fn=None):
+            num_heads, num_kv_heads, attention_impl, attn_fn=None,
+            lora=None):
     """Causal forward over right-padded prompts filling the compact cache.
     Returns (logits [B, S, V], cache).  ``attn_fn(q, k, v)`` overrides the
-    causal-attention dispatch (ALiBi models pass their biased form)."""
+    causal-attention dispatch (ALiBi models pass their biased form).
+    ``lora`` (ISSUE 20): gather-LoRA batch — the layer-major stacks ride
+    the layer scan as xs and the hooks receive a per-layer delta
+    callback (prompt KV depends on the adapter, so prefill MUST apply
+    it)."""
     from deepspeed_tpu.ops.attention import causal_attention
     tokens = batch["input_ids"]
     B, S = tokens.shape
@@ -354,16 +432,23 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
         attn_fn = lambda q, k, v: causal_attention(q, k, v,
                                                    impl=attention_impl)
 
-    def body(carry, layer):
+    def body(carry, xs):
         from deepspeed_tpu.models.model import maybe_stream
+        if lora is None:
+            layer, kw = xs, {}
+        else:
+            layer, ls = xs
+            kw = {"lora": lora_layer_fn(lora, ls)}
         layer = maybe_stream(layer)      # dequant / host-stream per layer
-        q, kk, v = qkv_fn(carry, layer, None)
+        q, kk, v = qkv_fn(carry, layer, None, **kw)
         hd = q.shape[-1]
         attn = attn_fn(q, kk, v)
-        out = finish_fn(carry, attn.reshape(B, S, H * hd), layer)
+        out = finish_fn(carry, attn.reshape(B, S, H * hd), layer, **kw)
         return out, (kk, v)
 
-    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    xs = params["blocks"] if lora is None \
+        else (params["blocks"], lora["stacks"])
+    x, (ks, vs) = lax.scan(body, x, xs)
     logits = head_fn(params, x)
     if "k_s" in cache:      # int8 cache: quantize the prefill block
         from deepspeed_tpu.ops.pallas.decode_attention import (
@@ -381,7 +466,7 @@ def prefill(params, batch, cache, *, embed_fn, qkv_fn, finish_fn, head_fn,
 def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
                 finish_fn, head_fn, num_heads, alibi_slopes=None,
                 moe_grouped: bool = False, fused_spec=None,
-                fused_weights_fn=None, moe_tail_fn=None):
+                fused_weights_fn=None, moe_tail_fn=None, lora=None):
     """One decode step: tokens [B], lengths [B] current fill counts.
     Rotary positions are per-row; the GQA cache stays compact (KV heads) —
     the decode kernel handles the query-group mapping.  ``alibi_slopes``
@@ -400,9 +485,15 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     x = embed_fn(params, tokens[:, None])[:, 0]             # [B, D]
     quantized = "k_s" in cache      # int8 cache: quantize new K/V vectors
 
-    fused = fused_decode_active(params["blocks"], fused_spec)
-    if use_scan_decode(params["blocks"], moe_grouped=moe_grouped,
-                       fused=fused):
+    # per-row gather-LoRA can't ride the fused megakernel or the scan
+    # form (the stacks slice per layer in the unrolled loop) — both
+    # dispatchers yield to the unrolled composition when a lora batch
+    # is armed (ISSUE 20)
+    fused = lora is None and fused_decode_active(params["blocks"],
+                                                 fused_spec)
+    if lora is None and use_scan_decode(params["blocks"],
+                                        moe_grouped=moe_grouped,
+                                        fused=fused):
         return decode_step_scan(
             params, x, cache, lengths, qkv_fn=qkv_fn, finish_fn=finish_fn,
             head_fn=head_fn, num_heads=H, alibi_slopes=alibi_slopes,
@@ -429,7 +520,8 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
                              keep_quantized=keep_q,
                              keep_moe_quantized=moe_grouped)
-        q, kk, v = qkv_fn(x[:, None, :], layer, lengths[:, None])
+        kw = {} if lora is None else {"lora": lora_at_layer(lora, l)}
+        q, kk, v = qkv_fn(x[:, None, :], layer, lengths[:, None], **kw)
         hd = q.shape[-1]
         if quantized:
             kq, ks1 = quantize_kv(kk[:, 0])
@@ -448,7 +540,7 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
             alibi_slopes=alibi_slopes)
         x = finish_fn(x[:, None, :],
                       attn.reshape(B, 1, H * hd).astype(x.dtype),
-                      layer)[:, 0, :]
+                      layer, **kw)[:, 0, :]
     logits = head_fn(params, x[:, None, :])[:, 0]
     if quantized:
         return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}
@@ -458,7 +550,7 @@ def decode_step(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
 def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
                   finish_fn, head_fn, num_heads, alibi_slopes=None,
                   moe_grouped: bool = False, fused_spec=None,
-                  fused_weights_fn=None, moe_tail_fn=None):
+                  fused_weights_fn=None, moe_tail_fn=None, lora=None):
     """Speculative-decoding verification: score a ``W``-token window in
     ONE weight pass per layer (the whole point of speculation — k+1
     drafted positions amortize a single stream of the layer weights
@@ -488,7 +580,7 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
     B, W = tokens.shape
     H = num_heads
     x = embed_fn(params, tokens)                            # [B, W, D]
-    if fused_decode_active(params["blocks"], fused_spec):
+    if lora is None and fused_decode_active(params["blocks"], fused_spec):
         # the whole W-token window per layer in ONE Pallas call — the
         # batched-window step (decode rows, spec verify, prefill chunks)
         # all compile onto this path (ISSUE 12)
@@ -507,7 +599,8 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
         layer = maybe_stream(jax.tree.map(lambda a: a[l], params["blocks"]),
                              keep_quantized=keep_q,
                              keep_moe_quantized=moe_grouped)
-        q, kk, v = qkv_fn(x, layer, positions)
+        kw = {} if lora is None else {"lora": lora_at_layer(lora, l)}
+        q, kk, v = qkv_fn(x, layer, positions, **kw)
         hd = q.shape[-1]
         attn_cols = []
         for j in range(W):
@@ -527,7 +620,8 @@ def verify_window(params, tokens, cache, lengths, *, embed_fn, qkv_fn,
                 v_scale=vsc[l] if quantized else None,
                 alibi_slopes=alibi_slopes))
         attn = jnp.stack(attn_cols, axis=1)                 # [B, W, H, hd]
-        x = finish_fn(x, attn.reshape(B, W, H * hd).astype(x.dtype), layer)
+        x = finish_fn(x, attn.reshape(B, W, H * hd).astype(x.dtype),
+                      layer, **kw)
     logits = head_fn(params, x)                             # [B, W, V]
     if quantized:
         return logits, {"k": kc, "v": vc, "k_s": ksc, "v_s": vsc}

@@ -115,6 +115,14 @@ KNOWN_FAULT_SITES = {
                        "comm window (the anomaly/comm_* drill), deny = "
                        "skip the window (recorded as a comm/denied "
                        "flight event)",
+    "adapter.load": "paged LoRA adapter swap-in/demotion (ISSUE 20): "
+                    "deny = fail the swap-in (typed rejection or "
+                    "base-model fallback per "
+                    "serving.adapters.fallback_to_base) / abandon a "
+                    "demotion (adapter stays HBM-resident); truncate = "
+                    "torn adapter payload on NVMe, detected before "
+                    "install; corrupt = size-preserving bit-flip, "
+                    "caught by the offload checksum and quarantined",
 }
 
 _SPEC_RE = re.compile(

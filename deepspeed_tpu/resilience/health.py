@@ -118,6 +118,33 @@ class HealthMonitor:
     def mark_ready(self, reason: str = "serving") -> bool:
         return self._to(HealthState.READY, reason)
 
+    def readmit(self, reason: str = "re-admitted") -> bool:
+        """Deliberate re-entry to READY after a COMPLETED drain or stop —
+        the live base-weight hot-swap path (ISSUE 20: drain → install →
+        re-admit, rolled one replica at a time).  Distinct from
+        ``mark_ready`` on purpose: a drain must stay un-cancellable from
+        the loop's side (no accidental un-draining), while re-admission
+        is an explicit router/operator action."""
+        with self._lock:
+            if self._state not in (HealthState.DRAINING,
+                                   HealthState.STOPPED):
+                logger.warning(f"health: ignoring readmit from "
+                               f"{self._state.value} ({reason})")
+                return False
+            logger.info(f"health: {self._state.value} -> ready "
+                        f"(readmit: {reason})")
+            prev = self._state
+            self._state = HealthState.READY
+            self._reason = reason
+            self._since = time.monotonic()
+            self.drain_started.clear()
+        from deepspeed_tpu.telemetry import get_tracer
+        get_tracer().instant("health/ready", cat="resilience",
+                             args={"from": prev.value, "reason": reason})
+        if self._on_transition is not None:
+            self._on_transition(HealthState.READY, reason)
+        return True
+
     def begin_drain(self, reason: str = "drain requested") -> bool:
         return self._to(HealthState.DRAINING, reason)
 

@@ -795,6 +795,11 @@ class FleetConfig(DeepSpeedConfigModel):
     #: weight of the matched-prefix fraction from the replica cache
     #: digest (PR 6 chained block hashes — the routing key)
     prefix_weight: float = 1.0
+    #: bonus for a replica whose AdapterStore already holds the
+    #: request's adapter (ISSUE 20): dispatching there skips the
+    #: swap-in; scaled by the residency tier (HBM full, host/NVMe by
+    #: the tier discounts below)
+    adapter_weight: float = 1.0
     #: prefix-score multiplier when the deepest digest hit sits in the
     #: replica's host-RAM tier (ISSUE 16): warm beats cold, HBM beats
     #: warm — attaching it costs a host→HBM swap-in
@@ -823,7 +828,7 @@ class FleetConfig(DeepSpeedConfigModel):
             raise ValueError(f"serving.fleet.policy={self.policy!r}: "
                              "choose scored | round_robin")
         for k in ("least_loaded_weight", "affinity_weight",
-                  "prefix_weight"):
+                  "prefix_weight", "adapter_weight"):
             if getattr(self, k) < 0:
                 raise ValueError(
                     f"serving.fleet.{k}={getattr(self, k)}: must be >= 0")
@@ -844,6 +849,83 @@ class FleetConfig(DeepSpeedConfigModel):
         if self.session_capacity < 1:
             raise ValueError(f"serving.fleet.session_capacity="
                              f"{self.session_capacity}: must be >= 1")
+
+
+class AdaptersConfig(DeepSpeedConfigModel):
+    """``serving.adapters`` — multi-tenant LoRA adapter serving
+    (ISSUE 20): a paged :class:`serving/adapters.AdapterStore` holds up
+    to ``max_hbm_adapters`` adapters HBM-resident as slot stacks feeding
+    the batched gather-LoRA pass; refcount-0 residents demote LRU
+    through the offload engine to host RAM/NVMe and swap back in
+    overlapped with the running decode.  The DS_ADAPTERS env var
+    overrides ``enabled`` either way (env-wins convention)."""
+    enabled: bool = False
+    #: adapter_id -> .npz path (the ``save_adapter`` on-disk spelling);
+    #: registered + ingested at scheduler construction.  The ``ds_serve
+    #: --adapters name=path,...`` flag populates this.
+    adapters: Any = None
+    #: HBM slot count — adapters concurrently usable in one step; the
+    #: gather-LoRA stacks are sized [L, S, d, r_max] by this
+    max_hbm_adapters: int = 4
+    #: slot rank ceiling; lower-rank adapters zero-pad (exact)
+    max_rank: int = 8
+    #: restrict target projections ("qkv_w", "wq", ...); empty = any
+    #: stacked block weight the registered adapters name
+    targets: Any = None
+    #: a failed adapter swap-in (fault/IO/integrity) serves the request
+    #: from the BASE model (flagged on the response) instead of a typed
+    #: rejection
+    fallback_to_base: bool = False
+    #: adapter_id -> SLO class name (ISSUE 9 QoS ladder): requests
+    #: submitted with a defaulted slo_class inherit their tenant's
+    slo_class_map: Any = None
+    #: host-RAM tier capacity in adapters; overflow spills oldest to
+    #: NVMe (0 = unbounded host tier, never spill)
+    max_host_adapters: int = 16
+    #: directory for NVMe-tier payload files; None = process-private
+    #: temp dir (removed with the engine)
+    nvme_dir: Optional[str] = None
+    #: aio worker threads per direction (kv_tiering semantics)
+    aio_threads: int = 2
+    #: max in-flight async reads/writes per direction
+    queue_depth: int = 2
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        raw = self.adapters or {}
+        if not isinstance(raw, dict):
+            raise ValueError("serving.adapters.adapters must be an object "
+                             "of adapter_id -> npz path")
+        self.adapters = {str(k): str(v) for k, v in raw.items()}
+        raw_map = self.slo_class_map or {}
+        if not isinstance(raw_map, dict):
+            raise ValueError("serving.adapters.slo_class_map must be an "
+                             "object of adapter_id -> SLO class name")
+        self.slo_class_map = {str(k): str(v) for k, v in raw_map.items()}
+        if self.targets is not None and not isinstance(
+                self.targets, (list, tuple)):
+            raise ValueError("serving.adapters.targets must be a list of "
+                             "projection names (or omitted)")
+        self.targets = tuple(str(t) for t in (self.targets or ()))
+        if self.max_hbm_adapters < 1:
+            raise ValueError(
+                "serving.adapters.max_hbm_adapters="
+                f"{self.max_hbm_adapters}: must be >= 1")
+        if self.max_rank < 1:
+            raise ValueError(f"serving.adapters.max_rank={self.max_rank}: "
+                             "must be >= 1")
+        if self.max_host_adapters < 0:
+            raise ValueError(
+                "serving.adapters.max_host_adapters="
+                f"{self.max_host_adapters}: must be >= 0 (0 = unbounded)")
+        if self.aio_threads < 1:
+            raise ValueError(
+                f"serving.adapters.aio_threads={self.aio_threads}: "
+                "must be >= 1")
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"serving.adapters.queue_depth={self.queue_depth}: "
+                "must be >= 1")
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -920,11 +1002,15 @@ class ServingConfig(DeepSpeedConfigModel):
     chunked_prefill: Any = None
     #: replica-fleet sub-section (same pattern; ISSUE 11)
     fleet: Any = None
+    #: multi-tenant LoRA adapter sub-section (same pattern; ISSUE 20)
+    adapters: Any = None
 
     def __init__(self, **data):
         super().__init__(**data)
         if not isinstance(self.spec, SpecDecodeConfig):
             self.spec = SpecDecodeConfig(**(self.spec or {}))
+        if not isinstance(self.adapters, AdaptersConfig):
+            self.adapters = AdaptersConfig(**(self.adapters or {}))
         if not isinstance(self.fleet, FleetConfig):
             self.fleet = FleetConfig(**(self.fleet or {}))
         if not isinstance(self.prefix_cache, PrefixCacheConfig):

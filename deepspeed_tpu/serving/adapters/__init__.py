@@ -1,0 +1,21 @@
+"""Multi-tenant LoRA adapter serving (ISSUE 20).
+
+- :class:`AdapterRegistry` — load/validate adapter weight trees keyed by
+  ``adapter_id`` (rank/target manifest, crc-stamped).
+- :class:`AdapterStore` — paged HBM residency: ref-counted slot stacks
+  feeding the batched gather-LoRA pass, LRU demotion of refcount-0
+  adapters through the SwapEngine to host RAM/NVMe.
+- ``adapters_enabled`` — the ``serving.adapters.enabled`` /
+  ``DS_ADAPTERS`` env-wins resolution.
+"""
+from deepspeed_tpu.serving.adapters.registry import (AdapterManifest,
+                                                     AdapterRegistry,
+                                                     load_adapter_file,
+                                                     save_adapter)
+from deepspeed_tpu.serving.adapters.store import (ADAPTERS_ENV,
+                                                  AdapterStore,
+                                                  adapters_enabled)
+
+__all__ = ["AdapterManifest", "AdapterRegistry", "AdapterStore",
+           "ADAPTERS_ENV", "adapters_enabled", "load_adapter_file",
+           "save_adapter"]

@@ -1,0 +1,206 @@
+"""Adapter registry: load/validate LoRA weight trees keyed by
+``adapter_id`` (ISSUE 20).
+
+An adapter is a ``{path: {"a": [L, d_in, r], "b": [L, r, d_out]}}``
+tree in the ``runtime/lora.py`` stacked-layer layout — the SAME trees
+``init_lora_params``/``merge_lora`` produce and consume, so the
+offline-merge parity reference is the training code, not a parallel
+implementation.  Registration normalizes paths to their target name
+(``blocks/qkv_w`` → ``qkv_w``), validates ranks and shapes against the
+registry's limits, and stamps every array with a crc32 — the manifest
+is the serving-side contract; payload integrity on the cold tiers is
+the offload engine's checksum (PR 18).
+"""
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _target_name(path: str) -> str:
+    """``blocks/qkv_w`` → ``qkv_w`` (tolerates bare target names)."""
+    return str(path).split("/")[-1]
+
+
+def save_adapter(path: str, lora_tree: Dict[str, Dict[str, np.ndarray]],
+                 alpha: Optional[float] = None) -> str:
+    """Write one adapter as an ``.npz`` (the ``ds_serve
+    --adapters name=path`` on-disk spelling): ``<target>.a`` /
+    ``<target>.b`` arrays plus an optional scalar ``alpha``."""
+    payload = {}
+    for p, ab in lora_tree.items():
+        t = _target_name(p)
+        payload[f"{t}.a"] = np.asarray(ab["a"])
+        payload[f"{t}.b"] = np.asarray(ab["b"])
+    if alpha is not None:
+        payload["alpha"] = np.float32(alpha)
+    np.savez(path, **payload)
+    return path
+
+
+def load_adapter_file(path: str) -> Tuple[Dict[str, Dict[str, np.ndarray]],
+                                          Optional[float]]:
+    """Inverse of :func:`save_adapter`: (tree, alpha-or-None)."""
+    with np.load(path) as z:
+        alpha = float(z["alpha"]) if "alpha" in z.files else None
+        tree: Dict[str, Dict[str, np.ndarray]] = {}
+        for k in z.files:
+            if k == "alpha":
+                continue
+            t, part = k.rsplit(".", 1)
+            tree.setdefault(t, {})[part] = np.asarray(z[k])
+    for t, ab in tree.items():
+        if set(ab) != {"a", "b"}:
+            raise ValueError(f"adapter file {path!r}: target {t!r} must "
+                             f"carry exactly 'a' and 'b' arrays")
+    return tree, alpha
+
+
+@dataclass
+class AdapterManifest:
+    """Validated per-adapter contract the store and scheduler key on."""
+    adapter_id: str
+    rank: int
+    scale: float                       #: (alpha or rank) / rank
+    targets: Tuple[str, ...]           #: sorted target names
+    shapes: Dict[str, Tuple[int, int, int]]   #: target -> (L, d_in, d_out)
+    crc32: Dict[str, int] = field(default_factory=dict)  #: "t.a" -> crc
+    nbytes: int = 0
+    source: str = "inline"             #: file path or "inline"
+    slo_class: Optional[str] = None    #: per-tenant QoS class (ISSUE 9)
+
+
+class AdapterRegistry:
+    """Validated adapter catalogue.  ``register`` keeps the manifest
+    forever and the payload arrays only until the store ingests them
+    (:meth:`take_arrays` pops — paging owns the bytes after that)."""
+
+    def __init__(self, max_rank: int = 8,
+                 allowed_targets: Optional[Tuple[str, ...]] = None):
+        self.max_rank = int(max_rank)
+        self.allowed_targets = (tuple(allowed_targets)
+                                if allowed_targets else None)
+        self._manifests: Dict[str, AdapterManifest] = {}
+        self._arrays: Dict[str, Dict[str, Dict[str, np.ndarray]]] = {}
+
+    # ------------------------------------------------------------ register
+    def register(self, adapter_id: str,
+                 lora_tree: Dict[str, Dict[str, np.ndarray]],
+                 alpha: Optional[float] = None,
+                 slo_class: Optional[str] = None,
+                 source: str = "inline") -> AdapterManifest:
+        """Validate + crc-stamp one adapter tree.  Raises ``ValueError``
+        on any structural problem (rank over the limit, inconsistent
+        ranks, targets outside the allowed set, malformed arrays) —
+        registration failures are configuration errors, not runtime
+        faults."""
+        adapter_id = str(adapter_id)
+        if not adapter_id:
+            raise ValueError("empty adapter_id")
+        if adapter_id in self._manifests:
+            raise ValueError(f"adapter {adapter_id!r} already registered")
+        norm: Dict[str, Dict[str, np.ndarray]] = {}
+        shapes: Dict[str, Tuple[int, int, int]] = {}
+        crcs: Dict[str, int] = {}
+        rank = None
+        nbytes = 0
+        for p, ab in lora_tree.items():
+            t = _target_name(p)
+            if self.allowed_targets is not None \
+                    and t not in self.allowed_targets:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: target {t!r} not in the "
+                    f"store's stacked set {self.allowed_targets}")
+            a = np.asarray(ab["a"], np.float32)
+            b = np.asarray(ab["b"], np.float32)
+            if a.ndim != 3 or b.ndim != 3:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: target {t!r} arrays must be "
+                    f"stacked [L, d_in, r] / [L, r, d_out] "
+                    f"(got {a.shape} / {b.shape})")
+            L, d_in, r = a.shape
+            Lb, rb, d_out = b.shape
+            if Lb != L or rb != r:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: target {t!r} A {a.shape} and "
+                    f"B {b.shape} disagree on layers/rank")
+            if rank is None:
+                rank = r
+            elif r != rank:
+                raise ValueError(
+                    f"adapter {adapter_id!r}: inconsistent ranks "
+                    f"({rank} vs {r} at {t!r})")
+            norm[t] = {"a": a, "b": b}
+            shapes[t] = (L, d_in, d_out)
+            crcs[f"{t}.a"] = zlib.crc32(np.ascontiguousarray(a).tobytes())
+            crcs[f"{t}.b"] = zlib.crc32(np.ascontiguousarray(b).tobytes())
+            nbytes += a.nbytes + b.nbytes
+        if rank is None:
+            raise ValueError(f"adapter {adapter_id!r}: no target arrays")
+        if rank > self.max_rank:
+            raise ValueError(
+                f"adapter {adapter_id!r}: rank {rank} exceeds "
+                f"serving.adapters.max_rank={self.max_rank}")
+        scale = (float(alpha) if alpha is not None else float(rank)) / rank
+        m = AdapterManifest(adapter_id=adapter_id, rank=rank, scale=scale,
+                            targets=tuple(sorted(norm)), shapes=shapes,
+                            crc32=crcs, nbytes=nbytes, source=source,
+                            slo_class=slo_class)
+        self._manifests[adapter_id] = m
+        self._arrays[adapter_id] = norm
+        return m
+
+    def register_file(self, adapter_id: str, path: str,
+                      slo_class: Optional[str] = None) -> AdapterManifest:
+        tree, alpha = load_adapter_file(path)
+        return self.register(adapter_id, tree, alpha=alpha,
+                             slo_class=slo_class, source=str(path))
+
+    # ------------------------------------------------------------- readers
+    def get(self, adapter_id: str) -> Optional[AdapterManifest]:
+        return self._manifests.get(adapter_id)
+
+    def ids(self) -> List[str]:
+        return list(self._manifests)
+
+    def __contains__(self, adapter_id: str) -> bool:
+        return adapter_id in self._manifests
+
+    def __len__(self) -> int:
+        return len(self._manifests)
+
+    def unregister(self, adapter_id: str):
+        """Drop a registration (rollback when the store refuses the
+        ingest — e.g. shapes that don't match the serving base model)."""
+        self._manifests.pop(adapter_id, None)
+        self._arrays.pop(adapter_id, None)
+
+    def take_arrays(self, adapter_id: str
+                    ) -> Optional[Dict[str, Dict[str, np.ndarray]]]:
+        """Pop the registration-time payload (store ingest consumes it —
+        after this the bytes live in exactly one paging tier)."""
+        return self._arrays.pop(adapter_id, None)
+
+    def validate_against(self, block_shapes: Dict[str, Tuple[int, int, int]]):
+        """Check every registered adapter's shapes against the base
+        model's stacked target shapes (scheduler construction time)."""
+        for m in self._manifests.values():
+            for t, (L, d_in, d_out) in m.shapes.items():
+                base = block_shapes.get(t)
+                if base is None:
+                    raise ValueError(
+                        f"adapter {m.adapter_id!r}: target {t!r} has no "
+                        f"stacked slot (store targets: "
+                        f"{sorted(block_shapes)})")
+                if base != (L, d_in, d_out):
+                    raise ValueError(
+                        f"adapter {m.adapter_id!r}: target {t!r} shape "
+                        f"(L={L}, d_in={d_in}, d_out={d_out}) does not "
+                        f"match the base model's {base}")
+
+    def summary(self) -> Dict[str, dict]:
+        return {aid: {"rank": m.rank, "scale": m.scale,
+                      "targets": list(m.targets), "nbytes": m.nbytes,
+                      "source": m.source, "slo_class": m.slo_class}
+                for aid, m in self._manifests.items()}

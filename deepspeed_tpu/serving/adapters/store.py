@@ -1,0 +1,405 @@
+"""Paged adapter store: ref-counted HBM slot residency with tiered
+spill through the offload engine (ISSUE 20 tentpole).
+
+S-LoRA idiom: adapter weights page like KV blocks.  The store owns
+``max_hbm_adapters`` HBM slots as layer-major stacked tensors per
+target — ``a`` [L, S, d_in, r_max] / ``b`` [L, S, r_max, d_out] plus
+``scale`` [S] — the exact operands the batched gather-LoRA pass
+(``models/serving.gather_lora_delta``) reads with a per-row ``groups``
+vector.  Lower-rank adapters zero-pad to ``r_max`` (exact: padded A
+columns meet padded B rows and contribute nothing); slots an adapter
+does not target are zeroed at install so a previous tenant's factors
+can never bleed through.
+
+Residency protocol (scheduler-lock discipline, like the BlockManager):
+
+- ``acquire``/``release`` ref-count a resident adapter per admitted
+  request; refcount-0 residents park on an LRU and are the ONLY
+  demotion victims — an adapter with live requests is pinned.
+- a non-resident adapter's admission schedules ``prefetch`` and the
+  request sits out one round (``req/adapter_swap_in``), overlapping
+  the NVMe read with the running decode exactly like cold-tier prefix
+  hits; the next round's ``swap_in`` installs into a slot (demoting an
+  LRU victim when full — demotion re-extracts the factors from the
+  device stacks, bit-exact for the fp32 payload).
+- single-tier residency: the engine's ``fetch`` consumes the cold
+  entry, and demotion writes it back — an adapter lives in exactly one
+  of HBM / host / NVMe (or is quarantined/dropped).
+- the ``adapter.load`` fault site gates every swap-in and demotion
+  (deny / truncate / corrupt); corruption rides the PR 18 integrity
+  contract — checksum mismatch quarantines the key in the engine and
+  the swap-in fails typed (or falls back to the base model per
+  ``serving.adapters.fallback_to_base``, the scheduler's call).
+"""
+import collections
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.resilience.faults import NULL_INJECTOR
+
+ADAPTERS_ENV = "DS_ADAPTERS"
+
+
+def adapters_enabled(cfg, env: Optional[dict] = None) -> bool:
+    """``serving.adapters.enabled`` with the ``DS_ADAPTERS`` env
+    override applied (env-wins convention: any non-empty value decides,
+    "0"/"false"/"off"/"no" disable)."""
+    env = os.environ if env is None else env
+    override = str(env.get(ADAPTERS_ENV, "") or "").strip().lower()
+    if override:
+        return override not in ("0", "false", "off", "no")
+    return bool(getattr(cfg, "enabled", False))
+
+
+class AdapterStore:
+    """Slot-stacked HBM residency + tiered spill for LoRA adapters.
+
+    ``block_shapes``: ``{target: (L, d_in, d_out)}`` — the base model's
+    stacked projection shapes for every target the store slots (the
+    scheduler derives them from ``params["blocks"]``)."""
+
+    def __init__(self, registry, cfg,
+                 block_shapes: Dict[str, Tuple[int, int, int]],
+                 injector=None, flightrec=None):
+        import jax.numpy as jnp
+        from deepspeed_tpu.offload import SwapEngine
+        self.registry = registry
+        self.cfg = cfg
+        self.injector = injector or NULL_INJECTOR
+        self.flightrec = flightrec
+        self.num_slots = max(1, int(getattr(cfg, "max_hbm_adapters", 4)))
+        self.max_rank = max(1, int(getattr(cfg, "max_rank", 8)))
+        self.block_shapes = dict(block_shapes)
+        self._engine = SwapEngine(
+            nvme_dir=getattr(cfg, "nvme_dir", None), owner="adapter",
+            aio_threads=getattr(cfg, "aio_threads", 2),
+            queue_depth=getattr(cfg, "queue_depth", 2),
+            injector=self.injector)
+        S, r = self.num_slots, self.max_rank
+        self.stacks = {
+            t: {"a": jnp.zeros((L, S, d_in, r), jnp.float32),
+                "b": jnp.zeros((L, S, r, d_out), jnp.float32)}
+            for t, (L, d_in, d_out) in self.block_shapes.items()}
+        self.scale = jnp.zeros((S,), jnp.float32)
+        self._slot_of: Dict[str, int] = {}        # resident adapter -> slot
+        self._free: List[int] = list(range(S))
+        self._ref: Dict[str, int] = {}            # live request refs
+        self._lru = collections.OrderedDict()     # refcount-0 residents
+        # monotonic policy counters (mirrored into serving/adapter_*
+        # metrics by the scheduler's gauge pass)
+        self.ingests = 0
+        self.swapins = 0        # cold payloads installed into a slot
+        self.demotions = 0      # HBM -> host extractions
+        self.spills = 0         # host -> NVMe overflow
+        self.load_failures = 0  # adapter.load faults / IO / integrity
+        self.demote_denied = 0  # denied demotions (victim stays pinned)
+        self.slot_waits = 0     # swap-in deferred: every slot had refs
+        self.dropped = 0        # capacity evictions (adapter truly gone)
+
+    # ------------------------------------------------------------ helpers
+    def _flight(self, kind: str, corr=None, **fields):
+        if self.flightrec is not None:
+            self.flightrec.record(kind, corr=corr, **fields)
+
+    def _payload(self, manifest, arrays) -> List[np.ndarray]:
+        """Deterministic flat array order: sorted targets, a then b."""
+        out: List[np.ndarray] = []
+        for t in manifest.targets:
+            out.append(np.ascontiguousarray(arrays[t]["a"], np.float32))
+            out.append(np.ascontiguousarray(arrays[t]["b"], np.float32))
+        return out
+
+    def _unflatten(self, manifest, flat: List[np.ndarray]
+                   ) -> Dict[str, Dict[str, np.ndarray]]:
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for i, t in enumerate(manifest.targets):
+            out[t] = {"a": flat[2 * i], "b": flat[2 * i + 1]}
+        return out
+
+    def _put(self, aid: str, manifest, arrays, tier: str) -> bool:
+        """Fault-gated swap-out to a cold tier; False = denied (the
+        caller decides whether the adapter stays HBM-resident)."""
+        if self.injector.deny("adapter.load"):
+            self.load_failures += 1
+            self._flight("adapter/load_fail", corr=aid, dir="out",
+                         tier=tier)
+            return False
+        if tier == "nvme" and not self._engine.nvme_allowed():
+            tier = "host"
+        flat = self._payload(manifest, arrays)
+        nbytes = int(sum(a.nbytes for a in flat))
+        keep = self.injector.truncate_bytes("adapter.load", nbytes)
+        corrupt = self.injector.corrupt_bytes("adapter.load", nbytes)
+        self._engine.put(aid, flat, tier=tier, truncate=keep,
+                         corrupt=corrupt)
+        self._spill_overflow()
+        return True
+
+    def _spill_overflow(self):
+        """Host-tier capacity waterfall: overflow spills oldest-first to
+        NVMe; a breaker-OPEN NVMe degrades overflow to drops."""
+        cap = int(getattr(self.cfg, "max_host_adapters", 0) or 0)
+        while cap and self._engine.count("host") > cap:
+            aid = self._engine.oldest("host")
+            if self.injector.deny("adapter.load"):
+                self.load_failures += 1
+                self._flight("adapter/load_fail", corr=aid, dir="out",
+                             tier="nvme")
+                self._engine.discard(aid)
+                self.dropped += 1
+                continue
+            if not self._engine.nvme_allowed():
+                self._engine.discard(aid)
+                self.dropped += 1
+                continue
+            nbytes = self._engine.nbytes_of(aid)
+            keep = self.injector.truncate_bytes("adapter.load", nbytes)
+            corrupt = self.injector.corrupt_bytes("adapter.load", nbytes)
+            self._engine.demote(aid, truncate=keep, corrupt=corrupt)
+            self.spills += 1
+            self._flight("adapter/spill", corr=aid, bytes=nbytes)
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, adapter_id: str) -> bool:
+        """Move a freshly-registered adapter's payload from the registry
+        into the host paging tier (swap-in installs it on first use)."""
+        m = self.registry.get(adapter_id)
+        if m is None:
+            return False
+        # validate BEFORE take_arrays pops the payload: a shape
+        # mismatch must leave the registration intact for rollback
+        for t, (L, d_in, d_out) in m.shapes.items():
+            base = self.block_shapes.get(t)
+            if base != (L, d_in, d_out):
+                raise ValueError(
+                    f"adapter {adapter_id!r}: target {t!r} shape "
+                    f"{(L, d_in, d_out)} does not match the base "
+                    f"model's {base}")
+        arrays = self.registry.take_arrays(adapter_id)
+        if arrays is None:
+            return False
+        ok = self._put(adapter_id, m, arrays, "host")
+        if ok:
+            self.ingests += 1
+        return ok
+
+    # ---------------------------------------------------------- residency
+    def resident(self, adapter_id: str) -> bool:
+        return adapter_id in self._slot_of
+
+    def slot_of(self, adapter_id: str) -> Optional[int]:
+        return self._slot_of.get(adapter_id)
+
+    def acquire(self, adapter_id: str) -> int:
+        """Pin one resident adapter for an admitted request."""
+        slot = self._slot_of[adapter_id]
+        self._ref[adapter_id] = self._ref.get(adapter_id, 0) + 1
+        self._lru.pop(adapter_id, None)
+        return slot
+
+    def release(self, adapter_id: str):
+        """Drop one request's pin; the last release parks the adapter
+        refcount-0 on the LRU (still resident, demotable)."""
+        r = self._ref.get(adapter_id, 0) - 1
+        if r > 0:
+            self._ref[adapter_id] = r
+            return
+        self._ref.pop(adapter_id, None)
+        if adapter_id in self._slot_of:
+            self._lru[adapter_id] = None
+
+    # ------------------------------------------------------------ swap-in
+    def schedule_swapin(self, adapter_id: str, corr=None) -> bool:
+        """Kick the async read for a cold adapter (NVMe I/O overlaps the
+        running decode); False = the adapter is in no tier (quarantined
+        or dropped) and can never materialize."""
+        tier = self._engine.tier_of(adapter_id)
+        if tier is None:
+            return False
+        self._flight("req/adapter_swap_in", corr=corr,
+                     adapter=adapter_id, tier=tier)
+        if tier == "nvme":
+            self._engine.prefetch(adapter_id)
+        return True
+
+    def _demote_victim(self) -> Optional[int]:
+        """Free one slot by demoting the LRU refcount-0 resident.  None
+        = no victim available (every resident is pinned) or the
+        demotion swap-out was denied (the victim stays resident — its
+        bytes are never lost)."""
+        if not self._lru:
+            return None
+        victim = next(iter(self._lru))
+        m = self.registry.get(victim)
+        slot = self._slot_of[victim]
+        arrays = self._extract(m, slot)
+        if not self._put(victim, m, arrays, "host"):
+            self.demote_denied += 1
+            return None
+        self._lru.pop(victim)
+        self._slot_of.pop(victim)
+        # the caller OWNS the returned slot (it installs into it
+        # directly) — appending to _free here would double-assign it
+        self.demotions += 1
+        self._flight("adapter/demote", corr=victim, slot=slot,
+                     bytes=m.nbytes)
+        return slot
+
+    def _extract(self, manifest, slot: int
+                 ) -> Dict[str, Dict[str, np.ndarray]]:
+        """Snapshot one slot's factors back to numpy at the adapter's
+        true rank (the zero padding is reconstructible, not payload)."""
+        r = manifest.rank
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for t in manifest.targets:
+            st = self.stacks[t]
+            out[t] = {"a": np.asarray(st["a"][:, slot, :, :r]),
+                      "b": np.asarray(st["b"][:, slot, :r, :])}
+        return out
+
+    def _install(self, manifest, arrays, slot: int):
+        """Write one adapter into HBM slot ``slot``: targeted stacks get
+        the zero-padded factors, untargeted stacks get zeros (a previous
+        tenant's factors must not survive in this slot)."""
+        import jax.numpy as jnp
+        r_max = self.max_rank
+        for t, st in self.stacks.items():
+            L, d_in, d_out = self.block_shapes[t]
+            a_p = np.zeros((L, d_in, r_max), np.float32)
+            b_p = np.zeros((L, r_max, d_out), np.float32)
+            if t in arrays:
+                r = manifest.rank
+                a_p[:, :, :r] = arrays[t]["a"]
+                b_p[:, :r, :] = arrays[t]["b"]
+            st["a"] = st["a"].at[:, slot].set(jnp.asarray(a_p))
+            st["b"] = st["b"].at[:, slot].set(jnp.asarray(b_p))
+        self.scale = self.scale.at[slot].set(manifest.scale)
+
+    def swap_in(self, adapter_id: str, corr=None
+                ) -> Tuple[str, Optional[int]]:
+        """Materialize one cold adapter into an HBM slot.  Returns
+        ``("ok", slot)``, ``("wait", None)`` (no demotable slot right
+        now — every resident is pinned; retry as requests retire), or
+        ``("fail", None)`` (fault/IO/integrity failure, or the adapter
+        is in no tier — the scheduler rejects typed or falls back to
+        the base model)."""
+        if adapter_id in self._slot_of:
+            return "ok", self._slot_of[adapter_id]
+        tier = self._engine.tier_of(adapter_id)
+        if tier is None:
+            return "fail", None
+        # slot first: a denied/failed fetch must not have demoted a
+        # victim for nothing is acceptable, but a no-slot wait must not
+        # consume the cold entry (fetch pops it)
+        slot = self._free.pop() if self._free else self._demote_victim()
+        if slot is None:
+            if not self._lru:
+                self.slot_waits += 1
+                return "wait", None
+            return "fail", None     # demotion denied by fault injection
+        if self.injector.deny("adapter.load"):
+            self.load_failures += 1
+            self._free.append(slot)
+            self._flight("adapter/load_fail", corr=corr,
+                         adapter=adapter_id, dir="in", tier=tier)
+            return "fail", None
+        m = self.registry.get(adapter_id)
+        try:
+            flat = self._engine.fetch(adapter_id)
+        except (IOError, OSError, KeyError):
+            self.load_failures += 1
+            self._free.append(slot)
+            self._engine.discard(adapter_id)
+            self._flight("adapter/load_fail", corr=corr,
+                         adapter=adapter_id, dir="in", tier=tier)
+            return "fail", None
+        self._install(m, self._unflatten(m, flat), slot)
+        self._slot_of[adapter_id] = slot
+        self._lru[adapter_id] = None    # resident, unpinned until acquire
+        self.swapins += 1
+        self._flight("adapter/swap_in", corr=corr, adapter=adapter_id,
+                     slot=slot, tier=tier, bytes=m.nbytes)
+        return "ok", slot
+
+    # ------------------------------------------------------------ readers
+    def residency_digest(self) -> Dict[str, str]:
+        """adapter_id -> tier for every adapter that could serve without
+        a full reload (router scoring: prefer replicas already holding
+        the tenant's adapter, hotter tiers first)."""
+        out = dict(self._engine.tiers())
+        for aid in self._slot_of:
+            out[aid] = "hbm"
+        return out
+
+    def slo_class_for(self, adapter_id: str) -> Optional[str]:
+        """Per-tenant SLO class: ``serving.adapters.slo_class_map``
+        wins, then the manifest's registered class."""
+        mapped = (getattr(self.cfg, "slo_class_map", None)
+                  or {}).get(adapter_id)
+        if mapped:
+            return str(mapped)
+        m = self.registry.get(adapter_id)
+        return m.slo_class if m is not None else None
+
+    def refcounts(self) -> Dict[str, int]:
+        return dict(self._ref)
+
+    def summary(self) -> Dict:
+        return {"slots": self.num_slots,
+                "resident": sorted(self._slot_of),
+                "pinned": {a: r for a, r in self._ref.items()},
+                "lru": list(self._lru),
+                "host_adapters": self._engine.count("host"),
+                "nvme_adapters": self._engine.count("nvme"),
+                "host_bytes": self._engine.bytes("host"),
+                "nvme_bytes": self._engine.bytes("nvme"),
+                "inflight": len(self._engine.inflight_reads()),
+                "ingests": self.ingests, "swap_ins": self.swapins,
+                "demotions": self.demotions, "spills": self.spills,
+                "load_failures": self.load_failures,
+                "demote_denied": self.demote_denied,
+                "slot_waits": self.slot_waits, "dropped": self.dropped,
+                "integrity_failures": self._engine.integrity_failures,
+                "quarantined": len(self._engine.quarantined()),
+                "breaker_state": self._engine.breaker().state,
+                "nvme_dir": self._engine.nvme_dir}
+
+    # --------------------------------------------------------- invariants
+    def check_invariant(self, live_refs: Optional[Dict[str, int]] = None):
+        """DS_SERVE_DEBUG=1 (armed from the scheduler's per-step debug
+        pass): slot bijection, pin accounting, LRU ∩ pinned = ∅,
+        single-tier residency, and — when the scheduler passes its
+        per-request adapter census — refcounts == table refs."""
+        slots = list(self._slot_of.values())
+        assert len(slots) == len(set(slots)), \
+            f"adapter slots not a bijection: {self._slot_of}"
+        assert not (set(slots) & set(self._free)), \
+            f"slot both free and assigned: {self._slot_of} / {self._free}"
+        assert len(slots) + len(self._free) == self.num_slots, \
+            f"slot leak: {len(slots)} assigned + {len(self._free)} free " \
+            f"!= {self.num_slots}"
+        for aid, r in self._ref.items():
+            assert r > 0, f"non-positive refcount {r} for {aid!r}"
+            assert aid in self._slot_of, \
+                f"pinned adapter {aid!r} is not resident"
+        lru = set(self._lru)
+        assert not (lru & set(self._ref)), \
+            f"LRU ∩ pinned != ∅: {lru & set(self._ref)}"
+        assert lru <= set(self._slot_of), \
+            f"LRU entry not resident: {lru - set(self._slot_of)}"
+        assert lru | set(self._ref) == set(self._slot_of), \
+            "resident adapter neither pinned nor on the LRU"
+        cold = set(self._engine.tiers())
+        assert not (cold & set(self._slot_of)), \
+            f"single-tier violation (HBM and cold): " \
+            f"{cold & set(self._slot_of)}"
+        if live_refs is not None:
+            mine = dict(self._ref)
+            assert mine == {k: v for k, v in live_refs.items() if v}, \
+                f"refcounts {mine} != live request census {live_refs}"
+
+    # ------------------------------------------------------------ lifetime
+    def close(self):
+        self._engine.close()

@@ -119,9 +119,18 @@ class BlockManager:
 
     # ------------------------------------------------------------ hashing
     @staticmethod
-    def _chain_hash(parent: Optional[str], tokens) -> str:
+    def _chain_hash(parent: Optional[str], tokens,
+                    salt: Optional[str] = None) -> str:
+        """``salt`` namespaces the whole chain at its root (ISSUE 20:
+        the scheduler salts with ``adapter_id`` so tenant A's cached
+        prefix can never attach to tenant B's request — same tokens,
+        different KV under different adapter weights).  ``salt=None``
+        produces the exact historical hash, so adapter-less serving is
+        bit-for-bit unchanged."""
         h = hashlib.blake2b(digest_size=16)
-        h.update((parent or "\x00root").encode())
+        if parent is None:
+            parent = "\x00root" if salt is None else f"\x00root:{salt}"
+        h.update(parent.encode())
         h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
         return h.hexdigest()
 
@@ -248,12 +257,14 @@ class BlockManager:
         return len(released)
 
     # ------------------------------------------------------- prefix cache
-    def match_prefix(self, token_ids) -> List[int]:
+    def match_prefix(self, token_ids,
+                     salt: Optional[str] = None) -> List[int]:
         """Block-granular cache lookup: walk the prompt's full blocks,
         chaining hashes, and return the longest run of consecutively
         cached blocks from token 0.  Read-only — attachment happens in
         :meth:`acquire_prefix`.  A ``kv.cache`` deny fault models a
-        lookup outage: no match, full prefill (chaos satellite)."""
+        lookup outage: no match, full prefill (chaos satellite).
+        ``salt`` namespaces the chain (per-adapter isolation)."""
         if not self.cache_enabled or not self._by_hash:
             return []
         if self.injector.deny("kv.cache"):
@@ -262,14 +273,16 @@ class BlockManager:
         h: Optional[str] = None
         bs = self.block_size
         for i in range(len(token_ids) // bs):
-            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs])
+            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs],
+                                 salt=salt)
             b = self._by_hash.get(h)
             if b is None:
                 break
             out.append(b)
         return out
 
-    def match_prefix_tiered(self, token_ids) -> List[Tuple[str, Optional[int], str]]:
+    def match_prefix_tiered(self, token_ids, salt: Optional[str] = None
+                            ) -> List[Tuple[str, Optional[int], str]]:
         """Tier-aware cache lookup (ISSUE 16): like :meth:`match_prefix`
         but the walk continues through cold-tier entries.  Returns
         ``(tier, block, hash)`` runs from token 0 — ``("hbm", b, h)``
@@ -286,7 +299,8 @@ class BlockManager:
         h: Optional[str] = None
         bs = self.block_size
         for i in range(len(token_ids) // bs):
-            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs])
+            h = self._chain_hash(h, token_ids[i * bs:(i + 1) * bs],
+                                 salt=salt)
             b = self._by_hash.get(h)
             if b is not None:
                 out.append(("hbm", b, h))
@@ -406,7 +420,8 @@ class BlockManager:
         return fresh, fork_pair
 
     def register_committed(self, request_id: int, token_ids,
-                           materialized: Optional[int] = None):
+                           materialized: Optional[int] = None,
+                           salt: Optional[str] = None):
         """Register the request's committed-and-KV-materialized full
         blocks as cache entries.  ``materialized`` is the number of
         leading tokens whose KV vectors are actually in the pool; by
@@ -432,7 +447,8 @@ class BlockManager:
         bs = self.block_size
         for i in range(len(chain), n_full):
             h = self._chain_hash(chain[-1] if chain else None,
-                                 token_ids[i * bs:(i + 1) * bs])
+                                 token_ids[i * bs:(i + 1) * bs],
+                                 salt=salt)
             chain.append(h)
             b = table[i]
             if b in self._hash_of or h in self._by_hash:

@@ -75,11 +75,32 @@ class Replica:
         return self.health.is_accepting()
 
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
-               timeout_s: float = 0.0, slo_class: str = "default"):
+               timeout_s: float = 0.0, slo_class: str = "default",
+               adapter_id=None):
         return self.scheduler.submit(prompt_ids, sampling,
                                      priority=priority,
                                      timeout_s=timeout_s,
-                                     slo_class=slo_class)
+                                     slo_class=slo_class,
+                                     adapter_id=adapter_id)
+
+    # --------------------------------------------------- weights hot-swap
+    def install_params(self, new_params, version: str):
+        """Install a new base-weight tree (ISSUE 20).  The Router calls
+        this only AFTER draining the replica; the scheduler validates
+        tree-structure equality so the swap never recompiles."""
+        self.scheduler.install_params(new_params, version)
+
+    def readmit(self, reason: str = "re-admitted") -> bool:
+        """Return a drained/stopped replica to READY (the hot-swap
+        roll's re-admission edge).  A started replica's exited drain
+        loop is joined and a fresh ServingLoop spun up."""
+        restarted = self._loop is not None
+        if restarted:
+            self.shutdown()          # join the exited drain loop
+        ok = self.health.readmit(reason)
+        if restarted and ok:
+            self.start()
+        return ok
 
     # ------------------------------------------------------------- views
     def outstanding_tokens(self) -> int:
@@ -103,6 +124,16 @@ class Replica:
         finally:
             lock.release()
 
+    def adapter_residency(self) -> Dict[str, str]:
+        """Router-facing adapter residency digest (ISSUE 20):
+        ``adapter_id -> tier`` ("hbm"/"host"/"nvme").  Lock-free
+        GIL-atomic snapshot, same contract as the debug views — a
+        slightly stale answer only costs routing quality."""
+        store = self.scheduler.adapter_store
+        if store is None:
+            return {}
+        return store.residency_digest()
+
     def summary(self) -> Dict:
         """One row of ``/healthz`` / ``/debug/fleet``: health + load at
         a glance (lock-free reads, same contract as the debug views)."""
@@ -117,4 +148,6 @@ class Replica:
             "active": sum(r is not None for r in list(sched._slots)),
             "outstanding_tokens": self.outstanding_tokens(),
             "cached_blocks": sched.block_mgr.num_cached_blocks,
+            "weights_version": sched.weights_version,
+            "adapters_resident": sorted(self.adapter_residency()),
         }

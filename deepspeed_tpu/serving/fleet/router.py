@@ -65,6 +65,10 @@ class FleetRequest:
     priority: int = 0
     timeout_s: float = 0.0
     slo_class: str = "default"
+    #: multi-tenant LoRA adapter (ISSUE 20) — routing prefers replicas
+    #: where the adapter is already resident, and the prefix hashes are
+    #: salted by it (cross-tenant cache isolation)
+    adapter_id: Optional[str] = None
     session_id: Optional[str] = None
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -115,6 +119,8 @@ class FleetRequest:
         }
         if self.session_id is not None:
             out["session_id"] = self.session_id
+        if self.adapter_id is not None:
+            out["adapter_id"] = self.adapter_id
         if self.reject_reason is not None:
             out["reject_reason"] = self.reject_reason
         if self.ttft_s is not None:
@@ -171,13 +177,14 @@ class Router:
     # ------------------------------------------------------------ submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
                timeout_s: float = 0.0, slo_class: str = "default",
-               session_id: Optional[str] = None) -> FleetRequest:
+               session_id: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> FleetRequest:
         """Dispatch one request onto the best healthy replica.  Raises
         the scheduler's AdmissionError family exactly like a direct
-        ``scheduler.submit`` (RequestTooLongError / RequestShedError
-        propagate; QueueFullError fails over to the next-best candidate
-        first), plus :class:`FleetUnavailableError` when no replica is
-        READY."""
+        ``scheduler.submit`` (RequestTooLongError / RequestShedError /
+        UnknownAdapterError propagate; QueueFullError fails over to the
+        next-best candidate first), plus
+        :class:`FleetUnavailableError` when no replica is READY."""
         candidates = [r for r in self.replicas if r.is_accepting()]
         if not candidates:
             self.registry.inc("fleet/unroutable")
@@ -189,10 +196,11 @@ class Router:
                 prompt_ids=np.asarray(prompt_ids, np.int32).reshape(-1),
                 sampling=sampling or SamplingParams(),
                 priority=priority, timeout_s=timeout_s,
-                slo_class=slo_class, session_id=session_id)
+                slo_class=slo_class, session_id=session_id,
+                adapter_id=adapter_id)
             self._next_id += 1
         # prompt hashing only pays off where a policy reads it
-        hashes = (self._prompt_hashes(handle.prompt_ids)
+        hashes = (self._prompt_hashes(handle.prompt_ids, salt=adapter_id)
                   if self.cfg.policy == "scored" else [])
         # chaos edge (ISSUE 11), ONE invocation per dispatch: a raise
         # spec surfaces as a dispatch failure (nothing bound yet), a
@@ -203,7 +211,8 @@ class Router:
             info = {"misroute": True}
             self.registry.inc("fleet/misroutes")
         else:
-            ordered, info = self._rank(candidates, hashes, session_id)
+            ordered, info = self._rank(candidates, hashes, session_id,
+                                       adapter_id=adapter_id)
         last_exc = None
         for rep in ordered:
             # the submit+bind pair rides the supervision lock: a
@@ -216,7 +225,8 @@ class Router:
                     req = rep.submit(handle.prompt_ids, handle.sampling,
                                      priority=priority,
                                      timeout_s=timeout_s,
-                                     slo_class=slo_class)
+                                     slo_class=slo_class,
+                                     adapter_id=adapter_id)
                 except QueueFullError as e:
                     last_exc = e        # fail over to the next candidate
                     continue
@@ -230,6 +240,7 @@ class Router:
             self.flightrec.record(
                 "route/dispatch", corr=handle.corr,
                 replica=rep.replica_id, session=session_id,
+                adapter=adapter_id,
                 prompt_tokens=int(handle.prompt_ids.size), **info)
             return handle
         raise last_exc      # every candidate queue-full: surface the 429
@@ -252,7 +263,8 @@ class Router:
 
     # ------------------------------------------------------------ policy
     def _rank(self, candidates: List[Replica], prompt_hashes: List[str],
-              session_id: Optional[str]
+              session_id: Optional[str],
+              adapter_id: Optional[str] = None
               ) -> Tuple[List[Replica], Dict]:
         """Candidates best-first under the configured policy, plus the
         winner's score breakdown (flight-recorder fields).  A scored
@@ -284,29 +296,45 @@ class Router:
             # coldest link bounds the attach latency)
             frac *= tier_w.get(tier, 1.0)
             affine = sticky == r.replica_id
-            score = (self.cfg.prefix_weight * frac
+            # adapter residency (ISSUE 20): prefer replicas where the
+            # tenant's adapter is already paged in — the same tier
+            # ladder discounts a host/NVMe-resident copy (swap-in cost)
+            # against an HBM-hot one; a replica without the adapter at
+            # all pays the full ingest+swap on admission
+            a_tier = (r.adapter_residency().get(adapter_id)
+                      if adapter_id is not None else None)
+            a_bonus = (self.cfg.adapter_weight * tier_w.get(a_tier, 1.0)
+                       if a_tier is not None else 0.0)
+            score = (self.cfg.prefix_weight * frac + a_bonus
                      + (self.cfg.affinity_weight if affine else 0.0)
                      - self.cfg.least_loaded_weight
                      * loads[r.replica_id] / max_load)
             scored.append((score, -loads[r.replica_id], -r.replica_id,
-                           r, matched, affine, tier))
+                           r, matched, affine, tier, a_tier))
         scored.sort(reverse=True)       # ties: least loaded, lowest id
-        _, _, _, best, matched, affine, tier = scored[0]
-        return ([s[3] for s in scored],
-                {"policy": "scored", "prefix_blocks": matched,
-                 "prefix_tier": tier, "affinity": bool(affine),
-                 "load": loads[best.replica_id]})
+        _, _, _, best, matched, affine, tier, a_tier = scored[0]
+        info = {"policy": "scored", "prefix_blocks": matched,
+                "prefix_tier": tier, "affinity": bool(affine),
+                "load": loads[best.replica_id]}
+        if adapter_id is not None:
+            info["adapter_tier"] = a_tier
+        return [s[3] for s in scored], info
 
-    def _prompt_hashes(self, prompt_ids: np.ndarray) -> List[str]:
+    def _prompt_hashes(self, prompt_ids: np.ndarray,
+                       salt: Optional[str] = None) -> List[str]:
         """The prompt's full-block chain hashes (the PR 6 recipe) —
-        the routing key.  Bounded by ``digest_max_entries``: hashing
-        more blocks than any digest retains cannot change a score."""
+        the routing key, salted by the tenant's ``adapter_id`` exactly
+        like the scheduler's cache keys (ISSUE 20: digests scored here
+        must agree with what each replica actually cached).  Bounded by
+        ``digest_max_entries``: hashing more blocks than any digest
+        retains cannot change a score."""
         bs = self._block_size
         n = min(int(prompt_ids.size) // bs, self.cfg.digest_max_entries)
         out: List[str] = []
         h: Optional[str] = None
         for i in range(n):
-            h = BlockManager._chain_hash(h, prompt_ids[i * bs:(i + 1) * bs])
+            h = BlockManager._chain_hash(h, prompt_ids[i * bs:(i + 1) * bs],
+                                         salt=salt)
             out.append(h)
         return out
 
@@ -439,14 +467,16 @@ class Router:
         prompt = np.concatenate(
             [h.prompt_ids, np.asarray(h.prefix_output, np.int32)])
         samp = dataclasses.replace(h.sampling, max_new_tokens=remaining)
-        hashes = (self._prompt_hashes(prompt)
+        hashes = (self._prompt_hashes(prompt, salt=h.adapter_id)
                   if self.cfg.policy == "scored" else [])
-        ordered, _info = self._rank(candidates, hashes, h.session_id)
+        ordered, _info = self._rank(candidates, hashes, h.session_id,
+                                    adapter_id=h.adapter_id)
         for rep in ordered:
             try:
                 req = rep.submit(prompt, samp, priority=h.priority,
                                  timeout_s=h.timeout_s,
-                                 slo_class=h.slo_class)
+                                 slo_class=h.slo_class,
+                                 adapter_id=h.adapter_id)
             except AdmissionError as e:
                 logger.warning(f"fleet: resubmit of {h.corr} to replica "
                                f"{rep.replica_id} refused: {e}")
@@ -499,6 +529,44 @@ class Router:
                               resubmits=h.resubmits, state="rejected")
         logger.warning(f"fleet: request {h.corr} failed: {reason}")
         h.done.set()
+
+    # ------------------------------------------------------ weights swap
+    def swap_weights(self, new_params, version: str,
+                     reason: str = "weights rollout") -> Dict:
+        """Live base-weight hot-swap (ISSUE 20): roll the fleet to
+        ``new_params`` one replica at a time so N-1 replicas keep
+        serving at every instant.  Per replica: drain (the membership
+        gate closes, queued AND active requests extract through the
+        scheduler's standard eviction path and resubmit to the rest of
+        the fleet — the continued streams are token-identical by
+        recompute-on-resume), install the new tree double-buffered
+        (structure-validated, zero recompiles — the old tree stays
+        referenced by any still-running execution until the swap
+        lands), then re-admit.  In-flight streams therefore finish
+        entirely on the old version or entirely on the new one via
+        resubmit, never mid-stream mixed.  Returns the roll summary;
+        ``weights_version`` labels every /metrics series and flight
+        event from each replica's install onward."""
+        version = str(version)
+        rolled = []
+        for rep in self.replicas:
+            moved = self.drain_replica(
+                rep.replica_id, reason=f"{reason}: {version}")
+            if rep.started:
+                # started mode: the drain loop exits on its own once
+                # the extracted scheduler is empty
+                rep.join(timeout=30)
+            rep.install_params(new_params, version)
+            rep.readmit(f"weights {version} installed")
+            self.registry.inc("fleet/weight_swaps")
+            self.flightrec.record(
+                "route/weights_swap", corr=f"swap-{version}",
+                replica=rep.replica_id, version=version, moved=moved)
+            rolled.append({"replica": rep.replica_id, "moved": moved})
+            self.poll()      # settle resubmitted handles promptly
+        logger.info(f"fleet: weights rolled to {version} across "
+                    f"{len(rolled)} replicas")
+        return {"version": version, "replicas": rolled}
 
     # ------------------------------------------------------------ driving
     def has_inflight(self) -> bool:
@@ -601,6 +669,10 @@ class Router:
             "resubmits": self.registry.get_counter("fleet/resubmits"),
             "misroutes": self.registry.get_counter("fleet/misroutes"),
             "aggregate_prefix_hit_rate": self.aggregate_prefix_hit_rate(),
+            "weight_swaps": self.registry.get_counter("fleet/weight_swaps"),
+            "weights_versions": {
+                str(r.replica_id): r.scheduler.weights_version
+                for r in self.replicas},
             "replicas": [r.summary() for r in self.replicas],
         }
 

@@ -31,7 +31,8 @@ from deepspeed_tpu.serving.fleet.replica import Replica
 from deepspeed_tpu.serving.fleet.router import (FleetUnavailableError,
                                                 Router)
 from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
-                                           RequestShedError)
+                                           RequestShedError,
+                                           UnknownAdapterError)
 from deepspeed_tpu.serving.server import (parse_generate_body,
                                           send_json_response)
 from deepspeed_tpu.utils.logging import logger
@@ -125,9 +126,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 priority=parsed["priority"],
                 timeout_s=parsed["timeout_s"],
                 slo_class=parsed["slo_class"],
-                session_id=parsed["session_id"])
+                session_id=parsed["session_id"],
+                adapter_id=parsed["adapter_id"])
         except FleetUnavailableError as e:
             self._send_json(503, {"error": str(e)})
+            return
+        except UnknownAdapterError as e:
+            # typed 400 (ISSUE 20), same contract as the single-replica
+            # front door — never a 500
+            self._send_json(400, {"error": str(e),
+                                  "unknown_adapter": True})
             return
         except RequestShedError as e:
             self._send_json(429, {"error": str(e), "shed": True},

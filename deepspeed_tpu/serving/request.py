@@ -54,6 +54,11 @@ class QueueFullError(AdmissionError):
     """serving.max_queued requests already waiting."""
 
 
+class UnknownAdapterError(AdmissionError):
+    """``adapter_id`` names no registered LoRA adapter (ISSUE 20) — a
+    typed 4xx at the front door, never a 500."""
+
+
 class RequestTooLongError(AdmissionError):
     """prompt + max_new_tokens can never fit the block pool / model ctx."""
 
@@ -92,6 +97,10 @@ class ServeRequest:
     #: ``serving.slo`` class for burn accounting (ISSUE 7); unknown
     #: names fall back to "default" at scoring time
     slo_class: str = "default"
+    #: multi-tenant LoRA adapter (ISSUE 20); None = base model.  Also
+    #: the prefix-cache salt: blocks cached under one adapter can never
+    #: attach to another tenant's request.
+    adapter_id: Optional[str] = None
     arrival_time: float = field(default_factory=time.monotonic)
 
     # -- scheduler-owned runtime state ----------------------------------
@@ -129,6 +138,12 @@ class ServeRequest:
     spec_passes: int = 0            #: verify passes that carried a draft
     spec_accept_ema: float = -1.0   #: rolling acceptance rate (-1 = none)
     spec_disabled: bool = False     #: min_accept_rate tripped
+    #: adapter swap-in failed and serving.adapters.fallback_to_base
+    #: degraded this request to the base model (adapter_id cleared)
+    adapter_fallback: bool = False
+    #: scheduler-owned: this request holds one AdapterStore refcount
+    #: (acquired at admission, released at retire/evict)
+    adapter_pinned: bool = False
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -187,6 +202,10 @@ class ServeRequest:
             "num_preemptions": self.num_preemptions,
             "num_cached_tokens": self.num_cached_tokens,
         }
+        if self.adapter_id is not None:
+            out["adapter_id"] = self.adapter_id
+        if self.adapter_fallback:
+            out["adapter_fallback"] = True
         if self.reject_reason is not None:
             out["reject_reason"] = self.reject_reason
         if self.ttft_s is not None:

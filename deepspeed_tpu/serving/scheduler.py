@@ -428,6 +428,40 @@ class ContinuousBatchingScheduler:
                 kt, injector=self.injector, flightrec=self.flightrec)
             self.block_mgr.attach_tiering(self._tier_store,
                                           self._extract_block)
+        # multi-tenant LoRA adapters (ISSUE 20): paged AdapterStore over
+        # the same offload engine — requests carry adapter_id, admission
+        # pins a resident HBM slot (swap-in overlapped with the running
+        # decode like cold-tier prefix hits), and every program family
+        # takes an optional trailing gather-LoRA operand
+        from deepspeed_tpu.serving.adapters import adapters_enabled
+        ac = getattr(config, "adapters", None)
+        self._adapters_cfg = ac
+        self.adapter_store = None
+        self.adapter_registry = None
+        #: request_id -> adapter_id whose swap-in is in flight (the
+        #: request sits out admission until it materializes)
+        self._adapter_pending: Dict[int, str] = {}
+        #: rolling base-weight version label (ISSUE 20 live hot-swap);
+        #: stamped on /metrics and every admit/retire/step flight event
+        self.weights_version = "v1"
+        self._weights_swapped = False
+        if ac is not None and adapters_enabled(ac):
+            if not model.meta.get("lora_serving"):
+                raise ValueError(
+                    f"serving.adapters.enabled: model "
+                    f"{model.meta.get('name')!r} does not implement the "
+                    "gather-LoRA serving pass (meta['lora_serving'])")
+            from deepspeed_tpu.serving.adapters import (AdapterRegistry,
+                                                        AdapterStore)
+            self.adapter_registry = AdapterRegistry(
+                max_rank=ac.max_rank,
+                allowed_targets=ac.targets or None)
+            shapes = self._lora_block_shapes()
+            self.adapter_store = AdapterStore(
+                self.adapter_registry, ac, shapes,
+                injector=self.injector, flightrec=self.flightrec)
+            for aid, path in sorted(ac.adapters.items()):
+                self.register_adapter(aid, path=path)
 
     def _resolve_proposer(self, proposer):
         spec = getattr(self.cfg, "spec", None)
@@ -446,6 +480,182 @@ class ContinuousBatchingScheduler:
         raise ValueError(
             "serving.spec.mode='draft' needs a DraftModelProposer passed "
             "as ContinuousBatchingScheduler(..., proposer=...)")
+
+    # -------------------------------------------- adapter serving (20)
+    def _lora_block_shapes(self) -> Dict[str, tuple]:
+        """Stackable gather-LoRA targets from the base params: every
+        3-D ``blocks`` leaf (stacked [L, d_in, d_out] projection;
+        biases/norms are 2-D and skip), optionally restricted to
+        ``serving.adapters.targets``.  Quantized leaves report their
+        LOGICAL shape — the LoRA delta applies in float on the qdot
+        output, never inside the int8 payload."""
+        blocks = (self.params.get("blocks", {})
+                  if isinstance(self.params, dict) else {})
+        want = set(self._adapters_cfg.targets or ())
+        shapes: Dict[str, tuple] = {}
+        for t, leaf in blocks.items():
+            if want and t not in want:
+                continue
+            shp = tuple(getattr(leaf, "shape", ()) or ())
+            if not shp and hasattr(leaf, "q"):
+                # QuantizedTensor: the int8 payload carries the logical
+                # [L, d_in, d_out] shape
+                shp = tuple(getattr(leaf.q, "shape", ()) or ())
+            if len(shp) == 3:
+                shapes[t] = (int(shp[0]), int(shp[1]), int(shp[2]))
+        if not shapes:
+            raise ValueError(
+                "serving.adapters: no stackable [L, d_in, d_out] block "
+                "weights found in the model params"
+                + (f" for targets {sorted(want)}" if want else ""))
+        return shapes
+
+    def register_adapter(self, adapter_id: str, lora_tree=None, path=None,
+                         alpha=None, slo_class=None):
+        """Register + ingest one LoRA adapter (the ``ds_serve
+        --adapters`` startup path and the test/tooling surface).
+        Validation failure raises ValueError and leaves the registry
+        unchanged; on success the payload enters the host paging tier
+        and the first request swap-ins it to HBM."""
+        if self.adapter_registry is None:
+            raise ValueError("serving.adapters is not enabled")
+        with self._lock:
+            if path is not None:
+                m = self.adapter_registry.register_file(
+                    adapter_id, path, slo_class=slo_class)
+            else:
+                m = self.adapter_registry.register(
+                    adapter_id, lora_tree, alpha=alpha,
+                    slo_class=slo_class)
+            try:
+                ok = self.adapter_store.ingest(adapter_id)
+            except ValueError:
+                self.adapter_registry.unregister(adapter_id)
+                raise
+            if not ok:
+                # fault-denied ingest: registered but in no tier — the
+                # typed failure surfaces per-request at swap-in time
+                self.metrics.counters["adapter_load_failures"] += 1
+            return m
+
+    def _adapter_slot(self, req: ServeRequest) -> int:
+        """This request's HBM adapter slot for program packing
+        (-1 = base model / no adapter)."""
+        if self.adapter_store is None or req.adapter_id is None:
+            return -1
+        s = self.adapter_store.slot_of(req.adapter_id)
+        return -1 if s is None else s
+
+    def _lora_arg(self, groups) -> tuple:
+        """Trailing gather-LoRA operand for one program execution: ()
+        when no packed row carries an adapter — the program runs its
+        unchanged base trace, so adapter-less steps pay exactly
+        nothing — else the one pytree the model-side pass consumes
+        (per-row slot groups + the store's slot stacks; each distinct
+        adapter's factors stream once per execution)."""
+        g = np.asarray(groups, np.int32)
+        if self.adapter_store is None or not (g >= 0).any():
+            return ()
+        st = self.adapter_store
+        return ({"groups": jnp.asarray(g), "scale": st.scale,
+                 "stacks": st.stacks},)
+
+    def _schedule_adapter_swapin(self, req: ServeRequest) -> bool:
+        """Kick (or piggyback on) the async swap-in for a cold adapter;
+        the request sits out admission until it materializes.  False =
+        the adapter is in no tier (quarantined / dropped) — the caller
+        runs the failure path."""
+        aid = req.adapter_id
+        if aid not in self._adapter_pending.values():
+            if not self.adapter_store.schedule_swapin(
+                    aid, corr=f"req-{req.request_id}"):
+                return False
+        if req.request_id not in self._adapter_pending:
+            self.flightrec.record("req/adapter_swap_in",
+                                  corr=f"req-{req.request_id}",
+                                  adapter=aid)
+        self._adapter_pending[req.request_id] = aid
+        return True
+
+    def _materialize_adapter_swapins(self):
+        """Complete adapter swap-ins scheduled on an earlier step (the
+        I/O already overlapped at least one decode iteration): install
+        each into an HBM slot; waiters re-enter this step's admission
+        line.  ``wait`` (every slot pinned) stays pending and retries
+        as requests retire; ``fail`` runs the per-request failure path
+        (typed reject, or base-model fallback per config)."""
+        if self.adapter_store is None or not self._adapter_pending:
+            return
+        queued = {r.request_id: r for r in self._queue}
+        status_of: Dict[str, str] = {}
+        for rid in list(self._adapter_pending):
+            aid = self._adapter_pending[rid]
+            req = queued.get(rid)
+            if req is None:         # expired / extracted while pending
+                self._adapter_pending.pop(rid)
+                continue
+            st = status_of.get(aid)
+            if st is None:
+                st, _slot = self.adapter_store.swap_in(
+                    aid, corr=f"req-{rid}")
+                status_of[aid] = st
+            if st == "ok":
+                self._adapter_pending.pop(rid)
+            elif st == "fail":
+                self._adapter_pending.pop(rid)
+                self._adapter_failure(req)
+
+    def _adapter_failure(self, req: ServeRequest) -> bool:
+        """One request's adapter could not materialize (fault / IO /
+        integrity / quarantine).  With ``fallback_to_base`` the request
+        degrades to the base model (flagged on its response) and True
+        returns; otherwise it fails TYPED — rejected with a reason,
+        never a crash — and every other tenant's stream is untouched."""
+        aid = req.adapter_id
+        ac = self._adapters_cfg
+        if ac is not None and getattr(ac, "fallback_to_base", False):
+            req.adapter_id = None
+            req.adapter_fallback = True
+            self.metrics.counters["adapter_fallbacks"] += 1
+            self.flightrec.record("req/adapter_fallback",
+                                  corr=f"req-{req.request_id}",
+                                  adapter=aid)
+            return True
+        if req in self._queue:
+            self._queue.remove(req)
+        req.state = RequestState.REJECTED
+        req.reject_reason = (f"adapter {aid!r} failed to load "
+                             "(fault/IO/integrity)")
+        self.metrics.counters["adapter_rejects"] += 1
+        self.flightrec.record("req/adapter_fail",
+                              corr=f"req-{req.request_id}", adapter=aid)
+        req.done.set()
+        return False
+
+    def install_params(self, new_params, version: str):
+        """Live base-weight hot-swap (ISSUE 20): install a new params
+        pytree under the scheduler lock and roll the version label.
+        Structure/shapes/dtypes must match the old tree — params is a
+        TRACED argument of every compiled program family, so an
+        identical-structure install triggers zero recompiles.  Call on
+        a drained replica (fleet ``Router.swap_weights``) for token-
+        identical streams; an undrained install changes weights
+        mid-stream."""
+        old = jax.tree_util.tree_structure(self.params)
+        new = jax.tree_util.tree_structure(new_params)
+        if old != new:
+            raise ValueError(
+                "install_params: new params tree does not match the "
+                "serving tree (hot-swap requires identical structure)")
+        with self._lock:
+            self.params = new_params
+            self.weights_version = str(version)
+            self._weights_swapped = True
+            self.flightrec.record("route/weights_swap",
+                                  corr=f"serve-step-{self._step_count}",
+                                  version=self.weights_version,
+                                  step=self._step_count)
+            self.metrics.counters["weights_swaps"] += 1
 
     # ------------------------------------------------------------- pool
     def _init_pool(self):
@@ -511,10 +721,14 @@ class ContinuousBatchingScheduler:
             model, kv_dtype = self.model, self.kv_cache_dtype
             cache_len = _round_up(sp, 64)
 
-            def fn(params, pool, tokens, length, dest_idx):
+            def fn(params, pool, tokens, length, dest_idx, lora=None):
                 cache = model.init_cache_fn(1, cache_len, kv_dtype)
-                logits, cache = model.prefill_fn(
-                    params, {"input_ids": tokens}, cache)
+                if lora is None:
+                    logits, cache = model.prefill_fn(
+                        params, {"input_ids": tokens}, cache)
+                else:
+                    logits, cache = model.prefill_fn(
+                        params, {"input_ids": tokens}, cache, lora=lora)
                 pool = jax.tree.map(
                     lambda p, c: p.at[:, dest_idx].set(c[:, 0, :sp]),
                     pool, cache)
@@ -540,7 +754,8 @@ class ContinuousBatchingScheduler:
         if key not in self._decode_fns:
             model = self.model
 
-            def fn(params, pool, ints, floats, do_flags, pos_idx):
+            def fn(params, pool, ints, floats, do_flags, pos_idx,
+                   lora=None):
                 # ints [4+k, B]: tokens, lengths, seeds, top_ks,
                 # dest_steps[k]; floats [2, B]: temps, top_ps.  One packed
                 # array per dtype — per-call device_put overhead measured
@@ -555,8 +770,12 @@ class ContinuousBatchingScheduler:
                 def body(carry, dest_idx):
                     pool, toks, lens = carry
                     dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
-                    logits, new_cache = model.decode_fn(
-                        params, toks, dense, lens)
+                    if lora is None:
+                        logits, new_cache = model.decode_fn(
+                            params, toks, dense, lens)
+                    else:
+                        logits, new_cache = model.decode_fn(
+                            params, toks, dense, lens, lora=lora)
                     # the ONE vector decode wrote per row, back to the pool
                     new_vecs = jax.tree.map(
                         lambda c: c[:, rows, lens], new_cache)
@@ -610,7 +829,8 @@ class ContinuousBatchingScheduler:
             if vf is None or os.environ.get("DS_SPEC_VERIFY") == "scan":
                 vf = scan_verify_fn(model.decode_fn)
 
-            def fn(params, pool, ints, floats, do_flags, pos_idx):
+            def fn(params, pool, ints, floats, do_flags, pos_idx,
+                   lora=None):
                 tokens = ints[:W].T                     # [B, W]
                 lengths = ints[W]
                 draft_len = ints[W + 1]
@@ -620,7 +840,14 @@ class ContinuousBatchingScheduler:
                 B = tokens.shape[0]
                 rows = jnp.arange(B)
                 dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
-                logits, new_cache = vf(params, tokens, dense, lengths)
+                if lora is None:
+                    logits, new_cache = vf(params, tokens, dense, lengths)
+                else:
+                    # adapters need the model's real verify surface (the
+                    # scan-of-decode fallback has no lora plumbing);
+                    # lora_serving models always expose verify_fn
+                    logits, new_cache = model.verify_fn(
+                        params, tokens, dense, lengths, lora=lora)
                 # ONE windowed scatter for the whole batch: clamped
                 # GATHER of each row's window from the dense view (the
                 # _suffix_prefill_fn clamp reasoning — pad rows whose
@@ -680,11 +907,16 @@ class ContinuousBatchingScheduler:
             if vf is None or os.environ.get("DS_SPEC_VERIFY") == "scan":
                 vf = scan_verify_fn(model.decode_fn)
 
-            def fn(params, pool, tokens, length, dests, pos_idx):
+            def fn(params, pool, tokens, length, dests, pos_idx,
+                   lora=None):
                 # tokens [1, W]; length [1] = first suffix position;
                 # dests [W] flat pool destinations; pos_idx [1, S_pad]
                 dense = jax.tree.map(lambda p: p[:, pos_idx], pool)
-                logits, new_cache = vf(params, tokens, dense, length)
+                if lora is None:
+                    logits, new_cache = vf(params, tokens, dense, length)
+                else:
+                    logits, new_cache = model.verify_fn(
+                        params, tokens, dense, length, lora=lora)
                 # ONE gather+scatter for the whole window (a per-position
                 # .set loop would copy the full pool W times on backends
                 # that don't fuse the chain).  Clamped GATHER, not a
@@ -804,28 +1036,56 @@ class ContinuousBatchingScheduler:
 
     # ----------------------------------------------------------- submit
     def submit(self, prompt_ids, sampling=None, priority: int = 0,
-               timeout_s: float = 0.0,
-               slo_class: str = "default") -> ServeRequest:
+               timeout_s: float = 0.0, slo_class: str = "default",
+               adapter_id: Optional[str] = None) -> ServeRequest:
         """Enqueue a request; raises AdmissionError (429-style) instead of
         crashing or wedging the loop.  ``slo_class`` names the request's
         ``serving.slo`` class for burn accounting AND admission control
         (unknown classes fall back to ``default``): with
         ``serving.slo.shed_enabled``, a saturated system sheds the
         lowest-priority classes here with a RequestShedError carrying
-        the Retry-After hint (ISSUE 9)."""
+        the Retry-After hint (ISSUE 9).  ``adapter_id`` selects the
+        tenant's LoRA adapter (ISSUE 20): unknown ids raise the typed
+        UnknownAdapterError (a 4xx at the front door, never a 500), and
+        a request submitted with the DEFAULT class inherits its
+        tenant's ``serving.adapters.slo_class_map`` class."""
         from deepspeed_tpu.serving.request import (RequestShedError,
-                                                   SamplingParams)
+                                                   SamplingParams,
+                                                   UnknownAdapterError)
         with self._lock:
             req = ServeRequest(
                 request_id=self._next_id,
                 prompt_ids=prompt_ids,
                 sampling=sampling or SamplingParams(),
                 priority=priority, timeout_s=timeout_s,
-                slo_class=slo_class)
+                slo_class=slo_class, adapter_id=adapter_id)
             # consume the id for REJECTED requests too: a reject's
             # flight-recorder event must never share its req-<id> corr
             # with a later accepted request's timeline
             self._next_id += 1
+            if adapter_id is not None:
+                if (self.adapter_registry is None
+                        or adapter_id not in self.adapter_registry):
+                    req.state = RequestState.REJECTED
+                    req.reject_reason = (
+                        f"unknown adapter {adapter_id!r}"
+                        if self.adapter_registry is not None else
+                        f"adapter {adapter_id!r} requested but "
+                        "serving.adapters is not enabled")
+                    self.metrics.counters["adapter_unknown"] += 1
+                    self.flightrec.record(
+                        "req/reject", corr=f"req-{req.request_id}",
+                        reason="adapter_unknown", adapter=adapter_id)
+                    req.done.set()
+                    raise UnknownAdapterError(req.reject_reason)
+                if slo_class == "default":
+                    # per-tenant QoS (ISSUE 9 ladder): the tenant's
+                    # mapped class drives shedding, admission order,
+                    # chunk service, and preemption below
+                    mapped = self.adapter_store.slo_class_for(adapter_id)
+                    if mapped:
+                        slo_class = mapped
+                        req.slo_class = mapped
             total = req.prompt_len + req.sampling.max_new_tokens
             if total > self.max_model_len \
                     or not self.block_mgr.fits_ever(total):
@@ -876,7 +1136,8 @@ class ContinuousBatchingScheduler:
             self.flightrec.record("req/queue", corr=f"req-{req.request_id}",
                                   prompt_tokens=req.prompt_len,
                                   max_new=req.sampling.max_new_tokens,
-                                  priority=priority, slo_class=slo_class)
+                                  priority=priority, slo_class=slo_class,
+                                  adapter=adapter_id)
             return req
 
     # ------------------------------------------------------------ state
@@ -963,9 +1224,16 @@ class ContinuousBatchingScheduler:
         """Prometheus text for the /metrics endpoint (locked, same
         exposition function as the training-side metrics server).  The
         fleet front-end passes ``extra_labels={"replica": "<id>"}`` so
-        N replicas merge into one labeled exposition (ISSUE 11)."""
+        N replicas merge into one labeled exposition (ISSUE 11).  On a
+        multi-tenant server (serving.adapters) or once install_params
+        has ever hot-swapped the base weights, every series additionally
+        carries ``weights_version`` (ISSUE 20) so the live roll is
+        attributable in dashboards."""
+        labels = dict(extra_labels or {})
+        if self.adapter_store is not None or self._weights_swapped:
+            labels.setdefault("weights_version", self.weights_version)
         with self._lock:
-            return self.metrics.render_prometheus(extra_labels=extra_labels)
+            return self.metrics.render_prometheus(extra_labels=labels)
 
     # ------------------------------------------------- debug introspection
     # Both views below are deliberately LOCK-FREE (ISSUE 7): they exist
@@ -984,6 +1252,7 @@ class ContinuousBatchingScheduler:
             "slot": req.slot,
             "priority": req.priority,
             "slo_class": req.slo_class,
+            "adapter_id": req.adapter_id,
             "prompt_tokens": req.prompt_len,
             "generated": req.num_generated,
             "max_new_tokens": req.sampling.max_new_tokens,
@@ -1077,6 +1346,14 @@ class ContinuousBatchingScheduler:
                                 "demoted_not_evicted": bm.cache_demotions,
                                 "pending_swapins": len(self._swap_pending)},
                                **self._tier_store.summary())),
+            "adapters": ({"enabled": False}
+                         if self.adapter_store is None else dict(
+                             {"enabled": True,
+                              "registered": sorted(
+                                  self.adapter_registry.ids()),
+                              "pending_swapins": len(self._adapter_pending),
+                              "weights_version": self.weights_version},
+                             **self.adapter_store.summary())),
         }
         return out
 
@@ -1098,8 +1375,12 @@ class ContinuousBatchingScheduler:
         # then free — hashed blocks park on the LRU for the next request
         self.block_mgr.register_committed(
             req.request_id, req.all_token_ids,
-            materialized=self._committed_tokens(req))
+            materialized=self._committed_tokens(req),
+            salt=req.adapter_id)
         self.block_mgr.free(req.request_id)
+        if req.adapter_pinned:
+            self.adapter_store.release(req.adapter_id)
+            req.adapter_pinned = False
         req.prefill_inputs = None
         req.prefill_pos = 0
         if req.slot >= 0:
@@ -1111,6 +1392,10 @@ class ContinuousBatchingScheduler:
         if state == RequestState.FINISHED:
             req.t_finish = time.monotonic()
             self.metrics.observe_finished(req)
+            if self.adapter_store is not None:
+                # per-tenant label (ISSUE 20): one series per adapter
+                self.metrics.registry.inc("serving/tenant_completed",
+                                          adapter=req.adapter_id or "base")
             self._finished_this_step.append(req)
             # SLO burn accounting (ISSUE 7): score the finished request
             # against its class targets; TPOT = mean inter-token gap
@@ -1129,7 +1414,8 @@ class ContinuousBatchingScheduler:
             state=state.value, generated=req.num_generated,
             ttft_ms=(round(req.ttft_s * 1e3, 3)
                      if req.ttft_s is not None else None),
-            reason=reason)
+            reason=reason, adapter=req.adapter_id,
+            version=self.weights_version)
         req.done.set()
 
     def _evict(self, victim: ServeRequest):
@@ -1144,7 +1430,8 @@ class ContinuousBatchingScheduler:
             self.proposer.release(victim.request_id)
         self.block_mgr.register_committed(
             victim.request_id, victim.all_token_ids,
-            materialized=self._committed_tokens(victim))
+            materialized=self._committed_tokens(victim),
+            salt=victim.adapter_id)
         victim_table = list(self.block_mgr.block_table(victim.request_id))
         self.block_mgr.free(victim.request_id)
         if self._tier_store is not None and self._park_on_preempt:
@@ -1163,6 +1450,12 @@ class ContinuousBatchingScheduler:
         if victim.slot >= 0:
             self._slots[victim.slot] = None
             victim.slot = -1
+        if victim.adapter_pinned:
+            # unpin: a preempted tenant's adapter becomes an ordinary
+            # LRU citizen — it may demote to host/NVMe before resume,
+            # and re-admission pays a swap-in, not a failure
+            self.adapter_store.release(victim.adapter_id)
+            victim.adapter_pinned = False
         victim.state = RequestState.EVICTED
         victim.num_preemptions += 1
         victim.queued_at = time.monotonic()    # timeout clock restarts
@@ -1250,18 +1543,34 @@ class ContinuousBatchingScheduler:
         # materialize first — their hashes re-enter the HBM cache and
         # the owning requests re-enter the admission line below
         self._materialize_swapins()
+        self._materialize_adapter_swapins()
         while self._queue:
             free_slots = [i for i, r in enumerate(self._slots) if r is None]
             if not free_slots:
                 break
-            # a request waiting on an in-flight swap-in sits out this
-            # round (its prefix materializes next step); others admit
+            # a request waiting on an in-flight swap-in (KV tier or
+            # adapter) sits out this round; others admit
+            waiting = self._swap_pending or self._adapter_pending
             cands = ([r for r in self._queue
-                      if r.request_id not in self._swap_pending]
-                     if self._swap_pending else self._queue)
+                      if r.request_id not in self._swap_pending
+                      and r.request_id not in self._adapter_pending]
+                     if waiting else self._queue)
             if not cands:
                 break
             req = max(cands, key=self._qos_key)
+            # multi-tenant LoRA (ISSUE 20): an admission whose adapter is
+            # cold schedules the swap-in and sits out this round — the
+            # swap overlaps the running decode, exactly like a cold-tier
+            # prefix hit.  A swap that cannot even start (no tier holds
+            # the payload) degrades per serving.adapters.fallback_to_base
+            # or rejects typed.
+            if (self.adapter_store is not None
+                    and req.adapter_id is not None
+                    and not self.adapter_store.resident(req.adapter_id)):
+                if self._schedule_adapter_swapin(req):
+                    continue
+                if not self._adapter_failure(req):
+                    continue
             resumed = req.state == RequestState.EVICTED
             tokens = req.all_token_ids
             # resume re-prefills everything but the last generated token —
@@ -1280,7 +1589,8 @@ class ContinuousBatchingScheduler:
                 # ordinary HBM hits and the request pays a swap-in
                 # instead of a re-prefill
                 if self._tier_store is not None:
-                    entries = bm.match_prefix_tiered(inputs)
+                    entries = bm.match_prefix_tiered(
+                        inputs, salt=req.adapter_id)
                     if (len(entries) > len(matched)
                             and len(entries) >= self._prefix_min_blocks
                             and self._schedule_swapins(req, entries)):
@@ -1345,11 +1655,22 @@ class ContinuousBatchingScheduler:
             req.slot = free_slots[0]
             self._slots[req.slot] = req
             req.num_cached_tokens = start
+            if self.adapter_store is not None \
+                    and req.adapter_id is not None:
+                # pin the adapter for the request's whole residency —
+                # refcount > 0 keeps the LRU from demoting it mid-decode
+                self.adapter_store.acquire(req.adapter_id)
+                req.adapter_pinned = True
+                self.flightrec.record(
+                    "req/adapter_attach", corr=f"req-{req.request_id}",
+                    adapter=req.adapter_id,
+                    adapter_slot=self.adapter_store.slot_of(req.adapter_id))
             self.flightrec.record(
                 "req/resume" if resumed else "req/admit",
                 corr=f"req-{req.request_id}", slot=req.slot,
                 step=self._step_count, cached_tokens=start,
-                prompt_tokens=n_in, deferred=bool(defer and need > 0))
+                prompt_tokens=n_in, deferred=bool(defer and need > 0),
+                adapter=req.adapter_id, version=self.weights_version)
             if matched:
                 self.flightrec.record(
                     "req/prefix_hit", corr=f"req-{req.request_id}",
@@ -1397,7 +1718,9 @@ class ContinuousBatchingScheduler:
                                args={"request_id": req.request_id,
                                      "prompt_tokens": n_in,
                                      "resumed": bool(resumed)}):
-            blocks = bm.match_prefix(inputs)
+            # salt = adapter_id (ISSUE 20): one tenant's cached blocks
+            # can never attach to another tenant's prompt
+            blocks = bm.match_prefix(inputs, salt=req.adapter_id)
         # hit/miss accounting happens in _admit once the admission
         # sticks — lookups that don't end in an attach count as misses
         if len(blocks) < self._prefix_min_blocks:
@@ -1438,7 +1761,8 @@ class ContinuousBatchingScheduler:
                                   for p in pos]
             last_logits, self.pool = self._prefill_fn(sp)(
                 self.params, self.pool, jnp.asarray(padded),
-                jnp.asarray([inputs.size], np.int32), jnp.asarray(dest))
+                jnp.asarray([inputs.size], np.int32), jnp.asarray(dest),
+                *self._lora_arg([self._adapter_slot(req)]))
         self.metrics.counters["prefill_tokens"] += int(inputs.size) - start
         if start == 0:
             # the cached-suffix path records per chunk; this is the
@@ -1465,7 +1789,8 @@ class ContinuousBatchingScheduler:
         # this very step hit them (materialized = exactly the prefilled
         # prefix; the token sampled below has no KV yet)
         self.block_mgr.register_committed(req.request_id, inputs,
-                                          materialized=int(inputs.size))
+                                          materialized=int(inputs.size),
+                                          salt=req.adapter_id)
         req.state = RequestState.DECODE
         req.prefill_inputs = None
         req.prefill_pos = 0
@@ -1509,7 +1834,8 @@ class ContinuousBatchingScheduler:
         logits, self.pool = self._suffix_prefill_fn(W)(
             self.params, self.pool, jnp.asarray(toks),
             jnp.asarray([pos], np.int32), jnp.asarray(dests),
-            jnp.asarray(pos_idx))
+            jnp.asarray(pos_idx),
+            *self._lora_arg([self._adapter_slot(req)]))
         return logits[0, take - 1][None]
 
     def _suffix_prefill(self, req: ServeRequest, inputs: np.ndarray,
@@ -1662,10 +1988,12 @@ class ContinuousBatchingScheduler:
         floats = np.ones((2, B), np.float32)
         do_flags = np.zeros((B,), bool)
         pos_idx = np.zeros((B, self.s_pad), np.int32)
+        groups = np.full((B,), -1, np.int32)
         for req in active:
             b = req.slot
             seq = req.all_token_ids
             pos_idx[b] = self._pos_idx_row(req.request_id)
+            groups[b] = self._adapter_slot(req)
             s = req.sampling
             ints[0, b], ints[1, b] = seq[-1], seq.size - 1
             ints[2, b], ints[3, b] = s.seed & 0x7FFFFFFF, s.top_k
@@ -1677,7 +2005,8 @@ class ContinuousBatchingScheduler:
         any_sampling = bool(do_flags.any())
         t0 = time.perf_counter()
         toks, self.pool = self._decode_fn(any_sampling)(
-            self.params, self.pool, ints, floats, do_flags, pos_idx)
+            self.params, self.pool, ints, floats, do_flags, pos_idx,
+            *self._lora_arg(groups))
         toks = np.asarray(toks)                  # [k, B]
         if self._costmodel_on:
             from deepspeed_tpu.telemetry.roofline import observe_achieved
@@ -1831,12 +2160,14 @@ class ContinuousBatchingScheduler:
         floats = np.ones((2, B), np.float32)
         do_flags = np.zeros((B,), bool)
         pos_idx = np.zeros((B, self.s_pad), np.int32)
+        groups = np.full((B,), -1, np.int32)
         for req in decode_rows:
             b = req.slot
             seq = req.all_token_ids
             d = drafts.get(req.request_id)
             nd = 0 if d is None else int(d.size)
             pos_idx[b] = self._pos_idx_row(req.request_id)
+            groups[b] = self._adapter_slot(req)
             s = req.sampling
             ints[0, b] = seq[-1]
             if nd:
@@ -1856,6 +2187,7 @@ class ContinuousBatchingScheduler:
             inputs = req.prefill_inputs
             pos = req.prefill_pos
             pos_idx[b] = self._pos_idx_row(req.request_id)
+            groups[b] = self._adapter_slot(req)
             s = req.sampling
             ints[0:take, b] = inputs[pos:pos + take]
             ints[W, b] = pos
@@ -1891,7 +2223,8 @@ class ContinuousBatchingScheduler:
         t0 = time.perf_counter()
         with tracer.span("serve/window", cat="serving", args=span_args):
             acc, out, self.pool = self._window_fn(W, any_sampling)(
-                self.params, self.pool, ints, floats, do_flags, pos_idx)
+                self.params, self.pool, ints, floats, do_flags, pos_idx,
+                *self._lora_arg(groups))
             acc, out = np.asarray(acc), np.asarray(out)
         if self._costmodel_on:
             from deepspeed_tpu.telemetry.roofline import observe_achieved
@@ -1925,7 +2258,8 @@ class ContinuousBatchingScheduler:
             # a same-prefix admission (or this row's own post-eviction
             # resume) attaches them instead of recomputing
             self.block_mgr.register_committed(
-                req.request_id, inputs, materialized=req.prefill_pos)
+                req.request_id, inputs, materialized=req.prefill_pos,
+                salt=req.adapter_id)
             if req.prefill_pos >= n_in:
                 # completion: the window's bonus column already drew the
                 # first token — ONE epilogue serves every prefill form
@@ -2075,7 +2409,8 @@ class ContinuousBatchingScheduler:
                     for r in self._slots:
                         if r is not None and r.state == RequestState.DECODE:
                             self.block_mgr.register_committed(
-                                r.request_id, r.all_token_ids)
+                                r.request_id, r.all_token_ids,
+                                salt=r.adapter_id)
                 self._step_count += 1
                 if self._debug_invariant:
                     # allocation-accounting invariant (ISSUE 5): spec
@@ -2084,6 +2419,15 @@ class ContinuousBatchingScheduler:
                     # (DS_SERVE_DEBUG=1; off by default — the scan is
                     # O(num_blocks) inside the scheduler lock)
                     self.block_mgr.check_invariant()
+                    if self.adapter_store is not None:
+                        # adapter census (ISSUE 20): every pinned row's
+                        # refcount must reconcile with the store's table
+                        census: Dict[str, int] = {}
+                        for r in self._slots:
+                            if r is not None and r.adapter_pinned:
+                                census[r.adapter_id] = \
+                                    census.get(r.adapter_id, 0) + 1
+                        self.adapter_store.check_invariant(census)
                 if active:
                     self.metrics.decode_occupancy.observe(
                         active / self.cfg.max_num_seqs)
@@ -2100,7 +2444,8 @@ class ContinuousBatchingScheduler:
             self.flightrec.record(
                 "serve/step", corr=f"serve-step-{step_id}",
                 dur_ms=round(dur_s * 1e3, 3), active=active,
-                queued=len(self._queue), finished=len(finished))
+                queued=len(self._queue), finished=len(finished),
+                version=self.weights_version)
             self.anomaly.observe("serve.step", dur_s,
                                  corr=f"serve-step-{step_id}")
             return finished
@@ -2147,6 +2492,25 @@ class ContinuousBatchingScheduler:
             if attempts:
                 self.metrics.gauges["kv_tier_hit_rate"] = round(
                     ts.swapins / attempts, 4)
+        st = self.adapter_store
+        if st is not None:
+            # adapter paging (ISSUE 20): store counters mirror in as
+            # serving/adapter_* counters; residency rides as gauges
+            s = st.summary()
+            c["adapter_swap_ins"] = s["swap_ins"]
+            c["adapter_demotions"] = s["demotions"]
+            c["adapter_spills"] = s["spills"]
+            c["adapter_dropped"] = s["dropped"]
+            c["adapter_load_failures"] = max(
+                c["adapter_load_failures"], s["load_failures"])
+            c["adapter_slot_waits"] = s["slot_waits"]
+            c["adapter_integrity_failures"] = s["integrity_failures"]
+            self.metrics.gauges.update(
+                adapter_resident_hbm=len(s["resident"]),
+                adapter_host=s["host_adapters"],
+                adapter_nvme=s["nvme_adapters"],
+                adapter_pending_swapins=len(self._adapter_pending),
+                adapter_quarantined=s["quarantined"])
         if elapsed > 0 and c["generated_tokens"]:
             self.metrics.gauges["tokens_per_s"] = round(
                 c["generated_tokens"] / elapsed, 3)

@@ -50,7 +50,8 @@ from deepspeed_tpu.resilience.health import (HealthMonitor, HealthState,
                                              SchedulerWatchdog, STATE_CODE)
 from deepspeed_tpu.serving.request import (AdmissionError, QueueFullError,
                                            RequestShedError,
-                                           SamplingParams)
+                                           SamplingParams,
+                                           UnknownAdapterError)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -108,6 +109,10 @@ def parse_generate_body(body: dict, default_timeout_s: float = 0.0):
         "priority": int(body.get("priority", 0)),
         "timeout_s": float(body.get("timeout_s", default_timeout_s)),
         "slo_class": str(body.get("slo_class", "default")),
+        # multi-tenant LoRA (ISSUE 20); unknown ids come back as a
+        # typed 400 (UnknownAdapterError), never a 500
+        "adapter_id": (str(body["adapter_id"])
+                       if body.get("adapter_id") is not None else None),
         # fleet session affinity (ISSUE 11); the single-replica
         # scheduler has nowhere to route by it and ignores it
         "session_id": (str(body["session_id"])
@@ -325,7 +330,8 @@ class _Handler(BaseHTTPRequestHandler):
                                         parsed["sampling"],
                                         priority=parsed["priority"],
                                         timeout_s=parsed["timeout_s"],
-                                        slo_class=parsed["slo_class"])
+                                        slo_class=parsed["slo_class"],
+                                        adapter_id=parsed["adapter_id"])
         except RequestShedError as e:
             # SLO admission control (ISSUE 9): saturated, and this
             # request's class is below the shed cutoff — bounded
@@ -340,6 +346,13 @@ class _Handler(BaseHTTPRequestHandler):
             # of hammering the full queue
             self._send_json(429, {"error": str(e)},
                             retry_after_s=self.scheduler.slo.retry_after_s)
+            return
+        except UnknownAdapterError as e:
+            # multi-tenant LoRA (ISSUE 20): a typo'd adapter_id is a
+            # client error — typed 400 + serving/adapter_unknown
+            # counter (bumped by submit), never a 500
+            self._send_json(400, {"error": str(e),
+                                  "unknown_adapter": True})
             return
         except AdmissionError as e:
             self._send_json(400, {"error": str(e)})

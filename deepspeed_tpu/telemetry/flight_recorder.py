@@ -98,6 +98,31 @@ KNOWN_EVENT_KINDS = {
              "comm/step (the per-train-step collective window closed: "
              "exposed/overlapped ms in fields), comm/denied (a denied "
              "comm.collective fault skipped the window)",
+    "req/adapter_attach": "admission pinned the request's LoRA adapter "
+                          "in an HBM slot (adapter/slot/tier in fields; "
+                          "ISSUE 20)",
+    "req/adapter_swap_in": "adapter not HBM-resident at admission; "
+                           "async swap-in scheduled and the request "
+                           "sits out this round (overlapped with the "
+                           "running decode)",
+    "req/adapter_fail": "adapter swap-in failed (adapter.load fault, "
+                        "corruption quarantine, or I/O error) and "
+                        "fallback_to_base is off — the request is "
+                        "rejected typed",
+    "req/adapter_fallback": "adapter swap-in failed and the request "
+                            "was degraded to the base model "
+                            "(serving.adapters.fallback_to_base)",
+    "adapter/": "prefix family: paged adapter-store lifecycle "
+                "(ISSUE 20) — adapter/demote (refcount-0 LRU victim "
+                "extracted from its HBM slot to host), adapter/spill "
+                "(host overflow pushed to NVMe), adapter/swap_in "
+                "(payload fetched and installed into an HBM slot), "
+                "adapter/load_fail (adapter.load fault or integrity "
+                "failure on the payload)",
+    "route/weights_swap": "live base-weight hot-swap: one replica "
+                          "drained, new params installed, replica "
+                          "re-admitted (version/moved in fields; "
+                          "ISSUE 20)",
     "postmortem": "a post-mortem bundle was written",
 }
 

@@ -50,6 +50,7 @@ SUBMODELS = {
     "serving.chunked_prefill": "ChunkedPrefillConfig",
     "serving.fleet": "FleetConfig",
     "serving.kv_tiering": "KvTieringConfig",
+    "serving.adapters": "AdaptersConfig",
     "resilience.retry": "RetryConfig",
     "resilience.offload": "OffloadIntegrityConfig",
     "telemetry.numerics": "NumericsConfig",
@@ -221,7 +222,12 @@ class Inventory:
         # flight-recorder kinds: flightrec.record("kind", ...) — also
         # the conditional ('a' if x else 'b') and prefix-family
         # (f"anomaly/{kind}") arg shapes the tree actually uses
-        if (attr == "record" and recv and _FLIGHT_RE.search(recv)
+        # both the direct flightrec.record(...) form and the repo's
+        # guard-helper idiom (``self._flight("kind", ...)`` delegating
+        # to an optional recorder — kv_tiering, offload engine, the
+        # adapter store)
+        if (((attr == "record" and recv and _FLIGHT_RE.search(recv))
+             or (attr == "_flight" and recv == "self"))
                 and rel != FLIGHTREC_PATH):
             for kind in _kind_values(node.args[0] if node.args else None,
                                      consts):

@@ -15,6 +15,9 @@ reference tables.
 ENV_VARS = {
     "DS_ACCELERATOR": "force the accelerator backend (tpu/cpu) instead "
                       "of auto-detection",
+    "DS_ADAPTERS": "0/1 disables/forces multi-tenant LoRA adapter "
+                   "serving (wins over serving.adapters.enabled; "
+                   "ISSUE 20)",
     "DS_BENCH_DIR": "bench-ledger directory override (default BENCH/; "
                     "scripts/bench_util.py)",
     "DS_BENCH_LEDGER": "1 appends BenchRecords from the bench scripts "
@@ -354,6 +357,42 @@ METRICS = {
                                 "gauge, labeled by replica",
     "fleet/prefix_cache_hit_rate": "fleet-aggregate prefix-cache hit "
                                    "rate gauge",
+    # --- serving: multi-tenant adapters (paged LoRA store, ISSUE 20)
+    "serving/adapter_unknown": "submissions naming an unregistered "
+                               "adapter_id (typed 4xx, never a 500)",
+    "serving/adapter_rejects": "requests terminally failed on adapter "
+                               "swap-in (no base fallback configured)",
+    "serving/adapter_fallbacks": "requests degraded to the base model "
+                                 "after an adapter swap-in failure",
+    "serving/adapter_load_failures": "adapter.load faults / integrity "
+                                     "failures during swap-in",
+    "serving/adapter_swap_ins": "adapters materialized into an HBM slot "
+                                "from the host/NVMe tiers",
+    "serving/adapter_demotions": "refcount-0 adapters demoted from HBM "
+                                 "to the host tier (LRU victims)",
+    "serving/adapter_spills": "host-tier adapters spilled onward to "
+                              "NVMe under max_host_adapters pressure",
+    "serving/adapter_dropped": "cold-tier adapter payloads dropped "
+                               "(re-ingest from the registry on next "
+                               "use)",
+    "serving/adapter_slot_waits": "swap-ins deferred because every HBM "
+                                  "slot was pinned by live requests",
+    "serving/adapter_integrity_failures": "adapter payload checksum "
+                                          "mismatches (key quarantined "
+                                          "in the offload engine)",
+    "serving/adapter_resident_hbm": "adapters HBM-resident gauge",
+    "serving/adapter_host": "adapters parked on the host tier gauge",
+    "serving/adapter_nvme": "adapters parked on NVMe gauge",
+    "serving/adapter_pending_swapins": "requests waiting on an adapter "
+                                       "swap-in gauge",
+    "serving/adapter_quarantined": "adapter keys in the engine's "
+                                   "quarantine ring gauge",
+    "serving/tenant_completed": "finished requests per tenant, labeled "
+                                "by adapter (\"base\" = no adapter)",
+    "serving/weights_swaps": "base-weight trees installed via "
+                             "install_params (live hot-swap)",
+    "fleet/weight_swaps": "fleet-wide base-weight rollouts completed "
+                          "through Router.swap_weights",
     # --- serving: SLO accounting
     "serving/slo_requests": "finished requests with SLO accounting, "
                             "labeled by class",

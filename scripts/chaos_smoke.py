@@ -23,7 +23,12 @@ kills the process:
   re-prefill (token-identical serving), flipped param shards rebuild
   from the fp32 masters (bitwise-identical losses), and a sustained
   swap.io outage trips the NVMe circuit breaker into host-only
-  degradation with every reverted entry still serving clean bytes.
+  degradation with every reverted entry still serving clean bytes;
+- adapter.load chaos (ISSUE 20): deny during a tenant's LoRA swap-in
+  rejects TYPED (or degrades to the base model under
+  serving.adapters.fallback_to_base) while the other tenant's stream
+  stays token-identical to the offline-merged oracle, and corrupted
+  adapter bytes quarantine through the checksum contract.
 
 Usage::
 
@@ -699,6 +704,115 @@ def case_fleet_replica_loss_resubmits():
     assert router._sessions.get("chaos") == h.replica_id
 
 
+def case_adapter_load_chaos():
+    """adapter.load chaos during LoRA swap-in (ISSUE 20): a deny storm
+    armed AFTER tenant A is resident gates only tenant B — B rejects
+    TYPED ("failed to load", adapter_rejects/load_failures counters at
+    /debug) while A's stream stays token-identical to the
+    offline-merged oracle; corrupt bytes at ingest quarantine the key
+    via the checksum contract; and with
+    serving.adapters.fallback_to_base the denied tenant degrades to
+    the BASE model (flagged on the response) instead of failing."""
+    import jax
+    import numpy as np
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from deepspeed_tpu.resilience import FaultInjector
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.runtime.lora import init_lora_params, merge_lora
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       RequestState, SamplingParams)
+    model = gpt2_model(size="custom", vocab_size=128, max_seq_len=64,
+                       num_layers=2, num_heads=4, d_model=32,
+                       dtype="float32", attention_impl="xla")
+    eng = deepspeed_tpu.init_inference(model=model,
+                                       config={"dtype": "float32"})
+
+    def mk_lora(seed):
+        # init_lora_params zeros B (merged == base); randomize it so the
+        # tenants are distinguishable from the base model
+        lora = init_lora_params(eng.params, rank=4,
+                                rng=jax.random.PRNGKey(seed))
+        r2 = np.random.default_rng(seed)
+        return {p: {"a": np.asarray(ab["a"]),
+                    "b": r2.normal(0, 0.05, ab["b"].shape).astype(
+                        np.float32)}
+                for p, ab in lora.items()}
+
+    def merged_ref(lora, prompt, max_new):
+        mp = (merge_lora(eng.params, lora, 1.0, freeze_base=False)
+              if lora else eng.params)
+        s = ContinuousBatchingScheduler(
+            model, mp, ServingConfig(block_size=8, num_blocks=64,
+                                     max_num_seqs=4))
+        r = s.submit(prompt, SamplingParams(max_new_tokens=max_new))
+        s.run_until_idle()
+        assert r.state == RequestState.FINISHED
+        return list(r.output_ids)
+
+    cfg = ServingConfig(block_size=8, num_blocks=64, max_num_seqs=4,
+                        adapters={"enabled": True,
+                                  "max_hbm_adapters": 2})
+    loraA, loraB = mk_lora(31), mk_lora(32)
+    sched = ContinuousBatchingScheduler(model, eng.params, cfg)
+    sched.register_adapter("A", lora_tree=loraA)
+    sched.register_adapter("B", lora_tree=loraB)
+    rng = np.random.default_rng(13)
+    pa, pb = [rng.integers(1, 128, (int(L),)).astype(np.int32)
+              for L in rng.integers(4, 12, 2)]
+    # tenant A materializes cleanly, THEN the deny storm arms so it
+    # gates only B's swap-in
+    ra = sched.submit(pa, SamplingParams(max_new_tokens=5),
+                      adapter_id="A")
+    while not sched.adapter_store.resident("A"):
+        sched.step()
+    sched.adapter_store.injector = FaultInjector("adapter.load:deny@*")
+    rb = sched.submit(pb, SamplingParams(max_new_tokens=5),
+                      adapter_id="B")
+    sched.run_until_idle()
+    sched.adapter_store.injector = FaultInjector([])
+    assert ra.state == RequestState.FINISHED
+    assert list(ra.output_ids) == merged_ref(loraA, pa, 5), \
+        "the surviving tenant drifted from the offline-merged oracle"
+    assert rb.state == RequestState.REJECTED
+    assert "failed to load" in rb.reject_reason, rb.reject_reason
+    assert sched.metrics.counters["adapter_rejects"] >= 1
+    dbg = sched.debug_scheduler()["adapters"]
+    assert dbg["load_failures"] >= 1, dbg
+
+    # corrupt bytes at ingest -> integrity failure + quarantine
+    sched.adapter_store.injector = \
+        FaultInjector("adapter.load:corrupt=4@*")
+    sched.register_adapter("C", lora_tree=mk_lora(33))
+    sched.adapter_store.injector = FaultInjector([])
+    rc = sched.submit(pa, SamplingParams(max_new_tokens=3),
+                      adapter_id="C")
+    sched.run_until_idle()
+    assert rc.state == RequestState.REJECTED
+    dbg = sched.debug_scheduler()["adapters"]
+    assert dbg["integrity_failures"] >= 1 and dbg["quarantined"] >= 1, \
+        dbg
+
+    # fallback_to_base: the denied tenant degrades instead of failing
+    cfg2 = ServingConfig(block_size=8, num_blocks=64, max_num_seqs=4,
+                         adapters={"enabled": True,
+                                   "max_hbm_adapters": 2,
+                                   "fallback_to_base": True})
+    s2 = ContinuousBatchingScheduler(model, eng.params, cfg2)
+    s2.register_adapter("A", lora_tree=loraA)
+    s2.adapter_store.injector = FaultInjector("adapter.load:deny@*")
+    rf = s2.submit(pa, SamplingParams(max_new_tokens=5),
+                   adapter_id="A")
+    s2.run_until_idle()
+    s2.adapter_store.injector = FaultInjector([])
+    assert rf.state == RequestState.FINISHED
+    assert rf.adapter_fallback and rf.adapter_id is None
+    assert list(rf.output_ids) == merged_ref(None, pa, 5), \
+        "base fallback drifted from the plain base-model trace"
+    assert s2.metrics.counters["adapter_fallbacks"] == 1
+    assert rf.to_response()["adapter_fallback"] is True
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="resilience chaos smoke")
     p.add_argument("--fast", action="store_true",
@@ -745,6 +859,8 @@ def main(argv=None):
                   case_offload_breaker_opens_host_only))
     cases.append(("fleet replica loss resubmits mid-stream",
                   case_fleet_replica_loss_resubmits))
+    cases.append(("adapter.load chaos rejects typed / falls back to base",
+                  case_adapter_load_chaos))
     cases.append(("train.nonfinite NaN attributed to its leaf group",
                   case_nonfinite_provenance))
     cases.append(("comm.collective stall raises anomaly/comm_*",

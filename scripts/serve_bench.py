@@ -14,6 +14,7 @@ the new serving families (NeoX/GPT-J/BLOOM/GPT-Neo).
     SERVE_MODE=spec SERVE_REQS=16 python scripts/serve_bench.py
     SERVE_MODE=prefix SERVE_REQS=24 python scripts/serve_bench.py
     SERVE_MODE=tier SERVE_REQS=16 python scripts/serve_bench.py
+    SERVE_MODE=lora SERVE_TENANTS=4 python scripts/serve_bench.py
     SERVE_MODE=moe python scripts/serve_bench.py            # mixtral A/B
     SERVE_MODE=moe SERVE_INT8_WEIGHTS=1 python scripts/serve_bench.py
     SERVE_MODE=slo SERVE_LONG_LEN=8192 python scripts/serve_bench.py
@@ -169,7 +170,7 @@ def main(argv=None):
         kwargs = {}
     elif os.environ.get("SERVE_MODE") in ("cb", "spec", "prefix", "moe",
                                           "slo", "fleet", "fused",
-                                          "tier"):
+                                          "tier", "lora"):
         # cb vs static is a scheduling comparison: a 2-layer d=32 toy is
         # ALL dispatch overhead and measures nothing — use the smallest
         # shape where device compute is non-trivial
@@ -182,7 +183,7 @@ def main(argv=None):
     # run a little longer than cb's heavy tail off-TPU)
     _mode = os.environ.get("SERVE_MODE")
     if _mode not in ("cb", "spec", "prefix", "moe", "slo", "fleet",
-                     "fused", "tier"):
+                     "fused", "tier", "lora"):
         cb_ctx = 0
     elif _mode == "slo":
         # headroom for the adversarial long prompts (heavy-prefill
@@ -237,6 +238,9 @@ def main(argv=None):
     if os.environ.get("SERVE_MODE") == "tier":
         return bench_kv_tiering(model, eng, spec, kv_dtype, on_tpu,
                                 json_path)
+    if os.environ.get("SERVE_MODE") == "lora":
+        return bench_lora_multitenant(model, eng, spec, kv_dtype, on_tpu,
+                                      json_path)
     if os.environ.get("SERVE_MODE") == "moe":
         return bench_moe_dispatch(model, eng, spec, kv_dtype, quant,
                                   on_tpu, json_path)
@@ -774,6 +778,150 @@ def bench_kv_tiering(model, eng, spec, kv_dtype, on_tpu,
                                    .get("read", {}).get("vs_floor")),
             "swap_write_vs_floor": (io_rows.get("ops", {})
                                     .get("write", {}).get("vs_floor")),
+        },
+    }, json_path)
+
+
+def bench_lora_multitenant(model, eng, spec, kv_dtype, on_tpu,
+                           json_path=None):
+    """Multi-tenant LoRA A/B (ISSUE 20): N tenants' adapters serve from
+    the paged AdapterStore with FEWER HBM slots than tenants, so the
+    round-robin workload keeps adapters paging between HBM and the host
+    tier (mixed hot/cold on purpose).  The paged run batches every
+    tenant — plus adapter-less base rows — into ONE unified window via
+    batched gather-LoRA; the A/B alternative is the dedicated-weights
+    deployment it replaces: one ``merge_lora`` scheduler per tenant,
+    serialized (no cross-tenant batching — that is the point).
+    Token-identical greedy outputs are ASSERTED between the two.  The
+    record carries both throughputs, the store's swap-in / demotion /
+    spill / slot-wait counters, the fraction of swap-in-pending steps
+    that still produced decode tokens (swap-in hidden behind running
+    decode), and per-tenant mean TTFT."""
+    import time as _time
+    import jax as _jax
+    from deepspeed_tpu.runtime.config import ServingConfig
+    from deepspeed_tpu.runtime.lora import init_lora_params, merge_lora
+    from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                       SamplingParams)
+
+    n_tenants = int(os.environ.get("SERVE_TENANTS", 6 if on_tpu else 4))
+    hbm_slots = int(os.environ.get(
+        "SERVE_HBM_ADAPTERS", max(2, n_tenants // 2) if on_tpu else 2))
+    n_reqs = int(os.environ.get("SERVE_REQS", 24 if on_tpu else 12))
+    max_seqs = int(os.environ.get("SERVE_B", 8 if on_tpu else 4))
+    rng = np.random.default_rng(0)
+    V = model.config.vocab_size
+    p_lo, p_hi = ((32, 128) if on_tpu else (4, 12))
+    n_lo, n_hi = ((32, 96) if on_tpu else (4, 10))
+
+    def mk_lora(seed):
+        # init_lora_params zeros B (merged == base) — randomize it so
+        # every tenant is distinguishable from the base model
+        lora = init_lora_params(eng.params, rank=4,
+                                rng=_jax.random.PRNGKey(seed))
+        r2 = np.random.default_rng(seed)
+        return {p: {"a": np.asarray(ab["a"]),
+                    "b": r2.normal(0, 0.05, ab["b"].shape).astype(
+                        np.float32)}
+                for p, ab in lora.items()}
+
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    loras = {t: mk_lora(100 + i) for i, t in enumerate(tenants)}
+    # round-robin over base + every tenant: adapter-less rows ride the
+    # same unified window and must skip the gather-LoRA pass exactly
+    ids = [None] + tenants
+    workload = []
+    for i in range(n_reqs):
+        prompt = rng.integers(
+            1, V, (int(rng.integers(p_lo, p_hi)),)).astype(np.int32)
+        workload.append((ids[i % len(ids)], prompt,
+                         int(rng.integers(n_lo, n_hi))))
+    useful = sum(nn for _, _, nn in workload)
+
+    bs = 16 if on_tpu else 8
+    max_len = max(p.size + nn for _, p, nn in workload)
+    need = -(-max_len // bs) + 1
+    base = dict(block_size=bs, max_num_seqs=max_seqs,
+                num_blocks=1 + need * (max_seqs + 1),
+                max_num_batched_tokens=1 << 30)
+
+    # paged run: adapters register COLD (host tier); fewer HBM slots
+    # than tenants keeps the store paging under the round-robin
+    cfg = ServingConfig(**base, adapters={"enabled": True,
+                                          "max_hbm_adapters": hbm_slots})
+    sched = ContinuousBatchingScheduler(model, eng.params, cfg,
+                                        kv_cache_dtype=kv_dtype)
+    for t in tenants:
+        sched.register_adapter(t, lora_tree=loras[t])
+    reqs = [sched.submit(p, SamplingParams(max_new_tokens=nn),
+                         adapter_id=t)
+            for t, p, nn in workload]
+    pending_steps = overlap_steps = 0
+    decoded_prev = 0
+    t0 = _time.time()
+    while sched.has_work():
+        waiting = bool(sched._adapter_pending)
+        sched.step()
+        decoded = sum(len(r.output_ids) for r in reqs)
+        if waiting:
+            pending_steps += 1
+            if decoded > decoded_prev:
+                overlap_steps += 1   # swap-in hid behind running decode
+        decoded_prev = decoded
+    paged_s = _time.time() - t0
+    paged_out = [list(r.output_ids) for r in reqs]
+    assert all(len(o) == nn
+               for o, (_, _, nn) in zip(paged_out, workload))
+
+    ttft = {}
+    for (t, _, _), r in zip(workload, reqs):
+        ttft.setdefault(t or "base", []).append(r.ttft_s * 1e3)
+    ttft_ms = {k: round(float(np.mean(v)), 3)
+               for k, v in sorted(ttft.items())}
+
+    # merged A/B: the dedicated-weights alternative — one offline
+    # merge_lora scheduler per tenant, serialized; the parity oracle
+    merged_out = [None] * len(workload)
+    t0 = _time.time()
+    for t in ids:
+        mp = (merge_lora(eng.params, loras[t], 1.0, freeze_base=False)
+              if t else eng.params)
+        s2 = ContinuousBatchingScheduler(model, mp, ServingConfig(**base),
+                                         kv_cache_dtype=kv_dtype)
+        mine = [(j, p, nn) for j, (tt, p, nn) in enumerate(workload)
+                if tt == t]
+        rs = [s2.submit(p, SamplingParams(max_new_tokens=nn))
+              for _, p, nn in mine]
+        s2.run_until_idle()
+        for (j, _, _), r in zip(mine, rs):
+            merged_out[j] = list(r.output_ids)
+    merged_s = _time.time() - t0
+    assert paged_out == merged_out, \
+        "paged gather-LoRA drifted from the offline-merged oracle"
+
+    st = sched.adapter_store.summary()
+    emit({
+        "metric": f"{spec}_serve_lora"
+                  + ("_int8kv" if kv_dtype == "int8" else ""),
+        "value": round(useful / paged_s, 1),
+        "unit": "tokens_per_sec",
+        "detail": {
+            "tenants": n_tenants, "hbm_adapter_slots": hbm_slots,
+            "requests": n_reqs, "useful_tokens": useful,
+            "max_num_seqs": max_seqs, "block_size": bs,
+            "paged_tok_s": round(useful / paged_s, 1),
+            "merged_tok_s": round(useful / merged_s, 1),
+            "token_identical": True,
+            "swap_ins": int(st["swap_ins"]),
+            "demotions": int(st["demotions"]),
+            "spills": int(st["spills"]),
+            "slot_waits": int(st["slot_waits"]),
+            "swapin_pending_steps": pending_steps,
+            "swapin_overlap_steps": overlap_steps,
+            "swapin_overlap_fraction": (
+                round(overlap_steps / pending_steps, 3)
+                if pending_steps else None),
+            "ttft_ms_by_tenant": ttft_ms,
         },
     }, json_path)
 

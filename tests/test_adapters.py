@@ -1,0 +1,685 @@
+"""Multi-tenant adapter serving (ISSUE 20 tentpole): paged LoRA store
+with tiered spill, batched gather-LoRA in the unified window, and live
+base-weight hot-swap.
+
+The load-bearing contracts:
+- paged gather-LoRA output == offline ``merge_lora`` weights
+  token-for-token (the jnp reference path), per tenant, INCLUDING the
+  int8 KV cache, prefix cache on, speculative decoding, and across
+  preemption/resume with the adapter demoted to a cold tier in between;
+- adapter-less rows skip the LoRA pass exactly (base trace unchanged);
+- prefix-cache block hashes are salted by ``adapter_id`` — tenants
+  sharing a prompt can never hit each other's cached KV;
+- an unknown ``adapter_id`` fails TYPED (4xx + counter), never a 500;
+- ``adapter.load`` chaos (deny/corrupt) fails only the targeted
+  tenant's requests (or degrades them to base per
+  ``serving.adapters.fallback_to_base``) — other tenants stay
+  token-identical;
+- ``Router.swap_weights`` rolls the fleet one replica at a time with
+  zero failed requests and a ``weights_version`` label on /metrics.
+"""
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.resilience.faults import FaultInjector
+from deepspeed_tpu.runtime.config import ServingConfig
+from deepspeed_tpu.runtime.lora import init_lora_params, merge_lora
+from deepspeed_tpu.serving import (ContinuousBatchingScheduler,
+                                   RequestState, SamplingParams)
+from deepspeed_tpu.serving.adapters import (AdapterRegistry,
+                                            adapters_enabled,
+                                            load_adapter_file,
+                                            save_adapter)
+from deepspeed_tpu.serving.request import UnknownAdapterError
+from tests.util import tiny_gpt2
+
+
+@pytest.fixture(autouse=True)
+def _debug_invariant(monkeypatch):
+    """DS_SERVE_DEBUG stays armed across this suite: every step asserts
+    the block-pool invariant AND the AdapterStore invariants (slot
+    bijection, pin census vs live requests, single-tier residency)."""
+    monkeypatch.setenv("DS_SERVE_DEBUG", "1")
+
+
+@pytest.fixture(scope="module")
+def served():
+    m = tiny_gpt2()
+    eng = deepspeed_tpu.init_inference(model=m, config={"dtype": "float32"})
+    return m, eng
+
+
+def _mixed_prompts(n=3, seed=0, lo=4, hi=12):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 128, (int(L),)).astype(np.int32)
+            for L in rng.integers(lo, hi, n)]
+
+
+def _mk_lora(params, seed, rank=4):
+    """A fresh adapter with a RANDOMIZED B (init_lora_params zeros B so
+    merged == base — useless for distinguishing tenants)."""
+    lora = init_lora_params(params, rank=rank, rng=jax.random.PRNGKey(seed))
+    r2 = np.random.default_rng(seed)
+    return {p: {"a": np.asarray(ab["a"]),
+                "b": r2.normal(0, 0.05, ab["b"].shape).astype(np.float32)}
+            for p, ab in lora.items()}
+
+
+def _merged_reference(m, params, lora, prompt, max_new, scale=1.0,
+                      cfg=None, kv_cache_dtype=None):
+    """The offline-merge parity oracle: a base-only scheduler over
+    ``merge_lora``-ed weights."""
+    mp = (merge_lora(params, lora, scale, freeze_base=False)
+          if lora else params)
+    cfg = cfg or ServingConfig(block_size=8, num_blocks=64, max_num_seqs=4)
+    s = ContinuousBatchingScheduler(m, mp, cfg,
+                                    kv_cache_dtype=kv_cache_dtype)
+    r = s.submit(prompt, SamplingParams(max_new_tokens=max_new))
+    s.run_until_idle()
+    assert r.state == RequestState.FINISHED
+    return list(r.output_ids)
+
+
+def _adapter_cfg(**kw):
+    ad = kw.pop("adapters", {})
+    ad.setdefault("enabled", True)
+    ad.setdefault("max_hbm_adapters", 2)
+    base = dict(block_size=8, num_blocks=64, max_num_seqs=4,
+                adapters=ad)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+# ----------------------------------------------------------- registry unit
+def test_adapter_registry_validation(served):
+    m, eng = served
+    reg = AdapterRegistry(max_rank=4)
+    lora = _mk_lora(eng.params, 1, rank=4)
+    man = reg.register("A", lora)
+    assert man.rank == 4 and man.scale == 1.0
+    assert set(man.targets) == {"qkv_w", "proj_w"}
+    assert man.crc32 and man.nbytes > 0
+    assert "A" in reg and reg.get("A") is man
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("A", lora)
+    with pytest.raises(ValueError, match="rank"):
+        reg.register("big", _mk_lora(eng.params, 2, rank=6))
+    with pytest.raises(ValueError, match="no target arrays"):
+        reg.register("empty", {})
+    # alpha rescales: scale = alpha / rank
+    man2 = reg.register("B", _mk_lora(eng.params, 3), alpha=8.0)
+    assert man2.scale == 2.0
+    # take_arrays pops exactly once (paging owns the bytes after)
+    assert reg.take_arrays("A") is not None
+    assert reg.take_arrays("A") is None
+    reg.unregister("A")
+    assert "A" not in reg
+
+
+def test_adapter_file_roundtrip(served, tmp_path):
+    m, eng = served
+    lora = _mk_lora(eng.params, 5)
+    path = save_adapter(str(tmp_path / "t.npz"), lora, alpha=8.0)
+    tree, alpha = load_adapter_file(path)
+    assert alpha == 8.0
+    for p, ab in lora.items():
+        t = p.split("/")[-1]
+        np.testing.assert_array_equal(tree[t]["a"], ab["a"])
+        np.testing.assert_array_equal(tree[t]["b"], ab["b"])
+    reg = AdapterRegistry(max_rank=8)
+    man = reg.register_file("T", path)
+    assert man.rank == 4 and man.scale == 2.0 and man.source == path
+
+
+# ----------------------------------------------------------- config plumbing
+def test_adapters_config_roundtrip(tmp_path):
+    cfg = ServingConfig(adapters={"enabled": True, "max_hbm_adapters": 3,
+                                  "max_rank": 16,
+                                  "adapters": {"a": "/x/a.npz"},
+                                  "slo_class_map": {"a": "strict"},
+                                  "fallback_to_base": True,
+                                  "max_host_adapters": 5,
+                                  "nvme_dir": str(tmp_path)})
+    ad = cfg.adapters
+    assert ad.enabled and ad.max_hbm_adapters == 3 and ad.max_rank == 16
+    assert ad.adapters == {"a": "/x/a.npz"}
+    assert ad.slo_class_map == {"a": "strict"}
+    assert ad.fallback_to_base and ad.max_host_adapters == 5
+    assert not ServingConfig().adapters.enabled       # off by default
+    with pytest.raises(ValueError, match="max_hbm_adapters"):
+        ServingConfig(adapters={"max_hbm_adapters": 0})
+    with pytest.raises(ValueError, match="max_rank"):
+        ServingConfig(adapters={"max_rank": 0})
+    with pytest.raises(ValueError, match="slo_class_map"):
+        ServingConfig(adapters={"slo_class_map": ["a"]})
+    with pytest.raises(ValueError, match="adapters.adapters"):
+        ServingConfig(adapters={"adapters": ["a"]})
+
+
+def test_adapters_env_override(monkeypatch):
+    cfg = ServingConfig(adapters={"enabled": True}).adapters
+    assert adapters_enabled(cfg)
+    monkeypatch.setenv("DS_ADAPTERS", "0")
+    assert not adapters_enabled(cfg)
+    monkeypatch.setenv("DS_ADAPTERS", "1")
+    assert adapters_enabled(ServingConfig().adapters)
+
+
+# ----------------------------------------------------- store paging + tiers
+def test_adapter_store_paging_and_spill(served, tmp_path):
+    """Direct store drive: ingest -> host, host-cap overflow spills
+    oldest to NVMe, swap-in demotes the LRU refcount-0 resident, a
+    pinned adapter is never a victim — and the invariant checker signs
+    off after every transition."""
+    from deepspeed_tpu.runtime.config import AdaptersConfig
+    from deepspeed_tpu.serving.adapters import AdapterStore
+    m, eng = served
+    reg = AdapterRegistry(max_rank=4)
+    cfg = AdaptersConfig(enabled=True, max_hbm_adapters=1, max_rank=4,
+                         max_host_adapters=1, nvme_dir=str(tmp_path))
+    # block shapes straight off the tiny model's stacked params
+    shapes = {t: tuple(np.shape(eng.params["blocks"][t]))
+              for t in ("qkv_w", "proj_w")}
+    st = AdapterStore(reg, cfg, shapes)
+    try:
+        for i, aid in enumerate(("A", "B", "C")):
+            reg.register(aid, _mk_lora(eng.params, 10 + i))
+            assert st.ingest(aid)
+            st.check_invariant()
+        s = st.summary()
+        # host cap 1: A and B spilled onward to NVMe oldest-first
+        assert s["host_adapters"] == 1 and s["nvme_adapters"] == 2
+        assert s["spills"] == 2
+        assert st.residency_digest() == {"A": "nvme", "B": "nvme",
+                                         "C": "host"}
+        # swap A in from NVMe
+        assert st.schedule_swapin("A")
+        assert st.swap_in("A") == ("ok", 0)
+        assert st.resident("A") and st.slot_of("A") == 0
+        st.check_invariant()
+        # pinned A blocks the only slot: B must wait, not demote it
+        st.acquire("A")
+        assert st.swap_in("B") == ("wait", None)
+        assert st.summary()["slot_waits"] == 1
+        # released -> refcount-0 A is the LRU victim for B's swap-in
+        st.release("A")
+        status, slot = st.swap_in("B")
+        assert status == "ok" and slot == 0
+        assert not st.resident("A")
+        st.check_invariant()
+        s = st.summary()
+        assert s["demotions"] == 1 and s["swap_ins"] == 2
+        assert st.residency_digest()["A"] in ("host", "nvme")
+        # round-trip integrity: A re-materializes bit-exact
+        st.release("B")
+        assert st.swap_in("A")[0] == "ok"
+        st.check_invariant()
+    finally:
+        st.close()
+
+
+# ------------------------------------------------------------------ parity
+def test_adapter_parity_paged_vs_merged(served):
+    """Acceptance: batched gather-LoRA (mixed tenants + a base row in
+    ONE window program) == per-tenant offline-merged weights,
+    token-for-token, prefix cache on."""
+    m, eng = served
+    loraA, loraB = _mk_lora(eng.params, 1), _mk_lora(eng.params, 2)
+    cfg = _adapter_cfg(prefix_cache={"enabled": True})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=loraA)
+    s.register_adapter("B", lora_tree=loraB)
+    prompts = _mixed_prompts(3, seed=1)
+    aids = [None, "A", "B"]
+    reqs = [s.submit(p, SamplingParams(max_new_tokens=6), adapter_id=a)
+            for p, a in zip(prompts, aids)]
+    s.run_until_idle()
+    ref_cfg = ServingConfig(block_size=8, num_blocks=64, max_num_seqs=4,
+                            prefix_cache={"enabled": True})
+    for p, a, r in zip(prompts, aids, reqs):
+        assert r.state == RequestState.FINISHED
+        lora = {"A": loraA, "B": loraB}.get(a)
+        assert list(r.output_ids) == _merged_reference(
+            m, eng.params, lora, p, 6, cfg=ref_cfg)
+    # both adapters came up through the paging tiers (ingest -> host ->
+    # demand swap-in), not via some side door
+    assert s.adapter_store.summary()["swap_ins"] == 2
+    assert 'weights_version="v1"' in s.render_metrics()
+
+
+def test_adapter_parity_int8_kv(served):
+    """Same parity with the quantized KV-cache pool: both sides see the
+    same activations, so the int8 round-trip stays token-identical."""
+    m, _ = served
+    eng8 = deepspeed_tpu.init_inference(
+        model=m, config={"dtype": "float32", "kv_cache_dtype": "int8"})
+    loraA = _mk_lora(eng8.params, 3)
+    cfg = _adapter_cfg()
+    s = ContinuousBatchingScheduler(m, eng8.params, cfg,
+                                    kv_cache_dtype="int8")
+    s.register_adapter("A", lora_tree=loraA)
+    prompts = _mixed_prompts(2, seed=4)
+    reqs = [s.submit(p, SamplingParams(max_new_tokens=5), adapter_id=a)
+            for p, a in zip(prompts, [None, "A"])]
+    s.run_until_idle()
+    ref_cfg = ServingConfig(block_size=8, num_blocks=64, max_num_seqs=4)
+    for p, a, r in zip(prompts, [None, "A"], reqs):
+        assert list(r.output_ids) == _merged_reference(
+            m, eng8.params, loraA if a else None, p, 5, cfg=ref_cfg,
+            kv_cache_dtype="int8")
+
+
+def test_adapter_batch_invariance_int8_weights(served):
+    """int8 WEIGHTS x adapters: the fp32 LoRA delta rides on the
+    fused-dequant base matmul, so the merged-weights oracle doesn't
+    apply (quantization isn't linear) — the contract here is batch
+    invariance: a mixed multi-tenant window == the same requests run
+    solo, token-for-token."""
+    m, eng = served
+    engq = deepspeed_tpu.init_inference(
+        model=m, config={"dtype": "float32", "quant": {"enabled": True}})
+    # quantized leaves are QuantizedTensors — derive the adapter from
+    # the fp32 tree (same logical shapes)
+    loraA = _mk_lora(eng.params, 6)
+    prompts = _mixed_prompts(2, seed=5)
+    aids = [None, "A"]
+
+    def run(batched):
+        s = ContinuousBatchingScheduler(m, engq.params, _adapter_cfg())
+        s.register_adapter("A", lora_tree=loraA)
+        outs = []
+        if batched:
+            reqs = [s.submit(p, SamplingParams(max_new_tokens=5),
+                             adapter_id=a)
+                    for p, a in zip(prompts, aids)]
+            s.run_until_idle()
+            outs = [list(r.output_ids) for r in reqs]
+        else:
+            for p, a in zip(prompts, aids):
+                r = s.submit(p, SamplingParams(max_new_tokens=5),
+                             adapter_id=a)
+                s.run_until_idle()
+                outs.append(list(r.output_ids))
+        return outs
+
+    assert run(batched=True) == run(batched=False)
+
+
+def test_adapter_parity_spec_decode(served):
+    """Speculative decoding x adapters: greedy spec parity holds per
+    tenant against the merged-weights oracle (draft/verify both see the
+    gather-LoRA pass)."""
+    m, eng = served
+    loraA = _mk_lora(eng.params, 7)
+    cfg = _adapter_cfg(spec={"mode": "ngram", "max_draft_tokens": 4},
+                       max_num_batched_tokens=256)
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=loraA)
+    prompts = _mixed_prompts(2, seed=8, lo=6, hi=10)
+    reqs = [s.submit(p, SamplingParams(max_new_tokens=8), adapter_id=a)
+            for p, a in zip(prompts, [None, "A"])]
+    s.run_until_idle()
+    for p, a, r in zip(prompts, [None, "A"], reqs):
+        assert r.state == RequestState.FINISHED
+        assert list(r.output_ids) == _merged_reference(
+            m, eng.params, loraA if a else None, p, 8)
+
+
+def test_adapter_preempt_resume_with_cold_tier(served, tmp_path):
+    """Preempt/resume x paging: pool pressure preempts the low-priority
+    tenant, its adapter demotes through host toward NVMe while it sits
+    queued, and the resumed stream still matches the merged oracle —
+    recompute-on-resume swap-ins the adapter back from the cold tier."""
+    m, eng = served
+    loraA, loraB = _mk_lora(eng.params, 11), _mk_lora(eng.params, 12)
+    cfg = ServingConfig(
+        block_size=4, num_blocks=8, max_num_seqs=2,
+        max_num_batched_tokens=64,
+        adapters={"enabled": True, "max_hbm_adapters": 2,
+                  "max_host_adapters": 1, "nvme_dir": str(tmp_path)})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=loraA)
+    s.register_adapter("B", lora_tree=loraB)
+    # host cap 1: B's ingest already pushed A onward to NVMe
+    assert s.adapter_store.summary()["nvme_adapters"] >= 1
+    pa, pb = _mixed_prompts(2, seed=6, lo=6, hi=7)
+    ra = s.submit(pa, SamplingParams(max_new_tokens=10), priority=1,
+                  adapter_id="A")
+    rb = s.submit(pb, SamplingParams(max_new_tokens=10), priority=0,
+                  adapter_id="B")
+    s.run_until_idle()
+    assert s.metrics.counters["preemptions"] >= 1
+    assert rb.num_preemptions >= 1            # lower priority = victim
+    for p, lora, r in ((pa, loraA, ra), (pb, loraB, rb)):
+        assert r.state == RequestState.FINISHED
+        assert list(r.output_ids) == _merged_reference(
+            m, eng.params, lora, p, 10)
+    st = s.adapter_store.summary()
+    assert st["swap_ins"] >= 2                # both tenants materialized
+    assert s.block_mgr.num_allocated_blocks == 0
+    # eviction released every pin
+    assert s.adapter_store.refcounts() == {}
+
+
+# --------------------------------------------------- cross-tenant isolation
+def test_prefix_salt_prevents_cross_tenant_hits(served):
+    """Regression: UNSALTED chain hashes for two tenants sharing a
+    prompt are identical (they WOULD collide — one tenant would serve
+    from the other's KV); the adapter_id salt separates them, and the
+    end-to-end outputs match each tenant's own oracle even when tenant
+    B replays tenant A's exact prompt against a warm cache."""
+    from deepspeed_tpu.serving.block_manager import BlockManager
+    tokens = (1, 2, 3, 4)
+    unsalted = BlockManager._chain_hash(None, tokens)
+    assert unsalted == BlockManager._chain_hash(None, tokens)
+    a = BlockManager._chain_hash(None, tokens, salt="A")
+    b = BlockManager._chain_hash(None, tokens, salt="B")
+    assert len({unsalted, a, b}) == 3
+
+    m, eng = served
+    loraA, loraB = _mk_lora(eng.params, 21), _mk_lora(eng.params, 22)
+    cfg = _adapter_cfg(prefix_cache={"enabled": True})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=loraA)
+    s.register_adapter("B", lora_tree=loraB)
+    prompt = _mixed_prompts(1, seed=9, lo=10, hi=11)[0]
+    # wave 1: tenant A commits its blocks into the cache
+    r1 = s.submit(prompt, SamplingParams(max_new_tokens=5),
+                  adapter_id="A")
+    s.run_until_idle()
+    # wave 2: same prompt as B, as base, and as A again
+    r2 = s.submit(prompt, SamplingParams(max_new_tokens=5),
+                  adapter_id="B")
+    r3 = s.submit(prompt, SamplingParams(max_new_tokens=5))
+    r4 = s.submit(prompt, SamplingParams(max_new_tokens=5),
+                  adapter_id="A")
+    s.run_until_idle()
+    for lora, r in ((loraA, r1), (loraB, r2), (None, r3), (loraA, r4)):
+        assert list(r.output_ids) == _merged_reference(
+            m, eng.params, lora, prompt, 5)
+    # A's replay hit its own salted prefix; B/base could not
+    assert s.metrics.counters["prefix_cache_hit"] >= 1
+
+
+# ------------------------------------------------------- typed failure paths
+def test_unknown_adapter_rejects_typed(served):
+    m, eng = served
+    s = ContinuousBatchingScheduler(m, eng.params, _adapter_cfg())
+    prompt = _mixed_prompts(1, seed=3)[0]
+    with pytest.raises(UnknownAdapterError):
+        s.submit(prompt, SamplingParams(max_new_tokens=2),
+                 adapter_id="nope")
+    assert s.metrics.counters["adapter_unknown"] == 1
+    # adapters disabled entirely: same typed error, never a crash
+    s2 = ContinuousBatchingScheduler(
+        m, eng.params, ServingConfig(block_size=8, num_blocks=32))
+    with pytest.raises(UnknownAdapterError):
+        s2.submit(prompt, SamplingParams(max_new_tokens=2),
+                  adapter_id="anything")
+
+
+def test_adapter_chaos_deny_and_corrupt(served):
+    """adapter.load chaos during swap-in: the targeted tenant fails
+    TYPED (reject + counters at /debug); corruption quarantines the key
+    through the PR 18 integrity contract; the OTHER tenant's stream is
+    token-identical throughout."""
+    m, eng = served
+    loraA, loraB = _mk_lora(eng.params, 31), _mk_lora(eng.params, 32)
+    s = ContinuousBatchingScheduler(m, eng.params, _adapter_cfg())
+    s.register_adapter("A", lora_tree=loraA)
+    s.register_adapter("B", lora_tree=loraB)
+    pa, pb = _mixed_prompts(2, seed=13)
+    # let tenant A materialize cleanly, THEN arm the deny storm so it
+    # gates only B's swap-in
+    ra = s.submit(pa, SamplingParams(max_new_tokens=5), adapter_id="A")
+    while not s.adapter_store.resident("A"):
+        s.step()
+    s.adapter_store.injector = FaultInjector("adapter.load:deny@*")
+    rb = s.submit(pb, SamplingParams(max_new_tokens=5), adapter_id="B")
+    s.run_until_idle()
+    s.adapter_store.injector = FaultInjector([])
+    assert ra.state == RequestState.FINISHED
+    assert list(ra.output_ids) == _merged_reference(
+        m, eng.params, loraA, pa, 5)
+    assert rb.state == RequestState.REJECTED
+    assert "failed to load" in rb.reject_reason
+    assert s.metrics.counters["adapter_rejects"] >= 1
+    dbg = s.debug_scheduler()["adapters"]
+    assert dbg["load_failures"] >= 1
+
+    # corruption at ingest -> integrity failure + quarantine at swap-in
+    s.adapter_store.injector = FaultInjector("adapter.load:corrupt=4@*")
+    s.register_adapter("C", lora_tree=_mk_lora(eng.params, 33))
+    s.adapter_store.injector = FaultInjector([])
+    rc = s.submit(pa, SamplingParams(max_new_tokens=3), adapter_id="C")
+    s.run_until_idle()
+    assert rc.state == RequestState.REJECTED
+    dbg = s.debug_scheduler()["adapters"]
+    assert dbg["integrity_failures"] >= 1 and dbg["quarantined"] >= 1
+
+
+def test_adapter_chaos_fallback_to_base(served):
+    """serving.adapters.fallback_to_base: the failed tenant degrades to
+    the BASE model (flagged on the response) instead of rejecting."""
+    m, eng = served
+    loraA = _mk_lora(eng.params, 41)
+    cfg = _adapter_cfg(adapters={"fallback_to_base": True})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=loraA)
+    p = _mixed_prompts(1, seed=14)[0]
+    s.adapter_store.injector = FaultInjector("adapter.load:deny@*")
+    r = s.submit(p, SamplingParams(max_new_tokens=5), adapter_id="A")
+    s.run_until_idle()
+    s.adapter_store.injector = FaultInjector([])
+    assert r.state == RequestState.FINISHED
+    assert r.adapter_fallback and r.adapter_id is None
+    assert list(r.output_ids) == _merged_reference(
+        m, eng.params, None, p, 5)
+    assert s.metrics.counters["adapter_fallbacks"] == 1
+    assert r.to_response()["adapter_fallback"] is True
+
+
+# ------------------------------------------------------------- QoS mapping
+def test_adapter_slo_class_mapping(served):
+    """Per-tenant SLO classes: adapter_id maps onto the ISSUE 9 QoS
+    ladder when the request doesn't name a class itself."""
+    m, eng = served
+    cfg = _adapter_cfg(
+        adapters={"slo_class_map": {"A": "strict"}},
+        slo={"classes": {"strict": {"ttft_ms": 50, "weight": 4.0}}})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    s.register_adapter("A", lora_tree=_mk_lora(eng.params, 51))
+    s.register_adapter("B", lora_tree=_mk_lora(eng.params, 52),
+                       slo_class="strict")   # manifest-registered class
+    p = _mixed_prompts(1, seed=15)[0]
+    ra = s.submit(p, SamplingParams(max_new_tokens=2), adapter_id="A")
+    rb = s.submit(p, SamplingParams(max_new_tokens=2), adapter_id="B")
+    rc = s.submit(p, SamplingParams(max_new_tokens=2), adapter_id="A",
+                  slo_class="default")
+    assert ra.slo_class == "strict" and rb.slo_class == "strict"
+    assert rc.slo_class == "strict"
+    s.run_until_idle()
+    text = s.render_metrics()
+    assert 'weights_version="v1"' in text
+    # per-tenant completion counter, labeled by adapter
+    assert 'adapter="A"' in text and 'adapter="B"' in text
+
+
+# ------------------------------------------------------------- HTTP surface
+def test_http_generate_adapter_end_to_end(served):
+    """/generate carries adapter_id; unknown ids are a typed 400 with
+    the serving/adapter_unknown counter bumped — never a 500."""
+    from deepspeed_tpu.serving.server import make_server
+    m, eng = served
+    loraA = _mk_lora(eng.params, 61)
+    s = ContinuousBatchingScheduler(m, eng.params, _adapter_cfg())
+    s.register_adapter("A", lora_tree=loraA)
+    httpd, loop = make_server(s, port=0)
+    loop.start()
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_port}"
+    try:
+        prompt = _mixed_prompts(1, seed=16)[0]
+        body = json.dumps({"input_ids": prompt.tolist(),
+                           "max_new_tokens": 4,
+                           "adapter_id": "A"}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            out = json.loads(resp.read())
+        assert out["adapter_id"] == "A"
+        assert out["output_ids"] == _merged_reference(
+            m, eng.params, loraA, prompt, 4)
+        bad = json.dumps({"input_ids": prompt.tolist(),
+                          "max_new_tokens": 4,
+                          "adapter_id": "ghost"}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=bad,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+        payload = json.loads(ei.value.read())
+        assert payload["unknown_adapter"] is True
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'serving_adapter_unknown{weights_version="v1"} 1' in text
+    finally:
+        httpd.shutdown()
+        loop.shutdown()
+        httpd.server_close()
+
+
+def test_ds_serve_adapters_flag(served, tmp_path):
+    """--adapters name=path,... lands in serving.adapters and the
+    npz round-trips through scheduler construction."""
+    import subprocess
+    import sys
+    m, eng = served
+    path = save_adapter(str(tmp_path / "a.npz"),
+                        _mk_lora(eng.params, 71))
+    r = subprocess.run([sys.executable, "bin/ds_serve", "--help"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0 and "--adapters" in r.stdout
+    # the construction path: config names the file, scheduler registers
+    # + ingests at build time
+    cfg = _adapter_cfg(adapters={"adapters": {"T": path}})
+    s = ContinuousBatchingScheduler(m, eng.params, cfg)
+    assert "T" in s.adapter_registry.ids()
+    assert s.adapter_store.residency_digest()["T"] == "host"
+
+
+# -------------------------------------------------------- fleet: hot swap
+def test_fleet_hot_swap_weights(served):
+    """Acceptance: a 2-replica rolling base-weight swap completes with
+    ZERO failed requests — in-flight streams extract, resubmit, and
+    finish token-identically; every replica lands on the new version
+    and /metrics carries the weights_version label."""
+    from deepspeed_tpu.serving.fleet.replica import Replica
+    from deepspeed_tpu.serving.fleet.router import Router
+    from deepspeed_tpu.telemetry.flight_recorder import FlightRecorder
+    m, eng = served
+    rec = FlightRecorder(4096)
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        max_fused_steps=1,
+                        adapters={"enabled": True},
+                        fleet={"num_replicas": 2, "digest_refresh_s": 0})
+    reps = [Replica(i, m, eng.params, cfg, flightrec=rec)
+            for i in range(2)]
+    router = Router(reps, cfg.fleet, flightrec=rec)
+    loraA = _mk_lora(eng.params, 81)
+    for rep in reps:
+        rep.scheduler.register_adapter("A", lora_tree=loraA)
+    prompts = _mixed_prompts(4, seed=17)
+    aids = [None, "A", None, "A"]
+    handles = [router.submit(p, SamplingParams(max_new_tokens=10),
+                             adapter_id=a)
+               for p, a in zip(prompts, aids)]
+    # commit a few tokens so the roll catches streams mid-flight
+    for _ in range(3):
+        for rep in reps:
+            if rep.scheduler.has_work():
+                rep.scheduler.step()
+    # value-identical new tree: proves zero-loss mechanics while keeping
+    # the token-identity oracle exact for resubmitted streams
+    new_params = jax.tree_util.tree_map(lambda x: x, eng.params)
+    out = router.swap_weights(new_params, "v2")
+    assert out["version"] == "v2"
+    assert len(out["replicas"]) == 2
+    router.run_until_idle()
+    for p, a, h in zip(prompts, aids, handles):
+        assert h.state == "finished", h.reject_reason
+        assert list(h.output_ids) == _merged_reference(
+            m, eng.params, loraA if a else None, p, 10)
+    assert router.registry.get_counter("fleet/weight_swaps") == 2
+    for rep in reps:
+        assert rep.scheduler.weights_version == "v2"
+        assert rep.is_accepting()
+        assert rep.summary()["weights_version"] == "v2"
+    assert 'weights_version="v2"' in router.render_metrics()
+    swaps = [e for e in rec.events(corr="swap-v2")
+             if e["kind"] == "route/weights_swap"]
+    assert len(swaps) == 2
+    assert {e["replica"] for e in swaps} == {0, 1}
+    # post-roll requests serve on the new version
+    h2 = router.submit(prompts[0], SamplingParams(max_new_tokens=3))
+    router.run_until_idle()
+    assert h2.state == "finished"
+    dbg = router.debug_fleet()
+    assert dbg["weight_swaps"] == 2
+    assert set(dbg["weights_versions"].values()) == {"v2"}
+
+
+def test_install_params_validates_structure(served):
+    """install_params is double-buffered behind a structure check: a
+    tree that would recompile (or silently misload) is refused."""
+    m, eng = served
+    s = ContinuousBatchingScheduler(
+        m, eng.params, ServingConfig(block_size=8, num_blocks=32))
+    assert s.weights_version == "v1"
+    new = jax.tree_util.tree_map(lambda x: x, eng.params)
+    s.install_params(new, "v2")
+    assert s.weights_version == "v2"
+    assert s.metrics.counters["weights_swaps"] == 1
+    with pytest.raises(ValueError):
+        s.install_params({"not": "a matching tree"}, "v3")
+    assert s.weights_version == "v2"
+
+
+# ---------------------------------------------------------- router digest
+def test_router_prefers_adapter_resident_replica(served):
+    """Routing digest awareness: with loads tied, the replica already
+    holding the tenant's adapter in a hotter tier wins the dispatch."""
+    from deepspeed_tpu.serving.fleet.replica import Replica
+    from deepspeed_tpu.serving.fleet.router import Router
+    m, eng = served
+    cfg = ServingConfig(block_size=8, num_blocks=32, max_num_seqs=2,
+                        adapters={"enabled": True},
+                        fleet={"num_replicas": 2, "digest_refresh_s": 0})
+    reps = [Replica(i, m, eng.params, cfg) for i in range(2)]
+    router = Router(reps, cfg.fleet)
+    loraA = _mk_lora(eng.params, 91)
+    for rep in reps:
+        rep.scheduler.register_adapter("A", lora_tree=loraA)
+    p = _mixed_prompts(1, seed=18)[0]
+    # replica 1 serves tenant A once: its adapter is HBM-resident there
+    r = reps[1].scheduler.submit(p, SamplingParams(max_new_tokens=2),
+                                 adapter_id="A")
+    reps[1].scheduler.run_until_idle()
+    assert r.state == RequestState.FINISHED
+    assert reps[1].adapter_residency()["A"] == "hbm"
+    assert reps[0].adapter_residency()["A"] == "host"
+    h = router.submit(p, SamplingParams(max_new_tokens=2),
+                      adapter_id="A")
+    assert h.replica_id == 1
+    router.run_until_idle()
+    assert h.state == "finished"
